@@ -1,0 +1,33 @@
+"""kubeflow_tpu — a TPU-native ML platform.
+
+A ground-up rebuild of the capabilities of the Kubeflow platform repo
+(reference: /root/reference) re-designed TPU-first:
+
+- the device compute path is JAX/XLA (pjit/GSPMD over a `jax.sharding.Mesh`,
+  pallas kernels for hot ops) instead of TF-on-GPU,
+- the distributed runtime is XLA collectives over ICI/DCN instead of the
+  parameter-server / OpenMPI-NCCL stack the reference gang-schedules,
+- the control plane (job gang controller, notebooks, profiles, HP search,
+  serving, deployment engine) is re-implemented against a k8s-shaped
+  in-memory state store so it is testable without a cluster and renders
+  to real manifests when one exists.
+
+Layer map (mirrors SURVEY.md §1, inverted to TPU-first):
+
+    training/   train-step engine: pjit sharding, checkpoint/resume
+    models/     benchmark vehicles (ResNet-50, BERT) — flax modules
+    parallel/   mesh/topology layer, collectives, ring attention, pipeline, MoE
+    ops/        attention + pallas kernels
+    cluster/    k8s-shaped object model, state store, controller runtime
+    controllers/ TPUJob (TFJob-equiv), Notebook, Profile, StudyJob, ...
+    api/        KFAM-equivalent, spawner backend, dashboard BFF
+    serving/    JAX model server (test_tf_serving.py shape)
+    deploy/     kfctl-equivalent two-phase apply engine
+    config/     typed config tree (KfDef-equivalent)
+    utils/      structured logging, metrics registry, retry
+    native/     C++ components (slice agent, state store core)
+"""
+
+from kubeflow_tpu.version import __version__
+
+__all__ = ["__version__"]

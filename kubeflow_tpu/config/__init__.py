@@ -1,0 +1,35 @@
+from kubeflow_tpu.config.core import (
+    ConfigError,
+    config_field,
+    ConfigNode,
+    from_dict,
+    to_dict,
+    load_yaml,
+    dump_yaml,
+    apply_env_overrides,
+)
+from kubeflow_tpu.config.platform import (
+    PlatformDef,
+    MeshConfig,
+    TrainingConfig,
+    SliceConfig,
+    NotebookDefaults,
+    load_platformdef,
+)
+
+__all__ = [
+    "ConfigError",
+    "config_field",
+    "ConfigNode",
+    "from_dict",
+    "to_dict",
+    "load_yaml",
+    "dump_yaml",
+    "apply_env_overrides",
+    "PlatformDef",
+    "MeshConfig",
+    "TrainingConfig",
+    "SliceConfig",
+    "NotebookDefaults",
+    "load_platformdef",
+]

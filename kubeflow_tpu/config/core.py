@@ -1,0 +1,217 @@
+"""Typed config tree.
+
+The reference layers four config mechanisms (SURVEY.md §5): the KfDef CR
+fetched as YAML (reference: bootstrap/cmd/bootstrap/app/kfctlServer.go:111-134,
+components/gcp-click-to-deploy/src/DeployForm.tsx:23-25), per-binary Go flags,
+env-var controller knobs, and admin YAML for UI behavior (reference:
+components/jupyter-web-app/backend/kubeflow_jupyter/common/utils.py:88-117).
+
+Here roles 1+4 collapse into one typed, validated dataclass tree with YAML
+load/dump, dotted-path env overrides (role 3), and strict unknown-key
+rejection so config drift fails loudly instead of silently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Mapping, Optional, Type, TypeVar, Union, get_args, get_origin
+
+import yaml
+
+T = TypeVar("T")
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def config_field(default=dataclasses.MISSING, default_factory=dataclasses.MISSING, help: str = ""):
+    kwargs: Dict[str, Any] = {"metadata": {"help": help}}
+    if default is not dataclasses.MISSING:
+        kwargs["default"] = default
+    if default_factory is not dataclasses.MISSING:
+        kwargs["default_factory"] = default_factory
+    return dataclasses.field(**kwargs)
+
+
+class ConfigNode:
+    """Marker base class; subclasses must be @dataclasses.dataclass."""
+
+    def validate(self) -> None:
+        """Override to add invariants; called after construction by from_dict."""
+
+    def replace(self: T, **changes: Any) -> T:
+        new = dataclasses.replace(self, **changes)  # type: ignore[type-var]
+        if isinstance(new, ConfigNode):
+            new.validate()
+        return new
+
+
+def _convert(value: Any, typ: Any, path: str) -> Any:
+    origin = get_origin(typ)
+    if typ is Any:
+        return value
+    if origin is Union:
+        args = [a for a in get_args(typ) if a is not type(None)]
+        if value is None:
+            if type(None) in get_args(typ):
+                return None
+            raise ConfigError(f"{path}: null not allowed")
+        if len(args) == 1:
+            return _convert(value, args[0], path)
+        for a in args:
+            try:
+                return _convert(value, a, path)
+            except (ConfigError, TypeError, ValueError):
+                continue
+        raise ConfigError(f"{path}: {value!r} matches none of {args}")
+    if origin in (list, List):
+        if not isinstance(value, (list, tuple)):
+            raise ConfigError(f"{path}: expected list, got {type(value).__name__}")
+        (item_t,) = get_args(typ) or (Any,)
+        return [_convert(v, item_t, f"{path}[{i}]") for i, v in enumerate(value)]
+    if origin in (dict, Dict):
+        if not isinstance(value, Mapping):
+            raise ConfigError(f"{path}: expected mapping, got {type(value).__name__}")
+        args = get_args(typ) or (Any, Any)
+        return {
+            _convert(k, args[0], f"{path}.{k}"): _convert(v, args[1], f"{path}.{k}")
+            for k, v in value.items()
+        }
+    if origin is tuple:
+        if not isinstance(value, (list, tuple)):
+            raise ConfigError(f"{path}: expected sequence, got {type(value).__name__}")
+        args = get_args(typ)
+        if len(args) == 2 and args[1] is Ellipsis:
+            return tuple(_convert(v, args[0], f"{path}[{i}]") for i, v in enumerate(value))
+        if args and len(args) != len(value):
+            raise ConfigError(f"{path}: expected {len(args)} items, got {len(value)}")
+        return tuple(
+            _convert(v, a, f"{path}[{i}]") for i, (v, a) in enumerate(zip(value, args))
+        )
+    if isinstance(typ, type) and issubclass(typ, ConfigNode):
+        if not isinstance(value, Mapping):
+            raise ConfigError(f"{path}: expected mapping for {typ.__name__}")
+        return from_dict(typ, value, path=path)
+    if typ is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str):
+            low = value.strip().lower()
+            if low in ("true", "1", "yes", "on"):
+                return True
+            if low in ("false", "0", "no", "off"):
+                return False
+        raise ConfigError(f"{path}: expected bool, got {value!r}")
+    if typ is int:
+        if isinstance(value, bool) or not isinstance(value, (int, str)):
+            raise ConfigError(f"{path}: expected int, got {value!r}")
+        try:
+            return int(value)
+        except ValueError as e:
+            raise ConfigError(f"{path}: {e}")
+    if typ is float:
+        if isinstance(value, bool) or not isinstance(value, (int, float, str)):
+            raise ConfigError(f"{path}: expected float, got {value!r}")
+        try:
+            return float(value)
+        except ValueError as e:
+            raise ConfigError(f"{path}: {e}")
+    if typ is str:
+        if not isinstance(value, str):
+            raise ConfigError(f"{path}: expected str, got {type(value).__name__}")
+        return value
+    return value
+
+
+def from_dict(cls: Type[T], data: Mapping[str, Any], path: str = "") -> T:
+    """Build a ConfigNode dataclass from a mapping, rejecting unknown keys."""
+    if not dataclasses.is_dataclass(cls):
+        raise ConfigError(f"{cls} is not a dataclass")
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ConfigError(
+            f"{path or cls.__name__}: unknown keys {sorted(unknown)}; "
+            f"valid keys: {sorted(fields)}"
+        )
+    kwargs: Dict[str, Any] = {}
+    for name, f in fields.items():
+        fpath = f"{path}.{name}" if path else name
+        if name in data:
+            kwargs[name] = _convert(data[name], f.type if not isinstance(f.type, str) else _resolve_type(cls, f), fpath)
+        elif (
+            f.default is dataclasses.MISSING
+            and f.default_factory is dataclasses.MISSING  # type: ignore[misc]
+        ):
+            raise ConfigError(f"{fpath}: required key missing")
+    obj = cls(**kwargs)
+    if isinstance(obj, ConfigNode):
+        obj.validate()
+    return obj
+
+
+def _resolve_type(cls: type, f: dataclasses.Field) -> Any:
+    import typing
+    import sys
+
+    hints = typing.get_type_hints(cls, vars(sys.modules[cls.__module__]))
+    return hints[f.name]
+
+
+def to_dict(node: Any) -> Any:
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        return {f.name: to_dict(getattr(node, f.name)) for f in dataclasses.fields(node)}
+    if isinstance(node, (list, tuple)):
+        return [to_dict(v) for v in node]
+    if isinstance(node, dict):
+        return {k: to_dict(v) for k, v in node.items()}
+    return node
+
+
+def load_yaml(cls: Type[T], text_or_path: str) -> T:
+    """Load a config tree from YAML text or a file path."""
+    if "\n" not in text_or_path and os.path.exists(text_or_path):
+        with open(text_or_path) as f:
+            data = yaml.safe_load(f)
+    else:
+        data = yaml.safe_load(text_or_path)
+    if data is None:
+        data = {}
+    if not isinstance(data, Mapping):
+        raise ConfigError(f"top-level YAML must be a mapping, got {type(data).__name__}")
+    return from_dict(cls, data)
+
+
+def dump_yaml(node: Any) -> str:
+    return yaml.safe_dump(to_dict(node), sort_keys=False)
+
+
+def apply_env_overrides(node: T, prefix: str, environ: Optional[Mapping[str, str]] = None) -> T:
+    """Apply env overrides like PREFIX_MESH__DATA=8 → node.mesh.data = 8.
+
+    Double underscore separates path segments (single underscores stay inside
+    a field name). This is the typed replacement for the reference's per-
+    controller env knobs (reference: components/notebook-controller/
+    controllers/notebook_controller.go:179 USE_ISTIO etc).
+    """
+    env = os.environ if environ is None else environ
+    data = to_dict(node)
+    pfx = prefix.rstrip("_") + "_"
+    for key, value in env.items():
+        if not key.startswith(pfx):
+            continue
+        segments = [s.lower() for s in key[len(pfx):].split("__") if s]
+        if not segments:
+            continue
+        cursor = data
+        for seg in segments[:-1]:
+            if not isinstance(cursor, dict) or seg not in cursor:
+                raise ConfigError(f"env override {key}: no such config path")
+            cursor = cursor[seg]
+        leaf = segments[-1]
+        if not isinstance(cursor, dict) or leaf not in cursor:
+            raise ConfigError(f"env override {key}: no such config path")
+        cursor[leaf] = yaml.safe_load(value)
+    return from_dict(type(node), data)

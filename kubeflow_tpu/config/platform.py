@@ -1,0 +1,269 @@
+"""PlatformDef — the KfDef-equivalent deployment/config API.
+
+The reference's KfDef CR (apps.kubeflow.org v1beta1) is the single config
+object driving deployment (reference: bootstrap/cmd/bootstrap/app/
+kfctlServer.go:105-309 consumes it; the click-to-deploy UI fetches it as
+versioned YAML, components/gcp-click-to-deploy/src/DeployForm.tsx:23-25).
+
+PlatformDef plays the same role for the TPU platform: one typed tree naming
+the slice topology, the parallelism mesh, training defaults, notebook spawner
+defaults, and the component roster to deploy. TPU-first differences:
+- device vocabulary is `google.com/tpu` + slice topology (v5e-16 etc.), not
+  `nvidia.com/gpu` counts (reference: tf-controller-examples/tf-cnn/
+  create_job_specs.py:165-170),
+- the parallelism menu is mesh axes (data/fsdp/tensor/pipeline/sequence/
+  expert) instead of MASTER/WORKER/PS replica counts (reference:
+  create_job_specs.py:125-191).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from kubeflow_tpu.config.core import (
+    ConfigError,
+    ConfigNode,
+    config_field,
+    load_yaml,
+)
+
+# Known TPU slice shapes: name -> (chips, chips_per_host, ici_link_bandwidth
+# relative class). Used for validation + topology selectors.
+TPU_TOPOLOGIES: Dict[str, Dict[str, int]] = {
+    "v4-8": {"chips": 4, "chips_per_host": 4},
+    "v4-16": {"chips": 8, "chips_per_host": 4},
+    "v4-32": {"chips": 16, "chips_per_host": 4},
+    "v5e-1": {"chips": 1, "chips_per_host": 1},
+    "v5e-4": {"chips": 4, "chips_per_host": 4},
+    "v5e-8": {"chips": 8, "chips_per_host": 8},
+    "v5e-16": {"chips": 16, "chips_per_host": 4},
+    "v5e-32": {"chips": 32, "chips_per_host": 4},
+    "v5e-64": {"chips": 64, "chips_per_host": 4},
+    "v5e-128": {"chips": 128, "chips_per_host": 4},
+    "v5e-256": {"chips": 256, "chips_per_host": 4},
+    "v5p-8": {"chips": 4, "chips_per_host": 4},
+    "v5p-16": {"chips": 8, "chips_per_host": 4},
+    "v5p-128": {"chips": 64, "chips_per_host": 4},
+}
+
+MESH_AXES = ("data", "fsdp", "tensor", "pipeline", "sequence", "expert")
+
+
+@dataclasses.dataclass
+class MeshConfig(ConfigNode):
+    """Logical parallelism mesh: axis name -> size.
+
+    Axis placement convention (ICI/DCN-aware, see parallel/mesh.py): the
+    outermost axes map to DCN (across slices), innermost to ICI. The product
+    of all axes must equal the total chip count of the gang.
+    """
+
+    data: int = config_field(default=1, help="data-parallel replicas")
+    fsdp: int = config_field(default=1, help="fully-sharded data-parallel axis")
+    tensor: int = config_field(default=1, help="tensor/model parallel axis")
+    pipeline: int = config_field(default=1, help="pipeline stages")
+    sequence: int = config_field(default=1, help="sequence/context parallel axis")
+    expert: int = config_field(default=1, help="expert (MoE) parallel axis")
+
+    def validate(self) -> None:
+        for axis in MESH_AXES:
+            v = getattr(self, axis)
+            if not isinstance(v, int) or v < 1:
+                raise ConfigError(f"mesh.{axis} must be a positive int, got {v!r}")
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for axis in MESH_AXES:
+            n *= getattr(self, axis)
+        return n
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in MESH_AXES}
+
+
+@dataclasses.dataclass
+class SliceConfig(ConfigNode):
+    """TPU slice request: the `google.com/tpu` + topology-selector vocabulary.
+
+    The TPU analog of the reference's GPU resource limits
+    (reference: create_job_specs.py:165-170 `nvidia.com/gpu: 1`).
+    """
+
+    topology: str = config_field(default="v5e-8", help="slice shape, e.g. v5e-16")
+    num_slices: int = config_field(default=1, help="multislice count (DCN-connected)")
+    reserved: bool = config_field(default=False, help="use reserved capacity")
+    spot: bool = config_field(default=False, help="allow preemptible capacity")
+
+    def validate(self) -> None:
+        if self.topology not in TPU_TOPOLOGIES:
+            raise ConfigError(
+                f"unknown TPU topology {self.topology!r}; known: "
+                f"{sorted(TPU_TOPOLOGIES)}"
+            )
+        if self.num_slices < 1:
+            raise ConfigError("num_slices must be >= 1")
+        if self.reserved and self.spot:
+            raise ConfigError("reserved and spot are mutually exclusive")
+
+    @property
+    def chips_per_slice(self) -> int:
+        return TPU_TOPOLOGIES[self.topology]["chips"]
+
+    @property
+    def chips_per_host(self) -> int:
+        return TPU_TOPOLOGIES[self.topology]["chips_per_host"]
+
+    @property
+    def hosts_per_slice(self) -> int:
+        return max(1, self.chips_per_slice // self.chips_per_host)
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips_per_slice * self.num_slices
+
+    @property
+    def total_hosts(self) -> int:
+        return self.hosts_per_slice * self.num_slices
+
+    def node_selectors(self) -> Dict[str, str]:
+        gen = self.topology.split("-")[0]
+        return {
+            "cloud.google.com/gke-tpu-accelerator": f"tpu-{gen}-slice",
+            "cloud.google.com/gke-tpu-topology": self.topology,
+        }
+
+    def resource_requests(self) -> Dict[str, str]:
+        return {"google.com/tpu": str(self.chips_per_host)}
+
+
+@dataclasses.dataclass
+class CheckpointConfig(ConfigNode):
+    enabled: bool = config_field(default=True)
+    directory: str = config_field(default="/tmp/kubeflow_tpu/checkpoints")
+    interval_steps: int = config_field(default=1000)
+    keep: int = config_field(default=3, help="checkpoints retained")
+    async_save: bool = config_field(default=True)
+
+    def validate(self) -> None:
+        if self.interval_steps < 1:
+            raise ConfigError("checkpoint.interval_steps must be >= 1")
+        if self.keep < 1:
+            raise ConfigError("checkpoint.keep must be >= 1")
+
+
+@dataclasses.dataclass
+class TrainingConfig(ConfigNode):
+    """Per-job training knobs (the benchmark-harness surface).
+
+    Mirrors the knob set of the reference's tf-cnn spec generator
+    (reference: create_job_specs.py:56-121 — model, batch size, num workers)
+    re-expressed mesh-first.
+    """
+
+    model: str = config_field(default="resnet50")
+    global_batch_size: int = config_field(default=256)
+    steps: int = config_field(default=100)
+    learning_rate: float = config_field(default=0.1)
+    weight_decay: float = config_field(default=1e-4)
+    warmup_steps: int = config_field(default=5)
+    dtype: str = config_field(default="bfloat16", help="compute dtype")
+    seed: int = config_field(default=0)
+    mesh: MeshConfig = config_field(default_factory=MeshConfig)
+    checkpoint: CheckpointConfig = config_field(default_factory=CheckpointConfig)
+    remat: bool = config_field(default=False, help="jax.checkpoint rematerialisation")
+
+    def validate(self) -> None:
+        if self.global_batch_size < 1:
+            raise ConfigError("global_batch_size must be >= 1")
+        if self.dtype not in ("float32", "bfloat16"):
+            raise ConfigError(f"dtype must be float32|bfloat16, got {self.dtype}")
+        dp = self.mesh.data * self.mesh.fsdp
+        if self.global_batch_size % dp != 0:
+            raise ConfigError(
+                f"global_batch_size {self.global_batch_size} not divisible by "
+                f"data*fsdp axes {dp}"
+            )
+
+
+@dataclasses.dataclass
+class NotebookDefaults(ConfigNode):
+    """Spawner-form defaults (the admin YAML role, reference: jupyter-web-app
+    backend spawner_ui_config utils.py:88-117) re-targeted at TPU-VM images."""
+
+    image: str = config_field(default="kubeflow-tpu/jax-notebook:latest")
+    images: List[str] = config_field(
+        default_factory=lambda: [
+            "kubeflow-tpu/jax-notebook:latest",
+            "kubeflow-tpu/jax-notebook:nightly",
+            "kubeflow-tpu/flax-notebook:latest",
+        ]
+    )
+    cpu: str = config_field(default="4")
+    memory: str = config_field(default="16Gi")
+    tpu_topology: str = config_field(default="", help="empty = no TPU attached")
+    workspace_size: str = config_field(default="10Gi")
+    enable_culling: bool = config_field(default=True)
+    idle_time_minutes: int = config_field(default=60)
+    culling_check_period_minutes: int = config_field(default=1)
+
+
+@dataclasses.dataclass
+class ComponentSpec(ConfigNode):
+    name: str = config_field()
+    enabled: bool = config_field(default=True)
+    params: Dict[str, str] = config_field(default_factory=dict)
+
+
+DEFAULT_COMPONENTS = [
+    "tpujob-controller",
+    "notebook-controller",
+    "profile-controller",
+    "tensorboard-controller",
+    "admission-webhook",
+    "access-management",
+    "studyjob-controller",
+    "serving",
+    "central-dashboard",
+    "jupyter-web-app",
+    "metrics-collector",
+]
+
+
+@dataclasses.dataclass
+class PlatformDef(ConfigNode):
+    """The whole-platform deployment config (KfDef-equivalent)."""
+
+    api_version: str = config_field(default="platform.kubeflow-tpu.dev/v1beta1")
+    kind: str = config_field(default="PlatformDef")
+    name: str = config_field(default="kubeflow-tpu")
+    project: str = config_field(default="", help="cloud project (empty = local)")
+    zone: str = config_field(default="")
+    use_istio: bool = config_field(default=True)
+    istio_gateway: str = config_field(default="kubeflow/kubeflow-gateway")
+    user_id_header: str = config_field(default="x-auth-user-email")
+    user_id_prefix: str = config_field(default="")
+    slice: SliceConfig = config_field(default_factory=SliceConfig)
+    training: TrainingConfig = config_field(default_factory=TrainingConfig)
+    notebooks: NotebookDefaults = config_field(default_factory=NotebookDefaults)
+    components: List[ComponentSpec] = config_field(
+        default_factory=lambda: [ComponentSpec(name=n) for n in DEFAULT_COMPONENTS]
+    )
+
+    def validate(self) -> None:
+        if self.kind != "PlatformDef":
+            raise ConfigError(f"kind must be PlatformDef, got {self.kind!r}")
+        names = [c.name for c in self.components]
+        if len(names) != len(set(names)):
+            raise ConfigError("duplicate component names")
+
+    def component(self, name: str) -> Optional[ComponentSpec]:
+        for c in self.components:
+            if c.name == name:
+                return c
+        return None
+
+
+def load_platformdef(text_or_path: str) -> PlatformDef:
+    return load_yaml(PlatformDef, text_or_path)

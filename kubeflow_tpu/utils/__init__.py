@@ -1,0 +1,21 @@
+from kubeflow_tpu.utils.logging import get_logger, configure_logging
+from kubeflow_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from kubeflow_tpu.utils.retry import retry, backoff_retry
+
+__all__ = [
+    "get_logger",
+    "configure_logging",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "retry",
+    "backoff_retry",
+]

@@ -1,0 +1,79 @@
+"""Structured logging.
+
+Equivalent role to the reference's logrus-with-filename-hook + optional JSON
+output for Stackdriver (reference: bootstrap/cmd/bootstrap/main.go:25-41) and
+the shared Python format string used across its test harness
+(reference: testing/test_tf_serving.py:149-155). One configuration point, two
+renderers (human text / JSON lines), caller location always attached.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any
+
+_TEXT_FORMAT = (
+    "%(levelname)s|%(asctime)s|%(pathname)s|%(lineno)d| %(message)s"
+)
+_DATE_FORMAT = "%Y-%m-%dT%H:%M:%S"
+
+_configured = False
+
+
+class JsonFormatter(logging.Formatter):
+    """Render each record as one JSON object per line (Stackdriver-style)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        payload: dict[str, Any] = {
+            "severity": record.levelname,
+            "time": time.strftime(_DATE_FORMAT, time.gmtime(record.created)),
+            "filename": record.pathname,
+            "line": record.lineno,
+            "message": record.getMessage(),
+        }
+        if record.exc_info and record.exc_info[0] is not None:
+            payload["exception"] = self.formatException(record.exc_info)
+        extra = getattr(record, "fields", None)
+        if isinstance(extra, dict):
+            payload.update(extra)
+        return json.dumps(payload)
+
+
+def configure_logging(json_output: bool = False, level: int = logging.INFO) -> None:
+    """Install the root handler. Idempotent re-configuration is allowed."""
+    global _configured
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler = logging.StreamHandler(sys.stderr)
+    if json_output:
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(_TEXT_FORMAT, _DATE_FORMAT))
+    root.addHandler(handler)
+    root.setLevel(level)
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    if not _configured:
+        configure_logging()
+    return logging.getLogger(name)
+
+
+class FieldsAdapter(logging.LoggerAdapter):
+    """Attach structured key/value fields to every record (logrus.WithFields)."""
+
+    def process(self, msg, kwargs):
+        extra = kwargs.setdefault("extra", {})
+        fields = dict(self.extra or {})
+        fields.update(extra.pop("fields", {}))
+        extra["fields"] = fields
+        return msg, kwargs
+
+
+def with_fields(logger: logging.Logger, **fields: Any) -> logging.LoggerAdapter:
+    return FieldsAdapter(logger, fields)

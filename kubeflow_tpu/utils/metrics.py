@@ -1,0 +1,285 @@
+"""Prometheus-style metrics registry.
+
+The reference instruments every server with prometheus counters/gauges/
+histograms: deployment-latency histograms and request/failure counters in
+bootstrap (reference: bootstrap/cmd/bootstrap/app/server.go:68-132), KFAM
+request counters + 10s heartbeat (reference:
+components/access-management/kfam/monitoring.go:25-76), and notebook lifecycle
+gauges (reference: components/notebook-controller/pkg/metrics/metrics.go:22-60).
+
+This module provides the same three metric kinds with labels, a registry, and
+a text renderer in the Prometheus exposition format so any HTTP handler can
+serve `/metrics`. Thread-safe; no external dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+LabelValues = Tuple[str, ...]
+
+
+def _validate_labels(
+    names: Sequence[str], labels: Dict[str, str]
+) -> LabelValues:
+    if set(labels) != set(names):
+        raise ValueError(
+            f"label mismatch: expected {sorted(names)}, got {sorted(labels)}"
+        )
+    return tuple(labels[n] for n in names)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def _render_series(self) -> Iterable[str]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        lines.extend(self._render_series())
+        return "\n".join(lines)
+
+    def _fmt_labels(self, values: LabelValues, extra: str = "") -> str:
+        parts = [f'{n}="{v}"' for n, v in zip(self.label_names, values)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()):
+        super().__init__(name, help, label_names)
+        self._values: Dict[LabelValues, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = _validate_labels(self.label_names, labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = _validate_labels(self.label_names, labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def _render_series(self) -> Iterable[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for values, v in items:
+            yield f"{self.name}{self._fmt_labels(values)} {v:g}"
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()):
+        super().__init__(name, help, label_names)
+        self._values: Dict[LabelValues, float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        key = _validate_labels(self.label_names, labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = _validate_labels(self.label_names, labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def set_to_current_time(self, **labels: str) -> None:
+        self.set(time.time(), **labels)
+
+    def value(self, **labels: str) -> float:
+        key = _validate_labels(self.label_names, labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def _render_series(self) -> Iterable[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for values, v in items:
+            yield f"{self.name}{self._fmt_labels(values)} {v:g}"
+
+
+# Default buckets follow the reference's deployment-latency envelopes
+# (reference: bootstrap/cmd/bootstrap/app/server.go:109-118 — GKE cluster
+# 30-450s, full platform 150-720s) generalised to a log-ish spread.
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60,
+    120, 300, 600,
+)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[LabelValues, list] = {}
+        self._sums: Dict[LabelValues, float] = {}
+        self._totals: Dict[LabelValues, int] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = _validate_labels(self.label_names, labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def time(self, **labels: str) -> "_Timer":
+        return _Timer(self, labels)
+
+    def count(self, **labels: str) -> int:
+        key = _validate_labels(self.label_names, labels)
+        with self._lock:
+            return self._totals.get(key, 0)
+
+    def sum(self, **labels: str) -> float:
+        key = _validate_labels(self.label_names, labels)
+        with self._lock:
+            return self._sums.get(key, 0.0)
+
+    def _render_series(self) -> Iterable[str]:
+        with self._lock:
+            keys = sorted(self._counts)
+            snapshot = [
+                (k, list(self._counts[k]), self._sums[k], self._totals[k])
+                for k in keys
+            ]
+        for key, counts, s, total in snapshot:
+            for b, c in zip(self.buckets, counts):
+                extra = f'le="{b:g}"'
+                yield f"{self.name}_bucket{self._fmt_labels(key, extra)} {c}"
+            inf_label = 'le="+Inf"'
+            yield f"{self.name}_bucket{self._fmt_labels(key, inf_label)} {total}"
+            yield f"{self.name}_sum{self._fmt_labels(key)} {s:g}"
+            yield f"{self.name}_count{self._fmt_labels(key)} {total}"
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: Dict[str, str]):
+        self._hist = hist
+        self._labels = labels
+
+    def __enter__(self):
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.monotonic() - self._start, **self._labels)
+        return False
+
+
+class MetricsRegistry:
+    """A named collection of metrics with a text exposition renderer."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(
+        self, name: str, help: str = "", label_names: Sequence[str] = ()
+    ) -> Counter:
+        return self._get_or_create(Counter, name, help, label_names)
+
+    def gauge(
+        self, name: str, help: str = "", label_names: Sequence[str] = ()
+    ) -> Gauge:
+        return self._get_or_create(Gauge, name, help, label_names)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, Histogram):
+                    raise ValueError(f"{name} already registered as {existing.kind}")
+                return existing
+            m = Histogram(name, help, label_names, buckets)
+            self._metrics[name] = m
+            return m
+
+    def _get_or_create(self, cls, name, help, label_names):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(f"{name} already registered as {existing.kind}")
+                return existing
+            m = cls(name, help, label_names)
+            self._metrics[name] = m
+            return m
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        return "\n".join(m.render() for m in metrics) + ("\n" if metrics else "")
+
+
+_default_registry = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _default_registry
+
+
+def start_heartbeat(
+    gauge: Gauge, period_s: float = 10.0, stop_event: Optional[threading.Event] = None
+) -> threading.Thread:
+    """Background heartbeat thread: set the gauge to now every `period_s`.
+
+    Mirrors the 10s heartbeat pattern the reference puts in every server
+    (reference: components/access-management/kfam/monitoring.go:60-76).
+    """
+    stop = stop_event or threading.Event()
+
+    def run():
+        while not stop.is_set():
+            gauge.set_to_current_time()
+            stop.wait(period_s)
+
+    t = threading.Thread(target=run, daemon=True, name=f"heartbeat-{gauge.name}")
+    t._stop_event = stop  # type: ignore[attr-defined]
+    t.start()
+    return t

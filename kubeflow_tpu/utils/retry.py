@@ -1,0 +1,96 @@
+"""Retry / backoff helpers.
+
+The reference leans on constant-backoff retries around every flaky boundary:
+3x around the k8s apply (reference: bootstrap/cmd/bootstrap/app/
+kfctlServer.go:291-296), 5x around namespace creation (reference:
+components/profile-controller/controllers/profile_controller.go:150-154),
+`@retry` decorators in tests (reference: testing/katib_studyjob_test.py:75,115)
+and a generic `run_with_retry.py`. This module is the one shared primitive.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def backoff_retry(
+    fn: Callable[[], T],
+    attempts: int = 3,
+    delay_s: float = 1.0,
+    multiplier: float = 1.0,
+    max_delay_s: float = 60.0,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Call `fn` up to `attempts` times with (constant or exponential) backoff.
+
+    multiplier=1.0 gives the reference's constant-backoff behavior.
+    """
+    if attempts < 1:
+        raise ValueError("attempts must be >= 1")
+    current = delay_s
+    last: BaseException
+    for i in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            last = e
+            if i == attempts - 1:
+                break
+            if on_retry is not None:
+                on_retry(i + 1, e)
+            sleep(min(current, max_delay_s))
+            current *= multiplier
+    raise last
+
+
+def retry(
+    attempts: int = 3,
+    delay_s: float = 1.0,
+    multiplier: float = 1.0,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+):
+    """Decorator form of `backoff_retry`."""
+
+    def deco(fn: Callable[..., T]) -> Callable[..., T]:
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs) -> T:
+            return backoff_retry(
+                lambda: fn(*args, **kwargs),
+                attempts=attempts,
+                delay_s=delay_s,
+                multiplier=multiplier,
+                retry_on=retry_on,
+            )
+
+        return wrapped
+
+    return deco
+
+
+def wait_for(
+    predicate: Callable[[], bool],
+    timeout_s: float = 60.0,
+    poll_s: float = 0.05,
+    desc: str = "condition",
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+) -> None:
+    """Poll until `predicate()` is true or `timeout_s` elapses.
+
+    The control-plane analog of the reference's `wait_for_condition`
+    (reference: testing/katib_studyjob_test.py:128-193) used by every e2e
+    assertion.
+    """
+    deadline = clock() + timeout_s
+    while True:
+        if predicate():
+            return
+        if clock() >= deadline:
+            raise TimeoutError(f"timed out after {timeout_s}s waiting for {desc}")
+        sleep(poll_s)

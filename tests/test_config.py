@@ -1,0 +1,158 @@
+"""Tests for the typed config tree and PlatformDef (KfDef-equivalent)."""
+
+import dataclasses
+
+import pytest
+
+from kubeflow_tpu.config import (
+    ConfigError,
+    ConfigNode,
+    MeshConfig,
+    PlatformDef,
+    SliceConfig,
+    TrainingConfig,
+    apply_env_overrides,
+    config_field,
+    dump_yaml,
+    from_dict,
+    load_platformdef,
+    load_yaml,
+    to_dict,
+)
+
+
+@dataclasses.dataclass
+class Inner(ConfigNode):
+    x: int = config_field(default=1)
+    name: str = config_field(default="a")
+
+
+@dataclasses.dataclass
+class Outer(ConfigNode):
+    inner: Inner = config_field(default_factory=Inner)
+    items: list = config_field(default_factory=list)
+    flag: bool = config_field(default=False)
+
+
+class TestCore:
+    def test_from_dict_nested(self):
+        o = from_dict(Outer, {"inner": {"x": 5}, "flag": "true"})
+        assert o.inner.x == 5
+        assert o.flag is True
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ConfigError, match="unknown keys"):
+            from_dict(Outer, {"nope": 1})
+
+    def test_type_coercion_errors(self):
+        with pytest.raises(ConfigError):
+            from_dict(Inner, {"x": "notanint"})
+
+    def test_roundtrip(self):
+        o = Outer(inner=Inner(x=9, name="z"), items=[1, 2], flag=True)
+        assert from_dict(Outer, to_dict(o)) == o
+
+    def test_yaml_roundtrip(self):
+        o = Outer(inner=Inner(x=3))
+        assert load_yaml(Outer, dump_yaml(o)) == o
+
+    def test_env_overrides(self):
+        o = Outer()
+        o2 = apply_env_overrides(
+            o, "KFT", {"KFT_INNER__X": "42", "KFT_FLAG": "true", "OTHER": "1"}
+        )
+        assert o2.inner.x == 42
+        assert o2.flag is True
+
+    def test_env_override_bad_path(self):
+        with pytest.raises(ConfigError, match="no such config path"):
+            apply_env_overrides(Outer(), "KFT", {"KFT_MISSING": "1"})
+
+
+class TestMeshConfig:
+    def test_defaults_single_device(self):
+        assert MeshConfig().num_devices == 1
+
+    def test_product(self):
+        mc = MeshConfig(data=2, tensor=4, pipeline=2)
+        assert mc.num_devices == 16
+
+    def test_invalid_axis(self):
+        with pytest.raises(ConfigError):
+            from_dict(MeshConfig, {"data": 0})
+
+
+class TestSliceConfig:
+    def test_v5e16_shape(self):
+        s = SliceConfig(topology="v5e-16")
+        assert s.chips_per_slice == 16
+        assert s.hosts_per_slice == 4
+        assert s.total_chips == 16
+
+    def test_multislice(self):
+        s = SliceConfig(topology="v5e-16", num_slices=2)
+        assert s.total_chips == 32
+        assert s.total_hosts == 8
+
+    def test_unknown_topology(self):
+        with pytest.raises(ConfigError, match="unknown TPU topology"):
+            from_dict(SliceConfig, {"topology": "h100-8"})
+
+    def test_selectors_and_requests(self):
+        s = SliceConfig(topology="v5e-16")
+        sel = s.node_selectors()
+        assert sel["cloud.google.com/gke-tpu-topology"] == "v5e-16"
+        assert s.resource_requests() == {"google.com/tpu": "4"}
+
+    def test_reserved_spot_exclusive(self):
+        with pytest.raises(ConfigError):
+            from_dict(SliceConfig, {"reserved": True, "spot": True})
+
+
+class TestTrainingConfig:
+    def test_batch_divisibility(self):
+        with pytest.raises(ConfigError, match="not divisible"):
+            from_dict(
+                TrainingConfig,
+                {"global_batch_size": 10, "mesh": {"data": 4}},
+            )
+
+    def test_valid(self):
+        t = from_dict(
+            TrainingConfig,
+            {"global_batch_size": 256, "mesh": {"data": 4, "tensor": 2}},
+        )
+        assert t.mesh.num_devices == 8
+
+
+class TestPlatformDef:
+    def test_defaults_valid(self):
+        p = PlatformDef()
+        p.validate()
+        assert p.component("tpujob-controller") is not None
+
+    def test_load_yaml(self):
+        text = """
+name: my-platform
+slice:
+  topology: v5e-16
+training:
+  model: resnet50
+  global_batch_size: 512
+  mesh:
+    data: 16
+"""
+        p = load_platformdef(text)
+        assert p.slice.total_chips == 16
+        assert p.training.mesh.data == 16
+
+    def test_duplicate_components(self):
+        with pytest.raises(ConfigError, match="duplicate"):
+            from_dict(
+                PlatformDef,
+                {"components": [{"name": "a"}, {"name": "a"}]},
+            )
+
+    def test_dump_load_roundtrip(self):
+        p = PlatformDef()
+        assert load_platformdef(dump_yaml(p)) == p

@@ -1,0 +1,183 @@
+"""Unit tests for utils: metrics registry, retry, logging.
+
+Shape mirrors the reference's T1/T2 unit tiers (SURVEY.md §4): pure in-process,
+no cluster.
+"""
+
+import json
+import logging
+
+import pytest
+
+from kubeflow_tpu.utils import metrics as m
+import types
+
+from kubeflow_tpu.utils.retry import backoff_retry, retry, wait_for
+
+r = types.SimpleNamespace(backoff_retry=backoff_retry, retry=retry, wait_for=wait_for)
+from kubeflow_tpu.utils.logging import JsonFormatter
+
+
+class TestCounter:
+    def test_inc_and_value(self):
+        c = m.Counter("requests_total", "requests")
+        c.inc()
+        c.inc(2)
+        assert c.value() == 3
+
+    def test_labels(self):
+        c = m.Counter("req", "r", ["method", "code"])
+        c.inc(method="GET", code="200")
+        c.inc(method="GET", code="500")
+        c.inc(method="GET", code="200")
+        assert c.value(method="GET", code="200") == 2
+        assert c.value(method="GET", code="500") == 1
+
+    def test_label_mismatch_raises(self):
+        c = m.Counter("req", "r", ["method"])
+        with pytest.raises(ValueError):
+            c.inc(code="200")
+
+    def test_negative_raises(self):
+        c = m.Counter("x", "")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_render(self):
+        c = m.Counter("req", "requests", ["code"])
+        c.inc(code="200")
+        out = c.render()
+        assert "# TYPE req counter" in out
+        assert 'req{code="200"} 1' in out
+
+
+class TestGauge:
+    def test_set_inc_dec(self):
+        g = m.Gauge("temp", "")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert g.value() == 4
+
+    def test_render_unlabeled_default(self):
+        g = m.Gauge("up", "is up")
+        assert "up 0" in g.render()
+
+
+class TestHistogram:
+    def test_observe_and_buckets(self):
+        h = m.Histogram("lat", "latency", buckets=[0.1, 1, 10])
+        h.observe(0.05)
+        h.observe(5)
+        assert h.count() == 2
+        assert h.sum() == pytest.approx(5.05)
+        out = h.render()
+        assert 'lat_bucket{le="0.1"} 1' in out
+        assert 'lat_bucket{le="10"} 2' in out
+        assert 'lat_bucket{le="+Inf"} 2' in out
+        assert "lat_count 2" in out
+
+    def test_timer(self):
+        h = m.Histogram("dur", "", buckets=[100])
+        with h.time():
+            pass
+        assert h.count() == 1
+
+    def test_labeled(self):
+        h = m.Histogram("lat", "", ["op"], buckets=[1])
+        h.observe(0.5, op="apply")
+        assert h.count(op="apply") == 1
+
+
+class TestRegistry:
+    def test_get_or_create_idempotent(self):
+        reg = m.MetricsRegistry()
+        c1 = reg.counter("a_total", "help")
+        c2 = reg.counter("a_total")
+        assert c1 is c2
+
+    def test_kind_conflict(self):
+        reg = m.MetricsRegistry()
+        reg.counter("x", "")
+        with pytest.raises(ValueError):
+            reg.gauge("x", "")
+
+    def test_render_sorted(self):
+        reg = m.MetricsRegistry()
+        reg.counter("b_total", "b").inc()
+        reg.gauge("a_gauge", "a").set(1)
+        out = reg.render()
+        assert out.index("a_gauge") < out.index("b_total")
+        assert out.endswith("\n")
+
+
+class TestRetry:
+    def test_succeeds_after_failures(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("flaky")
+            return "ok"
+
+        assert r.backoff_retry(fn, attempts=3, sleep=lambda s: None) == "ok"
+        assert len(calls) == 3
+
+    def test_exhausted_raises_last(self):
+        def fn():
+            raise KeyError("boom")
+
+        with pytest.raises(KeyError):
+            r.backoff_retry(fn, attempts=2, sleep=lambda s: None)
+
+    def test_only_retries_listed_exceptions(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise TypeError("not retryable")
+
+        with pytest.raises(TypeError):
+            r.backoff_retry(
+                fn, attempts=5, retry_on=(ValueError,), sleep=lambda s: None
+            )
+        assert len(calls) == 1
+
+    def test_decorator(self):
+        state = {"n": 0}
+
+        @r.retry(attempts=2, delay_s=0)
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 2:
+                raise ValueError
+            return state["n"]
+
+        assert flaky() == 2
+
+    def test_wait_for_timeout(self):
+        with pytest.raises(TimeoutError):
+            r.wait_for(lambda: False, timeout_s=0.05, poll_s=0.01)
+
+    def test_wait_for_success(self):
+        state = {"n": 0}
+
+        def pred():
+            state["n"] += 1
+            return state["n"] >= 3
+
+        r.wait_for(pred, timeout_s=5, poll_s=0.001)
+
+
+class TestJsonLogging:
+    def test_json_formatter_fields(self):
+        rec = logging.LogRecord(
+            "test", logging.INFO, "/x.py", 12, "hello %s", ("world",), None
+        )
+        rec.fields = {"job": "j1"}
+        out = json.loads(JsonFormatter().format(rec))
+        assert out["message"] == "hello world"
+        assert out["severity"] == "INFO"
+        assert out["line"] == 12
+        assert out["job"] == "j1"
