@@ -1,0 +1,31 @@
+from kubeflow_tpu.parallel.mesh import (
+    MESH_AXIS_ORDER,
+    MeshSpec,
+    build_mesh,
+    mesh_from_config,
+)
+from kubeflow_tpu.parallel.sharding import (
+    LOGICAL_RULES,
+    logical_to_spec,
+    named_sharding,
+    shard_constraint,
+)
+from kubeflow_tpu.parallel.distributed import (
+    GangEnv,
+    initialize_from_env,
+    render_gang_env,
+)
+
+__all__ = [
+    "MESH_AXIS_ORDER",
+    "MeshSpec",
+    "build_mesh",
+    "mesh_from_config",
+    "LOGICAL_RULES",
+    "logical_to_spec",
+    "named_sharding",
+    "shard_constraint",
+    "GangEnv",
+    "initialize_from_env",
+    "render_gang_env",
+]

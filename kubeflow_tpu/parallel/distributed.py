@@ -1,0 +1,137 @@
+"""Distributed gang wiring — the TF_CONFIG equivalent.
+
+The reference renders a TF_CONFIG JSON (cluster host lists + task type/index)
+into every pod and a launcher converts it to per-task flags (reference:
+tf-controller-examples/tf-cnn/launcher.py:59-88, create_job_specs.py:171-183).
+
+The TPU-native contract is smaller: every process needs
+  (coordinator_address, num_processes, process_id)
+for `jax.distributed.initialize`, plus slice metadata (slice id, hosts per
+slice) so the mesh layer can place DCN axes. This module renders that env for
+the gang controller (controllers/tpujob.py) and consumes it in-pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+ENV_COORDINATOR = "KFT_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "KFT_NUM_PROCESSES"
+ENV_PROCESS_ID = "KFT_PROCESS_ID"
+ENV_SLICE_ID = "KFT_SLICE_ID"
+ENV_NUM_SLICES = "KFT_NUM_SLICES"
+ENV_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_JOB_NAME = "KFT_JOB_NAME"
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclasses.dataclass(frozen=True)
+class GangEnv:
+    """Per-process view of the gang (parsed from env)."""
+
+    job_name: str
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    slice_id: int = 0
+    num_slices: int = 1
+
+    @classmethod
+    def from_env(cls, environ: Optional[Dict[str, str]] = None) -> "GangEnv":
+        env = os.environ if environ is None else environ
+        return cls(
+            job_name=env.get(ENV_JOB_NAME, "local"),
+            coordinator_address=env.get(ENV_COORDINATOR, ""),
+            num_processes=int(env.get(ENV_NUM_PROCESSES, "1")),
+            process_id=int(env.get(ENV_PROCESS_ID, "0")),
+            slice_id=int(env.get(ENV_SLICE_ID, "0")),
+            num_slices=int(env.get(ENV_NUM_SLICES, "1")),
+        )
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    @property
+    def single_process(self) -> bool:
+        return self.num_processes <= 1
+
+
+def render_gang_env(
+    job_name: str,
+    hostnames: List[str],
+    num_slices: int = 1,
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT,
+) -> List[Dict[str, str]]:
+    """Render the env block for each pod of a gang.
+
+    `hostnames[i]` is the stable DNS name of process i (headless-service pod
+    DNS in k8s). Process 0 is the coordinator. Slices are contiguous,
+    hosts_per_slice = len(hostnames) / num_slices — matching how GKE
+    multislice numbers workers.
+    """
+    n = len(hostnames)
+    if n < 1:
+        raise ValueError("gang needs at least one host")
+    if n % num_slices:
+        raise ValueError(f"{n} hosts not divisible into {num_slices} slices")
+    hosts_per_slice = n // num_slices
+    coord = f"{hostnames[0]}:{coordinator_port}"
+    envs = []
+    for i, _host in enumerate(hostnames):
+        envs.append(
+            {
+                ENV_JOB_NAME: job_name,
+                ENV_COORDINATOR: coord,
+                ENV_NUM_PROCESSES: str(n),
+                ENV_PROCESS_ID: str(i),
+                ENV_SLICE_ID: str(i // hosts_per_slice),
+                ENV_NUM_SLICES: str(num_slices),
+                ENV_WORKER_HOSTNAMES: ",".join(hostnames),
+            }
+        )
+    return envs
+
+
+_initialized = False
+
+
+def initialize_from_env(environ: Optional[Dict[str, str]] = None) -> GangEnv:
+    """In-pod entrypoint: parse GangEnv and bring up jax.distributed.
+
+    The launcher.py-equivalent (reference: launcher.py:59-88): instead of
+    converting TF_CONFIG into tf_cnn_benchmarks flags, we convert KFT_* env
+    into `jax.distributed.initialize` arguments. No-op for single-process
+    (local / single-host) runs.
+    """
+    global _initialized
+    gang = GangEnv.from_env(environ)
+    if gang.single_process or not gang.coordinator_address:
+        log.info("single-process gang; skipping jax.distributed.initialize")
+        return gang
+    if _initialized:
+        return gang
+    import jax
+
+    log.info(
+        "initializing jax.distributed: coordinator=%s procs=%d id=%d "
+        "slice=%d/%d",
+        gang.coordinator_address,
+        gang.num_processes,
+        gang.process_id,
+        gang.slice_id,
+        gang.num_slices,
+    )
+    jax.distributed.initialize(
+        coordinator_address=gang.coordinator_address,
+        num_processes=gang.num_processes,
+        process_id=gang.process_id,
+    )
+    _initialized = True
+    return gang

@@ -1,0 +1,194 @@
+"""Mesh / topology layer.
+
+The reference's distributed-topology contract is TF_CONFIG rendering: a JSON
+cluster dict of master/worker/ps host lists converted per-pod into flags
+(reference: tf-controller-examples/tf-cnn/launcher.py:68-80) — the wire
+protocol (gRPC PS, NCCL) lives inside the containers. The TPU-native
+equivalent is a `jax.sharding.Mesh` over the gang's devices: XLA inserts the
+collectives; this module decides *which axis lands on which interconnect*.
+
+Axis placement convention (the "How to Scale Your Model" recipe):
+- DCN (slow, across slices) gets the outermost, least-communicating axes:
+  pure data parallelism.
+- ICI (fast, within a slice) gets everything that communicates per-step:
+  fsdp (reduce-scatter/all-gather), sequence (ring ppermute), expert
+  (all_to_all), tensor (all-reduce every layer) — tensor innermost since it
+  communicates most.
+- pipeline sits between: stage boundaries are point-to-point transfers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from kubeflow_tpu.config.platform import MeshConfig
+
+# Outer → inner. Communication intensity increases left → right.
+MESH_AXIS_ORDER: Tuple[str, ...] = (
+    "data",
+    "fsdp",
+    "pipeline",
+    "expert",
+    "sequence",
+    "tensor",
+)
+
+# Axes that may ride DCN (across slices) without destroying step time.
+DCN_FRIENDLY_AXES: Tuple[str, ...] = ("data", "pipeline")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A resolved mesh: ordered (axis, size) pairs covering all gang devices."""
+
+    axis_sizes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def from_config(cls, cfg: MeshConfig) -> "MeshSpec":
+        return cls(tuple((a, getattr(cfg, a)) for a in MESH_AXIS_ORDER))
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(a for a, _ in self.axis_sizes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(s for _, s in self.axis_sizes)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.shape)
+
+    def size(self, axis: str) -> int:
+        for a, s in self.axis_sizes:
+            if a == axis:
+                return s
+        raise KeyError(axis)
+
+    def nontrivial_axes(self) -> List[str]:
+        return [a for a, s in self.axis_sizes if s > 1]
+
+    def dcn_split(self, num_slices: int) -> Tuple[Dict[str, int], Dict[str, int]]:
+        """Split axis sizes into (per-slice ICI sizes, across-slice DCN sizes).
+
+        Only DCN-friendly axes are allowed to span slices; the outermost such
+        axis absorbs the slice count. Raises if the mesh can't be laid out.
+        """
+        ici = dict(self.axis_sizes)
+        dcn = {a: 1 for a, _ in self.axis_sizes}
+        if num_slices == 1:
+            return ici, dcn
+        remaining = num_slices
+        for axis in DCN_FRIENDLY_AXES:
+            size = ici[axis]
+            g = math.gcd(size, remaining)
+            take = min(remaining, size)
+            if size % take == 0:
+                g = take
+            if g > 1:
+                ici[axis] = size // g
+                dcn[axis] = g
+                remaining //= g
+            if remaining == 1:
+                break
+        if remaining != 1:
+            raise ValueError(
+                f"cannot lay {num_slices} slices across DCN-friendly axes "
+                f"{DCN_FRIENDLY_AXES} of mesh {dict(self.axis_sizes)}; "
+                f"increase data/pipeline parallelism to a multiple of the "
+                f"slice count"
+            )
+        return ici, dcn
+
+
+def build_mesh(
+    spec: MeshSpec,
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_slices: int = 1,
+) -> Mesh:
+    """Construct a `jax.sharding.Mesh` with ICI/DCN-aware device placement.
+
+    Single-slice: `mesh_utils.create_device_mesh` lets XLA pick a physical
+    layout where the innermost (most-communicating) axes get contiguous ICI
+    neighbors. Multi-slice: hybrid mesh with DCN-friendly axes outermost
+    across slices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if spec.num_devices != len(devices):
+        raise ValueError(
+            f"mesh spec needs {spec.num_devices} devices "
+            f"({dict(spec.axis_sizes)}), got {len(devices)}"
+        )
+    if num_slices > 1:
+        ici, dcn = spec.dcn_split(num_slices)
+        ici_shape = tuple(ici[a] for a in spec.axis_names)
+        dcn_shape = tuple(dcn[a] for a in spec.axis_names)
+        try:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape,
+                dcn_shape,
+                devices=devices,
+                allow_split_physical_axes=True,
+            )
+        except (ValueError, AssertionError):
+            # Virtual/CPU devices carry no slice topology; fall back to a
+            # plain reshape that still honors the outer-DCN ordering.
+            dev_array = np.array(devices).reshape(spec.shape)
+        return Mesh(dev_array, spec.axis_names)
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            spec.shape, devices=devices, allow_split_physical_axes=True
+        )
+    except (ValueError, AssertionError):
+        dev_array = np.array(devices).reshape(spec.shape)
+    return Mesh(dev_array, spec.axis_names)
+
+
+def mesh_from_config(
+    cfg: MeshConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_slices: int = 1,
+) -> Mesh:
+    return build_mesh(MeshSpec.from_config(cfg), devices=devices, num_slices=num_slices)
+
+
+def single_device_mesh() -> Mesh:
+    """A 1-device mesh with the full axis vocabulary (all sizes 1 except data).
+
+    Lets single-chip paths (bench, serving) reuse the same PartitionSpecs as
+    the distributed path.
+    """
+    spec = MeshSpec.from_config(MeshConfig())
+    return build_mesh(spec, devices=jax.devices()[:1])
+
+
+def default_mesh_for(
+    num_devices: int,
+    tensor: int = 1,
+    pipeline: int = 1,
+    sequence: int = 1,
+    expert: int = 1,
+    fsdp: int = 1,
+) -> Mesh:
+    """Convenience: fill the data axis with whatever devices remain."""
+    denom = tensor * pipeline * sequence * expert * fsdp
+    if num_devices % denom:
+        raise ValueError(f"{num_devices} devices not divisible by {denom}")
+    cfg = MeshConfig(
+        data=num_devices // denom,
+        fsdp=fsdp,
+        tensor=tensor,
+        pipeline=pipeline,
+        sequence=sequence,
+        expert=expert,
+    )
+    return mesh_from_config(cfg, devices=jax.devices()[:num_devices])

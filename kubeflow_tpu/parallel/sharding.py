@@ -1,0 +1,109 @@
+"""Logical-axis sharding rules.
+
+The GSPMD idiom: models annotate arrays with *logical* axis names
+("batch", "embed", "heads", ...); one rules table maps logical names to mesh
+axes. Changing the parallelism strategy = changing the table, not the model.
+This replaces the reference's replica-count vocabulary (MASTER/WORKER/PS,
+reference: tf-controller-examples/tf-cnn/create_job_specs.py:125-191) with
+sharding declarations XLA compiles into collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicated).
+# The default table implements DP+FSDP+TP+SP+EP simultaneously; size-1 mesh
+# axes make the corresponding sharding a no-op, so one table serves every
+# strategy mix.
+LOGICAL_RULES: Dict[str, Union[None, str, Tuple[str, ...]]] = {
+    # activations
+    "batch": ("data", "fsdp"),
+    "seq": "sequence",
+    "kv_seq": None,            # KV length stays whole except in ring attention
+    "act_embed": None,
+    "act_mlp": "tensor",
+    "act_heads": "tensor",
+    # params
+    "embed": "fsdp",           # FSDP shards the embed dim of weights
+    "mlp": "tensor",
+    "heads": "tensor",
+    "kv": None,
+    "vocab": "tensor",
+    "stage": "pipeline",
+    "expert": "expert",
+    # conv/vision
+    "conv_in": None,
+    "conv_out": "tensor",
+    "spatial": None,
+}
+
+
+def logical_to_spec(
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict[str, Union[None, str, Tuple[str, ...]]]] = None,
+    mesh: Optional[Mesh] = None,
+) -> P:
+    """Map a tuple of logical axis names (None = replicated) to a PartitionSpec.
+
+    If `mesh` is given, mesh axes absent from it (or of size 1) are dropped —
+    so the same logical annotations work on any mesh shape.
+    """
+    table = LOGICAL_RULES if rules is None else rules
+    out = []
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+            continue
+        target = table.get(name, None)
+        if target is None:
+            out.append(None)
+            continue
+        axes = (target,) if isinstance(target, str) else tuple(target)
+        if mesh is not None:
+            axes = tuple(
+                a for a in axes if a in mesh.axis_names and mesh.shape[a] > 1
+            )
+        if not axes:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    # Trim trailing Nones: P() semantics are identical and specs print cleaner.
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def named_sharding(mesh: Mesh, *spec: Union[None, str, Tuple[str, ...]]) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_constraint(
+    x,
+    logical_axes: Sequence[Optional[str]],
+    mesh: Optional[Mesh] = None,
+    rules: Optional[Dict[str, Union[None, str, Tuple[str, ...]]]] = None,
+):
+    """with_sharding_constraint by logical axis names (no-op outside jit/mesh)."""
+    spec = logical_to_spec(logical_axes, rules=rules, mesh=mesh)
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        # No mesh context (eager single-device path) — constraint is advisory.
+        return x
+
+
+def param_specs(params, annotations, mesh: Optional[Mesh] = None):
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda ax: logical_to_spec(ax, mesh=mesh),
+        annotations,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        ),
+    )

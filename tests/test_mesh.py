@@ -1,0 +1,137 @@
+"""Mesh/topology layer tests on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.config.platform import MeshConfig
+from kubeflow_tpu.parallel import mesh as meshlib
+from kubeflow_tpu.parallel import sharding as sh
+from kubeflow_tpu.parallel.distributed import (
+    ENV_COORDINATOR,
+    ENV_PROCESS_ID,
+    GangEnv,
+    initialize_from_env,
+    render_gang_env,
+)
+
+
+class TestMeshSpec:
+    def test_from_config_order(self):
+        spec = meshlib.MeshSpec.from_config(MeshConfig(data=2, tensor=4))
+        assert spec.axis_names == meshlib.MESH_AXIS_ORDER
+        assert spec.size("data") == 2
+        assert spec.size("tensor") == 4
+        assert spec.num_devices == 8
+
+    def test_nontrivial_axes(self):
+        spec = meshlib.MeshSpec.from_config(MeshConfig(data=2, sequence=2))
+        assert spec.nontrivial_axes() == ["data", "sequence"]
+
+    def test_dcn_split_data_axis(self):
+        spec = meshlib.MeshSpec.from_config(MeshConfig(data=4, tensor=2))
+        ici, dcn = spec.dcn_split(num_slices=2)
+        assert dcn["data"] == 2 and ici["data"] == 2
+        assert ici["tensor"] == 2 and dcn["tensor"] == 1
+
+    def test_dcn_split_rejects_tensor_spanning(self):
+        spec = meshlib.MeshSpec.from_config(MeshConfig(tensor=8))
+        with pytest.raises(ValueError, match="cannot lay"):
+            spec.dcn_split(num_slices=2)
+
+
+class TestBuildMesh:
+    def test_dp_mesh(self, devices8):
+        m = meshlib.mesh_from_config(MeshConfig(data=8))
+        assert m.shape["data"] == 8
+        assert m.devices.size == 8
+
+    def test_2d_mesh(self, devices8):
+        m = meshlib.mesh_from_config(MeshConfig(data=2, tensor=4))
+        assert m.shape["data"] == 2
+        assert m.shape["tensor"] == 4
+
+    def test_wrong_device_count(self, devices8):
+        spec = meshlib.MeshSpec.from_config(MeshConfig(data=4))
+        with pytest.raises(ValueError, match="devices"):
+            meshlib.build_mesh(spec, devices=jax.devices()[:8])
+
+    def test_multislice_mesh(self, devices8):
+        m = meshlib.mesh_from_config(
+            MeshConfig(data=4, tensor=2), num_slices=2
+        )
+        assert m.shape["data"] == 4
+
+    def test_psum_over_mesh(self, devices8):
+        m = meshlib.mesh_from_config(MeshConfig(data=8))
+        x = jnp.arange(8.0)
+        y = jax.jit(
+            jax.shard_map(
+                lambda v: jax.lax.psum(v, "data"),
+                mesh=m,
+                in_specs=P("data"),
+                out_specs=P(),
+            )
+        )(x)
+        assert float(y[0]) == 28.0
+
+    def test_default_mesh_for(self, devices8):
+        m = meshlib.default_mesh_for(8, tensor=2)
+        assert m.shape["data"] == 4 and m.shape["tensor"] == 2
+
+
+class TestLogicalRules:
+    def test_batch_maps_to_data_fsdp(self):
+        spec = sh.logical_to_spec(("batch", "seq", "act_embed"))
+        assert spec[0] == ("data", "fsdp")
+
+    def test_mesh_filtering_drops_size1(self, devices8):
+        m = meshlib.mesh_from_config(MeshConfig(data=8))
+        spec = sh.logical_to_spec(("batch", "seq", "act_embed"), mesh=m)
+        # fsdp axis has size 1 → dropped; trailing Nones trimmed
+        assert spec == P("data")
+
+    def test_unknown_logical_replicated(self):
+        assert sh.logical_to_spec(("nope",)) == P()
+
+    def test_param_sharding_applies(self, devices8):
+        m = meshlib.mesh_from_config(MeshConfig(data=2, tensor=4))
+        w = jnp.zeros((16, 32))
+        spec = sh.logical_to_spec(("embed", "mlp"), mesh=m)
+        ws = jax.device_put(w, NamedSharding(m, spec))
+        assert ws.sharding.spec == P(None, "tensor")
+
+
+class TestGangEnv:
+    def test_render_single_slice(self):
+        envs = render_gang_env("job1", ["h0", "h1", "h2", "h3"])
+        assert len(envs) == 4
+        assert envs[0][ENV_COORDINATOR] == "h0:8476"
+        assert envs[3][ENV_PROCESS_ID] == "3"
+        assert all(e[ENV_COORDINATOR] == "h0:8476" for e in envs)
+
+    def test_render_multislice_ids(self):
+        envs = render_gang_env("j", [f"h{i}" for i in range(8)], num_slices=2)
+        assert envs[3]["KFT_SLICE_ID"] == "0"
+        assert envs[4]["KFT_SLICE_ID"] == "1"
+
+    def test_render_rejects_ragged(self):
+        with pytest.raises(ValueError):
+            render_gang_env("j", ["a", "b", "c"], num_slices=2)
+
+    def test_from_env_defaults(self):
+        g = GangEnv.from_env({})
+        assert g.single_process and g.is_coordinator
+
+    def test_initialize_single_process_noop(self):
+        g = initialize_from_env({})
+        assert g.num_processes == 1
+
+    def test_roundtrip(self):
+        envs = render_gang_env("j", ["h0", "h1"], num_slices=1)
+        g = GangEnv.from_env(envs[1])
+        assert g.process_id == 1
+        assert g.num_processes == 2
+        assert not g.is_coordinator
