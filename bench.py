@@ -1,0 +1,94 @@
+"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+
+The headline metric from BASELINE.json — the reference's tf-cnn harness
+measures images/sec of ResNet-50 under TFJob (batch 32/replica, parameter-
+server updates, one nvidia.com/gpu per worker; reference:
+tf-controller-examples/tf-cnn/create_job_specs.py:101-121, launcher.py:68-88).
+The reference publishes no numbers (BASELINE.md), so `vs_baseline` is
+computed against the era-representative published tf_cnn_benchmarks figure
+for the reference's target hardware: ResNet-50, batch 32/GPU, fp32,
+single V100 ≈ 341 images/sec (tensorflow/benchmarks methodology page).
+
+Here the full train step (fwd+bwd+SGD update, bf16 compute, global-batch BN)
+runs as one XLA program on the TPU chip via the platform's own Trainer.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+# Keep host-side noise out of the measurement.
+os.environ.setdefault("KFT_BENCH_BATCH", "128")
+os.environ.setdefault("KFT_BENCH_STEPS", "20")
+
+REFERENCE_V100_IMAGES_PER_SEC = 341.0
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+    from kubeflow_tpu.parallel.mesh import build_mesh, MeshSpec
+    from kubeflow_tpu.training.data import make_global_batch
+    from kubeflow_tpu.training.trainer import Trainer
+
+    batch = int(os.environ["KFT_BENCH_BATCH"])
+    steps = int(os.environ["KFT_BENCH_STEPS"])
+    n_dev = len(jax.devices())
+
+    # Use every available chip on the data axis; per-chip throughput is the
+    # metric so the number is comparable across slice sizes.
+    cfg = TrainingConfig(
+        model="resnet50",
+        global_batch_size=batch * n_dev,
+        steps=steps,
+        warmup_steps=1,
+        learning_rate=0.1,
+        mesh=MeshConfig(data=n_dev),
+    )
+    mesh = build_mesh(MeshSpec.from_config(cfg.mesh), devices=jax.devices())
+    trainer = Trainer(cfg, mesh=mesh)
+    state = trainer.init_state()
+
+    data = trainer.task.synthetic_data()
+    batch_dev = make_global_batch(data.batch_at(0), mesh)
+    rng = jax.random.PRNGKey(0)
+
+    # Warmup: compile + one execute.
+    state, metrics = trainer.train_step(state, batch_dev, rng)
+    jax.block_until_ready(metrics["loss"])
+    state, metrics = trainer.train_step(state, batch_dev, rng)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.monotonic()
+    for _ in range(steps):
+        state, metrics = trainer.train_step(state, batch_dev, rng)
+    jax.block_until_ready(metrics["loss"])
+    dt = (time.monotonic() - t0) / steps
+
+    images_per_sec = cfg.global_batch_size / dt
+    per_chip = images_per_sec / n_dev
+    loss = float(jax.device_get(metrics["loss"]))
+    assert np.isfinite(loss), "non-finite loss in benchmark"
+
+    print(
+        json.dumps(
+            {
+                "metric": "images/sec/chip (ResNet-50 train step, bf16, batch "
+                f"{batch}/chip, {n_dev} chip(s))",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / REFERENCE_V100_IMAGES_PER_SEC, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

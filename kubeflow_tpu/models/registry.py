@@ -1,0 +1,40 @@
+"""Model registry.
+
+The reference's model menu is a flag into tf_cnn_benchmarks
+(reference: tf-controller-examples/tf-cnn/create_job_specs.py:56-59
+`--model=resnet50`). Here the registry maps the same names to flax module
+factories so the TPUJob spec's `training.model` string resolves the vehicle.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str):
+    def deco(factory: Callable):
+        if name in _REGISTRY:
+            raise ValueError(f"model {name!r} already registered")
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def get_model(name: str, **kwargs):
+    # Import model modules lazily so `import kubeflow_tpu` stays light.
+    import kubeflow_tpu.models.resnet  # noqa: F401
+    import kubeflow_tpu.models.bert  # noqa: F401
+
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def list_models():
+    import kubeflow_tpu.models.resnet  # noqa: F401
+    import kubeflow_tpu.models.bert  # noqa: F401
+
+    return sorted(_REGISTRY)

@@ -1,0 +1,143 @@
+"""ResNet for TPU — the tf-cnn benchmark vehicle.
+
+The reference's benchmark harness launches tf_cnn_benchmarks ResNet-50 with
+parameter-server variable updates (reference: tf-controller-examples/tf-cnn/
+launcher.py:81-88, README.md:9-20); the model itself is upstream TF code.
+This is a ground-up flax implementation designed for the TPU memory system:
+
+- NHWC activations (XLA's native conv layout on TPU; channels-last keeps the
+  128-lane dimension dense for the MXU),
+- bfloat16 compute with float32 params and float32 batch-norm statistics,
+- under pjit, batch-norm statistics are computed over the *global* (sharded)
+  batch — XLA inserts the cross-device means, giving synchronized BN for free
+  where the reference's PS setup used per-worker stats,
+- no data-dependent control flow: the whole forward is one traced graph.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.registry import register_model
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut."""
+
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # Zero-init the last BN scale: the residual branch starts as identity,
+        # the standard trick for large-batch ResNet convergence.
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNetBlock(nn.Module):
+    """Two 3x3 convs (ResNet-18/34 basic block)."""
+
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=act,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+_VARIANTS = {
+    "resnet18": dict(stage_sizes=[2, 2, 2, 2], block_cls=ResNetBlock),
+    "resnet34": dict(stage_sizes=[3, 4, 6, 3], block_cls=ResNetBlock),
+    "resnet50": dict(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock),
+    "resnet101": dict(stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock),
+    "resnet152": dict(stage_sizes=[3, 8, 36, 3], block_cls=BottleneckBlock),
+}
+
+def _make_factory(variant: str):
+    def factory(**kwargs):
+        return ResNet(**{**_VARIANTS[variant], **kwargs})
+
+    factory.__name__ = variant
+    return factory
+
+
+for _name in _VARIANTS:
+    register_model(_name)(_make_factory(_name))

@@ -1,0 +1,4 @@
+from kubeflow_tpu.training.trainer import Trainer, TrainState
+from kubeflow_tpu.training.data import SyntheticData
+
+__all__ = ["Trainer", "TrainState", "SyntheticData"]

@@ -1,0 +1,81 @@
+"""Parameter logical-axis annotation.
+
+Maps every parameter leaf (by its tree path and rank) to a tuple of logical
+axis names consumed by parallel/sharding.py. One pattern table covers both
+model families; anything unmatched falls back to an FSDP heuristic (shard the
+largest divisible dim) so new models get memory scaling for free.
+
+This replaces the reference's parameter-server placement decision (variables
+live on PS pods, reference: create_job_specs.py:106 `--variable_update=
+parameter_server`) with GSPMD sharding declarations.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Tuple
+
+import jax
+
+# (path regex, rank) -> logical axes. Paths are "/"-joined flax param paths.
+_PATTERNS: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
+    # BERT attention: DenseGeneral kernels [embed, heads, head_dim]
+    (r".*/(query|key|value)/kernel$", ("embed", "heads", None)),
+    (r".*/attention/out/kernel$", ("heads", None, "embed")),
+    (r".*/(query|key|value)/bias$", ("heads", None)),
+    # BERT MLP
+    (r".*/mlp/wi/kernel$", ("embed", "mlp")),
+    (r".*/mlp/wo/kernel$", ("mlp", "embed")),
+    (r".*/mlp/wi/bias$", ("mlp",)),
+    # Embeddings + vocab projections
+    (r".*/(tok_emb|seg_emb)/embedding$", ("vocab", "embed")),
+    (r".*/pos_emb/embedding$", (None, "embed")),
+    (r".*/mlm_out/kernel$", ("embed", "vocab")),
+    (r".*/mlm_out/bias$", ("vocab",)),
+    (r".*/(mlm_transform|pooler)/kernel$", ("embed", "embed2")),
+    # Conv kernels [h, w, cin, cout]
+    (r".*conv.*/kernel$", (None, None, "conv_in", "conv_out")),
+    # Classifier head
+    (r".*/head/kernel$", ("embed", "vocab")),
+)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def logical_axes_for(
+    params,
+    fsdp_size: int = 1,
+) -> Dict:
+    """Return a pytree (matching params) of logical-axis tuples.
+
+    Unmatched leaves: rank>=2 leaves get their largest fsdp-divisible dim
+    annotated "embed" (→ fsdp axis); rank<=1 leaves are replicated.
+    """
+
+    def annotate(path, leaf):
+        p = _path_str(path)
+        for pattern, axes in _PATTERNS:
+            if re.match(pattern, p) and len(axes) == leaf.ndim:
+                return axes
+        if leaf.ndim >= 2 and fsdp_size > 1:
+            dims = sorted(
+                range(leaf.ndim), key=lambda i: leaf.shape[i], reverse=True
+            )
+            for d in dims:
+                if leaf.shape[d] % fsdp_size == 0:
+                    return tuple(
+                        "embed" if i == d else None for i in range(leaf.ndim)
+                    )
+        return tuple(None for _ in range(leaf.ndim))
+
+    return jax.tree_util.tree_map_with_path(annotate, params)

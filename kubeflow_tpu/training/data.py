@@ -1,0 +1,103 @@
+"""Input pipeline.
+
+The reference's benchmark input is tf_cnn_benchmarks' synthetic/imagenet data
+(reference: tf-controller-examples/tf-cnn/launcher.py:81-88 — no dataset flag
+passed, so synthetic); the platform's own data story is PVC/S3 staging
+(reference: components/openmpi-controller/controller/controller.py:104-116).
+
+TPU-first concerns handled here:
+- batches are produced host-side as numpy, then assembled into *global*
+  jax.Arrays with `jax.make_array_from_process_local_data` so each host feeds
+  only its shard (no host0 fan-out over DCN),
+- deterministic per-step RNG (seed + step) so a restarted gang regenerates
+  identical data — checkpoint/resume safe without iterator state.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class SyntheticData:
+    """Deterministic synthetic batches for image or MLM tasks."""
+
+    def __init__(
+        self,
+        task: str,
+        global_batch_size: int,
+        seed: int = 0,
+        image_size: int = 224,
+        num_classes: int = 1000,
+        seq_len: int = 128,
+        vocab_size: int = 30522,
+    ):
+        self.task = task
+        self.global_batch_size = global_batch_size
+        self.seed = seed
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+
+    def batch_at(self, step: int) -> Dict[str, np.ndarray]:
+        rng = np.random.default_rng(self.seed * 1_000_003 + step)
+        b = self.global_batch_size
+        if self.task == "image":
+            return {
+                "image": rng.standard_normal(
+                    (b, self.image_size, self.image_size, 3), dtype=np.float32
+                ),
+                "label": rng.integers(0, self.num_classes, (b,), dtype=np.int32),
+            }
+        if self.task == "mlm":
+            ids = rng.integers(0, self.vocab_size, (b, self.seq_len), dtype=np.int32)
+            labels = ids.copy()
+            # Mask 15% of positions; unmasked labels are -100 (ignored).
+            mask = rng.random((b, self.seq_len)) < 0.15
+            labels[~mask] = -100
+            ids[mask] = 1  # [MASK]-like id
+            return {
+                "input_ids": ids,
+                "attention_mask": np.ones((b, self.seq_len), dtype=np.int32),
+                "labels": labels,
+                "nsp_labels": rng.integers(0, 2, (b,), dtype=np.int32),
+            }
+        raise ValueError(f"unknown task {self.task!r}")
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        step = 0
+        while True:
+            yield self.batch_at(step)
+            step += 1
+
+
+def batch_spec(batch: Dict[str, np.ndarray]) -> Dict[str, P]:
+    """Batch arrays shard along (data, fsdp) on their leading dim."""
+    return {k: P(("data", "fsdp")) for k in batch}
+
+
+def make_global_batch(
+    batch: Dict[str, np.ndarray],
+    mesh: Mesh,
+    local_slice: Optional[slice] = None,
+) -> Dict[str, jax.Array]:
+    """Assemble host-local numpy into globally-sharded jax.Arrays.
+
+    Single-process: device_put with the batch sharding. Multi-process: each
+    host passes only its rows; `local_slice` selects them from a
+    globally-indexed batch when the caller generates the full batch
+    deterministically (SyntheticData does).
+    """
+    out = {}
+    for k, v in batch.items():
+        sharding = NamedSharding(mesh, P(("data", "fsdp")))
+        if jax.process_count() == 1:
+            out[k] = jax.device_put(v, sharding)
+        else:
+            local = v if local_slice is None else v[local_slice]
+            out[k] = jax.make_array_from_process_local_data(sharding, local)
+    return out

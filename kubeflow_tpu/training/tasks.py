@@ -1,0 +1,146 @@
+"""Task adapters: bind a model family to batch layout, loss, and init.
+
+The tf-cnn harness's single task is image classification (reference:
+tf-controller-examples/tf-cnn/launcher.py:81-88); BASELINE.md adds BERT
+pretrain. Each task knows how to init variables, compute loss, and produce
+synthetic batches — the Trainer is task-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kubeflow_tpu.config.platform import TrainingConfig
+from kubeflow_tpu.training.data import SyntheticData
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array, ignore: int = -1000000):
+    """Mean CE over labels != ignore; logits float32 [..., C], labels int."""
+    valid = labels != ignore
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    ll = jnp.where(valid, ll, 0.0)
+    count = jnp.maximum(valid.sum(), 1)
+    return -ll.sum() / count
+
+
+class ImageClassificationTask:
+    """ResNet-style: batch {image, label}; mutable batch_stats (BatchNorm)."""
+
+    name = "image"
+    has_batch_stats = True
+
+    def __init__(self, cfg: TrainingConfig, image_size: int = 224, num_classes: int = 1000):
+        self.cfg = cfg
+        self.image_size = image_size
+        self.num_classes = num_classes
+
+    def synthetic_data(self) -> SyntheticData:
+        return SyntheticData(
+            "image",
+            self.cfg.global_batch_size,
+            seed=self.cfg.seed,
+            image_size=self.image_size,
+            num_classes=self.num_classes,
+        )
+
+    def init_variables(self, model, rng, batch) -> Dict[str, Any]:
+        return model.init(rng, jnp.asarray(batch["image"][:1]), train=False)
+
+    def loss(
+        self, model, params, extra_vars, batch, train: bool, rngs
+    ) -> Tuple[jax.Array, Dict[str, Any]]:
+        variables = {"params": params, **extra_vars}
+        if train:
+            logits, updates = model.apply(
+                variables, batch["image"], train=True, mutable=["batch_stats"]
+            )
+        else:
+            logits = model.apply(variables, batch["image"], train=False)
+            updates = {}
+        loss = cross_entropy(logits, batch["label"])
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return loss, {"aux": {"accuracy": acc}, "var_updates": updates}
+
+    def count_items(self, batch) -> int:
+        return batch["image"].shape[0]
+
+
+class MlmTask:
+    """BERT pretrain: masked-LM + next-sentence losses."""
+
+    name = "mlm"
+    has_batch_stats = False
+
+    def __init__(self, cfg: TrainingConfig, seq_len: int = 128, vocab_size: int = 30522):
+        self.cfg = cfg
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+
+    def synthetic_data(self) -> SyntheticData:
+        return SyntheticData(
+            "mlm",
+            self.cfg.global_batch_size,
+            seed=self.cfg.seed,
+            seq_len=self.seq_len,
+            vocab_size=self.vocab_size,
+        )
+
+    def init_variables(self, model, rng, batch) -> Dict[str, Any]:
+        return model.init(
+            rng,
+            jnp.asarray(batch["input_ids"][:1]),
+            deterministic=True,
+        )
+
+    def loss(self, model, params, extra_vars, batch, train: bool, rngs):
+        out = model.apply(
+            {"params": params, **extra_vars},
+            batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=not train,
+            rngs=rngs if train else None,
+        )
+        mlm = cross_entropy(out["mlm_logits"], batch["labels"], ignore=-100)
+        nsp = cross_entropy(out["nsp_logits"], batch["nsp_labels"])
+        loss = mlm + nsp
+        return loss, {"aux": {"mlm_loss": mlm, "nsp_loss": nsp}, "var_updates": {}}
+
+    def count_items(self, batch) -> int:
+        # tokens/step is the BERT throughput unit
+        return batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
+
+
+def task_for_model(model_name: str, cfg: TrainingConfig, **kwargs):
+    if model_name.startswith("resnet"):
+        return ImageClassificationTask(cfg, **kwargs)
+    if model_name.startswith("bert"):
+        return MlmTask(cfg, **kwargs)
+    raise KeyError(f"no task adapter for model {model_name!r}")
+
+
+def make_optimizer(
+    cfg: TrainingConfig, model_name: str
+) -> Tuple[optax.GradientTransformation, optax.Schedule]:
+    """SGD-momentum for convnets (the tf-cnn recipe), AdamW for transformers."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=cfg.learning_rate,
+        warmup_steps=max(1, cfg.warmup_steps),
+        decay_steps=max(cfg.steps, cfg.warmup_steps + 1),
+        end_value=cfg.learning_rate * 0.01,
+    )
+    if model_name.startswith("resnet"):
+        return optax.chain(
+            optax.add_decayed_weights(cfg.weight_decay),
+            optax.sgd(schedule, momentum=0.9, nesterov=True),
+        ), schedule
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(schedule, weight_decay=cfg.weight_decay),
+    ), schedule

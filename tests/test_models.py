@@ -1,0 +1,82 @@
+"""Model unit tests: shapes, dtypes, registry."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.models import get_model, list_models
+
+
+class TestRegistry:
+    def test_known_models(self):
+        names = list_models()
+        for expected in ("resnet50", "resnet18", "bert_base", "bert_tiny"):
+            assert expected in names
+
+    def test_unknown_raises(self):
+        with pytest.raises(KeyError, match="unknown model"):
+            get_model("vgg16")
+
+
+class TestResNet:
+    def test_forward_shapes(self):
+        model = get_model("resnet18", num_classes=10)
+        x = jnp.zeros((2, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        logits = model.apply(variables, x, train=False)
+        assert logits.shape == (2, 10)
+        assert logits.dtype == jnp.float32
+
+    def test_batch_stats_collection_exists(self):
+        model = get_model("resnet18", num_classes=10)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+        )
+        assert "batch_stats" in variables
+
+    def test_resnet50_param_count(self):
+        model = get_model("resnet50")
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)), train=False
+        )
+        n = sum(x.size for x in jax.tree.leaves(variables["params"]))
+        # ResNet-50 @1000 classes: ~25.6M params
+        assert 25_000_000 < n < 26_100_000, n
+
+    def test_train_mode_updates_stats(self):
+        model = get_model("resnet18", num_classes=10)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        _, updates = model.apply(variables, x, train=True, mutable=["batch_stats"])
+        old = variables["batch_stats"]["bn_init"]["mean"]
+        new = updates["batch_stats"]["bn_init"]["mean"]
+        assert not jnp.allclose(old, new)
+
+
+class TestBert:
+    def test_forward_shapes(self):
+        model = get_model("bert_tiny")
+        ids = jnp.zeros((2, 16), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), ids, deterministic=True)
+        out = model.apply(variables, ids, deterministic=True)
+        assert out["mlm_logits"].shape == (2, 16, 512)
+        assert out["nsp_logits"].shape == (2, 2)
+        assert out["pooled"].shape == (2, 64)
+
+    def test_bert_base_config(self):
+        model = get_model("bert_base")
+        assert model.cfg.hidden_size == 768
+        assert model.cfg.num_layers == 12
+
+    def test_attention_mask_changes_output(self):
+        model = get_model("bert_tiny")
+        ids = jnp.arange(32, dtype=jnp.int32).reshape(2, 16) % 512
+        variables = model.init(jax.random.PRNGKey(0), ids, deterministic=True)
+        full = model.apply(variables, ids, deterministic=True)
+        half_mask = jnp.concatenate(
+            [jnp.ones((2, 8), jnp.int32), jnp.zeros((2, 8), jnp.int32)], axis=1
+        )
+        masked = model.apply(
+            variables, ids, attention_mask=half_mask, deterministic=True
+        )
+        assert not jnp.allclose(full["mlm_logits"], masked["mlm_logits"])

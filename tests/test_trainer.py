@@ -1,0 +1,159 @@
+"""Train-step engine tests on the 8-device virtual mesh.
+
+Covers the minimum end-to-end slice of SURVEY.md §7: sharded init, DP/FSDP/TP
+train steps, loss decrease, determinism, and checkpoint/resume.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+from kubeflow_tpu.training.checkpoint import CheckpointManager
+from kubeflow_tpu.training.tasks import MlmTask, cross_entropy, task_for_model
+from kubeflow_tpu.training.trainer import Trainer
+
+
+def tiny_image_trainer(mesh: MeshConfig, batch: int = 16, **cfg_kw) -> Trainer:
+    cfg = TrainingConfig(
+        model="resnet18",
+        global_batch_size=batch,
+        steps=2,
+        warmup_steps=1,
+        learning_rate=0.01,
+        mesh=mesh,
+        **cfg_kw,
+    )
+    tr = Trainer(cfg, model_kwargs={"num_classes": 10})
+    tr.task.image_size = 32
+    tr.task.num_classes = 10
+    return tr
+
+
+def tiny_bert_trainer(mesh: MeshConfig, batch: int = 8) -> Trainer:
+    cfg = TrainingConfig(
+        model="bert_tiny",
+        global_batch_size=batch,
+        steps=2,
+        warmup_steps=1,
+        learning_rate=1e-3,
+        mesh=mesh,
+    )
+    return Trainer(cfg, task=MlmTask(cfg, seq_len=32, vocab_size=512))
+
+
+class TestCrossEntropy:
+    def test_matches_manual(self):
+        logits = jnp.array([[2.0, 0.0], [0.0, 2.0]])
+        labels = jnp.array([0, 1])
+        expected = -jax.nn.log_softmax(logits)[jnp.arange(2), labels].mean()
+        assert cross_entropy(logits, labels) == pytest.approx(float(expected))
+
+    def test_ignore_index(self):
+        logits = jnp.array([[2.0, 0.0], [0.0, 2.0]])
+        labels = jnp.array([0, -100])
+        only_first = -jax.nn.log_softmax(logits)[0, 0]
+        assert cross_entropy(logits, labels, ignore=-100) == pytest.approx(
+            float(only_first)
+        )
+
+
+class TestTaskAdapters:
+    def test_task_for_model(self):
+        cfg = TrainingConfig()
+        assert task_for_model("resnet50", cfg).name == "image"
+        assert task_for_model("bert_base", cfg).name == "mlm"
+        with pytest.raises(KeyError):
+            task_for_model("gpt5", cfg)
+
+
+class TestTrainerDP(object):
+    def test_loss_decreases(self, devices8):
+        tr = tiny_image_trainer(MeshConfig(data=8))
+        data = tr.task.synthetic_data()
+        state = tr.init_state()
+        rng = jax.random.PRNGKey(0)
+        losses = []
+        batch0 = data.batch_at(0)
+        from kubeflow_tpu.training.data import make_global_batch
+
+        gb = make_global_batch(batch0, tr.mesh)
+        for _ in range(5):
+            state, m = tr.train_step(state, gb, rng)
+            losses.append(float(jax.device_get(m["loss"])))
+        assert losses[-1] < losses[0]
+
+    def test_params_replicated_under_pure_dp(self, devices8):
+        tr = tiny_image_trainer(MeshConfig(data=8))
+        state = tr.init_state()
+        leaf = jax.tree.leaves(state.params)[0]
+        assert leaf.sharding.spec == P()
+
+
+class TestTrainerFSDP:
+    def test_params_sharded(self, devices8):
+        tr = tiny_bert_trainer(MeshConfig(data=2, fsdp=4))
+        state = tr.init_state()
+        # the tok embedding [512, 64] should be sharded on fsdp via "embed"->fsdp?
+        # embed dim 64 maps dim1; vocab-> tensor (size 1, dropped). Check some
+        # leaf actually is sharded on fsdp.
+        specs = {
+            str(path): leaf.sharding.spec
+            for path, leaf in jax.tree_util.tree_leaves_with_path(state.params)
+        }
+        assert any("fsdp" in str(s) for s in specs.values()), specs
+
+    def test_fsdp_step_runs(self, devices8):
+        tr = tiny_bert_trainer(MeshConfig(data=2, fsdp=4))
+        m = tr.fit(steps=2, log_every=1)
+        assert np.isfinite(m.loss)
+
+
+class TestTrainerTP:
+    def test_tp_matches_dp_loss(self, devices8):
+        """Same seed, same data: TP=4 and pure DP runs must agree numerically."""
+        tr_dp = tiny_bert_trainer(MeshConfig(data=8))
+        tr_tp = tiny_bert_trainer(MeshConfig(data=2, tensor=4))
+        m_dp = tr_dp.fit(steps=2, log_every=1)
+        m_tp = tr_tp.fit(steps=2, log_every=1)
+        assert m_dp.loss == pytest.approx(m_tp.loss, rel=2e-2)
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, devices8, tmp_path):
+        tr = tiny_image_trainer(MeshConfig(data=8))
+        state = tr.init_state()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+        assert mgr.save(1, state)
+        mgr.wait()
+        restored = mgr.restore(state)
+        for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+            np.testing.assert_allclose(jax.device_get(a), jax.device_get(b))
+        mgr.close()
+
+    def test_latest_step_and_missing(self, devices8, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "empty"), async_save=False)
+        assert mgr.latest_step() is None
+        with pytest.raises(FileNotFoundError):
+            mgr.restore({})
+        mgr.close()
+
+    def test_resume_continues_training(self, devices8, tmp_path):
+        tr = tiny_image_trainer(MeshConfig(data=8))
+        mgr = CheckpointManager(str(tmp_path / "c2"), async_save=False)
+        state = tr.init_state()
+        from kubeflow_tpu.training.data import make_global_batch
+
+        data = tr.task.synthetic_data()
+        rng = jax.random.PRNGKey(0)
+        gb = make_global_batch(data.batch_at(0), tr.mesh)
+        state, _ = tr.train_step(state, gb, rng)
+        mgr.save(int(jax.device_get(state.step)), state)
+        mgr.wait()
+        restored = mgr.restore(state)
+        assert int(jax.device_get(restored.step)) == 1
+        state2, m = tr.train_step(restored, gb, rng)
+        assert int(jax.device_get(state2.step)) == 2
+        mgr.close()
