@@ -1,0 +1,22 @@
+from kubeflow_tpu.cluster.objects import (
+    Condition,
+    get_condition,
+    new_object,
+    set_condition,
+)
+from kubeflow_tpu.cluster.store import Conflict, NotFound, StateStore, WatchEvent
+from kubeflow_tpu.cluster.reconciler import Controller, ControllerManager, Result
+
+__all__ = [
+    "Condition",
+    "get_condition",
+    "new_object",
+    "set_condition",
+    "Conflict",
+    "NotFound",
+    "StateStore",
+    "WatchEvent",
+    "Controller",
+    "ControllerManager",
+    "Result",
+]

@@ -1,0 +1,142 @@
+"""Controller runtime tests: level-triggered reconcile, requeue, backoff."""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.cluster.objects import new_object, set_owner
+from kubeflow_tpu.cluster.reconciler import Controller, ControllerManager, Result
+from kubeflow_tpu.cluster.store import StateStore
+from kubeflow_tpu.utils.retry import wait_for
+
+
+class CountingController(Controller):
+    kind = "Widget"
+    name = "widget-controller"
+
+    def __init__(self):
+        super().__init__()
+        self.seen = []
+        self.lock = threading.Lock()
+
+    def reconcile(self, store, namespace, name):
+        with self.lock:
+            self.seen.append((namespace, name))
+        obj = store.try_get("Widget", name, namespace)
+        if obj is None:
+            return Result()
+        if obj["status"].get("phase") != "Ready":
+            store.patch_status("Widget", name, namespace, {"phase": "Ready"})
+        return Result()
+
+
+class TestRunUntilIdle:
+    def test_reconciles_existing_objects(self):
+        store = StateStore()
+        store.create(new_object("Widget", "w1"))
+        store.create(new_object("Widget", "w2", "other"))
+        c = CountingController()
+        cm = ControllerManager(store)
+        cm.register(c)
+        cm.run_until_idle()
+        assert ("default", "w1") in c.seen
+        assert ("other", "w2") in c.seen
+        assert store.get("Widget", "w1")["status"]["phase"] == "Ready"
+
+    def test_watch_triggers_reconcile(self):
+        store = StateStore()
+        c = CountingController()
+        cm = ControllerManager(store)
+        cm.register(c)
+        cm.run_until_idle()
+        n0 = len(c.seen)
+        store.create(new_object("Widget", "late"))
+        cm.run_until_idle()
+        assert ("default", "late") in c.seen[n0:]
+
+    def test_secondary_watch_maps_to_owner(self):
+        store = StateStore()
+
+        class OwnerController(CountingController):
+            def __init__(self):
+                super().__init__()
+                self.watches = {"Pod": self.map_owned}
+
+        c = OwnerController()
+        cm = ControllerManager(store)
+        cm.register(c)
+        owner = store.create(new_object("Widget", "w1"))
+        cm.run_until_idle()
+        n0 = len(c.seen)
+        pod = new_object("Pod", "w1-pod")
+        set_owner(pod, owner)
+        store.create(pod)
+        cm.run_until_idle()
+        assert ("default", "w1") in c.seen[n0:]
+
+    def test_requeue_after(self):
+        store = StateStore()
+
+        class Periodic(Controller):
+            kind = "Widget"
+            name = "periodic"
+
+            def __init__(self):
+                super().__init__()
+                self.count = 0
+
+            def reconcile(self, s, ns, name):
+                self.count += 1
+                if self.count < 3:
+                    return Result(requeue_after_s=0.02)
+                return Result()
+
+        c = Periodic()
+        cm = ControllerManager(store)
+        cm.register(c)
+        store.create(new_object("Widget", "w"))
+        cm.run_until_idle(max_seconds=5)
+        assert c.count == 3
+
+    def test_error_backoff_then_success(self):
+        store = StateStore()
+
+        class Flaky(Controller):
+            kind = "Widget"
+            name = "flaky"
+
+            def __init__(self):
+                super().__init__()
+                self.attempts = 0
+
+            def reconcile(self, s, ns, name):
+                self.attempts += 1
+                if self.attempts < 3:
+                    raise RuntimeError("boom")
+                return Result()
+
+        c = Flaky()
+        cm = ControllerManager(store)
+        cm.register(c)
+        store.create(new_object("Widget", "w"))
+        cm.run_until_idle(max_seconds=5)
+        assert c.attempts == 3
+
+
+class TestBackgroundMode:
+    def test_start_stop_processes_events(self):
+        store = StateStore()
+        c = CountingController()
+        cm = ControllerManager(store)
+        cm.register(c)
+        cm.start()
+        try:
+            store.create(new_object("Widget", "bg"))
+            wait_for(
+                lambda: store.get("Widget", "bg")["status"].get("phase") == "Ready",
+                timeout_s=5,
+                desc="widget ready",
+            )
+        finally:
+            cm.stop()

@@ -1,0 +1,154 @@
+"""State store tests: CRUD, optimistic concurrency, finalizers, watches."""
+
+import pytest
+
+from kubeflow_tpu.cluster.objects import (
+    condition_is_true,
+    get_condition,
+    new_object,
+    set_condition,
+    set_owner,
+)
+from kubeflow_tpu.cluster.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    StateStore,
+    WatchEvent,
+)
+
+
+@pytest.fixture
+def store():
+    return StateStore()
+
+
+class TestCrud:
+    def test_create_get(self, store):
+        obj = new_object("TPUJob", "j1", "team-a", spec={"topology": "v5e-16"})
+        created = store.create(obj)
+        assert created["metadata"]["uid"]
+        assert created["metadata"]["resourceVersion"] == "1"
+        got = store.get("TPUJob", "j1", "team-a")
+        assert got["spec"]["topology"] == "v5e-16"
+
+    def test_create_duplicate(self, store):
+        store.create(new_object("TPUJob", "j1"))
+        with pytest.raises(AlreadyExists):
+            store.create(new_object("TPUJob", "j1"))
+
+    def test_get_missing(self, store):
+        with pytest.raises(NotFound):
+            store.get("TPUJob", "nope")
+        assert store.try_get("TPUJob", "nope") is None
+
+    def test_update_bumps_rv(self, store):
+        obj = store.create(new_object("TPUJob", "j1"))
+        obj["spec"]["x"] = 1
+        updated = store.update(obj)
+        assert int(updated["metadata"]["resourceVersion"]) > int(
+            obj["metadata"]["resourceVersion"]
+        )
+
+    def test_update_conflict(self, store):
+        obj = store.create(new_object("TPUJob", "j1"))
+        store.update(dict(obj, spec={"a": 1}))
+        with pytest.raises(Conflict):
+            store.update(dict(obj, spec={"b": 2}))  # stale rv
+
+    def test_deepcopy_isolation(self, store):
+        obj = store.create(new_object("TPUJob", "j1", spec={"n": 1}))
+        obj["spec"]["n"] = 99
+        assert store.get("TPUJob", "j1")["spec"]["n"] == 1
+
+    def test_list_by_namespace_and_labels(self, store):
+        store.create(new_object("Pod", "p1", "ns1", labels={"job": "a"}))
+        store.create(new_object("Pod", "p2", "ns1", labels={"job": "b"}))
+        store.create(new_object("Pod", "p3", "ns2", labels={"job": "a"}))
+        assert len(store.list("Pod")) == 3
+        assert len(store.list("Pod", "ns1")) == 2
+        assert len(store.list("Pod", label_selector={"job": "a"})) == 2
+        assert len(store.list("Pod", "ns1", {"job": "a"})) == 1
+
+    def test_delete(self, store):
+        store.create(new_object("Pod", "p1"))
+        store.delete("Pod", "p1")
+        assert store.try_get("Pod", "p1") is None
+
+    def test_patch_status(self, store):
+        store.create(new_object("TPUJob", "j1"))
+        store.patch_status("TPUJob", "j1", "default", {"phase": "Running"})
+        assert store.get("TPUJob", "j1")["status"]["phase"] == "Running"
+
+
+class TestFinalizers:
+    def test_delete_with_finalizer_pends(self, store):
+        obj = new_object("Profile", "u1")
+        obj["metadata"]["finalizers"] = ["profile-cleanup"]
+        store.create(obj)
+        store.delete("Profile", "u1")
+        got = store.get("Profile", "u1")
+        assert got["metadata"]["deletionTimestamp"]
+        # removing the finalizer completes deletion
+        got["metadata"]["finalizers"] = []
+        store.update(got)
+        assert store.try_get("Profile", "u1") is None
+
+
+class TestWatch:
+    def test_watch_events_in_order(self, store):
+        w = store.watch(kind="Pod")
+        store.create(new_object("Pod", "p1"))
+        obj = store.get("Pod", "p1")
+        obj["spec"]["image"] = "x"
+        store.update(obj)
+        store.delete("Pod", "p1")
+        events = [w.q.get_nowait() for _ in range(3)]
+        assert [e.type for e in events] == [
+            WatchEvent.ADDED,
+            WatchEvent.MODIFIED,
+            WatchEvent.DELETED,
+        ]
+        store.close_watch(w)
+
+    def test_watch_filters_kind(self, store):
+        w = store.watch(kind="Pod")
+        store.create(new_object("Service", "s1"))
+        store.create(new_object("Pod", "p1"))
+        ev = w.q.get_nowait()
+        assert ev.object["kind"] == "Pod"
+        assert w.q.empty()
+
+
+class TestApply:
+    def test_apply_creates_then_updates(self, store):
+        obj = new_object("Service", "svc", spec={"port": 80})
+        store.apply(obj)
+        obj2 = new_object("Service", "svc", spec={"port": 81})
+        applied = store.apply(obj2)
+        assert applied["spec"]["port"] == 81
+        assert len(store.list("Service")) == 1
+
+
+class TestConditionsAndEvents:
+    def test_set_get_condition(self, store):
+        obj = new_object("TPUJob", "j1")
+        changed = set_condition(obj, "Running", "True", reason="AllPodsReady")
+        assert changed
+        assert condition_is_true(obj, "Running")
+        # same again: no change
+        assert not set_condition(obj, "Running", "True", reason="AllPodsReady")
+        assert get_condition(obj, "Missing") is None
+
+    def test_record_event(self, store):
+        job = store.create(new_object("TPUJob", "j1"))
+        store.record_event(job, "Created", "gang created")
+        evs = store.events_for(job)
+        assert len(evs) == 1
+        assert evs[0]["reason"] == "Created"
+
+    def test_owner_reference(self, store):
+        job = store.create(new_object("TPUJob", "j1"))
+        pod = new_object("Pod", "j1-w0")
+        set_owner(pod, job)
+        assert pod["metadata"]["ownerReferences"][0]["kind"] == "TPUJob"
