@@ -267,6 +267,9 @@ class StateStore:
         for field in ("labels", "annotations", "ownerReferences", "finalizers"):
             if field in m:
                 merged["metadata"][field] = copy.deepcopy(m[field])
+        if merged == existing:
+            # no-op apply: don't churn resourceVersion or wake watchers
+            return existing
         return self.update(merged)
 
     def record_event(

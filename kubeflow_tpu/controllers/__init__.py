@@ -1,0 +1,12 @@
+"""Controllers: the CRD reconcilers of the TPU platform control plane.
+
+Each module is the TPU-native equivalent of one reference Go controller
+(SURVEY.md §2.1); all run against the in-memory StateStore or, via a thin
+adapter, a real cluster.
+"""
+
+from kubeflow_tpu.controllers.helpers import (  # noqa: F401
+    apply_owned,
+    delete_owned,
+    wait_for_condition,
+)

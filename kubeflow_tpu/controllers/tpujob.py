@@ -1,0 +1,518 @@
+"""TPUTrainJob controller — the gang-scheduled training-job reconciler.
+
+This is the TPU-native replacement for the reference's TFJob path: the
+reference renders MASTER/WORKER/PS replica pods with `nvidia.com/gpu` limits
+and a TF_CONFIG env (reference: tf-controller-examples/tf-cnn/
+create_job_specs.py:125-191, launcher.py:68-80) and leans on k8s restart
+policies for failure handling (launcher.py:91-93 sleeps forever to defeat
+restarts). TPU slices demand stronger semantics, so this controller provides:
+
+- **all-or-nothing gang creation**: one pod per TPU host, created atomically
+  per reconcile pass — if any creation fails, the partial gang is torn down
+  (no half-placed slice holding chips),
+- **slice vocabulary**: `google.com/tpu` resource requests + GKE topology
+  node selectors from SliceConfig (the analog of the reference's GPU limits,
+  create_job_specs.py:165-170),
+- **jax.distributed env rendering**: coordinator address / process id /
+  slice id per pod (parallel/distributed.py render_gang_env — the TF_CONFIG
+  equivalent),
+- **whole-gang restart with checkpoint resume**: any pod failure fails the
+  slice; the gang is deleted and recreated (bounded by maxRestarts) with
+  KFT_RESTORE_DIR pointing at the job's checkpoint directory — the TPU analog
+  of the openmpi sidecar's master-phase watch (reference:
+  components/openmpi-controller/controller/controller.py:92-102),
+- **status conditions** (Created/Running/Restarting/Succeeded/Failed) shaped
+  exactly like the ones the reference's tests poll
+  (testing/katib_studyjob_test.py:128-193).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.cluster.objects import (
+    new_object,
+    now_iso,
+    set_condition,
+    set_owner,
+)
+from kubeflow_tpu.cluster.reconciler import Controller, Result
+from kubeflow_tpu.cluster.store import AlreadyExists, StateStore
+from kubeflow_tpu.config.core import ConfigError, from_dict
+from kubeflow_tpu.config.platform import SliceConfig, TrainingConfig
+from kubeflow_tpu.controllers.helpers import (
+    ensure_finalizer,
+    list_owned,
+    remove_finalizer,
+)
+from kubeflow_tpu.parallel.distributed import render_gang_env
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import default_registry
+
+log = get_logger(__name__)
+
+KIND = "TPUTrainJob"
+FINALIZER = "kubeflow-tpu.dev/gang-cleanup"
+JOB_NAME_LABEL = "kubeflow-tpu.dev/job-name"
+REPLICA_INDEX_LABEL = "kubeflow-tpu.dev/replica-index"
+DEFAULT_IMAGE = "kubeflow-tpu/trainer:latest"
+
+# Condition types (the contract tests/UIs poll).
+COND_CREATED = "Created"
+COND_RUNNING = "Running"
+COND_RESTARTING = "Restarting"
+COND_SUCCEEDED = "Succeeded"
+COND_FAILED = "Failed"
+
+TERMINAL_CONDITIONS = (COND_SUCCEEDED, COND_FAILED)
+
+# Pod phases (mirrors k8s).
+PENDING, RUNNING, SUCCEEDED, FAILED = "Pending", "Running", "Succeeded", "Failed"
+
+
+def new_tpu_train_job(
+    name: str,
+    namespace: str = "default",
+    training: Optional[Dict[str, Any]] = None,
+    slice_spec: Optional[Dict[str, Any]] = None,
+    max_restarts: int = 3,
+    image: str = DEFAULT_IMAGE,
+    active_deadline_seconds: Optional[float] = None,
+    clean_pod_policy: str = "None",
+) -> Dict[str, Any]:
+    """Spec constructor (the create_job_specs.py equivalent, mesh-first)."""
+    return new_object(
+        KIND,
+        name,
+        namespace,
+        spec={
+            "image": image,
+            "slice": dict(slice_spec or {}),
+            "training": dict(training or {}),
+            "runPolicy": {
+                "maxRestarts": max_restarts,
+                "activeDeadlineSeconds": active_deadline_seconds,
+                "cleanPodPolicy": clean_pod_policy,
+            },
+        },
+    )
+
+
+def parse_job_spec(spec: Dict[str, Any]):
+    """Validate + hydrate the typed configs embedded in a job spec."""
+    slice_cfg = from_dict(SliceConfig, spec.get("slice") or {})
+    slice_cfg.validate()
+    training = from_dict(TrainingConfig, spec.get("training") or {})
+    training.validate()
+    if training.mesh.num_devices != slice_cfg.total_chips:
+        raise ConfigError(
+            f"mesh needs {training.mesh.num_devices} chips but slice "
+            f"{slice_cfg.topology} x{slice_cfg.num_slices} provides "
+            f"{slice_cfg.total_chips}"
+        )
+    return slice_cfg, training
+
+
+def gang_pod_names(job_name: str, total_hosts: int) -> List[str]:
+    return [f"{job_name}-worker-{i}" for i in range(total_hosts)]
+
+
+def gang_hostnames(job_name: str, namespace: str, total_hosts: int) -> List[str]:
+    # Stable headless-service pod DNS, the k8s idiom for per-pod addresses.
+    svc = f"{job_name}-gang"
+    return [
+        f"{job_name}-worker-{i}.{svc}.{namespace}.svc"
+        for i in range(total_hosts)
+    ]
+
+
+class TPUTrainJobController(Controller):
+    kind = KIND
+    name = "tpujob-controller"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.watches = {"Pod": self.map_owned}
+        reg = default_registry()
+        self._jobs_total = reg.counter(
+            "tpujob_total", "job terminal outcomes", ["outcome"]
+        )
+        self._restarts_total = reg.counter(
+            "tpujob_gang_restarts_total", "whole-gang restarts", []
+        )
+        self._running = reg.gauge("tpujob_running", "jobs currently running", [])
+
+    # -- reconcile --------------------------------------------------------
+
+    def reconcile(self, store: StateStore, namespace: str, name: str) -> Result:
+        job = store.try_get(KIND, name, namespace)
+        if job is None:
+            return Result()
+
+        if job["metadata"].get("deletionTimestamp"):
+            return self._handle_deletion(store, job)
+
+        if ensure_finalizer(job, FINALIZER):
+            job = store.update(job)
+
+        status = job.setdefault("status", {})
+        if any(
+            c.get("type") in TERMINAL_CONDITIONS and c.get("status") == "True"
+            for c in status.get("conditions", [])
+        ):
+            self._maybe_clean_pods(store, job)
+            return Result()
+
+        try:
+            slice_cfg, training = parse_job_spec(job.get("spec", {}))
+        except ConfigError as e:
+            self._finish(store, job, COND_FAILED, "InvalidSpec", str(e))
+            return Result()
+
+        self._ensure_gang_service(store, job)
+
+        total_hosts = slice_cfg.total_hosts
+        pods = {
+            p["metadata"]["name"]: p for p in list_owned(store, job, "Pod")
+        }
+        desired = gang_pod_names(name, total_hosts)
+        missing = [n for n in desired if n not in pods]
+
+        changed = False
+        if not status.get("startTime"):
+            status["startTime"] = now_iso()
+            changed = True
+
+        if missing:
+            created = self._create_gang(
+                store, job, slice_cfg, training, desired, pods
+            )
+            if created:
+                changed |= set_condition(
+                    job,
+                    COND_CREATED,
+                    "True",
+                    "GangScheduled",
+                    f"all {total_hosts} gang pods created",
+                )
+            else:
+                # atomic placement failed; partial gang already torn down
+                changed |= set_condition(
+                    job, COND_CREATED, "False", "GangPending", "placement failed"
+                )
+                self._write_status(store, job)
+                return Result(requeue_after_s=1.0)
+            pods = {
+                p["metadata"]["name"]: p for p in list_owned(store, job, "Pod")
+            }
+            conflicts = [
+                n
+                for n in desired
+                if n not in pods
+                and store.try_get("Pod", n, namespace) is not None
+            ]
+            if conflicts:
+                # a foreign (un-owned) pod squats on a gang pod name; surface
+                # it as a terminal condition instead of crash-looping
+                self._finish(
+                    store,
+                    job,
+                    COND_FAILED,
+                    "PodNameConflict",
+                    f"pods {conflicts} exist but are not owned by this job",
+                )
+                return Result()
+
+        phases = [
+            pods[n].get("status", {}).get("phase", PENDING) if n in pods else PENDING
+            for n in desired
+        ]
+        replica_statuses = {
+            "active": sum(p in (PENDING, RUNNING) for p in phases),
+            "running": sum(p == RUNNING for p in phases),
+            "succeeded": sum(p == SUCCEEDED for p in phases),
+            "failed": sum(p == FAILED for p in phases),
+        }
+        if status.get("replicaStatuses") != replica_statuses:
+            status["replicaStatuses"] = replica_statuses
+            changed = True
+
+        deadline = (job["spec"].get("runPolicy") or {}).get("activeDeadlineSeconds")
+        if deadline and status.get("startTime"):
+            elapsed = time.time() - _parse_iso(status["startTime"])
+            if elapsed > float(deadline):
+                self._finish(
+                    store,
+                    job,
+                    COND_FAILED,
+                    "DeadlineExceeded",
+                    f"active for {elapsed:.0f}s > {deadline}s",
+                )
+                return Result()
+
+        if any(p == FAILED for p in phases):
+            return self._handle_gang_failure(store, job, desired, pods)
+
+        if all(p == SUCCEEDED for p in phases):
+            self._finish(
+                store, job, COND_SUCCEEDED, "GangSucceeded", "all workers succeeded"
+            )
+            self._maybe_clean_pods(store, job)
+            return Result()
+
+        if all(p == RUNNING for p in phases):
+            changed |= set_condition(
+                job, COND_RUNNING, "True", "GangRunning", "all workers running"
+            )
+        if changed:
+            self._write_status(store, job)
+        # periodic deadline check while non-terminal
+        return Result(requeue_after_s=1.0 if deadline else 5.0)
+
+    # -- gang creation ----------------------------------------------------
+
+    def _ensure_gang_service(self, store: StateStore, job: Dict[str, Any]) -> None:
+        m = job["metadata"]
+        svc = new_object(
+            "Service",
+            f"{m['name']}-gang",
+            m["namespace"],
+            spec={
+                "clusterIP": "None",  # headless: per-pod DNS
+                "selector": {JOB_NAME_LABEL: m["name"]},
+                "ports": [{"name": "coordinator", "port": 8476}],
+            },
+            labels={JOB_NAME_LABEL: m["name"]},
+        )
+        set_owner(svc, job)
+        store.apply(svc)
+
+    def _build_pod(
+        self,
+        job: Dict[str, Any],
+        slice_cfg: SliceConfig,
+        pod_name: str,
+        index: int,
+        env: Dict[str, str],
+    ) -> Dict[str, Any]:
+        m = job["metadata"]
+        spec = job["spec"]
+        restarts = job.get("status", {}).get("restarts", 0)
+        env = dict(env)
+        ckpt = (spec.get("training") or {}).get("checkpoint") or {}
+        ckpt_dir = ckpt.get("directory")
+        if ckpt_dir and restarts > 0:
+            # resume-on-gang-restart: the in-pod runner restores latest step
+            env["KFT_RESTORE_DIR"] = ckpt_dir
+        import json
+
+        pod = new_object(
+            "Pod",
+            pod_name,
+            m["namespace"],
+            api_version="v1",
+            labels={
+                JOB_NAME_LABEL: m["name"],
+                REPLICA_INDEX_LABEL: str(index),
+            },
+            annotations={
+                # the in-pod runner's config; on a real cluster this rides the
+                # image's config file instead
+                "kubeflow-tpu.dev/training-spec": json.dumps(
+                    spec.get("training") or {}
+                ),
+            },
+            spec={
+                "restartPolicy": "Never",  # gang restart is controller-driven
+                "nodeSelector": slice_cfg.node_selectors(),
+                "subdomain": f"{m['name']}-gang",
+                "hostname": pod_name,
+                "containers": [
+                    {
+                        "name": "trainer",
+                        "image": spec.get("image", DEFAULT_IMAGE),
+                        "env": [
+                            {"name": k, "value": v} for k, v in sorted(env.items())
+                        ],
+                        "resources": {
+                            "limits": slice_cfg.resource_requests(),
+                            "requests": slice_cfg.resource_requests(),
+                        },
+                    }
+                ],
+            },
+        )
+        if slice_cfg.spot:
+            pod["spec"]["nodeSelector"]["cloud.google.com/gke-spot"] = "true"
+        pod["status"] = {"phase": PENDING}
+        set_owner(pod, job)
+        return pod
+
+    def _create_gang(
+        self,
+        store: StateStore,
+        job: Dict[str, Any],
+        slice_cfg: SliceConfig,
+        training: TrainingConfig,
+        desired: List[str],
+        existing: Dict[str, Dict[str, Any]],
+    ) -> bool:
+        """All-or-nothing creation of the missing gang pods.
+
+        Returns True if after this pass the full gang exists; on any failure
+        the pods created *in this pass* are deleted so no partial slice holds
+        chips (atomic placement — the semantic the reference lacks).
+        """
+        m = job["metadata"]
+        hostnames = gang_hostnames(m["name"], m["namespace"], slice_cfg.total_hosts)
+        envs = render_gang_env(
+            m["name"], hostnames, num_slices=slice_cfg.num_slices
+        )
+        created_now: List[str] = []
+        try:
+            for i, pod_name in enumerate(desired):
+                if pod_name in existing:
+                    continue
+                pod = self._build_pod(job, slice_cfg, pod_name, i, envs[i])
+                try:
+                    store.create(pod)
+                except AlreadyExists:
+                    continue
+                created_now.append(pod_name)
+        except Exception as e:  # placement failure → tear down partial gang
+            log.warning(
+                "gang creation for %s/%s failed (%s); rolling back %d pods",
+                m["namespace"],
+                m["name"],
+                e,
+                len(created_now),
+            )
+            for pod_name in created_now:
+                try:
+                    store.delete("Pod", pod_name, m["namespace"])
+                except KeyError:
+                    pass
+            store.record_event(
+                job, "GangPlacementFailed", str(e), type="Warning"
+            )
+            return False
+        if created_now:
+            store.record_event(
+                job,
+                "GangScheduled",
+                f"created {len(created_now)} pods "
+                f"({slice_cfg.topology} x{slice_cfg.num_slices})",
+            )
+        return True
+
+    # -- failure / restart ------------------------------------------------
+
+    def _handle_gang_failure(
+        self,
+        store: StateStore,
+        job: Dict[str, Any],
+        desired: List[str],
+        pods: Dict[str, Dict[str, Any]],
+    ) -> Result:
+        status = job["status"]
+        restarts = status.get("restarts", 0)
+        max_restarts = (job["spec"].get("runPolicy") or {}).get("maxRestarts", 0)
+        failed = [
+            n for n in desired
+            if pods[n].get("status", {}).get("phase") == FAILED
+        ]
+        if restarts >= max_restarts:
+            self._finish(
+                store,
+                job,
+                COND_FAILED,
+                "BackoffLimitExceeded",
+                f"workers {failed} failed; {restarts} restarts exhausted",
+            )
+            self._maybe_clean_pods(store, job)
+            return Result()
+        # whole-gang restart: delete every pod, bump the counter; the next
+        # reconcile recreates the gang with KFT_RESTORE_DIR set.
+        for n in desired:
+            try:
+                store.delete("Pod", n, job["metadata"]["namespace"])
+            except KeyError:
+                pass
+        status["restarts"] = restarts + 1
+        set_condition(
+            job,
+            COND_RESTARTING,
+            "True",
+            "GangRestart",
+            f"workers {failed} failed; restart {restarts + 1}/{max_restarts}",
+        )
+        set_condition(job, COND_RUNNING, "False", "GangRestart", "")
+        self._restarts_total.inc()
+        store.record_event(
+            job,
+            "GangRestart",
+            f"restarting whole gang (attempt {restarts + 1}) after "
+            f"failure of {failed}",
+            type="Warning",
+        )
+        self._write_status(store, job)
+        return Result(requeue=True)
+
+    # -- terminal / cleanup -----------------------------------------------
+
+    def _finish(
+        self,
+        store: StateStore,
+        job: Dict[str, Any],
+        cond: str,
+        reason: str,
+        message: str,
+    ) -> None:
+        set_condition(job, cond, "True", reason, message)
+        set_condition(job, COND_RUNNING, "False", reason, "")
+        job["status"]["completionTime"] = now_iso()
+        self._jobs_total.inc(outcome=cond.lower())
+        store.record_event(
+            job, reason, message, type="Normal" if cond == COND_SUCCEEDED else "Warning"
+        )
+        self._write_status(store, job)
+
+    def _maybe_clean_pods(self, store: StateStore, job: Dict[str, Any]) -> None:
+        policy = (job["spec"].get("runPolicy") or {}).get("cleanPodPolicy", "None")
+        if policy == "All":
+            for p in list_owned(store, job, "Pod"):
+                try:
+                    store.delete("Pod", p["metadata"]["name"], p["metadata"]["namespace"])
+                except KeyError:
+                    pass
+        elif policy == "Running":
+            for p in list_owned(store, job, "Pod"):
+                if p.get("status", {}).get("phase") in (PENDING, RUNNING):
+                    try:
+                        store.delete(
+                            "Pod", p["metadata"]["name"], p["metadata"]["namespace"]
+                        )
+                    except KeyError:
+                        pass
+
+    def _handle_deletion(self, store: StateStore, job: Dict[str, Any]) -> Result:
+        for kind in ("Pod", "Service"):
+            for obj in list_owned(store, job, kind):
+                try:
+                    store.delete(kind, obj["metadata"]["name"], obj["metadata"]["namespace"])
+                except KeyError:
+                    pass
+        if remove_finalizer(job, FINALIZER):
+            store.update(job)
+        return Result()
+
+    def _write_status(self, store: StateStore, job: Dict[str, Any]) -> None:
+        m = job["metadata"]
+        store.patch_status(KIND, m["name"], m["namespace"], job["status"])
+
+
+def _parse_iso(ts: str) -> float:
+    import calendar
+
+    return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))

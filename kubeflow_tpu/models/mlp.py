@@ -1,0 +1,38 @@
+"""Tiny MLP classifier — the smoke-test/training-vehicle model.
+
+The reference's CI never trains a real model in unit tiers; it asserts
+control-plane behavior only (SURVEY.md §4). The TPU platform goes further:
+hermetic tests run *actual* XLA training end-to-end through the gang
+controller, which needs a model that compiles in milliseconds on a virtual
+CPU mesh. This MLP is that vehicle; it flows through the same
+ImageClassificationTask/Trainer path as ResNet.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.registry import register_model
+
+
+class Mlp(nn.Module):
+    hidden: Sequence[int] = (64, 64)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, h in enumerate(self.hidden):
+            x = nn.Dense(h, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("mlp")
+def mlp(**kwargs) -> Mlp:
+    return Mlp(**kwargs)

@@ -23,18 +23,20 @@ def register_model(name: str):
     return deco
 
 
-def get_model(name: str, **kwargs):
-    # Import model modules lazily so `import kubeflow_tpu` stays light.
-    import kubeflow_tpu.models.resnet  # noqa: F401
+def _import_builtin_models() -> None:
+    # Imported lazily so `import kubeflow_tpu` stays light.
     import kubeflow_tpu.models.bert  # noqa: F401
+    import kubeflow_tpu.models.mlp  # noqa: F401
+    import kubeflow_tpu.models.resnet  # noqa: F401
 
+
+def get_model(name: str, **kwargs):
+    _import_builtin_models()
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
     return _REGISTRY[name](**kwargs)
 
 
 def list_models():
-    import kubeflow_tpu.models.resnet  # noqa: F401
-    import kubeflow_tpu.models.bert  # noqa: F401
-
+    _import_builtin_models()
     return sorted(_REGISTRY)

@@ -1,0 +1,14 @@
+"""Runtime: the node-side half of the platform.
+
+The StateStore + controllers are the API-server side; this package is the
+kubelet side — pod runners that take scheduled Pod objects to
+Running/Succeeded/Failed, either simulated (hermetic control-plane tests,
+the analog of the reference testing against a fake client) or by actually
+executing the training workload in-process on local devices.
+"""
+
+from kubeflow_tpu.runtime.executor import (  # noqa: F401
+    FakePodRunner,
+    InProcessTrainerRunner,
+    PodExecutor,
+)

@@ -121,6 +121,10 @@ def task_for_model(model_name: str, cfg: TrainingConfig, **kwargs):
         return ImageClassificationTask(cfg, **kwargs)
     if model_name.startswith("bert"):
         return MlmTask(cfg, **kwargs)
+    if model_name.startswith("mlp"):
+        kwargs.setdefault("image_size", 8)
+        kwargs.setdefault("num_classes", 10)
+        return ImageClassificationTask(cfg, **kwargs)
     raise KeyError(f"no task adapter for model {model_name!r}")
 
 

@@ -1,0 +1,296 @@
+"""TPUTrainJob gang controller tests.
+
+Control-plane semantics with the scripted runner (the reference's fake-client
+tier, SURVEY.md §4 T1) plus the real end-to-end slice: CR → gang → in-process
+XLA training → Succeeded condition (the §7 "one model running" milestone).
+"""
+
+import pytest
+
+from kubeflow_tpu.cluster.reconciler import ControllerManager
+from kubeflow_tpu.cluster.store import StateStore
+from kubeflow_tpu.controllers import wait_for_condition
+from kubeflow_tpu.controllers.tpujob import (
+    COND_CREATED,
+    COND_FAILED,
+    COND_RESTARTING,
+    COND_RUNNING,
+    COND_SUCCEEDED,
+    JOB_NAME_LABEL,
+    TPUTrainJobController,
+    gang_pod_names,
+    new_tpu_train_job,
+)
+from kubeflow_tpu.parallel.distributed import (
+    ENV_COORDINATOR,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ENV_SLICE_ID,
+)
+from kubeflow_tpu.runtime.executor import (
+    FakePodRunner,
+    InProcessTrainerRunner,
+    PodExecutor,
+    pod_env,
+)
+
+
+def make_harness(runner=None):
+    store = StateStore()
+    cm = ControllerManager(store)
+    cm.register(TPUTrainJobController())
+    executor = PodExecutor(store, runner or FakePodRunner())
+    return store, cm, executor
+
+
+def drive(cm, executor, rounds=10):
+    """Alternate reconcile and kubelet ticks until both settle."""
+    for _ in range(rounds):
+        cm.run_until_idle(max_seconds=5)
+        if executor.tick() == 0 and executor.tick() == 0:
+            cm.run_until_idle(max_seconds=5)
+            return
+
+
+def submit(store, **kwargs):
+    defaults = dict(
+        training={
+            "model": "mlp",
+            "global_batch_size": 16,
+            "steps": 2,
+            "mesh": {"data": 16},
+            "checkpoint": {"enabled": False},
+        },
+        slice_spec={"topology": "v5e-16", "num_slices": 1},
+    )
+    defaults.update(kwargs)
+    job = new_tpu_train_job("train1", "team-a", **defaults)
+    return store.create(job)
+
+
+class TestGangCreation:
+    def test_creates_full_gang_with_env_and_resources(self):
+        store, cm, _ = make_harness()
+        submit(store)
+        cm.run_until_idle(max_seconds=5)
+        # v5e-16: 16 chips, 4 per host → 4 pods
+        pods = store.list("Pod", "team-a", {JOB_NAME_LABEL: "train1"})
+        assert len(pods) == 4
+        names = {p["metadata"]["name"] for p in pods}
+        assert names == set(gang_pod_names("train1", 4))
+        by_index = sorted(pods, key=lambda p: p["metadata"]["name"])
+        for i, pod in enumerate(by_index):
+            env = pod_env(pod)
+            assert env[ENV_PROCESS_ID] == str(i)
+            assert env[ENV_NUM_PROCESSES] == "4"
+            assert env[ENV_SLICE_ID] == "0"
+            assert "train1-worker-0.train1-gang.team-a.svc" in env[ENV_COORDINATOR]
+            c = pod["spec"]["containers"][0]
+            assert c["resources"]["limits"]["google.com/tpu"] == "4"
+            sel = pod["spec"]["nodeSelector"]
+            assert sel["cloud.google.com/gke-tpu-topology"] == "v5e-16"
+        # headless gang service exists
+        svc = store.get("Service", "train1-gang", "team-a")
+        assert svc["spec"]["clusterIP"] == "None"
+        job = store.get("TPUTrainJob", "train1", "team-a")
+        conds = {c["type"]: c["status"] for c in job["status"]["conditions"]}
+        assert conds[COND_CREATED] == "True"
+
+    def test_multislice_env(self):
+        store, cm, _ = make_harness()
+        job = new_tpu_train_job(
+            "ms",
+            training={
+                "model": "mlp",
+                "global_batch_size": 32,
+                "steps": 1,
+                "mesh": {"data": 32},
+                "checkpoint": {"enabled": False},
+            },
+            slice_spec={"topology": "v5e-16", "num_slices": 2},
+        )
+        store.create(job)
+        cm.run_until_idle(max_seconds=5)
+        pods = sorted(
+            store.list("Pod", "default", {JOB_NAME_LABEL: "ms"}),
+            key=lambda p: int(pod_env(p)[ENV_PROCESS_ID]),
+        )
+        assert len(pods) == 8  # 2 slices x 4 hosts
+        assert [pod_env(p)[ENV_SLICE_ID] for p in pods] == [
+            "0", "0", "0", "0", "1", "1", "1", "1",
+        ]
+
+    def test_invalid_spec_fails_without_pods(self):
+        store, cm, _ = make_harness()
+        submit(
+            store,
+            training={"model": "mlp", "mesh": {"data": 7}},  # 7 != 16 chips
+        )
+        cm.run_until_idle(max_seconds=5)
+        job = store.get("TPUTrainJob", "train1", "team-a")
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds[COND_FAILED]["status"] == "True"
+        assert conds[COND_FAILED]["reason"] == "InvalidSpec"
+        assert store.list("Pod", "team-a") == []
+
+
+class TestGangLifecycle:
+    def test_success_path_conditions(self):
+        store, cm, executor = make_harness()
+        submit(store)
+        drive(cm, executor)
+        job = wait_for_condition(
+            store, "TPUTrainJob", "train1", "team-a", COND_SUCCEEDED, timeout_s=5
+        )
+        assert job["status"]["completionTime"]
+        assert job["status"]["replicaStatuses"]["succeeded"] == 4
+
+    def test_running_condition_observed_midway(self):
+        store, cm, executor = make_harness()
+        submit(store)
+        cm.run_until_idle(max_seconds=5)
+        executor.tick()  # Pending -> Running
+        cm.run_until_idle(max_seconds=5)
+        job = store.get("TPUTrainJob", "train1", "team-a")
+        conds = {c["type"]: c["status"] for c in job["status"]["conditions"]}
+        assert conds[COND_RUNNING] == "True"
+
+    def test_gang_restart_on_single_pod_failure(self):
+        runner = FakePodRunner()
+        store, cm, executor = make_harness(runner)
+        submit(store)
+        cm.run_until_idle(max_seconds=5)
+        runner.fail_next("train1-worker-2")
+        drive(cm, executor)
+        job = wait_for_condition(
+            store, "TPUTrainJob", "train1", "team-a", COND_SUCCEEDED, timeout_s=5
+        )
+        assert job["status"]["restarts"] == 1
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds[COND_RESTARTING]["status"] == "True"
+        # every worker reran (whole-gang restart, not single-pod)
+        assert runner.ran.count("train1-worker-0") == 2
+
+    def test_backoff_limit_exhaustion_fails_job(self):
+        runner = FakePodRunner()
+        store, cm, executor = make_harness(runner)
+        submit(store, max_restarts=1)
+        cm.run_until_idle(max_seconds=5)
+        runner.fail_next("train1-worker-1", times=5)
+        drive(cm, executor, rounds=20)
+        job = wait_for_condition(
+            store, "TPUTrainJob", "train1", "team-a", COND_FAILED, timeout_s=5
+        )
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds[COND_FAILED]["reason"] == "BackoffLimitExceeded"
+        assert job["status"]["restarts"] == 1
+
+    def test_deletion_cleans_gang(self):
+        store, cm, executor = make_harness()
+        submit(store)
+        cm.run_until_idle(max_seconds=5)
+        assert len(store.list("Pod", "team-a")) == 4
+        store.delete("TPUTrainJob", "train1", "team-a")
+        cm.run_until_idle(max_seconds=5)
+        assert store.list("Pod", "team-a") == []
+        assert store.try_get("TPUTrainJob", "train1", "team-a") is None
+        assert store.try_get("Service", "train1-gang", "team-a") is None
+
+    def test_clean_pod_policy_all(self):
+        store, cm, executor = make_harness()
+        submit(store, clean_pod_policy="All")
+        drive(cm, executor)
+        wait_for_condition(
+            store, "TPUTrainJob", "train1", "team-a", COND_SUCCEEDED, timeout_s=5
+        )
+        cm.run_until_idle(max_seconds=5)
+        assert store.list("Pod", "team-a") == []
+
+
+class TestEndToEndTraining:
+    """The §7 minimum end-to-end slice: CR → gang → real XLA training."""
+
+    def test_job_trains_mlp_on_virtual_mesh(self, devices8):
+        runner = InProcessTrainerRunner(steps_override=2)
+        store, cm, executor = make_harness(runner)
+        job = new_tpu_train_job(
+            "e2e",
+            training={
+                "model": "mlp",
+                "global_batch_size": 8,
+                "steps": 2,
+                "mesh": {"data": 4},
+                "checkpoint": {"enabled": False},
+            },
+            slice_spec={"topology": "v5e-4", "num_slices": 1},
+        )
+        store.create(job)
+        drive(cm, executor)
+        done = wait_for_condition(
+            store, "TPUTrainJob", "e2e", "default", COND_SUCCEEDED, timeout_s=30
+        )
+        assert done["status"]["replicaStatuses"]["succeeded"] == 1
+        assert runner.last_metrics is not None
+        assert runner.last_metrics["items_per_sec"] > 0
+        # throughput surfaced on the pod for the platform metrics path
+        pod = store.get("Pod", "e2e-worker-0", "default")
+        assert float(
+            pod["metadata"]["annotations"]["kubeflow-tpu.dev/items-per-sec"]
+        ) > 0
+
+    def test_gang_restart_resumes_from_checkpoint(self, devices8, tmp_path):
+        runner = InProcessTrainerRunner()
+        store, cm, executor = make_harness(runner)
+        ckpt_dir = str(tmp_path / "ckpt")
+        job = new_tpu_train_job(
+            "resume",
+            training={
+                "model": "mlp",
+                "global_batch_size": 8,
+                "steps": 4,
+                "mesh": {"data": 4},
+                "checkpoint": {
+                    "enabled": True,
+                    "directory": ckpt_dir,
+                    "interval_steps": 2,
+                    "async_save": False,
+                },
+            },
+            slice_spec={"topology": "v5e-4", "num_slices": 1},
+        )
+        store.create(job)
+        # run to success once (saves checkpoints), then fail the gang by hand
+        # to exercise restart + restore
+        cm.run_until_idle(max_seconds=5)
+        executor.tick()  # -> Running
+        executor.tick()  # -> Succeeded (trains 4 steps, checkpoints at 2,4)
+        # simulate a mid-flight slice failure before the controller saw success
+        pod = store.get("Pod", "resume-worker-0", "default")
+        store.patch_status("Pod", "resume-worker-0", "default", {"phase": "Failed"})
+        cm.run_until_idle(max_seconds=5)  # gang restart: pods recreated
+        pod = store.get("Pod", "resume-worker-0", "default")
+        assert pod_env(pod).get("KFT_RESTORE_DIR") == ckpt_dir
+        drive(cm, executor)
+        done = wait_for_condition(
+            store, "TPUTrainJob", "resume", "default", COND_SUCCEEDED, timeout_s=30
+        )
+        assert done["status"]["restarts"] == 1
+        # resumed run starts past step 0 (restored from step >= 2)
+        assert runner.last_metrics["final_step"] >= 4
+
+
+class TestDeadline:
+    def test_active_deadline_exceeded(self):
+        import time
+
+        store, cm, executor = make_harness()
+        submit(store, active_deadline_seconds=0.05)
+        cm.run_until_idle(max_seconds=5)
+        time.sleep(1.1)  # startTime resolution is 1s
+        cm.enqueue_all()
+        cm.run_until_idle(max_seconds=5)
+        job = wait_for_condition(
+            store, "TPUTrainJob", "train1", "team-a", COND_FAILED, timeout_s=5
+        )
+        conds = {c["type"]: c for c in job["status"]["conditions"]}
+        assert conds[COND_FAILED]["reason"] == "DeadlineExceeded"
