@@ -28,6 +28,7 @@ restarts). TPU slices demand stronger semantics, so this controller provides:
 
 from __future__ import annotations
 
+import json
 import time
 from typing import Any, Dict, List, Optional
 
@@ -300,13 +301,12 @@ class TPUTrainJobController(Controller):
         spec = job["spec"]
         restarts = job.get("status", {}).get("restarts", 0)
         env = dict(env)
+        env["KFT_TRAINING_SPEC"] = json.dumps(spec.get("training") or {})
         ckpt = (spec.get("training") or {}).get("checkpoint") or {}
         ckpt_dir = ckpt.get("directory")
         if ckpt_dir and restarts > 0:
             # resume-on-gang-restart: the in-pod runner restores latest step
             env["KFT_RESTORE_DIR"] = ckpt_dir
-        import json
-
         pod = new_object(
             "Pod",
             pod_name,
@@ -315,13 +315,6 @@ class TPUTrainJobController(Controller):
             labels={
                 JOB_NAME_LABEL: m["name"],
                 REPLICA_INDEX_LABEL: str(index),
-            },
-            annotations={
-                # the in-pod runner's config; on a real cluster this rides the
-                # image's config file instead
-                "kubeflow-tpu.dev/training-spec": json.dumps(
-                    spec.get("training") or {}
-                ),
             },
             spec={
                 "restartPolicy": "Never",  # gang restart is controller-driven
@@ -332,13 +325,49 @@ class TPUTrainJobController(Controller):
                     {
                         "name": "trainer",
                         "image": spec.get("image", DEFAULT_IMAGE),
+                        # slice_agent (native sidecar): TPU device gate +
+                        # supervision; the file barrier spans the gang only
+                        # when a genuinely shared volume backs /var/run/gang
+                        # (otherwise per-pod, and the cross-host barrier is
+                        # jax.distributed.initialize in the launcher)
+                        "command": [
+                            "slice_agent",
+                            # attempt-scoped dir: a gang restart must never
+                            # see the previous attempt's signal files
+                            "--shared-dir", f"/var/run/gang/attempt-{restarts}",
+                            "--process-id",
+                            str(index) if spec.get("sharedVolume") else "0",
+                            "--num-processes",
+                            str(slice_cfg.total_hosts)
+                            if spec.get("sharedVolume")
+                            else "1",
+                            "--min-devices", str(slice_cfg.chips_per_host),
+                            # bound the gate+barrier wait (pod-skew budget) so
+                            # a half-placed gang can't hold chips forever
+                            "--timeout-ms", "600000",
+                            "--",
+                            "python", "-m", "kubeflow_tpu.runtime.launcher",
+                        ],
                         "env": [
                             {"name": k, "value": v} for k, v in sorted(env.items())
+                        ],
+                        "volumeMounts": [
+                            {"name": "gang-signals", "mountPath": "/var/run/gang"}
                         ],
                         "resources": {
                             "limits": slice_cfg.resource_requests(),
                             "requests": slice_cfg.resource_requests(),
                         },
+                    }
+                ],
+                "volumes": [
+                    {
+                        "name": "gang-signals",
+                        **(
+                            spec["sharedVolume"]
+                            if spec.get("sharedVolume")
+                            else {"emptyDir": {}}
+                        ),
                     }
                 ],
             },

@@ -1,0 +1,3 @@
+"""Native component build + access helpers."""
+
+from kubeflow_tpu.native.build import ensure_built, slice_agent_path  # noqa: F401

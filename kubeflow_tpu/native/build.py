@@ -1,0 +1,56 @@
+"""Build/locate the repo's native (C++) components.
+
+The reference ships compiled Go daemons built by Makefiles per component
+(e.g. components/notebook-controller/Makefile). Here the native components
+live under native/ and build with make+g++; this module builds on demand so
+tests and the platform runtime can call the binaries without a separate
+build step.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BUILD_DIR = os.path.join(REPO_ROOT, "build")
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def have_toolchain() -> bool:
+    return shutil.which("g++") is not None and shutil.which("make") is not None
+
+
+def ensure_built(component: str, binary: Optional[str] = None) -> str:
+    """Build native/<component> if its binary is missing/stale; return path."""
+    binary = binary or component
+    src_dir = os.path.join(REPO_ROOT, "native", component)
+    out = os.path.join(BUILD_DIR, binary)
+    src = os.path.join(src_dir, f"{binary}.cc")
+    if os.path.exists(out) and os.path.exists(src):
+        if os.path.getmtime(out) >= os.path.getmtime(src):
+            return out
+    if not have_toolchain():
+        raise NativeBuildError("g++/make not available")
+    proc = subprocess.run(
+        ["make", "-s", f"BUILD={BUILD_DIR}"],
+        cwd=src_dir,
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"building {component} failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+    if not os.path.exists(out):
+        raise NativeBuildError(f"{component} build produced no {out}")
+    return out
+
+
+def slice_agent_path() -> str:
+    return ensure_built("slice_agent")

@@ -86,21 +86,20 @@ class InProcessTrainerRunner(PodRunner):
         self.last_metrics: Optional[Dict[str, float]] = None
 
     def run(self, pod: Dict[str, Any]) -> Tuple[str, Dict[str, str]]:
+        import json
+
         from kubeflow_tpu.config.core import from_dict
         from kubeflow_tpu.config.platform import TrainingConfig
-        from kubeflow_tpu.training.trainer import Trainer
+        from kubeflow_tpu.runtime.train_run import run_training
 
         env = pod_env(pod)
         if env.get("KFT_PROCESS_ID", "0") != "0":
             # non-coordinator members of a simulated gang just report success;
             # the coordinator's in-process mesh covers their devices.
             return SUCCEEDED, {}
-        training_spec = pod.get("metadata", {}).get("annotations", {}).get(
-            "kubeflow-tpu.dev/training-spec"
+        cfg = from_dict(
+            TrainingConfig, json.loads(env.get("KFT_TRAINING_SPEC") or "{}")
         )
-        import json
-
-        cfg = from_dict(TrainingConfig, json.loads(training_spec or "{}"))
         import jax
 
         needed = cfg.mesh.num_devices
@@ -117,45 +116,23 @@ class InProcessTrainerRunner(PodRunner):
             mesh = build_mesh(
                 MeshSpec.from_config(cfg.mesh), devices=jax.devices()[:needed]
             )
-        trainer = Trainer(cfg, mesh=mesh)
-        ckpt_mgr = None
-        state = None
-        if cfg.checkpoint.enabled and cfg.checkpoint.directory:
-            from kubeflow_tpu.training.checkpoint import CheckpointManager
-
-            ckpt_mgr = CheckpointManager(
-                cfg.checkpoint.directory,
-                keep=cfg.checkpoint.keep,
-                async_save=cfg.checkpoint.async_save,
-            )
-            if env.get("KFT_RESTORE_DIR") and ckpt_mgr.latest_step() is not None:
-                state = trainer.init_state()
-                state = ckpt_mgr.restore(state)
-                log.info(
-                    "resumed %s from step %d",
-                    env.get("KFT_JOB_NAME", "?"),
-                    int(jax.device_get(state.step)),
-                )
-        steps = self.steps_override if self.steps_override else cfg.steps
-        if state is not None:
-            # resume runs only the remaining budget, not `steps` more
-            steps = max(1, steps - int(jax.device_get(state.step)))
-        metrics = trainer.fit(
-            steps=steps, state=state, checkpoint_manager=ckpt_mgr
+        result = run_training(
+            cfg,
+            restore=bool(env.get("KFT_RESTORE_DIR")),
+            steps_override=self.steps_override,
+            mesh=mesh,
         )
-        if ckpt_mgr is not None:
-            ckpt_mgr.save(metrics.step, trainer._final_state)
-            ckpt_mgr.close()
         self.last_metrics = {
-            "items_per_sec": metrics.items_per_sec,
-            "loss": metrics.loss,
-            "final_step": metrics.step,
+            "items_per_sec": result["items_per_sec"],
+            "loss": result["loss"],
+            "final_step": result["final_step"],
         }
         info = {
-            "items_per_sec": f"{metrics.items_per_sec:.2f}",
-            "final_loss": f"{metrics.loss:.4f}",
-            "final_step": str(metrics.step),
+            "items_per_sec": f"{result['items_per_sec']:.2f}",
+            "final_step": str(result["final_step"]),
         }
+        if result["loss"] is not None:
+            info["final_loss"] = f"{result['loss']:.4f}"
         return SUCCEEDED, info
 
 

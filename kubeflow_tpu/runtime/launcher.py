@@ -1,0 +1,85 @@
+"""In-pod training entrypoint — the launcher.py equivalent.
+
+The reference's launcher converts the TF_CONFIG env into tf_cnn_benchmarks
+flags and execs the benchmark (reference: tf-controller-examples/tf-cnn/
+launcher.py:59-88). Here the pod entrypoint parses the KFT_* gang env,
+brings up jax.distributed, builds the Trainer from the job's TrainingConfig
+(KFT_TRAINING_SPEC JSON env or --config file), runs the loop with
+checkpointing, and exits 0/1 — no sleep-forever hack (launcher.py:91-93):
+gang restart semantics live in the controller, so finishing cleanly is safe.
+
+Run under the slice_agent sidecar for device gating + gang barrier:
+  slice_agent --shared-dir /var/run/gang ... -- python -m kubeflow_tpu.runtime.launcher
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+ENV_TRAINING_SPEC = "KFT_TRAINING_SPEC"
+ENV_RESTORE_DIR = "KFT_RESTORE_DIR"
+
+
+def run(config_path: Optional[str] = None, steps: Optional[int] = None) -> int:
+    from kubeflow_tpu.config.core import from_dict
+    from kubeflow_tpu.config.platform import TrainingConfig
+    from kubeflow_tpu.parallel.distributed import initialize_from_env
+    from kubeflow_tpu.runtime.train_run import run_training
+
+    if config_path:
+        with open(config_path) as f:
+            spec = json.load(f) if config_path.endswith(".json") else None
+        if spec is None:
+            import yaml
+
+            with open(config_path) as f:
+                spec = yaml.safe_load(f)
+    else:
+        spec = json.loads(os.environ.get(ENV_TRAINING_SPEC, "{}"))
+    cfg = from_dict(TrainingConfig, spec)
+    cfg.validate()
+
+    gang = initialize_from_env()
+    import jax
+
+    log.info(
+        "launcher: job=%s process %d/%d devices=%d model=%s",
+        gang.job_name,
+        gang.process_id,
+        gang.num_processes,
+        len(jax.devices()),
+        cfg.model,
+    )
+    result = run_training(
+        cfg,
+        restore=bool(os.environ.get(ENV_RESTORE_DIR)),
+        steps_override=steps,
+    )
+    print(json.dumps({"job": gang.job_name, **result}))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="kubeflow-tpu training launcher")
+    ap.add_argument("--config", default=None, help="TrainingConfig yaml/json path")
+    ap.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args(argv)
+    try:
+        return run(args.config, args.steps)
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Shared training-run driver: config → Trainer → (resume, fit, final save).
+
+One implementation of the resume-aware run used by both in-pod execution
+(runtime/launcher.py, the launcher.py equivalent) and the in-process pod
+runner (runtime/executor.py) — the restore gate, remaining-step budget, and
+final checkpoint land in exactly one place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from kubeflow_tpu.config.platform import TrainingConfig
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def run_training(
+    cfg: TrainingConfig,
+    restore: bool = False,
+    steps_override: Optional[int] = None,
+    mesh=None,
+) -> Dict[str, Any]:
+    """Run one training job to completion; returns the result metrics.
+
+    `restore=True` resumes from the latest checkpoint in cfg.checkpoint's
+    directory (no-op if none exists). The step budget is cfg.steps total —
+    a resumed run executes only the remaining steps, and a checkpoint at or
+    past the budget short-circuits to done (gang restarts after the final
+    save must not train past the configured total).
+    """
+    import jax
+
+    from kubeflow_tpu.training.trainer import Trainer
+
+    trainer = Trainer(cfg, mesh=mesh)
+    ckpt_mgr = None
+    state = None
+    restored_step = 0
+    if cfg.checkpoint.enabled and cfg.checkpoint.directory:
+        from kubeflow_tpu.training.checkpoint import CheckpointManager
+
+        ckpt_mgr = CheckpointManager(
+            cfg.checkpoint.directory,
+            keep=cfg.checkpoint.keep,
+            async_save=cfg.checkpoint.async_save,
+        )
+        if restore and ckpt_mgr.latest_step() is not None:
+            state = trainer.init_state()
+            state = ckpt_mgr.restore(state)
+            restored_step = int(jax.device_get(state.step))
+            log.info("resumed from step %d", restored_step)
+
+    total = steps_override if steps_override is not None else cfg.steps
+    if restored_step >= total:
+        # checkpoint already covers the budget: report complete, train nothing
+        if ckpt_mgr is not None:
+            ckpt_mgr.close()
+        return {
+            "final_step": restored_step,
+            "loss": None,
+            "items_per_sec": 0.0,
+            "already_complete": True,
+        }
+    metrics = trainer.fit(
+        steps=total - restored_step, state=state, checkpoint_manager=ckpt_mgr
+    )
+    if ckpt_mgr is not None:
+        ckpt_mgr.save(metrics.step, trainer._final_state)
+        ckpt_mgr.close()
+    return {
+        "final_step": metrics.step,
+        "loss": metrics.loss,
+        "items_per_sec": metrics.items_per_sec,
+        "already_complete": False,
+    }

@@ -1,0 +1,307 @@
+// slice_agent — native gang-lifecycle sidecar for TPU slice jobs.
+//
+// The TPU-native, compiled equivalent of the reference's openmpi-controller
+// sidecar (reference: components/openmpi-controller/controller/controller.py):
+// that Python sidecar gates worker start on GPU-driver presence
+// (controller.py:81-90 polls /proc/driver/nvidia/version), coordinates the
+// gang via signal files on a shared volume (SIGCONT/SIGTERM, controller.py:
+// 9-13,53-61), and watches the master's phase to stop workers
+// (controller.py:92-102). Here the same contract is re-targeted at TPU
+// hosts and compiled (SURVEY.md requires native daemons for the reference's
+// compiled components):
+//
+//   - device health gate: wait until the expected number of TPU accelerator
+//     device nodes (/dev/accel* by default) exist,
+//   - gang barrier: every agent drops ready.<id>; the coordinator (id 0)
+//     waits for all N, then writes the `start` signal,
+//   - workload supervision: fork/exec the payload after `--`, forward
+//     termination, reap, and write phase.<id> = Succeeded|Failed,
+//   - master-phase watch: non-coordinator agents poll phase.0; if the
+//     coordinator finishes, workers terminate their payload (the gang dies
+//     together — whole-slice semantics),
+//   - `terminate` file: external controllers stop the whole gang by touching
+//     one file (the SIGTERM-file equivalent).
+//
+// Usage:
+//   slice_agent --shared-dir D --process-id I --num-processes N
+//               [--device-glob /dev/accel] [--min-devices 0]
+//               [--poll-ms 100] [--timeout-ms 0] -- payload args...
+//
+// Exit codes: payload's exit code; 3 = device gate timeout, 4 = barrier
+// timeout, 5 = terminated by gang signal, 2 = usage error.
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Options {
+  std::string shared_dir;
+  int process_id = 0;
+  int num_processes = 1;
+  std::string device_glob = "/dev/accel";  // prefix match
+  int min_devices = 0;
+  int poll_ms = 100;
+  long timeout_ms = 0;  // 0 = no timeout
+  std::vector<char*> payload;
+};
+
+void logmsg(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  std::fprintf(stderr, "[slice_agent] ");
+  std::vfprintf(stderr, fmt, ap);
+  std::fprintf(stderr, "\n");
+  va_end(ap);
+}
+
+long now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+bool file_exists(const std::string& path) {
+  struct stat st;
+  return ::stat(path.c_str(), &st) == 0;
+}
+
+// Count directory entries whose full path starts with `prefix`
+// (the /dev/accel* health probe; prefix match keeps it glob-free).
+int count_device_nodes(const std::string& prefix) {
+  auto slash = prefix.find_last_of('/');
+  std::string dir = slash == std::string::npos ? "." : prefix.substr(0, slash);
+  std::string base = slash == std::string::npos ? prefix : prefix.substr(slash + 1);
+  DIR* d = ::opendir(dir.c_str());
+  if (!d) return 0;
+  int n = 0;
+  while (struct dirent* e = ::readdir(d)) {
+    if (std::strncmp(e->d_name, base.c_str(), base.size()) == 0) n++;
+  }
+  ::closedir(d);
+  return n;
+}
+
+bool write_file(const std::string& path, const std::string& content) {
+  std::string tmp = path + ".tmp";
+  int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  ssize_t w = ::write(fd, content.data(), content.size());
+  ::close(fd);
+  if (w != static_cast<ssize_t>(content.size())) return false;
+  return ::rename(tmp.c_str(), path.c_str()) == 0;  // atomic publish
+}
+
+std::string read_file(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) return "";
+  char buf[256];
+  ssize_t n = ::read(fd, buf, sizeof(buf) - 1);
+  ::close(fd);
+  if (n <= 0) return "";
+  buf[n] = 0;
+  // trim trailing whitespace/newline
+  while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == ' ')) buf[--n] = 0;
+  return std::string(buf);
+}
+
+volatile sig_atomic_t g_signaled = 0;
+void on_signal(int) { g_signaled = 1; }
+
+int usage() {
+  std::fprintf(stderr,
+               "usage: slice_agent --shared-dir D --process-id I "
+               "--num-processes N [--device-glob P] [--min-devices M] "
+               "[--poll-ms MS] [--timeout-ms MS] -- payload...\n");
+  return 2;
+}
+
+bool parse_args(int argc, char** argv, Options* o) {
+  int i = 1;
+  for (; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&](long* out) {
+      if (i + 1 >= argc) return false;
+      *out = std::strtol(argv[++i], nullptr, 10);
+      return true;
+    };
+    long v;
+    if (a == "--shared-dir" && i + 1 < argc) o->shared_dir = argv[++i];
+    else if (a == "--process-id" && next(&v)) o->process_id = (int)v;
+    else if (a == "--num-processes" && next(&v)) o->num_processes = (int)v;
+    else if (a == "--device-glob" && i + 1 < argc) o->device_glob = argv[++i];
+    else if (a == "--min-devices" && next(&v)) o->min_devices = (int)v;
+    else if (a == "--poll-ms" && next(&v)) o->poll_ms = (int)v;
+    else if (a == "--timeout-ms" && next(&v)) o->timeout_ms = v;
+    else if (a == "--") { i++; break; }
+    else return false;
+  }
+  for (; i < argc; i++) o->payload.push_back(argv[i]);
+  return !o->shared_dir.empty() && o->num_processes >= 1 &&
+         o->process_id >= 0 && o->process_id < o->num_processes;
+}
+
+std::string sig_path(const Options& o, const std::string& name) {
+  return o.shared_dir + "/" + name;
+}
+
+bool deadline_passed(const Options& o, long start) {
+  return o.timeout_ms > 0 && now_ms() - start > o.timeout_ms;
+}
+
+bool gang_terminated(const Options& o) {
+  return file_exists(sig_path(o, "terminate"));
+}
+
+}  // namespace
+
+// mkdir -p (shared dirs are attempt-scoped subpaths created on demand).
+void mkdirs(const std::string& path) {
+  std::string cur;
+  for (size_t i = 0; i < path.size(); i++) {
+    cur += path[i];
+    if (path[i] == '/' || i + 1 == path.size()) {
+      if (cur != "/") ::mkdir(cur.c_str(), 0755);
+    }
+  }
+}
+
+int main(int argc, char** argv) {
+  Options o;
+  if (!parse_args(argc, argv, &o)) return usage();
+  ::signal(SIGTERM, on_signal);
+  ::signal(SIGINT, on_signal);
+  mkdirs(o.shared_dir);
+  long start = now_ms();
+
+  // 1. Device health gate (the nvidia-driver-poll equivalent,
+  //    reference controller.py:81-90).
+  if (o.min_devices > 0) {
+    while (count_device_nodes(o.device_glob) < o.min_devices) {
+      if (g_signaled || gang_terminated(o)) return 5;
+      if (deadline_passed(o, start)) {
+        logmsg("device gate timeout: <%d nodes at %s*", o.min_devices,
+               o.device_glob.c_str());
+        return 3;
+      }
+      ::usleep(o.poll_ms * 1000);
+    }
+    logmsg("device gate passed (%d nodes at %s*)",
+           count_device_nodes(o.device_glob), o.device_glob.c_str());
+  }
+
+  // 2. Gang barrier: publish readiness; coordinator collects then starts.
+  char rname[64];
+  std::snprintf(rname, sizeof(rname), "ready.%d", o.process_id);
+  if (!write_file(sig_path(o, rname), "1")) {
+    logmsg("cannot write %s", sig_path(o, rname).c_str());
+    return 2;
+  }
+  if (o.process_id == 0) {
+    for (;;) {
+      int ready = 0;
+      for (int j = 0; j < o.num_processes; j++) {
+        char nm[64];
+        std::snprintf(nm, sizeof(nm), "ready.%d", j);
+        if (file_exists(sig_path(o, nm))) ready++;
+      }
+      if (ready == o.num_processes) break;
+      if (g_signaled || gang_terminated(o)) return 5;
+      if (deadline_passed(o, start)) {
+        logmsg("barrier timeout: %d/%d ready", ready, o.num_processes);
+        return 4;
+      }
+      ::usleep(o.poll_ms * 1000);
+    }
+    // the SIGCONT-file equivalent; failing to publish it must not leave
+    // workers waiting forever while the coordinator trains alone
+    if (!write_file(sig_path(o, "start"), "1")) {
+      logmsg("cannot write start signal at %s", sig_path(o, "start").c_str());
+      return 2;
+    }
+    logmsg("gang of %d ready; start signaled", o.num_processes);
+  } else {
+    while (!file_exists(sig_path(o, "start"))) {
+      if (g_signaled || gang_terminated(o)) return 5;
+      if (deadline_passed(o, start)) {
+        logmsg("start-signal timeout");
+        return 4;
+      }
+      ::usleep(o.poll_ms * 1000);
+    }
+  }
+
+  if (o.payload.empty()) {
+    // gate-only mode: used by tests and as an init-container
+    write_file(sig_path(o, "phase." + std::to_string(o.process_id)),
+               "Succeeded");
+    return 0;
+  }
+
+  // 3. Run the payload under supervision.
+  pid_t child = ::fork();
+  if (child < 0) return 2;
+  if (child == 0) {
+    o.payload.push_back(nullptr);
+    ::execvp(o.payload[0], o.payload.data());
+    std::perror("execvp");
+    _exit(127);
+  }
+
+  std::string master_phase = sig_path(o, "phase.0");
+  int status = 0;
+  for (;;) {
+    pid_t r = ::waitpid(child, &status, WNOHANG);
+    if (r == child) break;
+    bool stop = g_signaled || gang_terminated(o);
+    bool gang_succeeded = false;
+    // master-phase watch (reference controller.py:92-102): if the
+    // coordinator's payload finished, the gang is done — stop workers.
+    // Coordinator success means the job is done: stopping a worker then is
+    // itself success (normal teardown skew), not a failure.
+    if (!stop && o.process_id != 0) {
+      std::string ph = read_file(master_phase);
+      if (ph == "Succeeded" || ph == "Failed") {
+        logmsg("coordinator phase=%s; stopping worker payload", ph.c_str());
+        stop = true;
+        gang_succeeded = (ph == "Succeeded");
+      }
+    }
+    if (stop) {
+      ::kill(child, SIGTERM);
+      long tkill = now_ms();
+      while (::waitpid(child, &status, WNOHANG) != child) {
+        if (now_ms() - tkill > 5000) {  // grace period, then hard kill
+          ::kill(child, SIGKILL);
+          ::waitpid(child, &status, 0);
+          break;
+        }
+        ::usleep(o.poll_ms * 1000);
+      }
+      write_file(sig_path(o, "phase." + std::to_string(o.process_id)),
+                 gang_succeeded ? "Succeeded" : "Failed");
+      return gang_succeeded ? 0 : 5;
+    }
+    ::usleep(o.poll_ms * 1000);
+  }
+
+  int code = WIFEXITED(status) ? WEXITSTATUS(status) : 128 + WTERMSIG(status);
+  write_file(sig_path(o, "phase." + std::to_string(o.process_id)),
+             code == 0 ? "Succeeded" : "Failed");
+  logmsg("payload exited %d", code);
+  return code;
+}

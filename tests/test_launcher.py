@@ -1,0 +1,94 @@
+"""In-pod launcher tests: KFT env contract + slice_agent supervision.
+
+The e2e shape the reference drives through real pods (launcher converts env
+→ training run, reference: tf-controller-examples/tf-cnn/launcher.py) —
+here as real OS processes under the native slice_agent.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.native import slice_agent_path
+from kubeflow_tpu.native.build import have_toolchain
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAINING_SPEC = {
+    "model": "mlp",
+    "global_batch_size": 8,
+    "steps": 2,
+    "mesh": {"data": 1},
+    "checkpoint": {"enabled": False},
+}
+
+
+def launcher_env(tmp=None):
+    env = dict(os.environ)
+    env.update(
+        {
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "KFT_TRAINING_SPEC": json.dumps(TRAINING_SPEC),
+            "KFT_JOB_NAME": "launcher-test",
+        }
+    )
+    env.pop("XLA_FLAGS", None)  # single device is enough and compiles faster
+    return env
+
+
+class TestLauncher:
+    def test_runs_training_from_env_spec(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.runtime.launcher"],
+            env=launcher_env(),
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        result = json.loads(out.stdout.strip().splitlines()[-1])
+        assert result["final_step"] == 2
+        assert result["items_per_sec"] > 0
+
+    def test_bad_spec_exits_nonzero(self):
+        env = launcher_env()
+        env["KFT_TRAINING_SPEC"] = json.dumps({"model": "mlp", "dtype": "fp99"})
+        out = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.runtime.launcher"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert out.returncode == 1
+        assert "dtype" in out.stderr
+
+
+@pytest.mark.skipif(not have_toolchain(), reason="no C++ toolchain")
+class TestLauncherUnderAgent:
+    def test_agent_gates_then_launcher_trains(self, tmp_path):
+        """The full pod entrypoint: slice_agent barrier → launcher → phase file."""
+        agent = slice_agent_path()
+        out = subprocess.run(
+            [
+                agent,
+                "--shared-dir", str(tmp_path),
+                "--process-id", "0",
+                "--num-processes", "1",
+                "--poll-ms", "20",
+                "--",
+                sys.executable, "-m", "kubeflow_tpu.runtime.launcher",
+            ],
+            env=launcher_env(),
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert (tmp_path / "phase.0").read_text() == "Succeeded"
+        result = json.loads(out.stdout.strip().splitlines()[-1])
+        assert result["final_step"] == 2

@@ -1,0 +1,127 @@
+"""Native slice_agent tests: device gate, gang barrier, master-phase watch.
+
+Exercises the compiled sidecar the way the reference's openmpi-controller is
+exercised by its gang lifecycle (reference: components/openmpi-controller/
+controller/controller.py) — but hermetically, with fake device nodes and a
+tmp shared volume.
+"""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+from kubeflow_tpu.native import slice_agent_path
+from kubeflow_tpu.native.build import have_toolchain
+
+pytestmark = pytest.mark.skipif(
+    not have_toolchain(), reason="no C++ toolchain"
+)
+
+
+@pytest.fixture(scope="module")
+def agent():
+    return slice_agent_path()
+
+
+def run_agent(agent, shared, pid, n, payload=None, timeout_ms=5000, extra=None):
+    cmd = [
+        agent,
+        "--shared-dir", str(shared),
+        "--process-id", str(pid),
+        "--num-processes", str(n),
+        "--poll-ms", "10",
+        "--timeout-ms", str(timeout_ms),
+    ] + (extra or [])
+    if payload:
+        cmd += ["--"] + payload
+    return subprocess.Popen(cmd, stderr=subprocess.PIPE, text=True)
+
+
+class TestGangBarrier:
+    def test_gang_of_three_starts_together(self, agent, tmp_path):
+        procs = [
+            run_agent(agent, tmp_path, i, 3, payload=["true"]) for i in range(3)
+        ]
+        for p in procs:
+            assert p.wait(timeout=10) == 0
+        assert (tmp_path / "start").exists()
+        for i in range(3):
+            assert (tmp_path / f"phase.{i}").read_text() == "Succeeded"
+
+    def test_barrier_times_out_without_full_gang(self, agent, tmp_path):
+        p = run_agent(agent, tmp_path, 0, 2, timeout_ms=300)
+        assert p.wait(timeout=10) == 4  # barrier timeout
+        assert not (tmp_path / "start").exists()
+
+    def test_worker_waits_for_coordinator_start(self, agent, tmp_path):
+        w = run_agent(agent, tmp_path, 1, 2, payload=["true"], timeout_ms=4000)
+        time.sleep(0.3)
+        assert w.poll() is None  # still waiting, no start signal
+        c = run_agent(agent, tmp_path, 0, 2, payload=["true"])
+        assert c.wait(timeout=10) == 0
+        assert w.wait(timeout=10) == 0
+
+
+class TestDeviceGate:
+    def test_blocks_until_device_nodes_appear(self, agent, tmp_path):
+        devdir = tmp_path / "dev"
+        devdir.mkdir()
+        p = run_agent(
+            agent, tmp_path, 0, 1, payload=["true"], timeout_ms=5000,
+            extra=["--device-glob", str(devdir / "accel"), "--min-devices", "2"],
+        )
+        time.sleep(0.3)
+        assert p.poll() is None  # gated
+        (devdir / "accel0").write_text("")
+        (devdir / "accel1").write_text("")
+        assert p.wait(timeout=10) == 0
+
+    def test_gate_timeout_exit_code(self, agent, tmp_path):
+        devdir = tmp_path / "dev"
+        devdir.mkdir()
+        p = run_agent(
+            agent, tmp_path, 0, 1, timeout_ms=300,
+            extra=["--device-glob", str(devdir / "accel"), "--min-devices", "1"],
+        )
+        assert p.wait(timeout=10) == 3
+
+
+class TestSupervision:
+    def test_payload_failure_writes_failed_phase(self, agent, tmp_path):
+        p = run_agent(agent, tmp_path, 0, 1, payload=["false"])
+        assert p.wait(timeout=10) == 1
+        assert (tmp_path / "phase.0").read_text() == "Failed"
+
+    def test_worker_stops_cleanly_when_coordinator_succeeds(self, agent, tmp_path):
+        # worker runs a long sleep; coordinator finishes instantly → the
+        # master-phase watch terminates the worker payload, and because the
+        # coordinator Succeeded that teardown is itself success
+        w = run_agent(
+            agent, tmp_path, 1, 2, payload=["sleep", "60"], timeout_ms=0
+        )
+        c = run_agent(agent, tmp_path, 0, 2, payload=["true"])
+        assert c.wait(timeout=10) == 0
+        assert w.wait(timeout=15) == 0
+        assert (tmp_path / "phase.1").read_text() == "Succeeded"
+
+    def test_worker_fails_when_coordinator_fails(self, agent, tmp_path):
+        w = run_agent(
+            agent, tmp_path, 1, 2, payload=["sleep", "60"], timeout_ms=0
+        )
+        c = run_agent(agent, tmp_path, 0, 2, payload=["false"])
+        assert c.wait(timeout=10) == 1
+        assert w.wait(timeout=15) == 5
+        assert (tmp_path / "phase.1").read_text() == "Failed"
+
+    def test_terminate_file_stops_gang(self, agent, tmp_path):
+        p = run_agent(agent, tmp_path, 0, 1, payload=["sleep", "60"])
+        time.sleep(0.5)
+        (tmp_path / "terminate").write_text("1")
+        assert p.wait(timeout=15) == 5
+
+    def test_terminate_before_start_aborts(self, agent, tmp_path):
+        (tmp_path / "terminate").write_text("1")
+        p = run_agent(agent, tmp_path, 1, 2, payload=["true"], timeout_ms=0)
+        assert p.wait(timeout=10) == 5
