@@ -1,0 +1,5 @@
+"""API backends (BFF layer): the REST services the UIs talk to.
+
+Each module re-implements one reference backend (SURVEY.md §2.2) on a shared
+stdlib WSGI router — no web framework dependency.
+"""

@@ -1,0 +1,126 @@
+"""Gatekeeper — the basic-auth gateway for clusterless/on-prem deployments.
+
+Re-implements the reference's gatekeeper (reference: components/gatekeeper/
+auth/AuthServer.go): an Ambassador-style auth service. Every request hits
+/auth (:62 ServeHTTP): a valid auth cookie or basic header passes (200, with
+the identity header attached for downstream KFAM/dashboard); anything else
+redirects to the login page (:143-199). POST /apikflogin checks
+username/password against the configured hash and issues the cookie (:118
+authpwd).
+
+Password hashing: PBKDF2-HMAC-SHA256 (stdlib) replacing the reference's
+bcrypt-style compare.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import secrets
+import time
+from typing import Dict, Optional, Tuple
+
+from kubeflow_tpu.api.wsgi import App, BadRequest, HttpError
+
+COOKIE_NAME = "KUBEFLOW-AUTH-KEY"
+LOGIN_PATH = "/kflogin"
+PBKDF2_ITERS = 100_000
+
+
+def hash_password(password: str, salt: Optional[bytes] = None) -> str:
+    salt = salt or secrets.token_bytes(16)
+    digest = hashlib.pbkdf2_hmac(
+        "sha256", password.encode(), salt, PBKDF2_ITERS
+    )
+    return f"pbkdf2${salt.hex()}${digest.hex()}"
+
+
+def check_password(password: str, stored: str) -> bool:
+    try:
+        scheme, salt_hex, digest_hex = stored.split("$")
+        if scheme != "pbkdf2":
+            return False
+        digest = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), bytes.fromhex(salt_hex), PBKDF2_ITERS
+        )
+        return hmac.compare_digest(digest.hex(), digest_hex)
+    except ValueError:
+        return False
+
+
+class Gatekeeper:
+    def __init__(
+        self,
+        username: str,
+        password_hash: str,
+        user_header: str = "x-auth-user-email",
+        session_ttl_s: float = 24 * 3600,
+    ):
+        self.username = username
+        self.password_hash = password_hash
+        self.user_header = user_header
+        self.session_ttl_s = session_ttl_s
+        self._sessions: Dict[str, Tuple[str, float]] = {}  # token -> (user, exp)
+        self.app = self._build()
+
+    def _issue_session(self, user: str) -> str:
+        token = secrets.token_urlsafe(32)
+        self._sessions[token] = (user, time.time() + self.session_ttl_s)
+        return token
+
+    def _session_user(self, token: str) -> Optional[str]:
+        entry = self._sessions.get(token)
+        if entry is None:
+            return None
+        user, exp = entry
+        if time.time() > exp:
+            self._sessions.pop(token, None)
+            return None
+        return user
+
+    def _build(self) -> App:
+        app = App("gatekeeper")
+
+        @app.post("/apikflogin")
+        def login(req):
+            body = req.body or {}
+            username = body.get("username", "")
+            password = body.get("password", "")
+            if not username or not password:
+                raise BadRequest("username and password required")
+            if username != self.username or not check_password(
+                password, self.password_hash
+            ):
+                raise HttpError(401, "invalid credentials")
+            token = self._issue_session(username)
+            req.response_headers.append(
+                (
+                    "Set-Cookie",
+                    f"{COOKIE_NAME}={token}; Path=/; HttpOnly",
+                )
+            )
+            return {"success": True, "user": username}
+
+        @app.get("/auth")
+        def auth(req):
+            # the Ambassador auth-service contract: 200 passes the original
+            # request through (with identity attached), 301 sends to login
+            token = req.cookies().get(COOKIE_NAME, "")
+            user = self._session_user(token) if token else None
+            if user is None:
+                req.response_headers.append(("Location", LOGIN_PATH))
+                return {"success": False, "log": "login required"}, 301
+            req.response_headers.append((self.user_header, user))
+            return {"success": True, "user": user}
+
+        @app.post("/logout")
+        def logout(req):
+            token = req.cookies().get(COOKIE_NAME, "")
+            self._sessions.pop(token, None)
+            req.response_headers.append(
+                ("Set-Cookie", f"{COOKIE_NAME}=; Path=/; Max-Age=0")
+            )
+            return {"success": True}
+
+        return app

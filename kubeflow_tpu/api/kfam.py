@@ -1,0 +1,190 @@
+"""KFAM — the workgroup access-management API.
+
+Re-implements the reference's access-management service (reference:
+components/access-management/kfam/): profile create/delete and contributor
+binding create/delete/list over REST (api_default.go:93-268, router table
+routers.go:31-101), guarded by isOwnerOrAdmin (:292) against the trusted
+identity header (main.go:37-39). A contributor binding materializes as a
+RoleBinding plus the Istio-side authorization entry (bindings.go:76-128),
+with the admin/edit/view → ClusterRole map (bindings.go:37-44).
+
+Routes (reference routers.go):
+- GET    /kfam/v1/bindings?namespace=<ns>
+- POST   /kfam/v1/bindings                {user, referredNamespace, role}
+- DELETE /kfam/v1/bindings                same body
+- POST   /kfam/v1/profiles               {name, user}
+- DELETE /kfam/v1/profiles/<name>
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from kubeflow_tpu.cluster.objects import new_object
+from kubeflow_tpu.cluster.store import AlreadyExists, NotFound, StateStore
+from kubeflow_tpu.api.wsgi import App, BadRequest, Forbidden, NotFoundError
+from kubeflow_tpu.controllers.profile import (
+    ADMIN_ROLE,
+    EDIT_ROLE,
+    OWNER_ANNOTATION,
+    VIEW_ROLE,
+    new_profile,
+)
+
+ROLE_MAP = {"admin": ADMIN_ROLE, "edit": EDIT_ROLE, "view": VIEW_ROLE}
+
+
+def binding_name(user: str, role: str) -> str:
+    # reference bindings.go: user-<email>-clusterrole-<role> (flattened)
+    safe = user.replace("@", "-").replace(".", "-").lower()
+    return f"user-{safe}-clusterrole-{ROLE_MAP[role]}"
+
+
+def is_owner_or_admin(store: StateStore, user: str, namespace: str) -> bool:
+    """reference api_default.go:292 isOwnerOrAdmin."""
+    ns = store.try_get("Namespace", namespace, namespace)
+    if ns is not None and (
+        ns["metadata"].get("annotations", {}).get(OWNER_ANNOTATION) == user
+    ):
+        return True
+    for rb in store.list("RoleBinding", namespace):
+        if rb.get("spec", {}).get("roleRef", {}).get("name") != ADMIN_ROLE:
+            continue
+        for s in rb.get("spec", {}).get("subjects", []):
+            if s.get("kind") == "User" and s.get("name") == user:
+                return True
+    return False
+
+
+def build_app(
+    store: StateStore,
+    user_header: str = "x-auth-user-email",
+    user_prefix: str = "",
+    cluster_admins: Optional[set] = None,
+) -> App:
+    app = App("kfam", user_header=user_header, user_prefix=user_prefix)
+    cluster_admins = cluster_admins or set()
+
+    def guard(user: str, namespace: str) -> None:
+        if not user:
+            raise Forbidden("no user identity")
+        if user in cluster_admins:
+            return
+        if not is_owner_or_admin(store, user, namespace):
+            raise Forbidden(f"{user} is not owner/admin of {namespace}")
+
+    @app.get("/kfam/v1/bindings")
+    def list_bindings(req):
+        ns = req.query.get("namespace", "")
+        if not ns:
+            raise BadRequest("namespace query param required")
+        out = []
+        for rb in store.list("RoleBinding", ns):
+            role_ref = rb.get("spec", {}).get("roleRef", {}).get("name", "")
+            role = next((k for k, v in ROLE_MAP.items() if v == role_ref), None)
+            if role is None:
+                continue
+            for s in rb.get("spec", {}).get("subjects", []):
+                if s.get("kind") == "User":
+                    out.append(
+                        {
+                            "user": {"kind": "User", "name": s["name"]},
+                            "referredNamespace": ns,
+                            "roleRef": {"kind": "ClusterRole", "name": role_ref},
+                            "role": role,
+                        }
+                    )
+        return {"bindings": out}
+
+    @app.post("/kfam/v1/bindings")
+    def create_binding(req):
+        body = req.body or {}
+        user = body.get("user", "")
+        ns = body.get("referredNamespace", "")
+        role = body.get("role", "edit")
+        if not user or not ns:
+            raise BadRequest("user and referredNamespace required")
+        if role not in ROLE_MAP:
+            raise BadRequest(f"role must be one of {sorted(ROLE_MAP)}")
+        guard(req.user, ns)
+        rb = new_object(
+            "RoleBinding",
+            binding_name(user, role),
+            ns,
+            api_version="rbac.authorization.k8s.io/v1",
+            annotations={"role": role, "user": user},
+            spec={
+                "roleRef": {"kind": "ClusterRole", "name": ROLE_MAP[role]},
+                "subjects": [{"kind": "User", "name": user}],
+            },
+        )
+        try:
+            store.create(rb)
+        except AlreadyExists:
+            raise BadRequest(f"binding for {user} role {role} exists in {ns}")
+        # Istio-side allow entry: add the contributor to the namespace's
+        # AuthorizationPolicy (the SRB-write of bindings.go:96-128). Values
+        # are prefix-qualified to match the raw header the mesh compares
+        # (the profile controller writes the owner the same way).
+        ap = store.try_get("AuthorizationPolicy", "ns-owner-access-istio", ns)
+        if ap is not None:
+            values = ap["spec"]["rules"][0]["when"][0]["values"]
+            qualified = f"{user_prefix}{user}"
+            if qualified not in values:
+                values.append(qualified)
+                store.update(ap)
+        return {"success": True}, 201
+
+    @app.delete("/kfam/v1/bindings")
+    def delete_binding(req):
+        body = req.body or {}
+        user = body.get("user", "")
+        ns = body.get("referredNamespace", "")
+        role = body.get("role", "edit")
+        if role not in ROLE_MAP:
+            raise BadRequest(f"role must be one of {sorted(ROLE_MAP)}")
+        guard(req.user, ns)
+        try:
+            store.delete("RoleBinding", binding_name(user, role), ns)
+        except NotFound:
+            raise NotFoundError(f"no {role} binding for {user} in {ns}")
+        # drop the Istio allow entry only when no binding in ANY role remains
+        still_bound = any(
+            store.try_get("RoleBinding", binding_name(user, r), ns) is not None
+            for r in ROLE_MAP
+        )
+        ap = store.try_get("AuthorizationPolicy", "ns-owner-access-istio", ns)
+        if ap is not None and not still_bound:
+            values = ap["spec"]["rules"][0]["when"][0]["values"]
+            qualified = f"{user_prefix}{user}"
+            if qualified in values:
+                values.remove(qualified)
+                store.update(ap)
+        return {"success": True}
+
+    @app.post("/kfam/v1/profiles")
+    def create_profile(req):
+        body = req.body or {}
+        name = body.get("name", "")
+        owner = body.get("user", req.user)
+        if not name:
+            raise BadRequest("name required")
+        if not req.user:
+            raise Forbidden("no user identity")
+        try:
+            store.create(new_profile(name, owner))
+        except AlreadyExists:
+            raise BadRequest(f"profile {name} exists")
+        return {"success": True}, 201
+
+    @app.delete("/kfam/v1/profiles/<name>")
+    def delete_profile(req):
+        name = req.params["name"]
+        guard(req.user, name)
+        try:
+            store.delete("Profile", name, "kubeflow")
+        except NotFound:
+            raise NotFoundError(f"profile {name} not found")
+        return {"success": True}
+
+    return app

@@ -1,0 +1,202 @@
+"""Notebook spawner backend — the jupyter-web-app equivalent.
+
+Re-implements the reference's Flask backend (reference: components/
+jupyter-web-app/backend/kubeflow_jupyter/common/base_app.py:22-175 routes,
+common/utils.py:88 spawner_ui_config + :338-513 form→CR assembly,
+common/api.py:80-193 SubjectAccessReview-gated k8s calls) against the
+platform StateStore:
+
+- GET  /api/config                                  spawner form defaults
+- GET  /api/namespaces/<ns>/notebooks               list (with status)
+- POST /api/namespaces/<ns>/notebooks               create from form
+- DELETE /api/namespaces/<ns>/notebooks/<name>      delete
+- GET  /api/namespaces/<ns>/pvcs                    list volumes
+- GET  /api/namespaces/<ns>/poddefaults             available configurations
+
+TPU-first: the form's accelerator field is a TPU topology (v5e-1/v5e-4/…)
+instead of the reference's GPU vendor dropdown (utils.py:392-413).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from kubeflow_tpu.cluster.objects import new_object
+from kubeflow_tpu.cluster.store import AlreadyExists, NotFound, StateStore
+from kubeflow_tpu.config.core import to_dict
+from kubeflow_tpu.config.platform import TPU_TOPOLOGIES, NotebookDefaults
+from kubeflow_tpu.api.wsgi import App, Authorizer, BadRequest, NotFoundError
+from kubeflow_tpu.controllers.notebook import new_notebook
+
+
+def notebook_summary(nb: Dict[str, Any], store: StateStore) -> Dict[str, Any]:
+    m = nb["metadata"]
+    status = nb.get("status", {})
+    ready = any(
+        c.get("type") == "Ready" and c.get("status") == "True"
+        for c in status.get("conditions", [])
+    )
+    container = (
+        nb.get("spec", {})
+        .get("template", {})
+        .get("spec", {})
+        .get("containers", [{}])[0]
+    )
+    return {
+        "name": m["name"],
+        "namespace": m["namespace"],
+        "image": container.get("image", ""),
+        "tpu": (nb.get("spec", {}).get("tpu") or {}).get("topology", ""),
+        "status": "running" if ready else (
+            "stopped"
+            if "kubeflow-resource-stopped" in m.get("annotations", {})
+            else "waiting"
+        ),
+        "age": m.get("creationTimestamp", ""),
+        "shortImage": container.get("image", "").split("/")[-1],
+    }
+
+
+def build_app(
+    store: StateStore,
+    defaults: Optional[NotebookDefaults] = None,
+    authorizer: Optional[Authorizer] = None,
+    user_header: str = "x-auth-user-email",
+    user_prefix: str = "",
+) -> App:
+    defaults = defaults or NotebookDefaults()
+    app = App(
+        "spawner",
+        user_header=user_header,
+        user_prefix=user_prefix,
+        authorizer=authorizer,
+    )
+
+    @app.get("/api/config")
+    def get_config(req):
+        cfg = to_dict(defaults)
+        cfg["tpu_topologies"] = [""] + sorted(
+            TPU_TOPOLOGIES, key=lambda t: (t.split("-")[0], TPU_TOPOLOGIES[t]["chips"])
+        )
+        return {"success": True, "config": cfg}
+
+    @app.get("/api/namespaces/<ns>/notebooks")
+    def list_notebooks(req):
+        app.require(req.user, "list", "notebooks", req.params["ns"])
+        items = [
+            notebook_summary(nb, store)
+            for nb in store.list("Notebook", req.params["ns"])
+        ]
+        return {"success": True, "notebooks": items}
+
+    @app.post("/api/namespaces/<ns>/notebooks")
+    def create_notebook(req):
+        ns = req.params["ns"]
+        app.require(req.user, "create", "notebooks", ns)
+        form = req.body or {}
+        name = form.get("name", "")
+        if not name or not name.replace("-", "").isalnum():
+            raise BadRequest(f"invalid notebook name {name!r}")
+        tpu = form.get("tpu", "") or form.get("tpuTopology", "")
+        if tpu and tpu not in TPU_TOPOLOGIES:
+            raise BadRequest(
+                f"unknown TPU topology {tpu!r}; known: {sorted(TPU_TOPOLOGIES)}"
+            )
+        workspace_pvc = None
+        if form.get("workspace", True):
+            # workspace volume (reference utils.py:200-249 get_workspace_vol)
+            workspace_pvc = f"workspace-{name}"
+            pvc = new_object(
+                "PersistentVolumeClaim",
+                workspace_pvc,
+                ns,
+                api_version="v1",
+                spec={
+                    "accessModes": ["ReadWriteOnce"],
+                    "resources": {
+                        "requests": {
+                            "storage": form.get(
+                                "workspaceSize", defaults.workspace_size
+                            )
+                        }
+                    },
+                },
+            )
+            try:
+                store.create(pvc)
+            except AlreadyExists:
+                pass
+        nb = new_notebook(
+            name,
+            ns,
+            image=form.get("image", defaults.image),
+            cpu=str(form.get("cpu", defaults.cpu)),
+            memory=form.get("memory", defaults.memory),
+            tpu_topology=tpu,
+            workspace_pvc=workspace_pvc,
+            pod_default_labels=form.get("configurations") or None,
+        )
+        try:
+            store.create(nb)
+        except AlreadyExists:
+            raise BadRequest(f"notebook {name} already exists")
+        return {"success": True, "log": f"created notebook {ns}/{name}"}, 201
+
+    @app.delete("/api/namespaces/<ns>/notebooks/<name>")
+    def delete_notebook(req):
+        ns, name = req.params["ns"], req.params["name"]
+        app.require(req.user, "delete", "notebooks", ns)
+        try:
+            store.delete("Notebook", name, ns)
+        except NotFound:
+            raise NotFoundError(f"notebook {ns}/{name} not found")
+        # owned children (StatefulSet/Service/VirtualService/pods) are GC'd by
+        # ownership; the workspace PVC survives by design (data retention)
+        for kind in ("StatefulSet", "Service"):
+            try:
+                store.delete(kind, name, ns)
+            except NotFound:
+                pass
+        try:
+            store.delete("VirtualService", f"notebook-{ns}-{name}", ns)
+        except NotFound:
+            pass
+        try:
+            store.delete("Pod", f"{name}-0", ns)
+        except NotFound:
+            pass
+        return {"success": True, "log": f"deleted notebook {ns}/{name}"}
+
+    @app.get("/api/namespaces/<ns>/pvcs")
+    def list_pvcs(req):
+        ns = req.params["ns"]
+        app.require(req.user, "list", "persistentvolumeclaims", ns)
+        return {
+            "success": True,
+            "pvcs": [
+                {
+                    "name": p["metadata"]["name"],
+                    "size": p["spec"]["resources"]["requests"]["storage"],
+                    "mode": p["spec"]["accessModes"][0],
+                }
+                for p in store.list("PersistentVolumeClaim", ns)
+            ],
+        }
+
+    @app.get("/api/namespaces/<ns>/poddefaults")
+    def list_poddefaults(req):
+        ns = req.params["ns"]
+        app.require(req.user, "list", "poddefaults", ns)
+        return {
+            "success": True,
+            "poddefaults": [
+                {
+                    "name": pd["metadata"]["name"],
+                    "desc": pd["spec"].get("desc", pd["metadata"]["name"]),
+                    "selector": pd["spec"].get("selector", {}),
+                }
+                for pd in store.list("PodDefault", ns)
+            ],
+        }
+
+    return app

@@ -1,0 +1,298 @@
+"""Minimal WSGI micro-framework for the platform's REST backends.
+
+The reference's backends span Flask (jupyter-web-app), Express (dashboard)
+and go-kit (bootstrap, KFAM). The platform standardizes on one tiny stdlib
+router so every backend is hermetic and testable without a web framework:
+
+- path patterns with <named> segments,
+- JSON in/out, error envelope {"success": false, "log": msg} shaped like the
+  reference's Flask responses (jupyter-web-app base_app.py),
+- trusted-header identity (reference: access-management/main.go:37-39 reads
+  `x-goog-authenticated-user-email` with an `accounts.google.com:` prefix;
+  dashboard attach_user_middleware.ts does the same),
+- a pluggable authorizer called per request — the SubjectAccessReview gate
+  (reference: jupyter-web-app common/api.py:80-193 decorates every k8s call
+  with an auth check).
+
+Served with wsgiref for real-socket tests; unit tests call the app directly.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import default_registry
+
+log = get_logger(__name__)
+
+Handler = Callable[["Request"], Any]
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Forbidden(HttpError):
+    def __init__(self, message: str = "forbidden"):
+        super().__init__(403, message)
+
+
+class NotFoundError(HttpError):
+    def __init__(self, message: str = "not found"):
+        super().__init__(404, message)
+
+
+class BadRequest(HttpError):
+    def __init__(self, message: str = "bad request"):
+        super().__init__(400, message)
+
+
+class Request:
+    def __init__(
+        self,
+        method: str,
+        path: str,
+        params: Dict[str, str],
+        body: Any,
+        headers: Dict[str, str],
+        user: str,
+        query: Dict[str, str],
+    ):
+        self.method = method
+        self.path = path
+        self.params = params
+        self.body = body
+        self.headers = headers
+        self.user = user
+        self.query = query
+        # handlers may append (name, value) pairs (Set-Cookie, Location, …)
+        self.response_headers: List[Tuple[str, str]] = []
+
+    def cookies(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for part in self.headers.get("cookie", "").split(";"):
+            if "=" in part:
+                k, v = part.strip().split("=", 1)
+                out[k] = v
+        return out
+
+
+# SubjectAccessReview-shaped authorizer: (user, verb, resource, namespace)
+Authorizer = Callable[[str, str, str, str], bool]
+
+
+def allow_all(user: str, verb: str, resource: str, namespace: str) -> bool:
+    return True
+
+
+_STATUS_TEXT = {
+    200: "200 OK",
+    201: "201 Created",
+    301: "301 Moved Permanently",
+    302: "302 Found",
+    400: "400 Bad Request",
+    401: "401 Unauthorized",
+    403: "403 Forbidden",
+    404: "404 Not Found",
+    405: "405 Method Not Allowed",
+    409: "409 Conflict",
+    500: "500 Internal Server Error",
+}
+
+
+class App:
+    """Route table + WSGI callable."""
+
+    def __init__(
+        self,
+        name: str,
+        user_header: str = "x-auth-user-email",
+        user_prefix: str = "",
+        authorizer: Optional[Authorizer] = None,
+    ):
+        self.name = name
+        self.user_header = user_header
+        self.user_prefix = user_prefix
+        self.authorizer: Authorizer = authorizer or allow_all
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        reg = default_registry()
+        self._requests = reg.counter(
+            "http_requests_total", "requests", ["app", "method", "status"]
+        )
+        self._latency = reg.histogram(
+            "http_request_seconds", "request latency", ["app"]
+        )
+
+    def route(self, method: str, pattern: str):
+        regex = re.compile(
+            "^" + re.sub(r"<([a-zA-Z_]+)>", r"(?P<\1>[^/]+)", pattern) + "$"
+        )
+
+        def deco(fn: Handler):
+            self._routes.append((method.upper(), regex, fn))
+            return fn
+
+        return deco
+
+    def get(self, pattern: str):
+        return self.route("GET", pattern)
+
+    def post(self, pattern: str):
+        return self.route("POST", pattern)
+
+    def delete(self, pattern: str):
+        return self.route("DELETE", pattern)
+
+    def patch(self, pattern: str):
+        return self.route("PATCH", pattern)
+
+    # -- direct-call interface (unit tests, in-process clients) -----------
+
+    def handle(
+        self,
+        method: str,
+        path: str,
+        body: Any = None,
+        headers: Optional[Dict[str, str]] = None,
+        query: Optional[Dict[str, str]] = None,
+    ) -> Tuple[int, Any]:
+        status, result, _ = self.handle_full(method, path, body, headers, query)
+        return status, result
+
+    def handle_full(
+        self,
+        method: str,
+        path: str,
+        body: Any = None,
+        headers: Optional[Dict[str, str]] = None,
+        query: Optional[Dict[str, str]] = None,
+    ) -> Tuple[int, Any, List[Tuple[str, str]]]:
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        raw_user = headers.get(self.user_header.lower(), "")
+        user = raw_user[len(self.user_prefix):] if raw_user.startswith(
+            self.user_prefix
+        ) else raw_user
+        matched_path = False
+        for m, regex, fn in self._routes:
+            match = regex.match(path)
+            if match is None:
+                continue
+            matched_path = True
+            if m != method.upper():
+                continue
+            req = Request(
+                method.upper(), path, match.groupdict(), body, headers, user,
+                dict(query or {}),
+            )
+            try:
+                with self._latency.time(app=self.name):
+                    result = fn(req)
+                status = 200
+                if isinstance(result, tuple):
+                    result, status = result
+            except HttpError as e:
+                result, status = {"success": False, "log": e.message}, e.status
+            except Exception:
+                log.error(
+                    "%s %s %s failed:\n%s",
+                    self.name,
+                    method,
+                    path,
+                    traceback.format_exc(),
+                )
+                result, status = {"success": False, "log": "internal error"}, 500
+            self._requests.inc(
+                app=self.name, method=method.upper(), status=str(status)
+            )
+            return status, result, req.response_headers
+        if matched_path:
+            return (
+                405,
+                {"success": False, "log": f"method {method} not allowed"},
+                [],
+            )
+        return 404, {"success": False, "log": f"no route for {path}"}, []
+
+    def require(self, user: str, verb: str, resource: str, namespace: str) -> None:
+        """The per-request SubjectAccessReview gate."""
+        if not user:
+            raise HttpError(401, "no user identity")
+        if not self.authorizer(user, verb, resource, namespace):
+            raise Forbidden(
+                f"user {user} cannot {verb} {resource} in {namespace}"
+            )
+
+    # -- WSGI -------------------------------------------------------------
+
+    def __call__(self, environ, start_response):
+        method = environ["REQUEST_METHOD"]
+        path = environ.get("PATH_INFO", "/")
+        query: Dict[str, str] = {}
+        for part in environ.get("QUERY_STRING", "").split("&"):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                query[k] = v
+        headers = {
+            k[5:].replace("_", "-").lower(): v
+            for k, v in environ.items()
+            if k.startswith("HTTP_")
+        }
+        body = None
+        try:
+            length = int(environ.get("CONTENT_LENGTH") or 0)
+        except ValueError:
+            length = 0
+        if length:
+            raw = environ["wsgi.input"].read(length)
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                start_response(_STATUS_TEXT[400], [("Content-Type", "application/json")])
+                return [json.dumps({"success": False, "log": "invalid JSON"}).encode()]
+        status, result, extra_headers = self.handle_full(
+            method, path, body, headers, query
+        )
+        payload = json.dumps(result).encode()
+        start_response(
+            _STATUS_TEXT.get(status, f"{status} Unknown"),
+            [
+                ("Content-Type", "application/json"),
+                ("Content-Length", str(len(payload))),
+            ]
+            + list(extra_headers),
+        )
+        return [payload]
+
+
+class Server:
+    """wsgiref server on a background thread (real-socket tests/demos)."""
+
+    def __init__(self, app: App, host: str = "127.0.0.1", port: int = 0):
+        from wsgiref.simple_server import WSGIRequestHandler, make_server
+
+        class QuietHandler(WSGIRequestHandler):
+            def log_message(self, *args):  # noqa: ARG002
+                pass
+
+        self._httpd = make_server(host, port, app, handler_class=QuietHandler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=2)

@@ -37,6 +37,10 @@ class AlreadyExists(RuntimeError):
     pass
 
 
+class AdmissionDenied(RuntimeError):
+    """Raised by an admission hook to reject an object create."""
+
+
 Key = Tuple[str, str, str]  # (kind, namespace, name)
 
 
@@ -90,10 +94,24 @@ class StateStore:
         self._lock = threading.RLock()
         self._rv_counter = 0
         self._watches: List[_Watch] = []
+        # Mutating-admission hooks by kind, run on create before persist —
+        # the interception point the reference implements as a webhook server
+        # (reference: components/admission-webhook/main.go:389 mutatePods).
+        self._admission_hooks: Dict[str, List[Callable[[Dict[str, Any]], None]]] = {}
         reg = default_registry()
         self._writes = reg.counter(
             "statestore_writes_total", "writes", ["kind", "op"]
         )
+
+    def add_admission_hook(
+        self, kind: str, hook: Callable[[Dict[str, Any]], None]
+    ) -> None:
+        """Register a mutating hook invoked on every create of `kind`.
+
+        The hook mutates the object in place; raising AdmissionDenied rejects
+        the create (the webhook allowed/denied contract)."""
+        with self._lock:
+            self._admission_hooks.setdefault(kind, []).append(hook)
 
     # -- internals -------------------------------------------------------
 
@@ -122,6 +140,8 @@ class StateStore:
             key = self._key(kind, namespace, name)
             if key in self._objects:
                 raise AlreadyExists(f"{kind} {namespace}/{name} exists")
+            for hook in self._admission_hooks.get(kind, []):
+                hook(obj)
             m["uid"] = m.get("uid") or fresh_uid()
             m["resourceVersion"] = self._next_rv()
             m["creationTimestamp"] = now_iso()

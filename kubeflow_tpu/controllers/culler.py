@@ -1,0 +1,103 @@
+"""Idle-notebook culling.
+
+Reimplements the reference's culler (reference: components/notebook-controller/
+pkg/culler/culler.go): probe the notebook server's /api/status endpoint,
+compare `last_activity` to the idle threshold, and stamp the
+`kubeflow-resource-stopped` annotation, which scales the notebook to zero
+(culler.go:37 STOP_ANNOTATION, :138-169 status fetch, :191
+NotebookNeedsCulling). Knobs keep the reference's env-variable names
+(culler.go:24-27).
+
+The activity probe is pluggable: the default probes HTTP like the reference;
+tests inject a fake (the platform's hermetic-CI requirement, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import os
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+STOP_ANNOTATION = "kubeflow-resource-stopped"
+LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+
+# Reference env knobs (culler.go:24-27).
+ENV_ENABLE_CULLING = "ENABLE_CULLING"
+ENV_IDLE_TIME = "IDLE_TIME"  # minutes
+ENV_CULLING_CHECK_PERIOD = "CULLING_CHECK_PERIOD"  # minutes
+
+DEFAULT_IDLE_MINUTES = 1440
+DEFAULT_CHECK_PERIOD_MINUTES = 1
+
+ActivityProbe = Callable[[Dict[str, Any]], Optional[dt.datetime]]
+
+
+def culling_enabled() -> bool:
+    return os.environ.get(ENV_ENABLE_CULLING, "false").lower() == "true"
+
+
+def idle_minutes() -> float:
+    # float (not the reference's int) so sub-minute thresholds work in demos
+    try:
+        return float(os.environ.get(ENV_IDLE_TIME, DEFAULT_IDLE_MINUTES))
+    except ValueError:
+        return DEFAULT_IDLE_MINUTES
+
+
+def check_period_minutes() -> float:
+    try:
+        return float(
+            os.environ.get(ENV_CULLING_CHECK_PERIOD, DEFAULT_CHECK_PERIOD_MINUTES)
+        )
+    except ValueError:
+        return DEFAULT_CHECK_PERIOD_MINUTES
+
+
+def http_activity_probe(notebook: Dict[str, Any]) -> Optional[dt.datetime]:
+    """GET http://<name>.<ns>/api/status and parse last_activity
+    (reference culler.go:138-169). Returns None if unreachable."""
+    m = notebook["metadata"]
+    url = f"http://{m['name']}.{m['namespace']}.svc.cluster.local/api/status"
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            payload = json.loads(resp.read())
+        return dt.datetime.fromisoformat(
+            payload["last_activity"].replace("Z", "+00:00")
+        )
+    except Exception as e:
+        log.debug("activity probe %s failed: %s", url, e)
+        return None
+
+
+def is_stopped(notebook: Dict[str, Any]) -> bool:
+    return STOP_ANNOTATION in notebook["metadata"].get("annotations", {})
+
+
+def needs_culling(
+    notebook: Dict[str, Any],
+    probe: ActivityProbe,
+    now: Optional[dt.datetime] = None,
+) -> bool:
+    """True if the notebook is idle past the threshold
+    (reference culler.go:191 NotebookNeedsCulling)."""
+    if not culling_enabled():
+        return False
+    if is_stopped(notebook):
+        return False
+    last = probe(notebook)
+    if last is None:
+        return False  # unreachable ≠ idle (matches reference's bail-out)
+    now = now or dt.datetime.now(dt.timezone.utc)
+    if last.tzinfo is None:
+        last = last.replace(tzinfo=dt.timezone.utc)
+    return (now - last) >= dt.timedelta(minutes=idle_minutes())
+
+
+def stop_annotation_value() -> str:
+    return dt.datetime.now(dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")

@@ -47,7 +47,10 @@ from kubeflow_tpu.controllers.helpers import (
     list_owned,
     remove_finalizer,
 )
-from kubeflow_tpu.parallel.distributed import render_gang_env
+from kubeflow_tpu.parallel.distributed import (
+    DEFAULT_COORDINATOR_PORT,
+    render_gang_env,
+)
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import default_registry
 
@@ -250,6 +253,13 @@ class TPUTrainJobController(Controller):
                     "DeadlineExceeded",
                     f"active for {elapsed:.0f}s > {deadline}s",
                 )
+                # deadline always reclaims the slice (k8s Job semantics),
+                # independent of cleanPodPolicy
+                for n in desired:
+                    try:
+                        store.delete("Pod", n, namespace)
+                    except KeyError:
+                        pass
                 return Result()
 
         if any(p == FAILED for p in phases):
@@ -282,7 +292,9 @@ class TPUTrainJobController(Controller):
             spec={
                 "clusterIP": "None",  # headless: per-pod DNS
                 "selector": {JOB_NAME_LABEL: m["name"]},
-                "ports": [{"name": "coordinator", "port": 8476}],
+                "ports": [
+                    {"name": "coordinator", "port": DEFAULT_COORDINATOR_PORT}
+                ],
             },
             labels={JOB_NAME_LABEL: m["name"]},
         )

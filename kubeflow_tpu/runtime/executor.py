@@ -167,23 +167,25 @@ class PodExecutor:
         fresh["status"]["phase"] = phase
         if info:
             fresh["status"].update(info)
+            if "items_per_sec" in info:
+                ann = fresh["metadata"].setdefault("annotations", {})
+                ann["kubeflow-tpu.dev/items-per-sec"] = info["items_per_sec"]
         try:
-            self.store.patch_status("Pod", m["name"], m["namespace"], fresh["status"])
-        except NotFound:
-            pass
-        if info and "items_per_sec" in info:
-            ann = fresh["metadata"].setdefault("annotations", {})
-            ann["kubeflow-tpu.dev/items-per-sec"] = info["items_per_sec"]
-            fresh["metadata"]["resourceVersion"] = ""
+            # one optimistic write for status + annotation (single watch event)
+            self.store.update(fresh)
+        except (NotFound, Conflict) as e:
+            log.warning(
+                "pod %s/%s phase write lost (%s); retrying status only",
+                m["namespace"],
+                m["name"],
+                e,
+            )
             try:
-                self.store.update(fresh)
-            except (NotFound, Conflict) as e:
-                log.warning(
-                    "dropping throughput annotation on %s/%s: %s",
-                    m["namespace"],
-                    m["name"],
-                    e,
+                self.store.patch_status(
+                    "Pod", m["name"], m["namespace"], fresh["status"]
                 )
+            except NotFound:
+                pass
 
     def tick(self) -> int:
         """Advance every eligible pod one phase; returns transitions made."""

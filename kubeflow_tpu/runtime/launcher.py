@@ -35,13 +35,10 @@ def run(config_path: Optional[str] = None, steps: Optional[int] = None) -> int:
     from kubeflow_tpu.runtime.train_run import run_training
 
     if config_path:
-        with open(config_path) as f:
-            spec = json.load(f) if config_path.endswith(".json") else None
-        if spec is None:
-            import yaml
+        import yaml  # YAML is a JSON superset; one loader covers both
 
-            with open(config_path) as f:
-                spec = yaml.safe_load(f)
+        with open(config_path) as f:
+            spec = yaml.safe_load(f)
     else:
         spec = json.loads(os.environ.get(ENV_TRAINING_SPEC, "{}"))
     cfg = from_dict(TrainingConfig, spec)

@@ -1,0 +1,335 @@
+"""Notebook path tests: controller, culler, PodDefaults webhook, spawner API.
+
+Mirrors the reference's T1 controller tests + culler tests + webhook merge
+tests (SURVEY.md §4; reference: notebook_controller_test.go,
+pkg/culler/culler_test.go, admission-webhook/main_test.go) plus the spawner
+API flow from §3.2 driven end-to-end against the state store.
+"""
+
+import datetime as dt
+
+import pytest
+
+from kubeflow_tpu.cluster.reconciler import ControllerManager
+from kubeflow_tpu.cluster.store import AdmissionDenied, StateStore
+from kubeflow_tpu.controllers import culler, poddefaults
+from kubeflow_tpu.controllers.notebook import NotebookController, new_notebook
+from kubeflow_tpu.controllers.statefulset import StatefulSetController
+from kubeflow_tpu.api.spawner import build_app
+
+
+def make_harness(activity_probe=None):
+    store = StateStore()
+    cm = ControllerManager(store)
+    cm.register(StatefulSetController())
+    cm.register(NotebookController(activity_probe=activity_probe))
+    return store, cm
+
+
+def run_pod(store, name, ns="default"):
+    store.patch_status("Pod", name, ns, {"phase": "Running"})
+
+
+class TestNotebookController:
+    def test_creates_statefulset_service_virtualservice(self):
+        store, cm = make_harness()
+        store.create(new_notebook("wb", "team-a", tpu_topology="v5e-1"))
+        cm.run_until_idle(max_seconds=5)
+        sts = store.get("StatefulSet", "wb", "team-a")
+        assert sts["spec"]["replicas"] == 1
+        pod_spec = sts["spec"]["template"]["spec"]
+        c = pod_spec["containers"][0]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["NB_PREFIX"] == "/notebook/team-a/wb"
+        assert c["resources"]["limits"]["google.com/tpu"] == "1"
+        assert pod_spec["securityContext"]["fsGroup"] == 100
+        assert (
+            pod_spec["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+            == "v5e-1"
+        )
+        svc = store.get("Service", "wb", "team-a")
+        assert svc["spec"]["ports"][0]["targetPort"] == 8888
+        vs = store.get("VirtualService", "notebook-team-a-wb", "team-a")
+        assert (
+            vs["spec"]["http"][0]["match"][0]["uri"]["prefix"]
+            == "/notebook/team-a/wb/"
+        )
+
+    def test_statefulset_pod_created_and_status_mirrored(self):
+        store, cm = make_harness()
+        store.create(new_notebook("wb", "team-a"))
+        cm.run_until_idle(max_seconds=5)
+        pod = store.get("Pod", "wb-0", "team-a")
+        assert pod["metadata"]["labels"]["notebook-name"] == "wb"
+        run_pod(store, "wb-0", "team-a")
+        cm.run_until_idle(max_seconds=5)
+        nb = store.get("Notebook", "wb", "team-a")
+        assert nb["status"]["readyReplicas"] == 1
+        assert nb["status"]["containerState"]["phase"] == "Running"
+        conds = {c["type"]: c["status"] for c in nb["status"]["conditions"]}
+        assert conds["Ready"] == "True"
+
+    def test_stop_annotation_scales_to_zero(self):
+        store, cm = make_harness()
+        store.create(new_notebook("wb", "team-a"))
+        cm.run_until_idle(max_seconds=5)
+        assert store.try_get("Pod", "wb-0", "team-a") is not None
+        nb = store.get("Notebook", "wb", "team-a")
+        nb["metadata"]["annotations"][culler.STOP_ANNOTATION] = "now"
+        store.update(nb)
+        cm.run_until_idle(max_seconds=5)
+        assert store.get("StatefulSet", "wb", "team-a")["spec"]["replicas"] == 0
+        assert store.try_get("Pod", "wb-0", "team-a") is None
+
+
+class TestCuller:
+    def test_idle_notebook_gets_stop_annotation(self, monkeypatch):
+        monkeypatch.setenv(culler.ENV_ENABLE_CULLING, "true")
+        monkeypatch.setenv(culler.ENV_IDLE_TIME, "60")
+        old = dt.datetime.now(dt.timezone.utc) - dt.timedelta(minutes=120)
+        store, cm = make_harness(activity_probe=lambda nb: old)
+        store.create(new_notebook("idle", "team-a"))
+        cm.run_until_idle(max_seconds=5)
+        nb = store.get("Notebook", "idle", "team-a")
+        assert culler.STOP_ANNOTATION in nb["metadata"]["annotations"]
+        cm.run_until_idle(max_seconds=5)
+        assert store.get("StatefulSet", "idle", "team-a")["spec"]["replicas"] == 0
+
+    def test_active_notebook_not_culled(self, monkeypatch):
+        monkeypatch.setenv(culler.ENV_ENABLE_CULLING, "true")
+        monkeypatch.setenv(culler.ENV_IDLE_TIME, "60")
+        now = dt.datetime.now(dt.timezone.utc)
+        store, cm = make_harness(activity_probe=lambda nb: now)
+        store.create(new_notebook("busy", "team-a"))
+        cm.run_until_idle(max_seconds=5)
+        nb = store.get("Notebook", "busy", "team-a")
+        assert culler.STOP_ANNOTATION not in nb["metadata"]["annotations"]
+
+    def test_unreachable_probe_does_not_cull(self, monkeypatch):
+        monkeypatch.setenv(culler.ENV_ENABLE_CULLING, "true")
+        monkeypatch.setenv(culler.ENV_IDLE_TIME, "0")
+        store, cm = make_harness(activity_probe=lambda nb: None)
+        store.create(new_notebook("quiet", "team-a"))
+        cm.run_until_idle(max_seconds=5)
+        nb = store.get("Notebook", "quiet", "team-a")
+        assert culler.STOP_ANNOTATION not in nb["metadata"]["annotations"]
+
+    def test_culling_disabled_by_default(self):
+        nb = new_notebook("x")
+        assert not culler.needs_culling(nb, lambda n: None)
+
+
+class TestPodDefaults:
+    def test_merge_env_volumes_by_selector(self):
+        store = StateStore()
+        poddefaults.register(store)
+        store.create(
+            poddefaults.new_pod_default(
+                "gcs-creds",
+                "team-a",
+                selector={"add-gcs-creds": "true"},
+                env=[{"name": "GOOGLE_APPLICATION_CREDENTIALS", "value": "/secret/sa.json"}],
+                volumes=[{"name": "sa", "secret": {"secretName": "gcs-sa"}}],
+                volume_mounts=[{"name": "sa", "mountPath": "/secret"}],
+            )
+        )
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "p1",
+                "namespace": "team-a",
+                "labels": {"add-gcs-creds": "true"},
+            },
+            "spec": {"containers": [{"name": "c", "image": "i"}]},
+            "status": {},
+        }
+        created = store.create(pod)
+        c = created["spec"]["containers"][0]
+        assert c["env"][0]["name"] == "GOOGLE_APPLICATION_CREDENTIALS"
+        assert created["spec"]["volumes"][0]["name"] == "sa"
+        assert c["volumeMounts"][0]["mountPath"] == "/secret"
+        assert any(
+            k.startswith(poddefaults.ANNOTATION_PREFIX)
+            for k in created["metadata"]["annotations"]
+        )
+
+    def test_non_matching_pod_untouched(self):
+        store = StateStore()
+        poddefaults.register(store)
+        store.create(
+            poddefaults.new_pod_default(
+                "x", "team-a", selector={"opt-in": "yes"}, env=[{"name": "A", "value": "1"}]
+            )
+        )
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "p2", "namespace": "team-a", "labels": {}},
+            "spec": {"containers": [{"name": "c", "image": "i"}]},
+            "status": {},
+        }
+        created = store.create(pod)
+        assert "env" not in created["spec"]["containers"][0]
+
+    def test_conflicting_env_denied(self):
+        store = StateStore()
+        poddefaults.register(store)
+        store.create(
+            poddefaults.new_pod_default(
+                "x", "team-a", selector={"l": "1"}, env=[{"name": "A", "value": "pd"}]
+            )
+        )
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "p3", "namespace": "team-a", "labels": {"l": "1"}},
+            "spec": {
+                "containers": [
+                    {"name": "c", "image": "i", "env": [{"name": "A", "value": "pod"}]}
+                ]
+            },
+            "status": {},
+        }
+        with pytest.raises(AdmissionDenied):
+            store.create(pod)
+
+    def test_notebook_pod_gets_poddefaults_e2e(self):
+        """Spawner 'configurations' flow: notebook labels → webhook merges."""
+        store, cm = make_harness()
+        poddefaults.register(store)
+        store.create(
+            poddefaults.new_pod_default(
+                "tpu-env",
+                "team-a",
+                selector={"tpu-env": "true"},
+                env=[{"name": "LIBTPU_INIT_ARGS", "value": "--xla_jf_spmd=true"}],
+            )
+        )
+        nb = new_notebook("wb", "team-a", pod_default_labels={"tpu-env": "true"})
+        store.create(nb)
+        cm.run_until_idle(max_seconds=5)
+        pod = store.get("Pod", "wb-0", "team-a")
+        env = {e["name"]: e.get("value") for e in pod["spec"]["containers"][0]["env"]}
+        assert env.get("LIBTPU_INIT_ARGS") == "--xla_jf_spmd=true"
+
+
+class FakeAuthz:
+    def __init__(self):
+        self.allowed = {("alice@x.io", "team-a")}
+
+    def __call__(self, user, verb, resource, namespace):
+        return (user, namespace) in self.allowed
+
+
+class TestSpawnerApi:
+    def make(self):
+        store, cm = make_harness()
+        app = build_app(store, authorizer=FakeAuthz())
+        return store, cm, app
+
+    def alice(self):
+        return {"x-auth-user-email": "alice@x.io"}
+
+    def test_config_lists_tpu_topologies(self):
+        _, _, app = self.make()
+        status, body = app.handle("GET", "/api/config")
+        assert status == 200
+        assert "v5e-8" in body["config"]["tpu_topologies"]
+
+    def test_create_notebook_flow(self):
+        store, cm, app = self.make()
+        status, body = app.handle(
+            "POST",
+            "/api/namespaces/team-a/notebooks",
+            body={"name": "mybook", "tpu": "v5e-1", "workspaceSize": "5Gi"},
+            headers=self.alice(),
+        )
+        assert status == 201, body
+        cm.run_until_idle(max_seconds=5)
+        assert store.try_get("StatefulSet", "mybook", "team-a") is not None
+        pvc = store.get("PersistentVolumeClaim", "workspace-mybook", "team-a")
+        assert pvc["spec"]["resources"]["requests"]["storage"] == "5Gi"
+        status, body = app.handle(
+            "GET", "/api/namespaces/team-a/notebooks", headers=self.alice()
+        )
+        assert body["notebooks"][0]["name"] == "mybook"
+        assert body["notebooks"][0]["tpu"] == "v5e-1"
+
+    def test_unauthorized_user_forbidden(self):
+        _, _, app = self.make()
+        status, body = app.handle(
+            "GET",
+            "/api/namespaces/team-a/notebooks",
+            headers={"x-auth-user-email": "mallory@x.io"},
+        )
+        assert status == 403
+        status, _ = app.handle("GET", "/api/namespaces/team-a/notebooks")
+        assert status == 401
+
+    def test_bad_requests(self):
+        _, _, app = self.make()
+        status, body = app.handle(
+            "POST",
+            "/api/namespaces/team-a/notebooks",
+            body={"name": "bad name!"},
+            headers=self.alice(),
+        )
+        assert status == 400
+        status, body = app.handle(
+            "POST",
+            "/api/namespaces/team-a/notebooks",
+            body={"name": "ok", "tpu": "h100"},
+            headers=self.alice(),
+        )
+        assert status == 400
+        assert "unknown TPU topology" in body["log"]
+
+    def test_delete_notebook(self):
+        store, cm, app = self.make()
+        app.handle(
+            "POST",
+            "/api/namespaces/team-a/notebooks",
+            body={"name": "gone"},
+            headers=self.alice(),
+        )
+        cm.run_until_idle(max_seconds=5)
+        status, _ = app.handle(
+            "DELETE", "/api/namespaces/team-a/notebooks/gone", headers=self.alice()
+        )
+        assert status == 200
+        assert store.try_get("Notebook", "gone", "team-a") is None
+        assert store.try_get("StatefulSet", "gone", "team-a") is None
+        # workspace PVC survives (data retention)
+        assert store.try_get("PersistentVolumeClaim", "workspace-gone", "team-a")
+        status, _ = app.handle(
+            "DELETE", "/api/namespaces/team-a/notebooks/gone", headers=self.alice()
+        )
+        assert status == 404
+
+    def test_over_http_socket(self):
+        """Full wire: WSGI server on a real socket."""
+        import json
+        import urllib.request
+
+        from kubeflow_tpu.api.wsgi import Server
+
+        store, cm, app = self.make()
+        srv = Server(app)
+        srv.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/api/namespaces/team-a/notebooks",
+                data=json.dumps({"name": "wired"}).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "x-auth-user-email": "alice@x.io",
+                },
+                method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 201
+                assert json.loads(resp.read())["success"] is True
+        finally:
+            srv.stop()
+        assert store.try_get("Notebook", "wired", "team-a") is not None

@@ -1,0 +1,54 @@
+"""Tensorboard controller tests (reference: tensorboard_controller.go)."""
+
+from kubeflow_tpu.cluster.reconciler import ControllerManager
+from kubeflow_tpu.cluster.store import StateStore
+from kubeflow_tpu.controllers.statefulset import DeploymentController
+from kubeflow_tpu.controllers.tensorboard import TensorboardController, new_tensorboard
+
+
+def make_harness():
+    store = StateStore()
+    cm = ControllerManager(store)
+    cm.register(DeploymentController())
+    cm.register(TensorboardController())
+    return store, cm
+
+
+class TestTensorboard:
+    def test_cloud_logdir_stateless(self):
+        store, cm = make_harness()
+        store.create(new_tensorboard("tb", "team-a", logdir="gs://bkt/logs"))
+        cm.run_until_idle(max_seconds=5)
+        dep = store.get("Deployment", "tb", "team-a")
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert "--logdir=gs://bkt/logs" in c["command"]
+        assert "volumes" not in dep["spec"]["template"]["spec"]
+        svc = store.get("Service", "tb", "team-a")
+        assert svc["spec"]["ports"][0] == {"port": 9000, "targetPort": 6006}
+        vs = store.get("VirtualService", "tensorboard-team-a-tb", "team-a")
+        assert (
+            vs["spec"]["http"][0]["match"][0]["uri"]["prefix"]
+            == "/tensorboard/team-a/tb/"
+        )
+
+    def test_local_logdir_gets_pvc_mount(self):
+        store, cm = make_harness()
+        store.create(new_tensorboard("tb", "team-a", logdir="/logs/run1"))
+        cm.run_until_idle(max_seconds=5)
+        dep = store.get("Deployment", "tb", "team-a")
+        spec = dep["spec"]["template"]["spec"]
+        assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "tb-logs"
+        assert spec["containers"][0]["volumeMounts"][0]["mountPath"] == "/logs/run1"
+
+    def test_ready_condition_tracks_deployment(self):
+        store, cm = make_harness()
+        store.create(new_tensorboard("tb", "team-a", logdir="gs://b/l"))
+        cm.run_until_idle(max_seconds=5)
+        tb = store.get("Tensorboard", "tb", "team-a")
+        conds = {c["type"]: c["status"] for c in tb["status"]["conditions"]}
+        assert conds["Ready"] == "False"
+        store.patch_status("Pod", "tb-0", "team-a", {"phase": "Running"})
+        cm.run_until_idle(max_seconds=5)
+        tb = store.get("Tensorboard", "tb", "team-a")
+        conds = {c["type"]: c["status"] for c in tb["status"]["conditions"]}
+        assert conds["Ready"] == "True"
