@@ -65,9 +65,33 @@ class Gatekeeper:
         self.app = self._build()
 
     def _issue_session(self, user: str) -> str:
+        now = time.time()
+        # sweep expired sessions so the map stays bounded by live logins
+        expired = [t for t, (_, exp) in self._sessions.items() if now > exp]
+        for t in expired:
+            self._sessions.pop(t, None)
         token = secrets.token_urlsafe(32)
-        self._sessions[token] = (user, time.time() + self.session_ttl_s)
+        self._sessions[token] = (user, now + self.session_ttl_s)
         return token
+
+    def _basic_auth_user(self, req) -> Optional[str]:
+        """Authorization: Basic support for programmatic clients (the
+        reference's header path, AuthServer.go:62-117)."""
+        header = req.headers.get("authorization", "")
+        if not header.lower().startswith("basic "):
+            return None
+        import base64
+
+        try:
+            decoded = base64.b64decode(header[6:]).decode()
+            username, _, password = decoded.partition(":")
+        except Exception:
+            return None
+        if username == self.username and check_password(
+            password, self.password_hash
+        ):
+            return username
+        return None
 
     def _session_user(self, token: str) -> Optional[str]:
         entry = self._sessions.get(token)
@@ -105,9 +129,12 @@ class Gatekeeper:
         @app.get("/auth")
         def auth(req):
             # the Ambassador auth-service contract: 200 passes the original
-            # request through (with identity attached), 301 sends to login
+            # request through (with identity attached), 301 sends to login.
+            # Cookie (browser) or Basic header (programmatic) both pass.
             token = req.cookies().get(COOKIE_NAME, "")
             user = self._session_user(token) if token else None
+            if user is None:
+                user = self._basic_auth_user(req)
             if user is None:
                 req.response_headers.append(("Location", LOGIN_PATH))
                 return {"success": False, "log": "login required"}, 301

@@ -35,9 +35,14 @@ ROLE_MAP = {"admin": ADMIN_ROLE, "edit": EDIT_ROLE, "view": VIEW_ROLE}
 
 
 def binding_name(user: str, role: str) -> str:
-    # reference bindings.go: user-<email>-clusterrole-<role> (flattened)
+    # reference bindings.go: user-<email>-clusterrole-<role> (flattened).
+    # A short digest disambiguates users that flatten identically
+    # (a.b@x.io vs a-b@x.io).
+    import hashlib
+
     safe = user.replace("@", "-").replace(".", "-").lower()
-    return f"user-{safe}-clusterrole-{ROLE_MAP[role]}"
+    digest = hashlib.sha1(user.encode()).hexdigest()[:8]
+    return f"user-{safe}-{digest}-clusterrole-{ROLE_MAP[role]}"
 
 
 def is_owner_or_admin(store: StateStore, user: str, namespace: str) -> bool:
@@ -149,12 +154,20 @@ def build_app(
         except NotFound:
             raise NotFoundError(f"no {role} binding for {user} in {ns}")
         # drop the Istio allow entry only when no binding in ANY role remains
+        # — and never for the namespace owner, whose access comes from the
+        # Profile, not from contributor bindings
         still_bound = any(
             store.try_get("RoleBinding", binding_name(user, r), ns) is not None
             for r in ROLE_MAP
         )
+        ns_obj = store.try_get("Namespace", ns, ns)
+        is_ns_owner = (
+            ns_obj is not None
+            and ns_obj["metadata"].get("annotations", {}).get(OWNER_ANNOTATION)
+            == user
+        )
         ap = store.try_get("AuthorizationPolicy", "ns-owner-access-istio", ns)
-        if ap is not None and not still_bound:
+        if ap is not None and not still_bound and not is_ns_owner:
             values = ap["spec"]["rules"][0]["when"][0]["values"]
             qualified = f"{user_prefix}{user}"
             if qualified in values:

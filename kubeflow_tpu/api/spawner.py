@@ -150,21 +150,9 @@ def build_app(
             store.delete("Notebook", name, ns)
         except NotFound:
             raise NotFoundError(f"notebook {ns}/{name} not found")
-        # owned children (StatefulSet/Service/VirtualService/pods) are GC'd by
-        # ownership; the workspace PVC survives by design (data retention)
-        for kind in ("StatefulSet", "Service"):
-            try:
-                store.delete(kind, name, ns)
-            except NotFound:
-                pass
-        try:
-            store.delete("VirtualService", f"notebook-{ns}-{name}", ns)
-        except NotFound:
-            pass
-        try:
-            store.delete("Pod", f"{name}-0", ns)
-        except NotFound:
-            pass
+        # owned children (StatefulSet → Pod, Service, VirtualService) are
+        # removed by the store's ownerReference cascade; the workspace PVC is
+        # deliberately un-owned and survives (data retention)
         return {"success": True, "log": f"deleted notebook {ns}/{name}"}
 
     @app.get("/api/namespaces/<ns>/pvcs")

@@ -77,12 +77,14 @@ class Request:
         self.response_headers: List[Tuple[str, str]] = []
 
     def cookies(self) -> Dict[str, str]:
-        out: Dict[str, str] = {}
-        for part in self.headers.get("cookie", "").split(";"):
-            if "=" in part:
-                k, v = part.strip().split("=", 1)
-                out[k] = v
-        return out
+        from http.cookies import SimpleCookie
+
+        jar = SimpleCookie()
+        try:
+            jar.load(self.headers.get("cookie", ""))
+        except Exception:
+            return {}
+        return {k: morsel.value for k, morsel in jar.items()}
 
 
 # SubjectAccessReview-shaped authorizer: (user, verb, resource, namespace)
@@ -233,13 +235,13 @@ class App:
     # -- WSGI -------------------------------------------------------------
 
     def __call__(self, environ, start_response):
+        from urllib.parse import parse_qsl
+
         method = environ["REQUEST_METHOD"]
         path = environ.get("PATH_INFO", "/")
-        query: Dict[str, str] = {}
-        for part in environ.get("QUERY_STRING", "").split("&"):
-            if "=" in part:
-                k, v = part.split("=", 1)
-                query[k] = v
+        query: Dict[str, str] = dict(
+            parse_qsl(environ.get("QUERY_STRING", ""))
+        )
         headers = {
             k[5:].replace("_", "-").lower(): v
             for k, v in environ.items()

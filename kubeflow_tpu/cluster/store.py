@@ -217,6 +217,28 @@ class StateStore:
         if obj is not None:
             self._writes.inc(kind=obj["kind"], op="delete")
             self._emit(WatchEvent.DELETED, obj)
+            self._cascade_delete(obj)
+
+    def _cascade_delete(self, owner: Dict[str, Any]) -> None:
+        """ownerReference garbage collection (the k8s GC controller): when an
+        owner goes away, its children follow — recursively, through the
+        normal delete path so finalizers still gate each object."""
+        uid = owner.get("metadata", {}).get("uid")
+        if not uid:
+            return
+        orphans = [
+            (k, ns, n)
+            for (k, ns, n), obj in list(self._objects.items())
+            if any(
+                ref.get("uid") == uid
+                for ref in obj.get("metadata", {}).get("ownerReferences", [])
+            )
+        ]
+        for kind, ns, n in orphans:
+            try:
+                self.delete(kind, n, ns)
+            except NotFound:
+                pass
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> None:
         with self._lock:

@@ -1,0 +1,154 @@
+"""InferenceService controller — model-serving deployments per CR.
+
+The reference treats the model server as an externally-deployed component
+(kfserving labels on profile namespaces, TF Serving smoke-tested by
+testing/test_tf_serving.py); the platform's job is the wiring. This
+controller owns that wiring natively: InferenceService CR → Deployment of
+the TPU model server + Service(8500) + VirtualService
+/models/<ns>/<name>/ — the same reconcile idiom as the tensorboard
+controller (reference: tensorboard_controller.go:54-260).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from kubeflow_tpu.cluster.objects import new_object, set_condition, set_owner
+from kubeflow_tpu.cluster.reconciler import Controller, Result
+from kubeflow_tpu.cluster.store import StateStore
+from kubeflow_tpu.config.core import from_dict
+from kubeflow_tpu.config.platform import SliceConfig
+from kubeflow_tpu.controllers.statefulset import new_deployment
+
+KIND = "InferenceService"
+DEFAULT_IMAGE = "kubeflow-tpu/model-server:latest"
+SERVE_PORT = 8500
+
+
+def new_inference_service(
+    name: str,
+    namespace: str = "default",
+    model: str = "",
+    checkpoint_dir: str = "",
+    tpu_topology: str = "",
+    replicas: int = 1,
+    image: str = DEFAULT_IMAGE,
+) -> Dict[str, Any]:
+    return new_object(
+        KIND,
+        name,
+        namespace,
+        spec={
+            "model": model,
+            "checkpointDir": checkpoint_dir,
+            "tpu": {"topology": tpu_topology} if tpu_topology else {},
+            "replicas": replicas,
+            "image": image,
+        },
+    )
+
+
+class InferenceServiceController(Controller):
+    kind = KIND
+    name = "inference-controller"
+
+    def __init__(
+        self, use_istio: bool = True, istio_gateway: str = "kubeflow/kubeflow-gateway"
+    ) -> None:
+        super().__init__()
+        self.use_istio = use_istio
+        self.istio_gateway = istio_gateway
+        self.watches = {"Deployment": self.map_owned}
+
+    def reconcile(self, store: StateStore, namespace: str, name: str) -> Result:
+        svc_cr = store.try_get(KIND, name, namespace)
+        if svc_cr is None or svc_cr["metadata"].get("deletionTimestamp"):
+            return Result()
+        spec = svc_cr.get("spec", {})
+
+        container: Dict[str, Any] = {
+            "name": "model-server",
+            "image": spec.get("image", DEFAULT_IMAGE),
+            "command": [
+                "python",
+                "-m",
+                "kubeflow_tpu.serving.main",
+                "--model", spec.get("model", ""),
+                "--checkpoint-dir", spec.get("checkpointDir", ""),
+                "--port", str(SERVE_PORT),
+            ],
+            "ports": [{"containerPort": SERVE_PORT}],
+        }
+        pod_spec: Dict[str, Any] = {"containers": [container]}
+        topology = (spec.get("tpu") or {}).get("topology", "")
+        if topology:
+            slice_cfg = from_dict(SliceConfig, {"topology": topology})
+            slice_cfg.validate()
+            container["resources"] = {"limits": slice_cfg.resource_requests()}
+            pod_spec["nodeSelector"] = slice_cfg.node_selectors()
+
+        dep = new_deployment(
+            name,
+            namespace,
+            int(spec.get("replicas", 1)),
+            pod_spec,
+            labels={"app": "model-server", "inferenceservice": name},
+        )
+        set_owner(dep, svc_cr)
+        store.apply(dep)
+
+        svc = new_object(
+            "Service",
+            name,
+            namespace,
+            api_version="v1",
+            spec={
+                "selector": {"inferenceservice": name},
+                "ports": [{"port": SERVE_PORT, "targetPort": SERVE_PORT}],
+            },
+        )
+        set_owner(svc, svc_cr)
+        store.apply(svc)
+
+        if self.use_istio:
+            vs = new_object(
+                "VirtualService",
+                f"inference-{namespace}-{name}",
+                namespace,
+                api_version="networking.istio.io/v1alpha3",
+                spec={
+                    "hosts": ["*"],
+                    "gateways": [self.istio_gateway],
+                    "http": [
+                        {
+                            "match": [
+                                {"uri": {"prefix": f"/models/{namespace}/{name}/"}}
+                            ],
+                            "rewrite": {"uri": "/"},
+                            "route": [
+                                {
+                                    "destination": {
+                                        "host": f"{name}.{namespace}.svc.cluster.local",
+                                        "port": {"number": SERVE_PORT},
+                                    }
+                                }
+                            ],
+                        }
+                    ],
+                },
+            )
+            set_owner(vs, svc_cr)
+            store.apply(vs)
+
+        ready = (
+            store.try_get("Deployment", name, namespace) or {}
+        ).get("status", {}).get("readyReplicas", 0)
+        changed = set_condition(
+            svc_cr,
+            "Ready",
+            "True" if ready >= int(spec.get("replicas", 1)) else "False",
+            "Available" if ready else "Pending",
+        )
+        if changed:
+            store.patch_status(KIND, name, namespace, svc_cr["status"])
+        return Result()

@@ -138,8 +138,8 @@ class NotebookController(Controller):
     def reconcile(self, store: StateStore, namespace: str, name: str) -> Result:
         nb = store.try_get(KIND, name, namespace)
         if nb is None or nb["metadata"].get("deletionTimestamp"):
-            # children are owner-referenced; store GC on delete is handled by
-            # owner cleanup in the deletion path of each child controller
+            # children are owner-referenced; the store's cascade GC removes
+            # them when the Notebook goes away
             return Result()
 
         stopped = culler.is_stopped(nb)
@@ -310,18 +310,12 @@ class NotebookController(Controller):
         status["conditions"] = nb["status"].get("conditions", [])
         if store.get(KIND, name, namespace).get("status") != status:
             store.patch_status(KIND, name, namespace, status)
-        # namespace-wide running count (this notebook's freshly-computed
-        # readiness; peers from their mirrored status)
-        running = sum(
+        # namespace-wide running count: peers from their mirrored status,
+        # this notebook from the readiness just computed
+        running = (1 if ready >= 1 else 0) + sum(
             1
             for other in store.list(KIND, namespace)
-            if (
-                other["metadata"]["name"] == name
-                and ready >= 1
-            )
-            or (
-                other["metadata"]["name"] != name
-                and other.get("status", {}).get("readyReplicas", 0) >= 1
-            )
+            if other["metadata"]["name"] != name
+            and other.get("status", {}).get("readyReplicas", 0) >= 1
         )
         self._running.set(running, namespace=namespace)

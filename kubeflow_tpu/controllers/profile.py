@@ -232,28 +232,47 @@ class ProfileController(Controller):
 
         # 4. Istio AuthorizationPolicy (modern equivalent of the v1alpha1
         #    ServiceRole+Binding pair, reference :337-429): allow requests
-        #    whose identity header matches the owner.
-        ap = new_object(
-            "AuthorizationPolicy",
-            f"ns-owner-access-istio",
-            ns_name,
-            api_version="security.istio.io/v1beta1",
-            spec={
-                "action": "ALLOW",
-                "rules": [
-                    {
-                        "when": [
-                            {
-                                "key": f"request.headers[{self.user_id_header}]",
-                                "values": [f"{self.user_id_prefix}{owner}"],
-                            }
-                        ]
-                    }
-                ],
-            },
+        #    whose identity header matches the owner. KFAM appends
+        #    contributors to the same values list, so reconcile must ensure
+        #    the owner's entry without rebuilding the list (a wholesale apply
+        #    would strip contributors on every reconcile).
+        qualified_owner = f"{self.user_id_prefix}{owner}"
+        existing_ap = store.try_get(
+            "AuthorizationPolicy", "ns-owner-access-istio", ns_name
         )
-        set_owner(ap, profile)
-        store.apply(ap)
+        if existing_ap is None:
+            ap = new_object(
+                "AuthorizationPolicy",
+                "ns-owner-access-istio",
+                ns_name,
+                api_version="security.istio.io/v1beta1",
+                spec={
+                    "action": "ALLOW",
+                    "rules": [
+                        {
+                            "when": [
+                                {
+                                    "key": (
+                                        "request.headers"
+                                        f"[{self.user_id_header}]"
+                                    ),
+                                    "values": [qualified_owner],
+                                }
+                            ]
+                        }
+                    ],
+                },
+            )
+            set_owner(ap, profile)
+            try:
+                store.create(ap)
+            except AlreadyExists:
+                pass
+        else:
+            values = existing_ap["spec"]["rules"][0]["when"][0]["values"]
+            if qualified_owner not in values:
+                values.insert(0, qualified_owner)
+                store.update(existing_ap)
 
         # 5. ResourceQuota (reference :241-256; TPU chips included)
         rq_spec = spec.get("resourceQuotaSpec") or {}

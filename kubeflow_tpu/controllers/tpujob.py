@@ -266,6 +266,20 @@ class TPUTrainJobController(Controller):
             return self._handle_gang_failure(store, job, desired, pods)
 
         if all(p == SUCCEEDED for p in phases):
+            # surface the coordinator's final metrics on the job (trial
+            # controllers and dashboards read these, not pod internals)
+            coord = pods.get(desired[0])
+            if coord is not None:
+                ps = coord.get("status", {})
+                metrics = {}
+                for key in ("items_per_sec", "final_loss", "final_step"):
+                    if key in ps:
+                        try:
+                            metrics[key] = float(ps[key])
+                        except (TypeError, ValueError):
+                            pass
+                if metrics:
+                    status["trainingMetrics"] = metrics
             self._finish(
                 store, job, COND_SUCCEEDED, "GangSucceeded", "all workers succeeded"
             )

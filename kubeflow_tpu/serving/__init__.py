@@ -1,0 +1,3 @@
+"""Serving: the TPU-backed model server + serving CRD."""
+
+from kubeflow_tpu.serving.server import ModelServer, ServedModel  # noqa: F401

@@ -1,0 +1,45 @@
+"""Model-server entrypoint: load a model + checkpoint, serve REST.
+
+The in-pod command the InferenceService controller renders
+(controllers/inference.py) — the platform's replacement for the reference's
+stock TF Serving image.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="kubeflow-tpu model server")
+    ap.add_argument("--model", required=True, help="registry model name")
+    ap.add_argument("--checkpoint-dir", default="", help="orbax checkpoint dir")
+    ap.add_argument("--port", type=int, default=8500)
+    ap.add_argument("--host", default="0.0.0.0")
+    args = ap.parse_args(argv)
+
+    from kubeflow_tpu.api.wsgi import Server
+    from kubeflow_tpu.serving.server import ModelServer, ServedModel
+
+    server = ModelServer()
+    server.add(
+        ServedModel.from_registry(
+            args.model, checkpoint_dir=args.checkpoint_dir or None
+        )
+    )
+    httpd = Server(server.app, host=args.host, port=args.port)
+    print(f"serving {args.model} on :{httpd.port}", flush=True)
+    httpd.start()
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        httpd.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

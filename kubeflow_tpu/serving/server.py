@@ -1,0 +1,178 @@
+"""TPU-backed model server speaking the TF-Serving REST contract.
+
+The reference delegates model serving to a stock TF Serving image and owns
+only the wiring + smoke test: POST /v1/models/<name>:predict with
+{"instances": […]} compared against golden predictions (reference:
+testing/test_tf_serving.py:60-145, request at :112-127, tolerance compare
+:40-57). This server is the TPU-native replacement for the image itself:
+
+- models from the platform registry with params restored from an orbax
+  checkpoint (or injected directly),
+- inference is one jitted XLA program per (model, padded batch size);
+  requests are padded to bucketed batch sizes so arbitrary instance counts
+  hit a small set of compiled programs instead of recompiling — the
+  static-shape discipline TPUs demand,
+- same REST shape, so the reference's smoke test translates 1:1.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.api.wsgi import App, BadRequest, NotFoundError
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import default_registry
+
+log = get_logger(__name__)
+
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def bucket_for(n: int) -> int:
+    for b in BATCH_BUCKETS:
+        if n <= b:
+            return b
+    return BATCH_BUCKETS[-1]
+
+
+class ServedModel:
+    """One named, versioned model: jitted apply over padded batches."""
+
+    def __init__(
+        self,
+        name: str,
+        apply_fn: Callable[[Any, jax.Array], jax.Array],
+        params: Any,
+        version: str = "1",
+        postprocess: Optional[Callable[[np.ndarray], Any]] = None,
+    ):
+        self.name = name
+        self.version = version
+        self.params = params
+        self.postprocess = postprocess
+        self._jitted = jax.jit(apply_fn)
+        self._lock = threading.Lock()
+        reg = default_registry()
+        self._latency = reg.histogram(
+            "serving_predict_seconds", "predict latency", ["model"]
+        )
+        self._requests = reg.counter(
+            "serving_requests_total", "predict requests", ["model"]
+        )
+
+    @classmethod
+    def from_registry(
+        cls,
+        model_name: str,
+        checkpoint_dir: Optional[str] = None,
+        params: Any = None,
+        served_name: Optional[str] = None,
+        **model_kwargs,
+    ) -> "ServedModel":
+        """Build from the platform model registry; params from an orbax
+        checkpoint's TrainState if a directory is given."""
+        from kubeflow_tpu.models.registry import get_model
+
+        model = get_model(model_name, **model_kwargs)
+        if params is None:
+            if checkpoint_dir is None:
+                raise ValueError("need checkpoint_dir or params")
+            import orbax.checkpoint as ocp
+
+            with ocp.CheckpointManager(checkpoint_dir) as mgr:
+                step = mgr.latest_step()
+                if step is None:
+                    raise FileNotFoundError(f"no checkpoint in {checkpoint_dir}")
+                restored = mgr.restore(step)
+            params = restored["params"]
+
+        def apply_fn(p, x):
+            return model.apply({"params": p}, x, train=False)
+
+        return cls(served_name or model_name, apply_fn, params)
+
+    def predict(self, instances: Sequence) -> List:
+        n = len(instances)
+        if n == 0:
+            return []
+        x = np.asarray(instances, dtype=np.float32)
+        padded_n = bucket_for(n)
+        if n > BATCH_BUCKETS[-1]:
+            # large request: chunk through the biggest bucket
+            out: List = []
+            for i in range(0, n, BATCH_BUCKETS[-1]):
+                out.extend(self.predict(instances[i : i + BATCH_BUCKETS[-1]]))
+            return out
+        if padded_n != n:
+            pad = np.repeat(x[:1], padded_n - n, axis=0)
+            x = np.concatenate([x, pad], axis=0)
+        self._requests.inc(model=self.name)
+        with self._latency.time(model=self.name), self._lock:
+            y = np.asarray(jax.device_get(self._jitted(self.params, jnp.asarray(x))))
+        y = y[:n]
+        if self.postprocess is not None:
+            return [self.postprocess(row) for row in y]
+        return [row.tolist() for row in y]
+
+
+class ModelServer:
+    """Multi-model server with the TF-Serving REST surface."""
+
+    def __init__(self) -> None:
+        self._models: Dict[str, ServedModel] = {}
+        self.app = self._build()
+
+    def add(self, model: ServedModel) -> None:
+        self._models[model.name] = model
+
+    def remove(self, name: str) -> None:
+        self._models.pop(name, None)
+
+    def _build(self) -> App:
+        app = App("model-server")
+
+        @app.get("/v1/models/<name>")
+        def model_status(req):
+            model = self._models.get(req.params["name"])
+            if model is None:
+                raise NotFoundError(f"model {req.params['name']} not loaded")
+            return {
+                "model_version_status": [
+                    {
+                        "version": model.version,
+                        "state": "AVAILABLE",
+                        "status": {"error_code": "OK", "error_message": ""},
+                    }
+                ]
+            }
+
+        @app.post("/v1/models/<name>:predict")
+        def predict(req):
+            model = self._models.get(req.params["name"])
+            if model is None:
+                raise NotFoundError(f"model {req.params['name']} not loaded")
+            body = req.body or {}
+            instances = body.get("instances")
+            if instances is None:
+                raise BadRequest("request body must contain 'instances'")
+            try:
+                predictions = model.predict(instances)
+            except (ValueError, TypeError) as e:
+                raise BadRequest(f"bad instances: {e}")
+            return {"predictions": predictions}
+
+        @app.get("/v1/models")
+        def list_models(req):
+            return {
+                "models": [
+                    {"name": m.name, "version": m.version}
+                    for m in self._models.values()
+                ]
+            }
+
+        return app

@@ -333,3 +333,16 @@ class TestSpawnerApi:
         finally:
             srv.stop()
         assert store.try_get("Notebook", "wired", "team-a") is not None
+
+
+class TestCascadeGc:
+    def test_direct_notebook_delete_cascades_children(self):
+        store, cm = make_harness()
+        store.create(new_notebook("wb", "team-a"))
+        cm.run_until_idle(max_seconds=5)
+        assert store.try_get("Pod", "wb-0", "team-a") is not None
+        store.delete("Notebook", "wb", "team-a")
+        assert store.try_get("StatefulSet", "wb", "team-a") is None
+        assert store.try_get("Service", "wb", "team-a") is None
+        assert store.try_get("VirtualService", "notebook-team-a-wb", "team-a") is None
+        assert store.try_get("Pod", "wb-0", "team-a") is None  # recursive

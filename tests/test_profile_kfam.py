@@ -259,3 +259,82 @@ class TestGatekeeper:
         gk.app.handle("POST", "/logout", headers={"cookie": token})
         status, _, _ = gk.app.handle_full("GET", "/auth", headers={"cookie": token})
         assert status == 301
+
+
+class TestReviewRegressions:
+    def test_profile_reconcile_preserves_kfam_contributors(self):
+        """AP must not be rebuilt wholesale: contributors survive reconciles."""
+        store, cm = make_harness()
+        app = kfam.build_app(store)
+        store.create(new_profile("team-a", ALICE))
+        cm.run_until_idle(max_seconds=5)
+        app.handle(
+            "POST", "/kfam/v1/bindings",
+            body={"user": BOB, "referredNamespace": "team-a", "role": "edit"},
+            headers={"x-auth-user-email": ALICE},
+        )
+        cm.enqueue_all()
+        cm.run_until_idle(max_seconds=5)  # reconcile again (restart analog)
+        ap = store.get("AuthorizationPolicy", "ns-owner-access-istio", "team-a")
+        values = ap["spec"]["rules"][0]["when"][0]["values"]
+        assert BOB in values and ALICE in values
+
+    def test_owner_never_removed_from_allowlist(self):
+        store, cm = make_harness()
+        app = kfam.build_app(store)
+        store.create(new_profile("team-a", ALICE))
+        cm.run_until_idle(max_seconds=5)
+        hdr = {"x-auth-user-email": ALICE}
+        app.handle(
+            "POST", "/kfam/v1/bindings",
+            body={"user": ALICE, "referredNamespace": "team-a", "role": "edit"},
+            headers=hdr,
+        )
+        app.handle(
+            "DELETE", "/kfam/v1/bindings",
+            body={"user": ALICE, "referredNamespace": "team-a", "role": "edit"},
+            headers=hdr,
+        )
+        ap = store.get("AuthorizationPolicy", "ns-owner-access-istio", "team-a")
+        assert ALICE in ap["spec"]["rules"][0]["when"][0]["values"]
+
+    def test_binding_names_do_not_collide(self):
+        assert kfam.binding_name("a.b@x.io", "edit") != kfam.binding_name(
+            "a-b@x.io", "edit"
+        )
+
+    def test_multi_role_delete_keeps_allowlist_entry(self):
+        store, cm = make_harness()
+        app = kfam.build_app(store)
+        store.create(new_profile("team-a", ALICE))
+        cm.run_until_idle(max_seconds=5)
+        hdr = {"x-auth-user-email": ALICE}
+        for role in ("edit", "view"):
+            app.handle(
+                "POST", "/kfam/v1/bindings",
+                body={"user": BOB, "referredNamespace": "team-a", "role": role},
+                headers=hdr,
+            )
+        app.handle(
+            "DELETE", "/kfam/v1/bindings",
+            body={"user": BOB, "referredNamespace": "team-a", "role": "edit"},
+            headers=hdr,
+        )
+        ap = store.get("AuthorizationPolicy", "ns-owner-access-istio", "team-a")
+        assert BOB in ap["spec"]["rules"][0]["when"][0]["values"]  # view remains
+
+    def test_gatekeeper_basic_auth_header(self):
+        import base64
+
+        gk = Gatekeeper("admin", hash_password("pw"))
+        creds = base64.b64encode(b"admin:pw").decode()
+        status, _, headers = gk.app.handle_full(
+            "GET", "/auth", headers={"authorization": f"Basic {creds}"}
+        )
+        assert status == 200
+        assert dict(headers)["x-auth-user-email"] == "admin"
+        bad = base64.b64encode(b"admin:wrong").decode()
+        status, _, _ = gk.app.handle_full(
+            "GET", "/auth", headers={"authorization": f"Basic {bad}"}
+        )
+        assert status == 301

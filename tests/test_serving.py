@@ -1,0 +1,182 @@
+"""Serving tests: model server REST contract + golden-prediction smoke test.
+
+The golden-prediction test mirrors the reference's serving smoke test
+(reference: testing/test_tf_serving.py:40-57 almost_equal tol comparison,
+:112-127 REST predict loop) against the TPU-native server, and the
+InferenceService controller test covers the wiring the reference asserts
+via cluster readiness.
+"""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.cluster.reconciler import ControllerManager
+from kubeflow_tpu.cluster.store import StateStore
+from kubeflow_tpu.controllers.inference import (
+    InferenceServiceController,
+    new_inference_service,
+)
+from kubeflow_tpu.controllers.statefulset import DeploymentController
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.serving.server import ModelServer, ServedModel, bucket_for
+
+
+@pytest.fixture(scope="module")
+def mlp_served():
+    model = get_model("mlp", hidden=(16,), num_classes=4)
+    x = jnp.zeros((1, 8), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+
+    def apply_fn(p, xb):
+        return model.apply({"params": p}, xb)
+
+    return ServedModel("mlp", apply_fn, params)
+
+
+class TestServedModel:
+    def test_bucketing(self):
+        assert bucket_for(1) == 1
+        assert bucket_for(3) == 4
+        assert bucket_for(128) == 128
+        assert bucket_for(129) == 128  # chunked upstream
+
+    def test_predict_shapes_and_padding(self, mlp_served):
+        out = mlp_served.predict([[0.0] * 8] * 3)  # pads 3→4
+        assert len(out) == 3
+        assert len(out[0]) == 4
+
+    def test_predict_deterministic(self, mlp_served):
+        inst = [[0.5] * 8]
+        a = mlp_served.predict(inst)
+        b = mlp_served.predict(inst)
+        np.testing.assert_allclose(a, b)
+
+    def test_large_request_chunks(self, mlp_served):
+        out = mlp_served.predict([[0.1] * 8] * 130)
+        assert len(out) == 130
+
+
+class TestModelServerRest:
+    def make(self, served):
+        server = ModelServer()
+        server.add(served)
+        return server
+
+    def test_predict_contract(self, mlp_served):
+        server = self.make(mlp_served)
+        status, body = server.app.handle(
+            "POST",
+            "/v1/models/mlp:predict",
+            body={"instances": [[0.0] * 8, [1.0] * 8]},
+        )
+        assert status == 200
+        assert len(body["predictions"]) == 2
+
+    def test_model_status_endpoint(self, mlp_served):
+        server = self.make(mlp_served)
+        status, body = server.app.handle("GET", "/v1/models/mlp")
+        assert status == 200
+        assert body["model_version_status"][0]["state"] == "AVAILABLE"
+        status, _ = server.app.handle("GET", "/v1/models/nope")
+        assert status == 404
+
+    def test_bad_requests(self, mlp_served):
+        server = self.make(mlp_served)
+        status, _ = server.app.handle("POST", "/v1/models/mlp:predict", body={})
+        assert status == 400
+        status, _ = server.app.handle(
+            "POST", "/v1/models/nope:predict", body={"instances": [[0.0] * 8]}
+        )
+        assert status == 404
+        status, _ = server.app.handle(
+            "POST", "/v1/models/mlp:predict", body={"instances": [["x"] * 8]}
+        )
+        assert status == 400
+
+    def test_golden_predictions_over_socket(self, mlp_served, tmp_path):
+        """The reference smoke test shape: predict over HTTP, compare golden
+        (test_tf_serving.py:40-57,112-133)."""
+        from kubeflow_tpu.api.wsgi import Server
+
+        server = self.make(mlp_served)
+        srv = Server(server.app)
+        srv.start()
+        try:
+            instances = [[0.25] * 8, [0.75] * 8]
+            # golden: computed once from the params directly (the reference
+            # ships a golden JSON; here it derives from the same weights)
+            golden = mlp_served.predict(instances)
+            golden_file = tmp_path / "golden.json"
+            golden_file.write_text(json.dumps({"predictions": golden}))
+
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/models/mlp:predict",
+                data=json.dumps({"instances": instances}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+                result = json.loads(resp.read())
+            expected = json.loads(golden_file.read_text())
+            np.testing.assert_allclose(
+                result["predictions"], expected["predictions"], atol=1e-3
+            )
+        finally:
+            srv.stop()
+
+    def test_from_registry_with_checkpoint(self, tmp_path):
+        """Restore served params from a real orbax checkpoint."""
+        import orbax.checkpoint as ocp
+
+        model = get_model("mlp", hidden=(8,), num_classes=3)
+        params = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 8)))["params"]
+        ckpt_dir = str(tmp_path / "ckpt")
+        with ocp.CheckpointManager(ckpt_dir) as mgr:
+            mgr.save(5, args=ocp.args.StandardSave({"params": params}))
+            mgr.wait_until_finished()
+        served = ServedModel.from_registry(
+            "mlp", checkpoint_dir=ckpt_dir, hidden=(8,), num_classes=3
+        )
+        out = served.predict([[0.0] * 8])
+        assert len(out[0]) == 3
+
+
+class TestInferenceServiceController:
+    def test_renders_deployment_service_route(self):
+        store = StateStore()
+        cm = ControllerManager(store)
+        cm.register(DeploymentController())
+        cm.register(InferenceServiceController())
+        store.create(
+            new_inference_service(
+                "resnet-serve",
+                "team-a",
+                model="resnet50",
+                checkpoint_dir="gs://bkt/ckpt",
+                tpu_topology="v5e-4",
+            )
+        )
+        cm.run_until_idle(max_seconds=5)
+        dep = store.get("Deployment", "resnet-serve", "team-a")
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert "--model" in c["command"] and "resnet50" in c["command"]
+        assert c["resources"]["limits"]["google.com/tpu"] == "4"
+        svc = store.get("Service", "resnet-serve", "team-a")
+        assert svc["spec"]["ports"][0]["port"] == 8500
+        vs = store.get("VirtualService", "inference-team-a-resnet-serve", "team-a")
+        assert (
+            vs["spec"]["http"][0]["match"][0]["uri"]["prefix"]
+            == "/models/team-a/resnet-serve/"
+        )
+        # becomes Ready when the pod runs
+        store.patch_status("Pod", "resnet-serve-0", "team-a", {"phase": "Running"})
+        cm.run_until_idle(max_seconds=5)
+        isvc = store.get("InferenceService", "resnet-serve", "team-a")
+        conds = {c["type"]: c["status"] for c in isvc["status"]["conditions"]}
+        assert conds["Ready"] == "True"
