@@ -1,0 +1,200 @@
+"""Central dashboard BFF — the landing-page API.
+
+Re-implements the reference's Express backend (reference:
+components/centraldashboard/app/): workgroup endpoints
+(api_workgroup.ts:247-381 exists/create/env-info/add-contributor), activity
+feed from k8s Events (api.ts), and resource-utilization time series behind a
+pluggable MetricsService interface (metrics_service.ts:17-50; Stackdriver
+impl swapped for one backed by the platform metrics registry — TPU runtime
+metrics in a real deployment).
+
+Identity rides the trusted header like every backend here
+(attach_user_middleware.ts).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Protocol
+
+from kubeflow_tpu.api.wsgi import App, BadRequest, Forbidden
+from kubeflow_tpu.api import kfam as kfam_api
+from kubeflow_tpu.cluster.store import AlreadyExists, StateStore
+from kubeflow_tpu.controllers.profile import OWNER_ANNOTATION, new_profile
+from kubeflow_tpu.utils.metrics import default_registry
+from kubeflow_tpu.version import __version__
+
+
+class MetricsService(Protocol):
+    """reference metrics_service.ts:17-50."""
+
+    def query(
+        self, namespace: str, metric: str, window_s: float
+    ) -> List[Dict[str, Any]]: ...
+
+
+class RegistryMetricsService:
+    """Time series sampled from the in-process metrics registry (the
+    Stackdriver-implementation seam, stackdriver_metrics_service.ts)."""
+
+    def __init__(self, max_points: int = 360):
+        self.max_points = max_points
+        self._series: Dict[str, List[Dict[str, Any]]] = {}
+
+    def sample(self) -> None:
+        """Capture current gauge values (call on a timer)."""
+        now = time.time()
+        reg = default_registry()
+        for family in reg.collect():
+            if family.get("type") != "gauge":
+                continue
+            for sample in family.get("samples", []):
+                key = family["name"]
+                points = self._series.setdefault(key, [])
+                points.append(
+                    {"t": now, "value": sample["value"], "labels": sample["labels"]}
+                )
+                del points[: -self.max_points]
+
+    def query(
+        self, namespace: str, metric: str, window_s: float
+    ) -> List[Dict[str, Any]]:
+        cutoff = time.time() - window_s
+        out = []
+        for p in self._series.get(metric, []):
+            if p["t"] < cutoff:
+                continue
+            labels = p.get("labels", {})
+            if labels.get("namespace") not in (None, namespace):
+                continue
+            out.append(p)
+        return out
+
+
+def build_app(
+    store: StateStore,
+    metrics_service: Optional[MetricsService] = None,
+    user_header: str = "x-auth-user-email",
+    user_prefix: str = "",
+) -> App:
+    app = App("dashboard", user_header=user_header, user_prefix=user_prefix)
+    metrics_service = metrics_service or RegistryMetricsService()
+    app.metrics_service = metrics_service  # callers wire the sample() timer
+
+    def user_namespaces(user: str) -> List[Dict[str, Any]]:
+        out = []
+        for ns in store.list("Namespace"):
+            owner = ns["metadata"].get("annotations", {}).get(OWNER_ANNOTATION)
+            if owner == user:
+                out.append({"namespace": ns["metadata"]["name"], "role": "owner"})
+                continue
+            for rb in store.list("RoleBinding", ns["metadata"]["name"]):
+                if any(
+                    s.get("kind") == "User" and s.get("name") == user
+                    for s in rb.get("spec", {}).get("subjects", [])
+                ):
+                    out.append(
+                        {
+                            "namespace": ns["metadata"]["name"],
+                            "role": rb["metadata"]
+                            .get("annotations", {})
+                            .get("role", "contributor"),
+                        }
+                    )
+                    break
+        return out
+
+    @app.get("/api/workgroup/exists")
+    def workgroup_exists(req):
+        # reference api_workgroup.ts:247-272
+        if not req.user:
+            raise Forbidden("no user identity")
+        namespaces = user_namespaces(req.user)
+        return {
+            "hasAuth": True,
+            "user": req.user,
+            "hasWorkgroup": bool(namespaces),
+            "registrationFlowAllowed": True,
+        }
+
+    @app.post("/api/workgroup/create")
+    def workgroup_create(req):
+        # reference api_workgroup.ts:273-300: self-service onboarding
+        if not req.user:
+            raise Forbidden("no user identity")
+        body = req.body or {}
+        name = body.get("namespace") or req.user.split("@")[0].replace(".", "-")
+        try:
+            store.create(new_profile(name, req.user))
+        except AlreadyExists:
+            raise BadRequest(f"workgroup {name} exists")
+        return {"success": True, "namespace": name}, 201
+
+    @app.get("/api/workgroup/env-info")
+    def env_info(req):
+        # reference api_workgroup.ts:301-340
+        if not req.user:
+            raise Forbidden("no user identity")
+        return {
+            "user": req.user,
+            "platform": {
+                "kubeflowVersion": __version__,
+                "provider": "tpu",
+            },
+            "namespaces": user_namespaces(req.user),
+            "isClusterAdmin": False,
+        }
+
+    def require_member(req, ns: str) -> None:
+        if not req.user:
+            raise Forbidden("no user identity")
+        if ns not in {n["namespace"] for n in user_namespaces(req.user)}:
+            raise Forbidden(f"{req.user} is not a member of {ns}")
+
+    @app.get("/api/activities/<ns>")
+    def activities(req):
+        ns = req.params["ns"]
+        require_member(req, ns)
+        events = store.list("Event", ns)
+        events.sort(
+            key=lambda e: int(e["metadata"].get("resourceVersion", 0)),
+            reverse=True,
+        )
+        return {
+            "activities": [
+                {
+                    "time": e.get("lastTimestamp", ""),
+                    "event": e.get("reason", ""),
+                    "message": e.get("message", ""),
+                    "type": e.get("type", "Normal"),
+                    "involved": e.get("involvedObject", {}),
+                }
+                for e in events[:50]
+            ]
+        }
+
+    @app.get("/api/metrics/<ns>")
+    def metrics(req):
+        ns = req.params["ns"]
+        require_member(req, ns)
+        metric = req.query.get("metric", "training_items_per_sec")
+        try:
+            window = float(req.query.get("window_s", "3600"))
+        except ValueError:
+            raise BadRequest("window_s must be a number")
+        return {"metric": metric, "points": metrics_service.query(ns, metric, window)}
+
+    @app.get("/api/dashboard-links")
+    def links(req):
+        # the sub-app registry the dashboard iframes (main-page.js)
+        return {
+            "menuLinks": [
+                {"link": "/jupyter/", "text": "Notebooks"},
+                {"link": "/tensorboards/", "text": "Tensorboards"},
+                {"link": "/jobs/", "text": "Training Jobs"},
+                {"link": "/studies/", "text": "HP Studies"},
+                {"link": "/models/", "text": "Models"},
+            ]
+        }
+
+    return app

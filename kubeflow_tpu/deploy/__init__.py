@@ -1,0 +1,1 @@
+"""Deployment engine: the kfctl/bootstrap equivalent (SURVEY.md L3)."""

@@ -1,0 +1,120 @@
+"""Two-phase deployment coordinator — the kfctl apply engine.
+
+Re-implements the reference's handleDeployment flow (reference:
+bootstrap/cmd/bootstrap/app/kfctlServer.go:105-309): Apply(PLATFORM)
+provisions the underlying infrastructure (GKE/DM there; TPU slice capacity
+here), then Apply(K8S) installs the component manifests with a x3
+constant-backoff retry (:291-296) — the flaky step in real clusters. The
+platform side hides behind a provider interface exactly like the reference
+injects fake coordinator builders for tests (kfctlServer.go:66-67), and the
+whole thing is idempotent: the e2e suite's second-apply test is the contract
+(testing/kfctl/kfctl_second_apply.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Protocol
+
+from kubeflow_tpu.cluster.store import StateStore
+from kubeflow_tpu.config.platform import PlatformDef
+from kubeflow_tpu.deploy import manifests
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import default_registry
+
+log = get_logger(__name__)
+
+APPLY_K8S_RETRIES = 3  # reference kfctlServer.go:291-296
+RETRY_BACKOFF_S = 0.5
+
+
+class PlatformProvider(Protocol):
+    """Provisions the infrastructure under the cluster (the GCP/DM seam)."""
+
+    def apply_platform(self, platform: PlatformDef) -> Dict[str, Any]: ...
+
+    def delete_platform(self, platform: PlatformDef) -> None: ...
+
+
+class LocalProvider:
+    """No-cloud provider: validates slice capacity against local devices."""
+
+    def apply_platform(self, platform: PlatformDef) -> Dict[str, Any]:
+        platform.slice.validate()
+        return {
+            "provider": "local",
+            "topology": platform.slice.topology,
+            "chips": platform.slice.total_chips,
+        }
+
+    def delete_platform(self, platform: PlatformDef) -> None:
+        pass
+
+
+class Coordinator:
+    """Drives one PlatformDef through PLATFORM then K8S apply."""
+
+    def __init__(
+        self,
+        store: StateStore,
+        provider: Optional[PlatformProvider] = None,
+    ) -> None:
+        self.store = store
+        self.provider = provider or LocalProvider()
+        reg = default_registry()
+        # the reference's metric battery (server.go:68-132)
+        self._deploy_seconds = reg.histogram(
+            "deployment_seconds", "end-to-end deploy latency", ["phase"]
+        )
+        self._deploy_total = reg.counter(
+            "deployments_total", "deployment attempts", ["outcome"]
+        )
+
+    def apply(self, platform: PlatformDef) -> Dict[str, Any]:
+        platform.validate()
+        t0 = time.monotonic()
+        try:
+            with self._deploy_seconds.time(phase="platform"):
+                platform_info = self.provider.apply_platform(platform)
+            with self._deploy_seconds.time(phase="k8s"):
+                applied = self._apply_k8s_with_retry(platform)
+        except Exception:
+            self._deploy_total.inc(outcome="failed")
+            raise
+        self._deploy_total.inc(outcome="succeeded")
+        return {
+            "name": platform.name,
+            "platform": platform_info,
+            "objects_applied": applied,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
+
+    def _apply_k8s_with_retry(self, platform: PlatformDef) -> int:
+        objs = manifests.render(platform)
+        last_exc: Optional[Exception] = None
+        for attempt in range(1, APPLY_K8S_RETRIES + 1):
+            try:
+                for obj in objs:
+                    self.store.apply(obj)  # create-or-update: idempotent
+                return len(objs)
+            except Exception as e:  # flaky-boundary retry
+                last_exc = e
+                log.warning(
+                    "Apply(K8S) attempt %d/%d failed: %s",
+                    attempt,
+                    APPLY_K8S_RETRIES,
+                    e,
+                )
+                time.sleep(RETRY_BACKOFF_S * attempt)
+        raise RuntimeError(
+            f"Apply(K8S) failed after {APPLY_K8S_RETRIES} attempts"
+        ) from last_exc
+
+    def delete(self, platform: PlatformDef) -> None:
+        for obj in reversed(manifests.render(platform)):
+            m = obj["metadata"]
+            try:
+                self.store.delete(obj["kind"], m["name"], m["namespace"])
+            except KeyError:
+                pass
+        self.provider.delete_platform(platform)

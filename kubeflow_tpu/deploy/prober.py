@@ -1,0 +1,88 @@
+"""Availability prober — the metric-collector equivalent.
+
+Re-implements the reference's external black-box probe (reference:
+metric-collector/service-readiness/kubeflow-readiness.py): hit the platform
+endpoint on a period, export the `kubeflow_availability` gauge (:20-37), and
+emit a k8s Event on the dashboard service when the state flips (:102-141).
+The OIDC dance is replaced by a pluggable check callable (in-cluster the
+endpoint sits behind the gatekeeper, which takes Basic auth).
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+from kubeflow_tpu.cluster.store import NotFound, StateStore
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import default_registry
+
+log = get_logger(__name__)
+
+Check = Callable[[], bool]
+
+
+def http_check(url: str, timeout_s: float = 5.0) -> Check:
+    def check() -> bool:
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+                return 200 <= resp.status < 400
+        except Exception:
+            return False
+
+    return check
+
+
+class AvailabilityProber:
+    def __init__(
+        self,
+        check: Check,
+        store: Optional[StateStore] = None,
+        period_s: float = 10.0,  # reference probe period (:140-141)
+        event_target: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.check = check
+        self.store = store
+        self.period_s = period_s
+        self.event_target = event_target
+        self.last_state: Optional[bool] = None
+        self._gauge = default_registry().gauge(
+            "kubeflow_availability", "platform endpoint availability", []
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def probe_once(self) -> bool:
+        up = bool(self.check())
+        self._gauge.set(1 if up else 0)
+        if self.last_state is not None and up != self.last_state:
+            log.warning("availability flipped: %s -> %s", self.last_state, up)
+            if self.store is not None and self.event_target is not None:
+                try:
+                    self.store.record_event(
+                        self.event_target,
+                        "AvailabilityUp" if up else "AvailabilityDown",
+                        f"platform endpoint {'reachable' if up else 'unreachable'}",
+                        type="Normal" if up else "Warning",
+                    )
+                except NotFound:
+                    pass
+        self.last_state = up
+        return up
+
+    def start(self) -> None:
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                self.probe_once()
+                self._stop.wait(self.period_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="prober")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -1,0 +1,181 @@
+"""Deployment server + router + GC — the bootstrap server trio.
+
+Re-implements the reference's bootstrap backend (reference:
+bootstrap/cmd/bootstrap/app/):
+
+- **DeployServer** ≡ kfctlServer (kfctlServer.go:81-400): accepts a
+  PlatformDef over REST, enqueues it, and a single worker processes
+  deployments serially off the queue (the goroutine+channel pattern
+  :88-93,311-330); latest status is snapshotted for polling (:332-340,461).
+- **Router** (router.go:146-482): one isolated DeployServer per named
+  deployment, created on demand and proxied to.
+- **GC** (gcServer.go:24-94): expires routers' per-deployment servers after
+  max_lifetime.
+
+Routes:
+- POST /kfctl/apps/v1beta1/create     {spec: PlatformDef-dict, name}
+- GET  /kfctl/apps/v1beta1/status?name=<name>
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from kubeflow_tpu.api.wsgi import App, BadRequest, NotFoundError
+from kubeflow_tpu.cluster.store import StateStore
+from kubeflow_tpu.config.core import ConfigError, from_dict
+from kubeflow_tpu.config.platform import PlatformDef
+from kubeflow_tpu.deploy.coordinator import Coordinator, PlatformProvider
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import default_registry
+
+log = get_logger(__name__)
+
+
+class DeployServer:
+    """Serial deployment processor for ONE deployment target."""
+
+    def __init__(
+        self,
+        store: Optional[StateStore] = None,
+        provider: Optional[PlatformProvider] = None,
+    ) -> None:
+        self.store = store or StateStore()
+        self.coordinator = Coordinator(self.store, provider)
+        self._queue: "queue.Queue[PlatformDef]" = queue.Queue()
+        self._status_lock = threading.Lock()
+        self._status: Dict[str, Any] = {"state": "Pending"}
+        self.created_at = time.time()
+        self._worker = threading.Thread(
+            target=self._process_loop, daemon=True, name="deploy-worker"
+        )
+        self._stop = threading.Event()
+        self._worker.start()
+
+    def submit(self, platform: PlatformDef) -> None:
+        with self._status_lock:
+            self._status = {"state": "Queued", "name": platform.name}
+        self._queue.put(platform)
+
+    def status(self) -> Dict[str, Any]:
+        with self._status_lock:
+            return dict(self._status)
+
+    def _process_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                platform = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            with self._status_lock:
+                self._status = {"state": "Deploying", "name": platform.name}
+            try:
+                result = self.coordinator.apply(platform)
+                with self._status_lock:
+                    self._status = {
+                        "state": "Succeeded",
+                        "name": platform.name,
+                        **result,
+                    }
+            except Exception as e:
+                log.error("deployment %s failed: %s", platform.name, e)
+                with self._status_lock:
+                    self._status = {
+                        "state": "Failed",
+                        "name": platform.name,
+                        "error": str(e),
+                    }
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._worker.join(timeout=2)
+
+
+class Router:
+    """Per-deployment server registry + REST facade + GC."""
+
+    def __init__(
+        self,
+        provider: Optional[PlatformProvider] = None,
+        max_lifetime_s: float = 3600.0,
+        shared_store: Optional[StateStore] = None,
+    ) -> None:
+        self.provider = provider
+        self.max_lifetime_s = max_lifetime_s
+        self.shared_store = shared_store
+        self._servers: Dict[str, DeployServer] = {}
+        self._lock = threading.Lock()
+        reg = default_registry()
+        self._gc_total = reg.counter(
+            "deploy_servers_gc_total", "per-deployment servers expired"
+        )
+        self.app = self._build()
+
+    def _server_for(self, name: str, create: bool = False) -> DeployServer:
+        with self._lock:
+            srv = self._servers.get(name)
+            if srv is None:
+                if not create:
+                    raise NotFoundError(f"no deployment {name!r}")
+                # one isolated server per deployment (router.go:275-405);
+                # a shared store models deploying into one cluster
+                srv = DeployServer(store=self.shared_store, provider=self.provider)
+                self._servers[name] = srv
+            return srv
+
+    def gc(self, now: Optional[float] = None) -> int:
+        """Expire servers past max_lifetime (gcServer.go:56-94).
+
+        Shutdown happens outside the lock: a worker mid-apply can take
+        seconds to join and must not block /create//status routing."""
+        now = now if now is not None else time.time()
+        expired = []
+        with self._lock:
+            for name, srv in list(self._servers.items()):
+                if now - srv.created_at > self.max_lifetime_s:
+                    expired.append(srv)
+                    del self._servers[name]
+        for srv in expired:
+            srv.shutdown()
+            self._gc_total.inc()
+        return len(expired)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for srv in self._servers.values():
+                srv.shutdown()
+            self._servers.clear()
+
+    def _build(self) -> App:
+        app = App("deploy-router")
+
+        @app.post("/kfctl/apps/v1beta1/create")
+        def create(req):
+            body = req.body or {}
+            spec = body.get("spec") or {}
+            try:
+                platform = from_dict(PlatformDef, spec)
+                platform.validate()
+            except ConfigError as e:
+                raise BadRequest(f"invalid PlatformDef: {e}")
+            name = body.get("name") or platform.name
+            srv = self._server_for(name, create=True)
+            srv.submit(platform)
+            return {"success": True, "name": name, "state": "Queued"}, 201
+
+        @app.get("/kfctl/apps/v1beta1/status")
+        def status(req):
+            name = req.query.get("name", "")
+            if not name:
+                raise BadRequest("name query param required")
+            srv = self._server_for(name)
+            return {"success": True, **srv.status()}
+
+        @app.post("/kfctl/apps/v1beta1/gc")
+        def run_gc(req):
+            return {"success": True, "expired": self.gc()}
+
+        return app

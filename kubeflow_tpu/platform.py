@@ -1,0 +1,155 @@
+"""Platform assembly — everything wired together, in process.
+
+The reference's "running platform" is a GKE cluster with ~20 deployments the
+e2e asserts ready (reference: testing/kfctl/kf_is_ready_test.py:75-180).
+The TPU platform's equivalent is this object: one StateStore, every
+controller registered on a ControllerManager, admission hooks installed, the
+REST backends built, and a pod executor playing kubelet. It serves three
+roles:
+
+- the hermetic e2e harness (tests drive exactly what a cluster would run),
+- the single-host/dev deployment mode (a real working platform on one TPU
+  VM — train jobs actually train),
+- the component registry a real-cluster deployment renders into manifests
+  (deploy/manifests.py uses the same roster).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from kubeflow_tpu.cluster.reconciler import ControllerManager
+from kubeflow_tpu.cluster.store import StateStore
+from kubeflow_tpu.config.platform import PlatformDef
+from kubeflow_tpu.controllers import poddefaults
+from kubeflow_tpu.controllers.inference import InferenceServiceController
+from kubeflow_tpu.controllers.notebook import NotebookController
+from kubeflow_tpu.controllers.profile import ProfileController
+from kubeflow_tpu.controllers.statefulset import (
+    DeploymentController,
+    StatefulSetController,
+)
+from kubeflow_tpu.controllers.studyjob import StudyJobController
+from kubeflow_tpu.controllers.tensorboard import TensorboardController
+from kubeflow_tpu.controllers.tpujob import TPUTrainJobController
+from kubeflow_tpu.deploy.coordinator import Coordinator
+from kubeflow_tpu.runtime.executor import (
+    FakePodRunner,
+    InProcessTrainerRunner,
+    PodExecutor,
+    PodRunner,
+)
+from kubeflow_tpu.api import dashboard as dashboard_api
+from kubeflow_tpu.api import kfam as kfam_api
+from kubeflow_tpu.api import spawner as spawner_api
+
+
+class Platform:
+    """One fully-wired platform instance over a single state store."""
+
+    def __init__(
+        self,
+        platform_def: Optional[PlatformDef] = None,
+        pod_runner: Optional[PodRunner] = None,
+        activity_probe=None,
+        profile_plugins=None,
+    ) -> None:
+        self.platform_def = platform_def or PlatformDef()
+        self.store = StateStore()
+        poddefaults.register(self.store)
+
+        self.manager = ControllerManager(self.store)
+        use_istio = self.platform_def.use_istio
+        gw = self.platform_def.istio_gateway
+        self.controllers = [
+            StatefulSetController(),
+            DeploymentController(),
+            TPUTrainJobController(),
+            StudyJobController(),
+            NotebookController(
+                use_istio=use_istio,
+                istio_gateway=gw,
+                activity_probe=activity_probe,
+            ),
+            TensorboardController(use_istio=use_istio, istio_gateway=gw),
+            InferenceServiceController(use_istio=use_istio, istio_gateway=gw),
+            ProfileController(
+                user_id_header=self.platform_def.user_id_header,
+                user_id_prefix=self.platform_def.user_id_prefix,
+                plugins=profile_plugins,
+            ),
+        ]
+        for c in self.controllers:
+            self.manager.register(c)
+
+        runner = pod_runner
+        if runner is None:
+            runner = InProcessTrainerRunner()
+        self.executor = PodExecutor(self.store, runner)
+
+        hdr = self.platform_def.user_id_header
+        prefix = self.platform_def.user_id_prefix
+        self.spawner = spawner_api.build_app(
+            self.store,
+            defaults=self.platform_def.notebooks,
+            user_header=hdr,
+            user_prefix=prefix,
+        )
+        self.kfam = kfam_api.build_app(
+            self.store, user_header=hdr, user_prefix=prefix
+        )
+        self.dashboard = dashboard_api.build_app(
+            self.store, user_header=hdr, user_prefix=prefix
+        )
+        self.metrics_service = self.dashboard.metrics_service
+        self.coordinator = Coordinator(self.store)
+        self._sampler_stop = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def deploy(self) -> Dict[str, Any]:
+        """Two-phase apply of the platform's own manifests (kfctl Apply)."""
+        return self.coordinator.apply(self.platform_def)
+
+    def start(self, metrics_sample_period_s: float = 15.0) -> "Platform":
+        self.manager.start()
+        self.executor.start()
+        import threading
+
+        stop = threading.Event()
+
+        def sample_loop():
+            sample = getattr(self.metrics_service, "sample", None)
+            while not stop.is_set():
+                if sample is not None:
+                    sample()
+                stop.wait(metrics_sample_period_s)
+
+        self._sampler_stop = stop
+        threading.Thread(
+            target=sample_loop, daemon=True, name="metrics-sampler"
+        ).start()
+        return self
+
+    def stop(self) -> None:
+        if self._sampler_stop is not None:
+            self._sampler_stop.set()
+        self.executor.stop()
+        self.manager.stop()
+
+    def settle(self, max_seconds: float = 30.0) -> None:
+        """Deterministic drain for tests: reconcile + kubelet until quiet."""
+        for _ in range(40):
+            self.manager.run_until_idle(max_seconds=max_seconds)
+            if self.executor.tick() == 0 and self.executor.tick() == 0:
+                self.manager.run_until_idle(max_seconds=max_seconds)
+                sample = getattr(self.metrics_service, "sample", None)
+                if sample is not None:
+                    sample()
+                return
+
+    def __enter__(self) -> "Platform":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

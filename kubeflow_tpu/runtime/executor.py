@@ -39,9 +39,12 @@ def pod_env(pod: Dict[str, Any]) -> Dict[str, str]:
 
 
 class PodRunner:
-    """Decides what happens to a scheduled pod. Returns (phase, info)."""
+    """Decides what happens to a scheduled pod.
 
-    def run(self, pod: Dict[str, Any]) -> Tuple[str, Dict[str, str]]:
+    Returns (terminal_phase, info) — or (None, {}) for a pod that keeps
+    running (service/notebook pods have no terminal state)."""
+
+    def run(self, pod: Dict[str, Any]) -> Tuple[Optional[str], Dict[str, str]]:
         raise NotImplementedError
 
 
@@ -85,7 +88,7 @@ class InProcessTrainerRunner(PodRunner):
         self.steps_override = steps_override
         self.last_metrics: Optional[Dict[str, float]] = None
 
-    def run(self, pod: Dict[str, Any]) -> Tuple[str, Dict[str, str]]:
+    def run(self, pod: Dict[str, Any]) -> Tuple[Optional[str], Dict[str, str]]:
         import json
 
         from kubeflow_tpu.config.core import from_dict
@@ -93,6 +96,10 @@ class InProcessTrainerRunner(PodRunner):
         from kubeflow_tpu.runtime.train_run import run_training
 
         env = pod_env(pod)
+        if "KFT_TRAINING_SPEC" not in env:
+            # not a training pod (notebook/component/service): it has no
+            # terminal state — it just keeps running
+            return None, {}
         if env.get("KFT_PROCESS_ID", "0") != "0":
             # non-coordinator members of a simulated gang just report success;
             # the coordinator's in-process mesh covers their devices.
@@ -207,6 +214,8 @@ class PodExecutor:
                         "reason": "RunnerError",
                         "message": traceback.format_exc(limit=3),
                     }
+                if terminal is None:
+                    continue  # long-running pod: no terminal transition
                 self._set_phase(pod, terminal, info)
                 n += 1
         return n

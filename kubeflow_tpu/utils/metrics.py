@@ -251,6 +251,27 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def collect(self) -> list:
+        """Structured snapshot of counters/gauges: [{name, type, samples:
+        [{labels, value}]}] — the programmatic twin of render() for metric
+        services (dashboard charts) that shouldn't parse exposition text."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        out = []
+        for m in metrics:
+            if not isinstance(m, (Counter, Gauge)):
+                continue
+            with m._lock:
+                samples = [
+                    {
+                        "labels": dict(zip(m.label_names, key)),
+                        "value": v,
+                    }
+                    for key, v in sorted(m._values.items())
+                ]
+            out.append({"name": m.name, "type": m.kind, "samples": samples})
+        return out
+
     def render(self) -> str:
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]

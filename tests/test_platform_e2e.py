@@ -1,0 +1,155 @@
+"""Whole-platform e2e: deploy → onboard → spawn notebook → train → study.
+
+The hermetic twin of the reference's cluster e2e tier (SURVEY.md §4 T4:
+kf_is_ready_test.py roster assertions + workload e2e) driven through the
+assembled Platform object — every controller, webhook, API, and the real
+XLA training path in one flow.
+"""
+
+import pytest
+
+from kubeflow_tpu.controllers import wait_for_condition
+from kubeflow_tpu.controllers.tpujob import new_tpu_train_job
+from kubeflow_tpu.deploy.manifests import PLATFORM_NAMESPACE
+from kubeflow_tpu.platform import Platform
+from kubeflow_tpu.runtime.executor import InProcessTrainerRunner
+
+ALICE = "alice@corp.com"
+HDR = {"x-auth-user-email": ALICE}
+
+
+@pytest.fixture()
+def platform():
+    return Platform(pod_runner=InProcessTrainerRunner(steps_override=2))
+
+
+class TestPlatformE2E:
+    def test_deploy_roster_ready(self, platform):
+        """kf_is_ready_test equivalent: all components applied."""
+        result = platform.deploy()
+        assert result["objects_applied"] > 10
+        deps = platform.store.list("Deployment", PLATFORM_NAMESPACE)
+        assert len(deps) >= 10
+
+    def test_full_user_journey(self, platform, devices8):
+        p = platform
+        p.deploy()
+
+        # 1. onboarding: dashboard workgroup flow (§3.4)
+        status, body = p.dashboard.handle(
+            "GET", "/api/workgroup/exists", headers=HDR
+        )
+        assert status == 200 and body["hasWorkgroup"] is False
+        status, body = p.dashboard.handle(
+            "POST", "/api/workgroup/create", body={"namespace": "alice"}, headers=HDR
+        )
+        assert status == 201
+        p.settle()
+        assert p.store.get("Namespace", "alice", "alice")
+        status, body = p.dashboard.handle(
+            "GET", "/api/workgroup/exists", headers=HDR
+        )
+        assert body["hasWorkgroup"] is True
+
+        # 2. spawn a notebook (§3.2)
+        status, body = p.spawner.handle(
+            "POST",
+            "/api/namespaces/alice/notebooks",
+            body={"name": "lab", "tpu": "v5e-1"},
+            headers=HDR,
+        )
+        assert status == 201, body
+        p.settle()
+        assert p.store.get("StatefulSet", "lab", "alice")
+
+        # 3. submit a training job (§3.3) — real XLA training
+        p.store.create(
+            new_tpu_train_job(
+                "train",
+                "alice",
+                training={
+                    "model": "mlp",
+                    "global_batch_size": 8,
+                    "steps": 2,
+                    "mesh": {"data": 4},
+                    "checkpoint": {"enabled": False},
+                },
+                slice_spec={"topology": "v5e-4"},
+            )
+        )
+        for _ in range(10):
+            p.settle()
+            job = p.store.get("TPUTrainJob", "train", "alice")
+            if any(
+                c["type"] == "Succeeded" and c["status"] == "True"
+                for c in job.get("status", {}).get("conditions", [])
+            ):
+                break
+        job = wait_for_condition(
+            p.store, "TPUTrainJob", "train", "alice", "Succeeded", timeout_s=5
+        )
+        assert job["status"]["trainingMetrics"]["items_per_sec"] > 0
+
+        # 4. activity feed shows the journey
+        status, body = p.dashboard.handle(
+            "GET", "/api/activities/alice", headers=HDR
+        )
+        reasons = {a["event"] for a in body["activities"]}
+        assert "GangScheduled" in reasons
+
+    def test_background_mode_lifecycle(self, platform, devices8):
+        with platform as p:
+            p.store.create(
+                new_tpu_train_job(
+                    "bg",
+                    training={
+                        "model": "mlp",
+                        "global_batch_size": 8,
+                        "steps": 2,
+                        "mesh": {"data": 4},
+                        "checkpoint": {"enabled": False},
+                    },
+                    slice_spec={"topology": "v5e-4"},
+                )
+            )
+            job = wait_for_condition(
+                p.store, "TPUTrainJob", "bg", "default", "Succeeded", timeout_s=60
+            )
+            assert job["status"]["replicaStatuses"]["succeeded"] == 1
+
+
+class TestDashboardGuards:
+    def test_activities_require_membership(self, platform):
+        p = platform
+        p.deploy()
+        p.dashboard.handle(
+            "POST", "/api/workgroup/create", body={"namespace": "alice"}, headers=HDR
+        )
+        p.settle()
+        status, _ = p.dashboard.handle("GET", "/api/activities/alice", headers=HDR)
+        assert status == 200
+        status, _ = p.dashboard.handle(
+            "GET", "/api/activities/alice",
+            headers={"x-auth-user-email": "eve@corp.com"},
+        )
+        assert status == 403
+        status, _ = p.dashboard.handle("GET", "/api/activities/alice")
+        assert status == 403
+
+    def test_metrics_endpoint_serves_sampled_points(self, platform):
+        p = platform
+        p.deploy()
+        p.dashboard.handle(
+            "POST", "/api/workgroup/create", body={"namespace": "alice"}, headers=HDR
+        )
+        p.settle()  # settle() samples gauges into the metrics service
+        status, body = p.dashboard.handle(
+            "GET", "/api/metrics/alice",
+            headers=HDR,
+            query={"metric": "kubeflow_availability", "window_s": "60"},
+        )
+        assert status == 200
+        status, body = p.dashboard.handle(
+            "GET", "/api/metrics/alice", headers=HDR, query={"window_s": "soon"}
+        )
+        assert status == 400
