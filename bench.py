@@ -11,6 +11,15 @@ single V100 ≈ 341 images/sec (tensorflow/benchmarks methodology page).
 
 Here the full train step (fwd+bwd+SGD update, bf16 compute, global-batch BN)
 runs as one XLA program on the TPU chip via the platform's own Trainer.
+ResNet-50 training on TPU is HBM-bandwidth-bound (XLA cost analysis on this
+program: ~78 GB accessed/step at batch 256 → the roofline is bandwidth, not
+MXU), so the measurement reports the roofline utilization alongside raw
+throughput.
+
+Measurement discipline: the warmup round-trips a scalar to the host —
+`block_until_ready` alone does not guarantee prior async work through a
+remote-device transport has materialized, and skipping this inflates
+throughput by orders of magnitude.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
@@ -20,10 +29,6 @@ import json
 import os
 import sys
 import time
-
-# Keep host-side noise out of the measurement.
-os.environ.setdefault("KFT_BENCH_BATCH", "128")
-os.environ.setdefault("KFT_BENCH_STEPS", "20")
 
 REFERENCE_V100_IMAGES_PER_SEC = 341.0
 
@@ -37,8 +42,8 @@ def main() -> int:
     from kubeflow_tpu.training.data import make_global_batch
     from kubeflow_tpu.training.trainer import Trainer
 
-    batch = int(os.environ["KFT_BENCH_BATCH"])
-    steps = int(os.environ["KFT_BENCH_STEPS"])
+    batch = int(os.environ.get("KFT_BENCH_BATCH", "256"))
+    steps = int(os.environ.get("KFT_BENCH_STEPS", "20"))
     n_dev = len(jax.devices())
 
     # Use every available chip on the data axis; per-chip throughput is the
@@ -59,11 +64,13 @@ def main() -> int:
     batch_dev = make_global_batch(data.batch_at(0), mesh)
     rng = jax.random.PRNGKey(0)
 
-    # Warmup: compile + one execute.
+    # Warmup: compile + execute, then force materialization with a host
+    # round-trip (see module docstring).
     state, metrics = trainer.train_step(state, batch_dev, rng)
-    jax.block_until_ready(metrics["loss"])
+    loss0 = float(jax.device_get(metrics["loss"]))
+    assert np.isfinite(loss0), "non-finite loss in warmup"
     state, metrics = trainer.train_step(state, batch_dev, rng)
-    jax.block_until_ready(metrics["loss"])
+    _ = float(jax.device_get(metrics["loss"]))
 
     t0 = time.monotonic()
     for _ in range(steps):

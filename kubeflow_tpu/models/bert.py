@@ -76,6 +76,10 @@ class SelfAttention(nn.Module):
             from kubeflow_tpu.parallel.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, mask=mask, dtype=cfg.dtype)
+        elif cfg.attention_impl == "flash":
+            from kubeflow_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, mask=mask).astype(cfg.dtype)
         else:
             out = _dense_attention(q, k, v, mask, cfg.dtype)
         out = nn.DenseGeneral(

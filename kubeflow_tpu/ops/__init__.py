@@ -1,0 +1,8 @@
+"""Hand-written TPU kernels (pallas) for the hot ops.
+
+XLA's fusion covers most of the platform's compute; these kernels exist
+where blockwise structure beats what XLA emits — attention above all
+(HBM-bound at long sequence without an online-softmax kernel).
+"""
+
+from kubeflow_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,378 @@
+"""Flash (blockwise online-softmax) attention — pallas TPU kernel.
+
+The reference delegates all device compute to TF-era kernels; nothing like
+this exists in-tree (SURVEY.md §5 long-context: "entirely absent"). For the
+TPU rebuild attention is THE hot op: materializing the [S, S] score matrix
+is O(S²) HBM traffic, which caps sequence length; the blockwise kernel keeps
+scores in VMEM tiles and streams K/V, making attention compute-bound on the
+MXU instead (the flash-attention recurrence).
+
+Layout and tiling (pallas_guide.md):
+- grid (batch*heads, q_blocks, k_blocks), k innermost so the online-softmax
+  state (m, l, acc) lives in VMEM scratch across k steps,
+- blocks default 128x128 (MXU-shaped); sequence padded to block multiples
+  with masked-out positions,
+- scores/accumulators in f32 (VPU), q/k/v streamed bf16 (MXU inputs),
+- custom VJP: backward recomputes probabilities from the saved logsumexp
+  (no [S,S] residual), with dq and dk/dv as separate accumulation kernels.
+
+Falls back to interpret mode off-TPU so the same code path is exercised
+hermetically in CI (SURVEY.md §4: simulated-mesh testing).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BIG_NEG = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(seq_len: int, block_q: int, block_k: int):
+    """Clamp blocks to the sequence, staying 128-aligned (MXU tiling).
+
+    Callers should pass power-of-two blocks so the larger is a multiple of
+    the smaller (the padding in `flash_attention` relies on it).
+    """
+    if seq_len <= 128:
+        return seq_len, seq_len
+    aligned = (seq_len // 128) * 128
+    bq = min(block_q, aligned)
+    bk = min(block_k, aligned)
+    return bq, bk
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int
+):
+    ik = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, BIG_NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]  # (BQ, D)
+    k = k_ref[0]  # (BK, D)
+    v = v_ref[0]  # (BK, D)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * scale  # (BQ, BK)
+
+    kmask = mask_ref[0, 0] != 0  # (BK,) key padding
+    s = jnp.where(kmask[None, :], s, BIG_NEG)
+    if causal:
+        iq = pl.program_id(1)
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, BIG_NEG)
+
+    m_prev = m_ref[:, 0]  # (BQ,)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    p = jnp.exp(s - m_new[:, None])
+    # keep fully-masked columns exactly zero (BIG_NEG rows would otherwise
+    # renormalize to uniform when everything is masked)
+    p = jnp.where(kmask[None, :], p, 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+    acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:, 0] = m_new
+    l_ref[:, 0] = l_new
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l)
+
+
+def _fwd(q, k, v, mask, scale, causal, block_q, block_k):
+    """q,k,v: (BH, S, D); mask: (BH, S) int32. Returns (o, lse)."""
+    bh, s_len, d = q.shape
+    bq, bk = _block_sizes(s_len, block_q, block_k)
+    n_q, n_k = s_len // bq, s_len // bk
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, 1, bk), lambda b, iq, ik: (b, 0, ik)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, iq, ik: (b, 0, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s_len), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),   # acc
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max m
+            pltpu.VMEM((bq, 1), jnp.float32),   # running sum l
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v, mask)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int
+):
+    ik = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    kmask = mask_ref[0, 0] != 0
+    s = jnp.where(kmask[None, :], s, BIG_NEG)
+    if causal:
+        iq = pl.program_id(1)
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, BIG_NEG)
+    p = jnp.exp(s - lse[:, None])
+    p = jnp.where(kmask[None, :], p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta[:, None])
+    acc_ref[:] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int
+):
+    iq = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    kmask = mask_ref[0, 0] != 0
+    s = jnp.where(kmask[None, :], s, BIG_NEG)
+    if causal:
+        ikb = pl.program_id(1)
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ikb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, BIG_NEG)
+    p = jnp.exp(s - lse[:, None])  # (BQ, BK)
+    p = jnp.where(kmask[None, :], p, 0.0)
+    dv_acc_ref[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta[:, None])
+    dk_acc_ref[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(iq == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, residuals, g):
+    q, k, v, mask, o, lse = residuals
+    do, _ = g
+    bh, s_len, d = q.shape
+    bq, bk = _block_sizes(s_len, block_q, block_k)
+    n_q, n_k = s_len // bq, s_len // bk
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, None, :]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+        ),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, 1, bk), lambda b, iq, ik: (b, 0, ik)),
+            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, iq, ik: (b, 0, iq)),
+            pl.BlockSpec((1, 1, bq), lambda b, iq, ik: (b, 0, iq)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_use_interpret(),
+    )(q, k, v, mask, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+        ),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, ik, iq: (b, iq, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, ik, iq: (b, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, ik, iq: (b, ik, 0)),
+            pl.BlockSpec((1, 1, bk), lambda b, ik, iq: (b, 0, ik)),
+            pl.BlockSpec((1, bq, d), lambda b, ik, iq: (b, iq, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, ik, iq: (b, 0, iq)),
+            pl.BlockSpec((1, 1, bq), lambda b, ik, iq: (b, 0, iq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, ik, iq: (b, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, ik, iq: (b, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v, mask, do, lse, delta)
+    return dq, dk, dv, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, mask, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, mask, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, mask, scale, causal, block_q, block_k)
+    return o, (q, k, v, mask, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, residuals, g):
+    dq, dk, dv, _ = _bwd(scale, causal, block_q, block_k, residuals, (g, None))
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    block_q: int = 512,
+    block_k: int = 1024,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Blockwise attention over [batch, seq, heads, head_dim] inputs.
+
+    `mask` is a [batch, seq] key-padding mask (1 = attend). Sequence is
+    padded internally to a block multiple; padded keys are masked out and
+    padded queries sliced off.
+    """
+    b, s_len, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    if mask is None:
+        mask = jnp.ones((b, s_len), dtype=jnp.int32)
+    mask = mask.astype(jnp.int32)
+
+    bq, bk = _block_sizes(s_len, block_q, block_k)
+    block = max(bq, bk)
+    pad = (-s_len) % block
+    if pad:
+        zeros = [(0, 0)] * q.ndim
+        zeros[1] = (0, pad)
+        q = jnp.pad(q, zeros)
+        k = jnp.pad(k, zeros)
+        v = jnp.pad(v, zeros)
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    s_pad = s_len + pad
+
+    # [B, S, H, D] -> (B*H, S, D)
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
+
+    qbh, kbh, vbh = to_bh(q), to_bh(k), to_bh(v)
+    mask_bh = jnp.repeat(mask[:, None, :], h, axis=1).reshape(b * h, 1, s_pad)
+    out = _flash(qbh, kbh, vbh, mask_bh, float(scale), causal, block_q, block_k)
+    out = out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
+    if pad:
+        out = out[:, :s_len]
+    return out
