@@ -61,6 +61,44 @@ def is_owner_or_admin(store: StateStore, user: str, namespace: str) -> bool:
     return False
 
 
+READ_VERBS = frozenset({"get", "list", "watch"})
+
+
+def store_authorizer(store: StateStore):
+    """SubjectAccessReview-shaped authorizer backed by the state store.
+
+    The reference gates every spawner k8s call with a SubjectAccessReview
+    (jupyter-web-app common/api.py:80-193); the platform equivalent checks
+    namespace ownership (Profile owner annotation), admin RoleBindings, and
+    contributor RoleBindings. `view`-role contributors get read verbs only;
+    unknown users are denied (default-deny).
+    """
+
+    def authorize(user: str, verb: str, resource: str, namespace: str) -> bool:
+        if not user:
+            return False
+        ns = store.try_get("Namespace", namespace, namespace)
+        if ns is not None and (
+            ns["metadata"].get("annotations", {}).get(OWNER_ANNOTATION) == user
+        ):
+            return True
+        for rb in store.list("RoleBinding", namespace):
+            subjects = rb.get("spec", {}).get("subjects", [])
+            if not any(
+                s.get("kind") == "User" and s.get("name") == user
+                for s in subjects
+            ):
+                continue
+            role = rb.get("spec", {}).get("roleRef", {}).get("name", "")
+            if role in (ADMIN_ROLE, EDIT_ROLE):
+                return True
+            if role == VIEW_ROLE and verb in READ_VERBS:
+                return True
+        return False
+
+    return authorize
+
+
 def build_app(
     store: StateStore,
     user_header: str = "x-auth-user-email",

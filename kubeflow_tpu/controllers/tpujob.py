@@ -473,9 +473,11 @@ class TPUTrainJobController(Controller):
         status = job["status"]
         restarts = status.get("restarts", 0)
         max_restarts = (job["spec"].get("runPolicy") or {}).get("maxRestarts", 0)
+        # tolerate pods deleted out-of-band (e.g. cascade GC racing a
+        # failure) — a missing gang member must not crash the reconcile
         failed = [
             n for n in desired
-            if pods[n].get("status", {}).get("phase") == FAILED
+            if pods.get(n, {}).get("status", {}).get("phase") == FAILED
         ]
         if restarts >= max_restarts:
             self._finish(

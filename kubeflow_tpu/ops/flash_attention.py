@@ -38,18 +38,31 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _block_sizes(seq_len: int, block_q: int, block_k: int):
-    """Clamp blocks to the sequence, staying 128-aligned (MXU tiling).
+def _resolve_blocks(s_pad: int, block_q: int, block_k: int):
+    """Pick final (bq, bk) as exact divisors of the padded length.
 
-    Callers should pass power-of-two blocks so the larger is a multiple of
-    the smaller (the padding in `flash_attention` relies on it).
+    `s_pad` is the sequence length after padding to a 128 multiple (or the
+    raw length when <= 128). Each block is the largest multiple of 128 that
+    both divides `s_pad` and does not exceed the requested block size — so
+    the grid `s_pad // b` always tiles the whole sequence, with no trailing
+    remainder blocks (128 always qualifies since 128 | s_pad).
     """
-    if seq_len <= 128:
-        return seq_len, seq_len
-    aligned = (seq_len // 128) * 128
-    bq = min(block_q, aligned)
-    bk = min(block_k, aligned)
-    return bq, bk
+    if s_pad <= 128:
+        return s_pad, s_pad
+
+    def best(cap: int) -> int:
+        cap = min(cap, s_pad)
+        if cap >= 128:
+            for d in range(cap - cap % 128, 0, -128):
+                if s_pad % d == 0:
+                    return d
+        # sub-128 request (caller bounding VMEM): honor the largest divisor
+        for d in range(cap, 0, -1):
+            if s_pad % d == 0:
+                return d
+        return 128
+
+    return best(block_q), best(block_k)
 
 
 # ---------------------------------------------------------------------------
@@ -113,9 +126,14 @@ def _fwd_kernel(
 
 
 def _fwd(q, k, v, mask, scale, causal, block_q, block_k):
-    """q,k,v: (BH, S, D); mask: (BH, S) int32. Returns (o, lse)."""
+    """q,k,v: (BH, S, D); mask: (BH, S) int32. Returns (o, lse).
+
+    block_q/block_k must already be resolved divisors of S (see
+    `_resolve_blocks`); every block is processed — no truncation.
+    """
     bh, s_len, d = q.shape
-    bq, bk = _block_sizes(s_len, block_q, block_k)
+    bq, bk = block_q, block_k
+    assert s_len % bq == 0 and s_len % bk == 0, (s_len, bq, bk)
     n_q, n_k = s_len // bq, s_len // bk
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
@@ -256,7 +274,8 @@ def _bwd(scale, causal, block_q, block_k, residuals, g):
     q, k, v, mask, o, lse = residuals
     do, _ = g
     bh, s_len, d = q.shape
-    bq, bk = _block_sizes(s_len, block_q, block_k)
+    bq, bk = block_q, block_k
+    assert s_len % bq == 0 and s_len % bk == 0, (s_len, bq, bk)
     n_q, n_k = s_len // bq, s_len // bk
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, None, :]
 
@@ -343,8 +362,10 @@ def flash_attention(
     """Blockwise attention over [batch, seq, heads, head_dim] inputs.
 
     `mask` is a [batch, seq] key-padding mask (1 = attend). Sequence is
-    padded internally to a block multiple; padded keys are masked out and
-    padded queries sliced off.
+    padded internally to a 128 multiple; the final block sizes are resolved
+    here as exact divisors of the padded length and passed down unchanged to
+    the forward/backward kernels (padded keys are masked out and padded
+    queries sliced off).
     """
     b, s_len, h, d = q.shape
     if scale is None:
@@ -353,9 +374,8 @@ def flash_attention(
         mask = jnp.ones((b, s_len), dtype=jnp.int32)
     mask = mask.astype(jnp.int32)
 
-    bq, bk = _block_sizes(s_len, block_q, block_k)
-    block = max(bq, bk)
-    pad = (-s_len) % block
+    pad = 0 if s_len <= 128 else (-s_len) % 128
+    bq, bk = _resolve_blocks(s_len + pad, block_q, block_k)
     if pad:
         zeros = [(0, 0)] * q.ndim
         zeros[1] = (0, pad)
@@ -371,7 +391,7 @@ def flash_attention(
 
     qbh, kbh, vbh = to_bh(q), to_bh(k), to_bh(v)
     mask_bh = jnp.repeat(mask[:, None, :], h, axis=1).reshape(b * h, 1, s_pad)
-    out = _flash(qbh, kbh, vbh, mask_bh, float(scale), causal, block_q, block_k)
+    out = _flash(qbh, kbh, vbh, mask_bh, float(scale), causal, bq, bk)
     out = out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
     if pad:
         out = out[:, :s_len]

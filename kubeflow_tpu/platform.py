@@ -89,9 +89,15 @@ class Platform:
 
         hdr = self.platform_def.user_id_header
         prefix = self.platform_def.user_id_prefix
+        # store-backed SubjectAccessReview gate: without it App.require falls
+        # back to allow_all and any identity could manage another user's
+        # notebooks/PVCs (reference gates these calls per-request,
+        # jupyter-web-app common/api.py:80-193)
+        self.authorizer = kfam_api.store_authorizer(self.store)
         self.spawner = spawner_api.build_app(
             self.store,
             defaults=self.platform_def.notebooks,
+            authorizer=self.authorizer,
             user_header=hdr,
             user_prefix=prefix,
         )

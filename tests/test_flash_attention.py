@@ -61,6 +61,21 @@ class TestForward:
         assert got.shape == want.shape == (1, 200, 2, 64)
         np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
 
+    @pytest.mark.parametrize("s", [640, 650, 768, 896])
+    def test_awkward_seq_lengths_default_blocks(self, s):
+        """Regression: lengths where clamped blocks used to truncate the grid
+        (trailing query/key blocks silently unprocessed)."""
+        q, k, v = make_qkv(b=1, s=s, h=2, d=32)
+        got = flash_attention(q, k, v)  # default block_q=512, block_k=1024
+        want = reference_attention(q, k, v)
+        np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+
+    def test_awkward_seq_length_causal(self):
+        q, k, v = make_qkv(b=1, s=768, h=2, d=32)
+        got = flash_attention(q, k, v, causal=True)
+        want = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+
     def test_bfloat16_inputs(self):
         q, k, v = make_qkv(dtype=jnp.bfloat16)
         got = flash_attention(q, k, v)
@@ -80,6 +95,23 @@ class TestForward:
 class TestGradients:
     def test_grads_match_reference(self):
         q, k, v = make_qkv(b=1, s=128, h=2, d=32)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(
+                a, b, atol=5e-3, rtol=5e-3, err_msg=f"d{name}"
+            )
+
+    def test_grads_awkward_seq_length(self):
+        """Gradients at a length that used to hit the truncated-grid bug."""
+        q, k, v = make_qkv(b=1, s=768, h=1, d=32, seed=5)
 
         def loss_flash(q, k, v):
             return jnp.sum(flash_attention(q, k, v) ** 2)

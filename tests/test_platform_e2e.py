@@ -136,6 +136,61 @@ class TestDashboardGuards:
         status, _ = p.dashboard.handle("GET", "/api/activities/alice")
         assert status == 403
 
+    def test_spawner_enforces_namespace_isolation(self, platform):
+        """The spawner is SubjectAccessReview-gated: an identity without a
+        RoleBinding in the namespace is denied (default-deny), a view
+        contributor may list but not create, an edit contributor may create."""
+        p = platform
+        p.deploy()
+        p.dashboard.handle(
+            "POST", "/api/workgroup/create", body={"namespace": "alice"}, headers=HDR
+        )
+        p.settle()
+
+        eve = {"x-auth-user-email": "eve@corp.com"}
+        status, _ = p.spawner.handle(
+            "POST", "/api/namespaces/alice/notebooks",
+            body={"name": "intruder"}, headers=eve,
+        )
+        assert status == 403
+        status, _ = p.spawner.handle(
+            "GET", "/api/namespaces/alice/notebooks", headers=eve
+        )
+        assert status == 403
+        assert p.store.try_get("Notebook", "intruder", "alice") is None
+
+        # owner grants view → list ok, create still denied
+        status, _ = p.kfam.handle(
+            "POST", "/kfam/v1/bindings",
+            body={"user": "eve@corp.com", "referredNamespace": "alice",
+                  "role": "view"},
+            headers=HDR,
+        )
+        assert status in (200, 201)
+        status, _ = p.spawner.handle(
+            "GET", "/api/namespaces/alice/notebooks", headers=eve
+        )
+        assert status == 200
+        status, _ = p.spawner.handle(
+            "POST", "/api/namespaces/alice/notebooks",
+            body={"name": "intruder"}, headers=eve,
+        )
+        assert status == 403
+
+        # upgrade to edit → create allowed
+        status, _ = p.kfam.handle(
+            "POST", "/kfam/v1/bindings",
+            body={"user": "eve@corp.com", "referredNamespace": "alice",
+                  "role": "edit"},
+            headers=HDR,
+        )
+        assert status in (200, 201)
+        status, body = p.spawner.handle(
+            "POST", "/api/namespaces/alice/notebooks",
+            body={"name": "shared"}, headers=eve,
+        )
+        assert status == 201, body
+
     def test_metrics_endpoint_serves_sampled_points(self, platform):
         p = platform
         p.deploy()

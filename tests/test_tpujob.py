@@ -171,6 +171,21 @@ class TestGangLifecycle:
         # every worker reran (whole-gang restart, not single-pod)
         assert runner.ran.count("train1-worker-0") == 2
 
+    def test_gang_failure_tolerates_pod_deleted_out_of_band(self):
+        """A gang member deleted (e.g. cascade GC racing the failure) while
+        another pod is Failed must trigger a restart, not a KeyError."""
+        runner = FakePodRunner()
+        store, cm, executor = make_harness(runner)
+        submit(store)
+        cm.run_until_idle(max_seconds=5)
+        pod = store.get("Pod", "train1-worker-2", "team-a")
+        pod.setdefault("status", {})["phase"] = "Failed"
+        store.update(pod)
+        store.delete("Pod", "train1-worker-1", "team-a")
+        cm.run_until_idle(max_seconds=5)
+        job = store.get("TPUTrainJob", "train1", "team-a")
+        assert job["status"]["restarts"] == 1
+
     def test_backoff_limit_exhaustion_fails_job(self):
         runner = FakePodRunner()
         store, cm, executor = make_harness(runner)
