@@ -154,6 +154,45 @@ class CheckpointConfig(ConfigNode):
 
 
 @dataclasses.dataclass
+class DataConfig(ConfigNode):
+    """Input-pipeline selection: synthetic (the tf-cnn default, reference
+    launcher.py:81-88 passes no data flags) or a real dataset, plus the eval
+    loop knobs that make train-to-accuracy jobs (BASELINE.json north star)
+    expressible."""
+
+    name: str = config_field(
+        default="synthetic", help="dataset: synthetic | blobs | npz"
+    )
+    path: str = config_field(default="", help="file/dir for npz datasets")
+    eval_fraction: float = config_field(
+        default=0.0, help="held-out fraction split from train when no eval file"
+    )
+    eval_every_steps: int = config_field(
+        default=0, help="eval period; 0 = only at end of training"
+    )
+    eval_batch_size: int = config_field(default=0, help="0 = global_batch_size")
+    target_accuracy: float = config_field(
+        default=0.0, help="stop early when eval top-1 reaches this (0 = off)"
+    )
+    shuffle: bool = config_field(default=True)
+    num_examples: int = config_field(
+        default=4096, help="generated dataset size (blobs)"
+    )
+
+    def validate(self) -> None:
+        if self.name not in ("synthetic", "blobs", "npz"):
+            raise ConfigError(
+                f"data.name must be synthetic|blobs|npz, got {self.name!r}"
+            )
+        if not 0.0 <= self.eval_fraction < 1.0:
+            raise ConfigError("data.eval_fraction must be in [0, 1)")
+        if not 0.0 <= self.target_accuracy <= 1.0:
+            raise ConfigError("data.target_accuracy must be in [0, 1]")
+        if self.name == "npz" and not self.path:
+            raise ConfigError("data.name=npz requires data.path")
+
+
+@dataclasses.dataclass
 class TrainingConfig(ConfigNode):
     """Per-job training knobs (the benchmark-harness surface).
 
@@ -171,6 +210,7 @@ class TrainingConfig(ConfigNode):
     dtype: str = config_field(default="bfloat16", help="compute dtype")
     seed: int = config_field(default=0)
     mesh: MeshConfig = config_field(default_factory=MeshConfig)
+    data: DataConfig = config_field(default_factory=DataConfig)
     checkpoint: CheckpointConfig = config_field(default_factory=CheckpointConfig)
     remat: bool = config_field(default=False, help="jax.checkpoint rematerialisation")
 

@@ -272,7 +272,9 @@ class TPUTrainJobController(Controller):
             if coord is not None:
                 ps = coord.get("status", {})
                 metrics = {}
-                for key in ("items_per_sec", "final_loss", "final_step"):
+                for key in (
+                    "items_per_sec", "final_loss", "final_step", "eval_top1"
+                ):
                     if key in ps:
                         try:
                             metrics[key] = float(ps[key])

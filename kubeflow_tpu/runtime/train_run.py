@@ -69,9 +69,17 @@ def run_training(
     if ckpt_mgr is not None:
         ckpt_mgr.save(metrics.step, trainer._final_state)
         ckpt_mgr.close()
-    return {
+    result = {
         "final_step": metrics.step,
         "loss": metrics.loss,
         "items_per_sec": metrics.items_per_sec,
         "already_complete": False,
     }
+    if "eval_top1" in metrics.aux:
+        result["eval_top1"] = metrics.aux["eval_top1"]
+        result["eval_loss"] = metrics.aux["eval_loss"]
+        target = cfg.data.target_accuracy
+        result["target_reached"] = bool(
+            target and metrics.aux["eval_top1"] >= target
+        )
+    return result

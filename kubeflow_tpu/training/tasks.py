@@ -29,6 +29,12 @@ def cross_entropy(logits: jax.Array, labels: jax.Array, ignore: int = -1000000):
     return -ll.sum() / count
 
 
+def _nll(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-position negative log-likelihood (no reduction)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+
+
 class ImageClassificationTask:
     """ResNet-style: batch {image, label}; mutable batch_stats (BatchNorm)."""
 
@@ -69,6 +75,24 @@ class ImageClassificationTask:
 
     def count_items(self, batch) -> int:
         return batch["image"].shape[0]
+
+    def eval_stats(self, model, params, extra_vars, batch) -> Dict[str, jax.Array]:
+        """Summable eval statistics for one batch (top-1 numerator/denominator
+        + loss sum). `eval_mask` marks real rows in a padded final batch."""
+        logits = model.apply(
+            {"params": params, **extra_vars}, batch["image"], train=False
+        )
+        valid = batch.get(
+            "eval_mask", jnp.ones(batch["label"].shape[0], jnp.float32)
+        )
+        correct = jnp.sum(
+            (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32)
+            * valid
+        )
+        loss_sum = jnp.sum(
+            _nll(logits.astype(jnp.float32), batch["label"]) * valid
+        )
+        return {"correct": correct, "count": valid.sum(), "loss_sum": loss_sum}
 
 
 class MlmTask:
@@ -114,6 +138,27 @@ class MlmTask:
     def count_items(self, batch) -> int:
         # tokens/step is the BERT throughput unit
         return batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
+
+    def eval_stats(self, model, params, extra_vars, batch) -> Dict[str, jax.Array]:
+        """Masked-token prediction accuracy + loss over labels != -100."""
+        out = model.apply(
+            {"params": params, **extra_vars},
+            batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=True,
+        )
+        labels = batch["labels"]
+        row_valid = batch.get(
+            "eval_mask", jnp.ones(labels.shape[0], jnp.float32)
+        )[:, None]
+        valid = (labels != -100).astype(jnp.float32) * row_valid
+        safe = jnp.where(labels == -100, 0, labels)
+        logits = out["mlm_logits"].astype(jnp.float32)
+        correct = jnp.sum(
+            (jnp.argmax(logits, -1) == safe).astype(jnp.float32) * valid
+        )
+        loss_sum = jnp.sum(_nll(logits, safe) * valid)
+        return {"correct": correct, "count": valid.sum(), "loss_sum": loss_sum}
 
 
 def task_for_model(model_name: str, cfg: TrainingConfig, **kwargs):

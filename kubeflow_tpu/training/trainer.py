@@ -35,12 +35,31 @@ from kubeflow_tpu.models.registry import get_model
 from kubeflow_tpu.parallel.mesh import mesh_from_config
 from kubeflow_tpu.parallel.sharding import logical_to_spec
 from kubeflow_tpu.training.annotations import logical_axes_for
-from kubeflow_tpu.training.data import SyntheticData, make_global_batch
+from kubeflow_tpu.training.data import make_global_batch
 from kubeflow_tpu.training.tasks import make_optimizer, task_for_model
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import default_registry
 
 log = get_logger(__name__)
+
+
+def _pad_to_shard_multiple(batch_np: Dict[str, np.ndarray], dp: int):
+    """Pad an eval batch's leading dim to a multiple of the data-parallel
+    shard count; padded rows are masked out of the statistics via eval_mask
+    (a batch not divisible by data*fsdp cannot be laid out on the mesh)."""
+    b = len(next(iter(batch_np.values())))
+    rem = (-b) % dp
+    mask = batch_np.get("eval_mask")
+    if mask is None:
+        mask = np.ones((b,), np.float32)
+    if rem:
+        batch_np = {
+            k: np.concatenate([v, np.repeat(v[-1:], rem, axis=0)])
+            for k, v in batch_np.items()
+            if k != "eval_mask"
+        }
+        mask = np.concatenate([mask, np.zeros((rem,), np.float32)])
+    return {**batch_np, "eval_mask": mask}
 
 
 class TrainState(flax.struct.PyTreeNode):
@@ -83,6 +102,7 @@ class Trainer:
         self.task = task if task is not None else task_for_model(cfg.model, cfg)
         self.tx, self.schedule = make_optimizer(cfg, cfg.model)
         self._train_step = None
+        self._eval_step = None
         self._state_shardings = None
 
     # ---- state init ----------------------------------------------------
@@ -213,20 +233,82 @@ class Trainer:
         with jax.set_mesh(self.mesh):
             return self._train_step(state, batch, rng)
 
+    # ---- eval ----------------------------------------------------------
+
+    def _build_eval_step(self):
+        mesh = self.mesh
+        task = self.task
+        model = self.model
+        batch_sh = NamedSharding(mesh, P(("data", "fsdp")))
+
+        def eval_fn(state: TrainState, batch):
+            return task.eval_stats(
+                model, state.params, state.extra_vars, batch
+            )
+
+        return jax.jit(
+            eval_fn,
+            in_shardings=(self._state_shardings, batch_sh),
+            out_shardings=NamedSharding(mesh, P()),
+        )
+
+    def evaluate(self, state: TrainState, eval_data) -> Dict[str, float]:
+        """Full pass over the eval split; returns {top1, loss, count}.
+
+        Per-batch stats are summable scalars so the sharded eval step reduces
+        on device; only three floats cross to host per batch.
+        """
+        if self._eval_step is None:
+            if self._state_shardings is None:
+                with jax.set_mesh(self.mesh):
+                    shapes = jax.eval_shape(lambda s: s, state)
+                self._state_shardings = self.state_shardings(shapes)
+            self._eval_step = self._build_eval_step()
+        dp = self.mesh.shape.get("data", 1) * self.mesh.shape.get("fsdp", 1)
+        correct = count = loss_sum = 0.0
+        with jax.set_mesh(self.mesh):
+            for batch_np in eval_data.eval_batches():
+                batch_np = _pad_to_shard_multiple(batch_np, dp)
+                batch = make_global_batch(batch_np, self.mesh)
+                stats = jax.device_get(self._eval_step(state, batch))
+                correct += float(stats["correct"])
+                count += float(stats["count"])
+                loss_sum += float(stats["loss_sum"])
+        count = max(count, 1.0)
+        return {
+            "top1": correct / count,
+            "loss": loss_sum / count,
+            "count": count,
+        }
+
     # ---- the loop ------------------------------------------------------
 
     def fit(
         self,
         steps: Optional[int] = None,
-        data: Optional[SyntheticData] = None,
+        data=None,
+        eval_data=None,
         state: Optional[TrainState] = None,
         log_every: int = 10,
         checkpoint_manager=None,
     ) -> StepMetrics:
-        """Run the training loop; returns the final step's metrics."""
+        """Run the training loop; returns the final step's metrics.
+
+        With `eval_data` set, a full eval pass runs every
+        `cfg.data.eval_every_steps` (and always at the end); when
+        `cfg.data.target_accuracy` > 0 training stops early once eval top-1
+        reaches it (the BASELINE.json train-to-accuracy contract). Final
+        eval metrics land in the returned StepMetrics.aux as
+        eval_top1/eval_loss.
+        """
         cfg = self.cfg
         steps = cfg.steps if steps is None else steps
-        data = data if data is not None else self.task.synthetic_data()
+        if data is None:
+            from kubeflow_tpu.training.datasets import build_data
+
+            data, built_eval = build_data(cfg, self.task)
+            if eval_data is None:
+                eval_data = built_eval
         if state is None:
             state = self.init_state()
         rng = jax.random.PRNGKey(cfg.seed + 1)
@@ -237,12 +319,20 @@ class Trainer:
         thpt = registry.gauge(
             "training_items_per_sec", "items (images/tokens) per second", ["model"]
         )
+        acc_gauge = registry.gauge(
+            "training_eval_top1", "held-out top-1 accuracy", ["model"]
+        )
         start_step = int(jax.device_get(state.step))
+        eval_every = cfg.data.eval_every_steps if eval_data is not None else 0
+        target = cfg.data.target_accuracy if eval_data is not None else 0.0
+        eval_metrics: Dict[str, float] = {}
 
         last: Optional[StepMetrics] = None
         t_last = time.monotonic()
         steps_since_log = 0
-        for i in range(start_step, start_step + steps):
+        stop_reason = ""
+        end_step = start_step + steps
+        for i in range(start_step, end_step):
             batch_np = data.batch_at(i)
             batch = make_global_batch(batch_np, self.mesh)
             state, metrics = self.train_step(state, batch, rng)
@@ -251,7 +341,25 @@ class Trainer:
                 (i + 1) % cfg.checkpoint.interval_steps == 0
             ):
                 checkpoint_manager.save(i + 1, state)
-            if (i + 1) % log_every == 0 or i == start_step + steps - 1:
+            is_last = i == end_step - 1
+            if eval_data is not None and (
+                is_last or (eval_every and (i + 1) % eval_every == 0)
+            ):
+                eval_metrics = self.evaluate(state, eval_data)
+                acc_gauge.set(eval_metrics["top1"], model=cfg.model)
+                log.info(
+                    "step %d eval top1=%.4f loss=%.4f (%d examples)",
+                    i + 1,
+                    eval_metrics["top1"],
+                    eval_metrics["loss"],
+                    int(eval_metrics["count"]),
+                )
+                if target and eval_metrics["top1"] >= target:
+                    stop_reason = (
+                        f"target accuracy {target:.2%} reached at step {i + 1}"
+                    )
+                    is_last = True
+            if (i + 1) % log_every == 0 or is_last:
                 metrics = jax.device_get(metrics)
                 now = time.monotonic()
                 dt = (now - t_last) / steps_since_log
@@ -260,14 +368,16 @@ class Trainer:
                 items = self.task.count_items(batch_np)
                 step_hist.observe(dt, model=cfg.model)
                 thpt.set(items / dt, model=cfg.model)
+                aux = {k: float(v) for k, v in metrics.items() if k != "loss"}
+                if eval_metrics:
+                    aux["eval_top1"] = eval_metrics["top1"]
+                    aux["eval_loss"] = eval_metrics["loss"]
                 last = StepMetrics(
                     step=i + 1,
                     loss=float(metrics["loss"]),
                     items_per_sec=items / dt,
                     step_time_s=dt,
-                    aux={
-                        k: float(v) for k, v in metrics.items() if k != "loss"
-                    },
+                    aux=aux,
                 )
                 log.info(
                     "step %d loss=%.4f %.1f items/s (%.1f ms/step)",
@@ -276,5 +386,8 @@ class Trainer:
                     last.items_per_sec,
                     dt * 1e3,
                 )
+            if stop_reason:
+                log.info("early stop: %s", stop_reason)
+                break
         self._final_state = state
         return last

@@ -156,3 +156,27 @@ training:
     def test_dump_load_roundtrip(self):
         p = PlatformDef()
         assert load_platformdef(dump_yaml(p)) == p
+
+    def test_imagenet_north_star_config_is_valid(self):
+        """configs/resnet50_imagenet_v5e16.yaml parses into a schedulable
+        job whose mesh matches the slice (the BASELINE.json target)."""
+        import os
+
+        import yaml
+
+        from kubeflow_tpu.controllers.tpujob import (
+            new_tpu_train_job,
+            parse_job_spec,
+        )
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "configs",
+            "resnet50_imagenet_v5e16.yaml",
+        )
+        with open(path) as f:
+            spec = yaml.safe_load(f)
+        job = new_tpu_train_job("north-star", **spec)
+        slice_cfg, training = parse_job_spec(job["spec"])[:2]
+        assert slice_cfg.total_chips == training.mesh.num_devices == 16
+        assert training.data.name == "npz"
+        assert training.data.target_accuracy == 0.76

@@ -1,0 +1,259 @@
+"""Real-data input pipeline + eval loop: the north-star path.
+
+BASELINE.json's headline is train-to-top-1-accuracy; these tests prove the
+whole chain hermetically — deterministic dataset → sharded train/eval steps →
+target-accuracy early stop → eval_top1 surfaced on the TPUTrainJob status —
+with a learnable generated dataset standing in for imagenet (SURVEY.md §4:
+simulated-mesh testing).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubeflow_tpu.config.platform import (
+    DataConfig,
+    MeshConfig,
+    TrainingConfig,
+)
+from kubeflow_tpu.training.datasets import (
+    ArrayDataset,
+    build_data,
+    load_npz,
+    make_blobs,
+    split_eval,
+)
+
+
+def tiny_arrays(n=64):
+    rng = np.random.default_rng(0)
+    return {
+        "image": rng.standard_normal((n, 4, 4, 3)).astype(np.float32),
+        "label": rng.integers(0, 5, (n,), dtype=np.int32),
+    }
+
+
+class TestArrayDataset:
+    def test_batches_deterministic_across_instances(self):
+        a = ArrayDataset(tiny_arrays(), 16, seed=3)
+        b = ArrayDataset(tiny_arrays(), 16, seed=3)
+        for s in (0, 1, 7, 12):
+            np.testing.assert_array_equal(
+                a.batch_at(s)["image"], b.batch_at(s)["image"]
+            )
+
+    def test_epoch_reshuffles(self):
+        ds = ArrayDataset(tiny_arrays(), 16, seed=3)
+        # same position in two different epochs → different examples
+        e0 = ds.batch_at(0)["label"]
+        e1 = ds.batch_at(ds.steps_per_epoch)["label"]
+        assert not np.array_equal(e0, e1)
+
+    def test_epoch_covers_every_example_once(self):
+        arrays = tiny_arrays(64)
+        ds = ArrayDataset(arrays, 16, seed=1)
+        seen = np.concatenate(
+            [ds.batch_at(s)["image"].reshape(16, -1) for s in range(4)]
+        )
+        want = arrays["image"].reshape(64, -1)
+        # same multiset of rows
+        assert sorted(map(tuple, seen)) == sorted(map(tuple, want))
+
+    def test_no_shuffle_is_ordered(self):
+        arrays = tiny_arrays(32)
+        ds = ArrayDataset(arrays, 8, shuffle=False)
+        np.testing.assert_array_equal(
+            ds.batch_at(0)["label"], arrays["label"][:8]
+        )
+
+    def test_no_shuffle_wraparound_covers_remainder(self):
+        """shuffle=False must not silently drop the n % batch tail."""
+        arrays = {
+            "image": np.zeros((10, 2, 2, 3), np.float32),
+            "label": np.arange(10, dtype=np.int32),
+        }
+        ds = ArrayDataset(arrays, 4, shuffle=False)
+        seen = np.concatenate([ds.batch_at(s)["label"] for s in range(5)])
+        # 20 sequential draws over 10 rows: every row exactly twice
+        np.testing.assert_array_equal(np.bincount(seen), np.full(10, 2))
+
+    def test_eval_batches_pad_and_mask(self):
+        arrays = tiny_arrays(20)
+        ds = ArrayDataset(arrays, 20, shuffle=False)
+        batches = list(ds.eval_batches(batch_size=8))
+        assert len(batches) == 3
+        assert all(b["image"].shape[0] == 8 for b in batches)
+        masks = np.concatenate([b["eval_mask"] for b in batches])
+        assert masks.sum() == 20
+        # padded rows are at the tail of the last batch
+        np.testing.assert_array_equal(
+            batches[-1]["eval_mask"], [1, 1, 1, 1, 0, 0, 0, 0]
+        )
+
+    def test_rejects_ragged_and_small(self):
+        with pytest.raises(ValueError):
+            ArrayDataset(
+                {"a": np.zeros((4, 2)), "b": np.zeros((5, 2))}, 2
+            )
+        with pytest.raises(ValueError):
+            ArrayDataset({"a": np.zeros((4, 2))}, 8)
+
+
+class TestSplitAndNpz:
+    def test_split_eval_disjoint_and_deterministic(self):
+        arrays = tiny_arrays(64)
+        t1, e1 = split_eval(arrays, 0.25, seed=7)
+        t2, e2 = split_eval(arrays, 0.25, seed=7)
+        assert len(e1["label"]) == 16 and len(t1["label"]) == 48
+        np.testing.assert_array_equal(t1["image"], t2["image"])
+        np.testing.assert_array_equal(e1["image"], e2["image"])
+        rows = lambda a: set(map(tuple, a.reshape(len(a), -1)))  # noqa: E731
+        assert not rows(t1["image"]) & rows(e1["image"])
+
+    def test_load_npz_shards_concatenate(self, tmp_path):
+        a = tiny_arrays(16)
+        b = tiny_arrays(8)
+        np.savez(tmp_path / "train-000.npz", **a)
+        np.savez(tmp_path / "train-001.npz", **b)
+        got = load_npz(str(tmp_path), "train")
+        assert got["image"].shape[0] == 24
+        np.testing.assert_array_equal(got["image"][:16], a["image"])
+        assert load_npz(str(tmp_path), "val") is None
+
+    def test_build_data_npz_with_split(self, tmp_path):
+        np.savez(tmp_path / "train-000.npz", **tiny_arrays(64))
+        cfg = TrainingConfig(
+            model="mlp",
+            global_batch_size=8,
+            steps=1,
+            data=DataConfig(
+                name="npz", path=str(tmp_path), eval_fraction=0.25
+            ),
+        )
+        from kubeflow_tpu.training.tasks import task_for_model
+
+        train, ev = build_data(cfg, task_for_model("mlp", cfg))
+        assert train.num_examples == 48
+        assert ev is not None and ev.num_examples == 16
+
+
+def blobs_config(**overrides):
+    base = dict(
+        model="mlp",
+        global_batch_size=64,
+        steps=120,
+        learning_rate=5e-3,
+        warmup_steps=5,
+        dtype="float32",
+        mesh=MeshConfig(data=4),
+        data=DataConfig(
+            name="blobs",
+            num_examples=1024,
+            eval_fraction=0.125,
+            eval_every_steps=40,
+            target_accuracy=0.9,
+        ),
+        checkpoint={"enabled": False},
+    )
+    base.update(overrides)
+    return TrainingConfig(**base)
+
+
+class TestTrainToAccuracy:
+    def test_blobs_rejects_non_image_task(self):
+        from kubeflow_tpu.training.tasks import task_for_model
+
+        cfg = TrainingConfig(
+            model="bert_tiny",
+            global_batch_size=8,
+            steps=1,
+            data=DataConfig(name="blobs"),
+        )
+        with pytest.raises(ValueError, match="image-classification"):
+            build_data(cfg, task_for_model("bert_tiny", cfg))
+
+    def test_eval_split_indivisible_by_mesh(self, devices8):
+        """An eval split smaller than the batch and not divisible by the
+        data-parallel degree must evaluate cleanly (padded + masked)."""
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = blobs_config(
+            steps=5,
+            data=DataConfig(
+                name="blobs",
+                num_examples=1024,
+                eval_fraction=0.01,  # 10 eval rows on a 4-way mesh
+                eval_every_steps=0,
+                target_accuracy=0.0,
+            ),
+        )
+        mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:4])
+        trainer = Trainer(cfg, mesh=mesh)
+        metrics = trainer.fit(log_every=5)
+        assert "eval_top1" in metrics.aux
+        # exactly the 10 real rows were counted, none of the padding
+        state = trainer._final_state
+        from kubeflow_tpu.training.datasets import build_data as bd
+
+        _, ev = bd(cfg, trainer.task)
+        stats = trainer.evaluate(state, ev)
+        assert stats["count"] == 10
+
+    def test_trainer_reaches_target_and_stops_early(self, devices8):
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = blobs_config()
+        mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:4])
+        trainer = Trainer(cfg, mesh=mesh)
+        metrics = trainer.fit(log_every=40)
+        assert metrics.aux["eval_top1"] >= 0.9
+        # blobs are easily separable: the budget should not be exhausted
+        assert metrics.step <= cfg.steps
+
+    def test_eval_metrics_flow_through_controller(self, devices8):
+        """TPUTrainJob with a real dataset + target accuracy: job succeeds
+        and eval_top1 lands in status.trainingMetrics (north-star shape)."""
+        from kubeflow_tpu.cluster.reconciler import ControllerManager
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.config.core import to_dict
+        from kubeflow_tpu.controllers import wait_for_condition
+        from kubeflow_tpu.controllers.tpujob import (
+            TPUTrainJobController,
+            new_tpu_train_job,
+        )
+        from kubeflow_tpu.runtime.executor import (
+            InProcessTrainerRunner,
+            PodExecutor,
+        )
+
+        store = StateStore()
+        cm = ControllerManager(store)
+        cm.register(TPUTrainJobController())
+        executor = PodExecutor(store, InProcessTrainerRunner())
+        job = new_tpu_train_job(
+            "acc1",
+            "default",
+            training=to_dict(blobs_config(steps=200)),
+            slice_spec={"topology": "v5e-4"},
+        )
+        store.create(job)
+        for _ in range(40):
+            cm.run_until_idle(max_seconds=5)
+            if executor.tick() == 0 and executor.tick() == 0:
+                cm.run_until_idle(max_seconds=5)
+                obj = store.get("TPUTrainJob", "acc1", "default")
+                conds = {
+                    c["type"]: c["status"]
+                    for c in obj.get("status", {}).get("conditions", [])
+                }
+                if conds.get("Succeeded") == "True":
+                    break
+        job = wait_for_condition(
+            store, "TPUTrainJob", "acc1", "default", "Succeeded", timeout_s=5
+        )
+        tm = job["status"]["trainingMetrics"]
+        assert tm["eval_top1"] >= 0.9
+        assert tm["final_step"] < 200  # early stop, not budget exhaustion
