@@ -190,6 +190,18 @@ class DataConfig(ConfigNode):
             raise ConfigError("data.target_accuracy must be in [0, 1]")
         if self.name == "npz" and not self.path:
             raise ConfigError("data.name=npz requires data.path")
+        # eval knobs must be reachable: silently skipping the configured
+        # train-to-accuracy contract would burn the whole step budget
+        wants_eval = self.target_accuracy > 0 or self.eval_every_steps > 0
+        if wants_eval and self.name == "synthetic":
+            raise ConfigError(
+                "eval (target_accuracy/eval_every_steps) requires a real "
+                "dataset; data.name=synthetic has no held-out split"
+            )
+        if wants_eval and self.name == "blobs" and self.eval_fraction == 0:
+            raise ConfigError(
+                "data.name=blobs with eval requires data.eval_fraction > 0"
+            )
 
 
 @dataclasses.dataclass

@@ -85,19 +85,26 @@ def make_global_batch(
     mesh: Mesh,
     local_slice: Optional[slice] = None,
 ) -> Dict[str, jax.Array]:
-    """Assemble host-local numpy into globally-sharded jax.Arrays.
+    """Assemble host-generated numpy into globally-sharded jax.Arrays.
 
-    Single-process: device_put with the batch sharding. Multi-process: each
-    host passes only its rows; `local_slice` selects them from a
-    globally-indexed batch when the caller generates the full batch
-    deterministically (SyntheticData does).
+    Single-process: device_put with the batch sharding. Multi-process: the
+    batch dict is the *global* batch, regenerated identically on every host
+    (batch_at(step) is deterministic), and `make_array_from_callback` hands
+    each local device exactly its rows — no host0 fan-out over DCN, and
+    correct for any device→process layout. `local_slice` alternatively
+    feeds pre-sliced host-local rows via make_array_from_process_local_data.
     """
     out = {}
     for k, v in batch.items():
         sharding = NamedSharding(mesh, P(("data", "fsdp")))
         if jax.process_count() == 1:
             out[k] = jax.device_put(v, sharding)
+        elif local_slice is not None:
+            out[k] = jax.make_array_from_process_local_data(
+                sharding, v[local_slice]
+            )
         else:
-            local = v if local_slice is None else v[local_slice]
-            out[k] = jax.make_array_from_process_local_data(sharding, local)
+            out[k] = jax.make_array_from_callback(
+                v.shape, sharding, lambda idx, v=v: v[idx]
+            )
     return out

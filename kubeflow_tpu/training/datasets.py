@@ -76,9 +76,24 @@ class ArrayDataset:
         return self.n
 
     def _perm(self, epoch: int) -> np.ndarray:
-        if not self.shuffle:
-            return np.arange(self.n)
-        return np.random.default_rng([self.seed, epoch]).permutation(self.n)
+        # one-slot memo: the permutation changes once per epoch, not per step
+        cached = getattr(self, "_perm_cache", None)
+        if cached is not None and cached[0] == epoch:
+            return cached[1]
+        perm = np.random.default_rng([self.seed, epoch]).permutation(self.n)
+        self._perm_cache = (epoch, perm)
+        return perm
+
+    def _finalize(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Materialize (mmap rows → RAM) and decode storage dtypes: uint8
+        images (the disk-efficient imagenet layout) become centered f32."""
+        out = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            if k == "image" and v.dtype == np.uint8:
+                v = v.astype(np.float32) / 127.5 - 1.0
+            out[k] = v
+        return out
 
     def batch_at(self, step: int) -> Dict[str, np.ndarray]:
         bs = self.global_batch_size
@@ -88,7 +103,7 @@ class ArrayDataset:
         else:
             epoch, pos = divmod(step, self.steps_per_epoch)
             idx = self._perm(epoch)[pos * bs:(pos + 1) * bs]
-        return {k: v[idx] for k, v in self.arrays.items()}
+        return self._finalize({k: v[idx] for k, v in self.arrays.items()})
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         step = 0
@@ -97,24 +112,33 @@ class ArrayDataset:
             step += 1
 
     def eval_batches(
-        self, batch_size: Optional[int] = None
+        self,
+        batch_size: Optional[int] = None,
+        pad_to_multiple: int = 1,
     ) -> Iterator[Dict[str, np.ndarray]]:
-        """Every example exactly once, in order; the last batch is padded to
-        full size with `eval_mask` marking real rows (sharded eval needs
-        static shapes — XLA recompiles on a ragged final batch otherwise)."""
+        """Every example exactly once, in order; every batch has the same
+        (padded) size with `eval_mask` marking real rows. `pad_to_multiple`
+        rounds the batch up to the mesh's data-shard count — sharded eval
+        needs static, divisible shapes (XLA recompiles on ragged batches and
+        cannot lay out an indivisible one)."""
         bs = batch_size or self.global_batch_size
+        m = max(1, pad_to_multiple)
+        padded = -(-bs // m) * m
         for start in range(0, self.n, bs):
             idx = np.arange(start, min(start + bs, self.n))
             batch = {k: v[idx] for k, v in self.arrays.items()}
             valid = len(idx)
-            if valid < bs:
-                pad = bs - valid
+            if valid < padded:
+                pad = padded - valid
                 batch = {
-                    k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                    k: np.concatenate(
+                        [np.asarray(v), np.repeat(np.asarray(v[-1:]), pad, axis=0)]
+                    )
                     for k, v in batch.items()
                 }
-            mask = np.zeros((bs,), np.float32)
+            mask = np.zeros((padded,), np.float32)
             mask[:valid] = 1.0
+            batch = self._finalize(batch)
             batch[EVAL_MASK] = mask
             yield batch
 
@@ -174,8 +198,10 @@ def _npz_files(path: str, prefix: str) -> List[str]:
 def load_npz(path: str, split: str = "train") -> Optional[Dict[str, np.ndarray]]:
     """Load `<path>` (single .npz) or `<path>/<split>-*.npz` shards.
 
-    Arrays with the same key are concatenated across shards. Returns None
-    when the split has no files (caller falls back to `split_eval`).
+    Arrays with the same key are concatenated across shards — suitable for
+    datasets that fit host RAM. Imagenet-scale sets use the `.npy` mmap
+    layout instead (`load_npy_mmap`), which `build_data` prefers when
+    present. Returns None when the split has no files.
     """
     files = _npz_files(path, split)
     if not files:
@@ -189,6 +215,26 @@ def load_npz(path: str, split: str = "train") -> Optional[Dict[str, np.ndarray]]
         k: (v[0] if len(v) == 1 else np.concatenate(v, axis=0))
         for k, v in parts.items()
     }
+
+
+def load_npy_mmap(
+    path: str, split: str = "train"
+) -> Optional[Dict[str, np.ndarray]]:
+    """Memory-mapped split: `<path>/<split>_<key>.npy` (e.g. train_image.npy,
+    train_label.npy), opened with mmap_mode='r' so only the rows a batch
+    touches are ever read — the layout for imagenet-scale data (a [1.28M,
+    224,224,3] uint8 image file is ~193 GB on disk and ~0 resident; batch_at
+    materializes just its rows, and uint8 images decode to f32 per batch).
+    """
+    if not os.path.isdir(path):
+        return None
+    prefix = f"{split}_"
+    out = {}
+    for f in sorted(os.listdir(path)):
+        if f.startswith(prefix) and f.endswith(".npy"):
+            key = f[len(prefix):-len(".npy")]
+            out[key] = np.load(os.path.join(path, f), mmap_mode="r")
+    return out or None
 
 
 def build_data(
@@ -219,15 +265,24 @@ def build_data(
         if d.eval_fraction > 0:
             arrays, eval_arrays = split_eval(arrays, d.eval_fraction, cfg.seed)
     elif d.name == "npz":
-        arrays = load_npz(d.path, "train")
+        # prefer the mmap .npy layout (imagenet-scale); fall back to npz
+        arrays = load_npy_mmap(d.path, "train")
+        eval_arrays = load_npy_mmap(d.path, "val") if arrays else None
+        if arrays is None:
+            arrays = load_npz(d.path, "train")
+            eval_arrays = load_npz(d.path, "val")
         if arrays is None:
             raise FileNotFoundError(
-                f"no train npz data at {d.path!r} (expected a file or "
-                f"train-*.npz shards)"
+                f"no train data at {d.path!r} (expected train_<key>.npy "
+                f"mmap files, a single .npz, or train-*.npz shards)"
             )
-        eval_arrays = load_npz(d.path, "val")
         if eval_arrays is None and d.eval_fraction > 0:
             arrays, eval_arrays = split_eval(arrays, d.eval_fraction, cfg.seed)
+        if eval_arrays is None and (d.target_accuracy or d.eval_every_steps):
+            raise FileNotFoundError(
+                f"eval requested (target_accuracy/eval_every_steps) but "
+                f"{d.path!r} has no val split and data.eval_fraction == 0"
+            )
     else:  # validated upstream; defensive
         raise ValueError(f"unknown dataset {d.name!r}")
 

@@ -43,25 +43,6 @@ from kubeflow_tpu.utils.metrics import default_registry
 log = get_logger(__name__)
 
 
-def _pad_to_shard_multiple(batch_np: Dict[str, np.ndarray], dp: int):
-    """Pad an eval batch's leading dim to a multiple of the data-parallel
-    shard count; padded rows are masked out of the statistics via eval_mask
-    (a batch not divisible by data*fsdp cannot be laid out on the mesh)."""
-    b = len(next(iter(batch_np.values())))
-    rem = (-b) % dp
-    mask = batch_np.get("eval_mask")
-    if mask is None:
-        mask = np.ones((b,), np.float32)
-    if rem:
-        batch_np = {
-            k: np.concatenate([v, np.repeat(v[-1:], rem, axis=0)])
-            for k, v in batch_np.items()
-            if k != "eval_mask"
-        }
-        mask = np.concatenate([mask, np.zeros((rem,), np.float32)])
-    return {**batch_np, "eval_mask": mask}
-
-
 class TrainState(flax.struct.PyTreeNode):
     step: jax.Array
     params: Any
@@ -267,8 +248,9 @@ class Trainer:
         dp = self.mesh.shape.get("data", 1) * self.mesh.shape.get("fsdp", 1)
         correct = count = loss_sum = 0.0
         with jax.set_mesh(self.mesh):
-            for batch_np in eval_data.eval_batches():
-                batch_np = _pad_to_shard_multiple(batch_np, dp)
+            # batches padded to a multiple of data*fsdp: a ragged batch
+            # cannot be laid out on the mesh (padding masked via eval_mask)
+            for batch_np in eval_data.eval_batches(pad_to_multiple=dp):
                 batch = make_global_batch(batch_np, self.mesh)
                 stats = jax.device_get(self._eval_step(state, batch))
                 correct += float(stats["correct"])
@@ -345,7 +327,11 @@ class Trainer:
             if eval_data is not None and (
                 is_last or (eval_every and (i + 1) % eval_every == 0)
             ):
+                t_eval = time.monotonic()
                 eval_metrics = self.evaluate(state, eval_data)
+                # eval wall time must not pollute train-step timing (the
+                # items_per_sec here is the job's headline benchmark number)
+                t_last += time.monotonic() - t_eval
                 acc_gauge.set(eval_metrics["top1"], model=cfg.model)
                 log.info(
                     "step %d eval top1=%.4f loss=%.4f (%d examples)",

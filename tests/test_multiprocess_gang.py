@@ -1,0 +1,135 @@
+"""True multi-process gang execution over localhost.
+
+The first end-to-end proof of the coordinator/process-id/launcher contract
+with real OS processes: env rendered by `render_gang_env`, each process
+calling `jax.distributed.initialize` against a localhost coordinator, XLA
+CPU collectives (gloo) carrying the all-reduce, and the native slice_agent
+barrier spanning the gang via a genuinely shared directory — the TPU-native
+analog of the reference's TF_CONFIG + openmpi-controller lifecycle
+(reference: tf-controller-examples/tf-cnn/launcher.py:68-80,
+components/openmpi-controller/controller/controller.py).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.native import slice_agent_path
+from kubeflow_tpu.native.build import have_toolchain
+from kubeflow_tpu.parallel.distributed import render_gang_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# forces the CPU platform before any backend init (the runtime image
+# pre-imports jax with the TPU platform selected; see tests/conftest.py)
+WRAPPER = (
+    "import jax; jax.config.update('jax_platforms', 'cpu'); "
+    "import sys; from kubeflow_tpu.runtime.launcher import main; "
+    "sys.exit(main())"
+)
+
+TRAINING_SPEC = {
+    "model": "mlp",
+    "global_batch_size": 8,
+    "steps": 3,
+    "dtype": "float32",
+    "mesh": {"data": 4},
+    "checkpoint": {"enabled": False},
+}
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def gang_process(env_block, devices_per_proc=2, agent=None, shared=None):
+    env = dict(os.environ)
+    env.update(env_block)
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_proc}"
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["KFT_TRAINING_SPEC"] = json.dumps(TRAINING_SPEC)
+    payload = [sys.executable, "-c", WRAPPER]
+    if agent is not None:
+        payload = [
+            agent,
+            "--shared-dir", str(shared),
+            "--process-id", env_block["KFT_PROCESS_ID"],
+            "--num-processes", env_block["KFT_NUM_PROCESSES"],
+            "--poll-ms", "20",
+            "--timeout-ms", "120000",
+            "--",
+        ] + payload
+    return subprocess.Popen(
+        payload,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def final_result(stdout: str):
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def run_gang(n, agent=None, shared=None):
+    envs = render_gang_env(
+        "mp-gang", ["127.0.0.1"] * n, coordinator_port=free_port()
+    )
+    procs = [gang_process(e, agent=agent, shared=shared) for e in envs]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    return outs
+
+
+class TestMultiProcessGang:
+    def test_two_process_gang_trains_and_agrees(self):
+        """2 real processes x 2 virtual CPU devices = one 4-device mesh;
+        jax.distributed.initialize actually runs and both processes finish
+        the same training with identical (all-reduced) final loss."""
+        outs = run_gang(2)
+        results = []
+        for rc, out, err in outs:
+            assert rc == 0, f"gang member failed:\n{err[-3000:]}"
+            r = final_result(out)
+            assert r is not None, f"no result JSON in stdout: {out!r}"
+            results.append(r)
+        assert all(r["final_step"] == 3 for r in results)
+        losses = [r["loss"] for r in results]
+        # SPMD: every process computed the same replicated loss
+        assert losses[0] == pytest.approx(losses[1], rel=1e-6)
+
+    @pytest.mark.skipif(not have_toolchain(), reason="no C++ toolchain")
+    def test_gang_under_slice_agent_barrier(self, tmp_path):
+        """The compiled sidecar's barrier spans real processes via a shared
+        dir; payloads only start once the whole gang arrived, and each
+        member's terminal phase is recorded."""
+        agent = slice_agent_path()
+        outs = run_gang(2, agent=agent, shared=tmp_path)
+        for rc, out, err in outs:
+            assert rc == 0, f"agent-wrapped member failed:\n{err[-3000:]}"
+            assert final_result(out)["final_step"] == 3
+        assert (tmp_path / "start").exists()
+        for i in range(2):
+            assert (tmp_path / f"phase.{i}").read_text() == "Succeeded"
