@@ -39,8 +39,13 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     dtype: Any = jnp.bfloat16
-    attention_impl: str = "dense"  # "dense" | "ring"
+    attention_impl: str = "dense"  # "dense" | "ring" | "flash"
     remat: bool = False
+    # pipeline parallelism: >1 stacks the encoder into stages sharded over
+    # the `pipeline` mesh axis and runs a GPipe microbatch schedule
+    # (parallel/pipeline.py). num_layers must divide evenly into stages.
+    pipeline_stages: int = 1
+    num_microbatches: int = 0  # 0 = pipeline_stages
 
 
 def _dense_attention(q, k, v, mask, dtype):
@@ -121,6 +126,73 @@ class EncoderLayer(nn.Module):
         return shard_constraint(x, ("batch", "seq", "act_embed"))
 
 
+class StageBlock(nn.Module):
+    """One pipeline stage: a contiguous run of encoder layers."""
+
+    cfg: BertConfig
+    layers_per_stage: int
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        layer_cls = EncoderLayer
+        if self.cfg.remat:
+            layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
+        for i in range(self.layers_per_stage):
+            x = layer_cls(self.cfg, name=f"layer_{i}")(x, mask, deterministic)
+        return x
+
+
+class PipelinedEncoder(nn.Module):
+    """Encoder stack as a GPipe pipeline over the `pipeline` mesh axis.
+
+    Stage params are stacked [S, ...] by nn.vmap (annotated "stage" →
+    pipeline by training/annotations.py); execution is the microbatch
+    schedule in parallel/pipeline.py.
+    """
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        from kubeflow_tpu.parallel.pipeline import (
+            gpipe,
+            microbatch,
+            pipeline_stage_slices,
+            unmicrobatch,
+        )
+        from kubeflow_tpu.parallel.sharding import logical_to_spec
+
+        cfg = self.cfg
+        layers_per_stage, s = pipeline_stage_slices(
+            cfg.num_layers, cfg.pipeline_stages
+        )
+        # clamp microbatches to a divisor of the batch (init traces the
+        # model with a single example; param shapes don't depend on m)
+        m = min(cfg.num_microbatches or s, x.shape[0])
+        while x.shape[0] % m:
+            m -= 1
+        stack = nn.vmap(
+            StageBlock,
+            in_axes=(0, 0, None),
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+        )(cfg, layers_per_stage, name="stages")
+        x_mb = microbatch(x, m)
+        mask_mb = microbatch(mask, m)
+        out = gpipe(
+            lambda st, mk: stack(st, mk, deterministic),
+            x_mb,
+            [mask_mb],
+            num_stages=s,
+            state_spec=logical_to_spec(
+                ("stage", "batch", "seq", "act_embed")
+            ),
+            travel_specs=[logical_to_spec(("stage", "batch", "seq"))],
+        )
+        return unmicrobatch(out)
+
+
 class Bert(nn.Module):
     """BERT encoder with MLM + next-sentence heads."""
 
@@ -157,11 +229,18 @@ class Bert(nn.Module):
         x = x.astype(cfg.dtype)
         x = shard_constraint(x, ("batch", "seq", "act_embed"))
 
-        layer_cls = EncoderLayer
-        if cfg.remat:
-            layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
-        for i in range(cfg.num_layers):
-            x = layer_cls(cfg, name=f"layer_{i}")(x, attention_mask, deterministic)
+        if cfg.pipeline_stages > 1:
+            x = PipelinedEncoder(cfg, name="encoder")(
+                x, attention_mask, deterministic
+            )
+        else:
+            layer_cls = EncoderLayer
+            if cfg.remat:
+                layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
+            for i in range(cfg.num_layers):
+                x = layer_cls(cfg, name=f"layer_{i}")(
+                    x, attention_mask, deterministic
+                )
 
         # MLM head: transform + tied-style output projection to vocab.
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)

@@ -1,0 +1,108 @@
+"""Pipeline parallelism — GPipe microbatch schedule in pure GSPMD.
+
+The reference has no pipeline parallelism (SURVEY.md §2.5: absent); the
+TPU-native equivalent maps stages onto a `pipeline` mesh axis. The design
+avoids per-stage programs entirely (one XLA program, SPMD):
+
+- stage parameters are *stacked* with a leading [S] dim annotated with the
+  "stage" logical axis → sharded over the `pipeline` mesh axis, so each
+  pipeline group holds only its stage's weights,
+- the batch splits into M microbatches; a state buffer [S, mb, ...] holds
+  one in-flight microbatch per stage, also sharded on `pipeline`,
+- each tick applies the (vmapped) stage function to every slot in parallel
+  — per-stage compute lands on that stage's devices — then shifts the
+  buffer one stage down with `jnp.roll(., axis=0)`, which XLA lowers to a
+  CollectivePermute over ICI neighbors,
+- microbatches are injected at stage 0 and collected after stage S-1;
+  T = M + S - 1 ticks drain the pipeline (the GPipe bubble is (S-1)/T).
+
+The tick loop is unrolled in Python: M and S are small static ints, and an
+unrolled graph lets XLA overlap the permute with the next tick's compute.
+Gradients flow through roll/collect mechanically (reverse permutes).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _constrain(x, spec: Optional[P]):
+    if spec is None:
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x  # eager / no-mesh context: advisory only
+
+
+def gpipe(
+    stage_call: Callable,
+    x_mb: jax.Array,
+    travel: Sequence[jax.Array] = (),
+    *,
+    num_stages: int,
+    state_spec: Optional[P] = None,
+    travel_specs: Optional[Sequence[Optional[P]]] = None,
+) -> jax.Array:
+    """Run a stacked stage function as a GPipe pipeline.
+
+    stage_call: ([S, mb, ...] state, *[S, ...] travel) -> [S, mb, ...] —
+      applies stage i's parameters to slot i (an `nn.vmap`'d module stack).
+    x_mb: [M, mb, ...] microbatched input activations.
+    travel: per-microbatch side inputs that ride along with their microbatch
+      through the pipeline (e.g. the attention mask).
+    Returns [M, mb, ...] last-stage outputs, microbatch order preserved.
+    """
+    m = x_mb.shape[0]
+    s = num_stages
+    if travel_specs is None:
+        travel_specs = [None] * len(travel)
+    state = jnp.zeros((s,) + x_mb.shape[1:], x_mb.dtype)
+    tstate = [jnp.zeros((s,) + a.shape[1:], a.dtype) for a in travel]
+    outs = []
+    for t in range(m + s - 1):
+        if t < m:
+            # inject microbatch t at stage 0
+            state = state.at[0].set(x_mb[t])
+            tstate = [ts.at[0].set(a[t]) for ts, a in zip(tstate, travel)]
+        state = _constrain(state, state_spec)
+        tstate = [_constrain(ts, sp) for ts, sp in zip(tstate, travel_specs)]
+        y = stage_call(state, *tstate)
+        if t >= s - 1:
+            # microbatch injected at tick t-(s-1) exits the last stage now
+            outs.append(y[s - 1])
+        if t < m + s - 2:
+            # shift every in-flight microbatch to the next stage
+            # (CollectivePermute over the pipeline axis); slot 0 is
+            # overwritten by the next injection or holds drained garbage
+            state = jnp.roll(y, 1, axis=0)
+            tstate = [jnp.roll(ts, 1, axis=0) for ts in tstate]
+    return jnp.stack(outs, 0)
+
+
+def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...] (leading-dim split, order preserving)."""
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible into {num_microbatches} microbatches"
+        )
+    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+
+def unmicrobatch(x_mb: jax.Array) -> jax.Array:
+    """[M, mb, ...] -> [B, ...]."""
+    return x_mb.reshape((x_mb.shape[0] * x_mb.shape[1],) + x_mb.shape[2:])
+
+
+def pipeline_stage_slices(num_layers: int, num_stages: int) -> Tuple[int, int]:
+    """Validate and return (layers_per_stage, num_stages)."""
+    if num_layers % num_stages:
+        raise ValueError(
+            f"{num_layers} layers not divisible into {num_stages} stages"
+        )
+    return num_layers // num_stages, num_stages

@@ -64,18 +64,22 @@ def logical_axes_for(
 
     def annotate(path, leaf):
         p = _path_str(path)
+        # pipeline-stacked params (nn.vmap'd stage stack): leading [S] dim
+        # is the "stage" axis; match the remaining dims against the table
+        stacked = "/stages/" in f"/{p}"
+        ndim = leaf.ndim - 1 if stacked else leaf.ndim
+        lead = ("stage",) if stacked else ()
         for pattern, axes in _PATTERNS:
-            if re.match(pattern, p) and len(axes) == leaf.ndim:
-                return axes
-        if leaf.ndim >= 2 and fsdp_size > 1:
-            dims = sorted(
-                range(leaf.ndim), key=lambda i: leaf.shape[i], reverse=True
-            )
+            if re.match(pattern, p) and len(axes) == ndim:
+                return lead + axes
+        if ndim >= 2 and fsdp_size > 1:
+            shape = leaf.shape[1:] if stacked else leaf.shape
+            dims = sorted(range(ndim), key=lambda i: shape[i], reverse=True)
             for d in dims:
-                if leaf.shape[d] % fsdp_size == 0:
-                    return tuple(
-                        "embed" if i == d else None for i in range(leaf.ndim)
+                if shape[d] % fsdp_size == 0:
+                    return lead + tuple(
+                        "embed" if i == d else None for i in range(ndim)
                     )
-        return tuple(None for _ in range(leaf.ndim))
+        return lead + tuple(None for _ in range(ndim))
 
     return jax.tree_util.tree_map_with_path(annotate, params)

@@ -98,12 +98,14 @@ def make_global_batch(
     for k, v in batch.items():
         sharding = NamedSharding(mesh, P(("data", "fsdp")))
         if jax.process_count() == 1:
-            out[k] = jax.device_put(v, sharding)
+            out[k] = jax.device_put(np.asarray(v), sharding)
         elif local_slice is not None:
             out[k] = jax.make_array_from_process_local_data(
                 sharding, v[local_slice]
             )
         else:
+            # v may be a lazy column (datasets._LazyColumn): each device's
+            # index tuple slices (and decodes) just that device's rows
             out[k] = jax.make_array_from_callback(
                 v.shape, sharding, lambda idx, v=v: v[idx]
             )

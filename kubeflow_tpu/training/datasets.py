@@ -37,6 +37,42 @@ from kubeflow_tpu.training.data import SyntheticData
 EVAL_MASK = "eval_mask"
 
 
+def _decode(key: str, v: np.ndarray) -> np.ndarray:
+    """Decode storage dtypes: uint8 images (the disk-efficient imagenet
+    layout) become centered f32; everything else passes through."""
+    if key == "image" and v.dtype == np.uint8:
+        return v.astype(np.float32) / 127.5 - 1.0
+    return v
+
+
+class _LazyColumn:
+    """A batch column whose rows materialize + decode only when sliced.
+
+    Multi-host jobs hand this to `make_array_from_callback`, so each host
+    reads and decodes exactly the rows its own devices consume instead of
+    the whole global batch (process_count× read amplification otherwise).
+    """
+
+    def __init__(self, base, indices: np.ndarray, key: str):
+        self.base = base
+        self.indices = indices
+        self.key = key
+        probe = _decode(key, np.asarray(base[indices[:1]]))
+        self.dtype = probe.dtype
+        self.shape = (len(indices),) + probe.shape[1:]
+
+    def __getitem__(self, idx):
+        if isinstance(idx, tuple):
+            rows, rest = idx[0], idx[1:]
+            out = _decode(self.key, np.asarray(self.base[self.indices[rows]]))
+            return out[(slice(None),) + rest] if rest else out
+        return _decode(self.key, np.asarray(self.base[self.indices[idx]]))
+
+    def __array__(self, dtype=None):
+        out = _decode(self.key, np.asarray(self.base[self.indices]))
+        return out.astype(dtype) if dtype is not None else out
+
+
 class ArrayDataset:
     """Finite in-memory dataset with deterministic epoch shuffling.
 
@@ -85,25 +121,26 @@ class ArrayDataset:
         return perm
 
     def _finalize(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """Materialize (mmap rows → RAM) and decode storage dtypes: uint8
-        images (the disk-efficient imagenet layout) become centered f32."""
-        out = {}
-        for k, v in batch.items():
-            v = np.asarray(v)
-            if k == "image" and v.dtype == np.uint8:
-                v = v.astype(np.float32) / 127.5 - 1.0
-            out[k] = v
-        return out
+        """Materialize (mmap rows → RAM) and decode storage dtypes."""
+        return {k: _decode(k, np.asarray(v)) for k, v in batch.items()}
 
-    def batch_at(self, step: int) -> Dict[str, np.ndarray]:
+    def _batch_indices(self, step: int) -> np.ndarray:
         bs = self.global_batch_size
         if not self.shuffle:
             # sequential with wraparound: remainder rows are not dropped
-            idx = (step * bs + np.arange(bs)) % self.n
-        else:
-            epoch, pos = divmod(step, self.steps_per_epoch)
-            idx = self._perm(epoch)[pos * bs:(pos + 1) * bs]
+            return (step * bs + np.arange(bs)) % self.n
+        epoch, pos = divmod(step, self.steps_per_epoch)
+        return self._perm(epoch)[pos * bs:(pos + 1) * bs]
+
+    def batch_at(self, step: int) -> Dict[str, np.ndarray]:
+        idx = self._batch_indices(step)
         return self._finalize({k: v[idx] for k, v in self.arrays.items()})
+
+    def lazy_batch_at(self, step: int) -> Dict[str, "_LazyColumn"]:
+        """Multi-host variant: columns slice/decode on demand, so each host
+        touches only the rows its devices own (see _LazyColumn)."""
+        idx = self._batch_indices(step)
+        return {k: _LazyColumn(v, idx, k) for k, v in self.arrays.items()}
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         step = 0
@@ -143,17 +180,59 @@ class ArrayDataset:
             yield batch
 
 
+class _IndexedView:
+    """Lazy row-indexed view over a (possibly memory-mapped) base array.
+
+    Indexing a memmap with a fancy index materializes only the touched
+    rows; this view composes a fixed split permutation with per-batch
+    indices so a train/eval split of an imagenet-scale memmap stays ~0
+    resident instead of copying the whole set into host RAM.
+    """
+
+    def __init__(self, base, indices: np.ndarray):
+        self.base = base
+        self.indices = indices
+
+    def __len__(self) -> int:
+        return len(self.indices)
+
+    @property
+    def shape(self):
+        return (len(self.indices),) + self.base.shape[1:]
+
+    @property
+    def dtype(self):
+        return self.base.dtype
+
+    def __getitem__(self, idx):
+        return self.base[self.indices[idx]]
+
+    def __array__(self, dtype=None):
+        out = self.base[self.indices]
+        return out.astype(dtype) if dtype is not None else out
+
+
 def split_eval(
     arrays: Dict[str, np.ndarray], eval_fraction: float, seed: int = 0
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
-    """Deterministic held-out split (same permutation on every host/restart)."""
+    """Deterministic held-out split (same permutation on every host/restart).
+
+    Memory-mapped arrays are split as lazy views (no materialization);
+    in-RAM arrays are sliced eagerly.
+    """
     n = len(next(iter(arrays.values())))
     n_eval = max(1, int(n * eval_fraction))
     perm = np.random.default_rng([seed, 0xE7A1]).permutation(n)
-    eval_idx, train_idx = perm[:n_eval], perm[n_eval:]
+    eval_idx, train_idx = np.sort(perm[:n_eval]), np.sort(perm[n_eval:])
+
+    def take(v, idx):
+        if isinstance(v, (np.memmap, _IndexedView)):
+            return _IndexedView(v, idx)
+        return v[idx]
+
     return (
-        {k: v[train_idx] for k, v in arrays.items()},
-        {k: v[eval_idx] for k, v in arrays.items()},
+        {k: take(v, train_idx) for k, v in arrays.items()},
+        {k: take(v, eval_idx) for k, v in arrays.items()},
     )
 
 
@@ -186,7 +265,9 @@ def make_blobs(
 
 def _npz_files(path: str, prefix: str) -> List[str]:
     if os.path.isfile(path):
-        return [path]
+        # a single-file dataset is train-only; it must not double as the
+        # val split (eval == train would report training accuracy)
+        return [path] if prefix == "train" else []
     files = sorted(
         os.path.join(path, f)
         for f in os.listdir(path)
@@ -265,12 +346,11 @@ def build_data(
         if d.eval_fraction > 0:
             arrays, eval_arrays = split_eval(arrays, d.eval_fraction, cfg.seed)
     elif d.name == "npz":
-        # prefer the mmap .npy layout (imagenet-scale); fall back to npz
-        arrays = load_npy_mmap(d.path, "train")
-        eval_arrays = load_npy_mmap(d.path, "val") if arrays else None
-        if arrays is None:
-            arrays = load_npz(d.path, "train")
-            eval_arrays = load_npz(d.path, "val")
+        # prefer the mmap .npy layout (imagenet-scale); fall back to npz —
+        # independently per split, so a mmap train set can pair with an
+        # npz val set and vice versa
+        arrays = load_npy_mmap(d.path, "train") or load_npz(d.path, "train")
+        eval_arrays = load_npy_mmap(d.path, "val") or load_npz(d.path, "val")
         if arrays is None:
             raise FileNotFoundError(
                 f"no train data at {d.path!r} (expected train_<key>.npy "

@@ -77,6 +77,10 @@ class Trainer:
         )
         kwargs = dict(model_kwargs or {})
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        if cfg.mesh.pipeline > 1 and model is None:
+            # a pipeline mesh axis requires a stage-partitionable model;
+            # factories without pipeline support raise TypeError loudly
+            kwargs.setdefault("pipeline_stages", cfg.mesh.pipeline)
         self.model = model if model is not None else get_model(
             cfg.model, dtype=dtype, **kwargs
         )
@@ -309,13 +313,18 @@ class Trainer:
         target = cfg.data.target_accuracy if eval_data is not None else 0.0
         eval_metrics: Dict[str, float] = {}
 
+        # multi-host: lazy columns let each host read/decode only its rows
+        get_batch = data.batch_at
+        if jax.process_count() > 1 and hasattr(data, "lazy_batch_at"):
+            get_batch = data.lazy_batch_at
+
         last: Optional[StepMetrics] = None
         t_last = time.monotonic()
         steps_since_log = 0
         stop_reason = ""
         end_step = start_step + steps
         for i in range(start_step, end_step):
-            batch_np = data.batch_at(i)
+            batch_np = get_batch(i)
             batch = make_global_batch(batch_np, self.mesh)
             state, metrics = self.train_step(state, batch, rng)
             steps_since_log += 1

@@ -140,6 +140,73 @@ class TestSplitAndNpz:
         )
         assert load_npy_mmap(str(tmp_path), "val") is None
 
+    def test_split_eval_on_memmap_stays_lazy(self, tmp_path):
+        """Splitting a memmap must not materialize the dataset: the split
+        returns index views; only batched rows are ever copied."""
+        from kubeflow_tpu.training.datasets import _IndexedView
+
+        np.save(tmp_path / "x.npy", np.arange(64, dtype=np.float32))
+        mm = np.load(tmp_path / "x.npy", mmap_mode="r")
+        train, ev = split_eval({"x": mm}, 0.25, seed=1)
+        assert isinstance(train["x"], _IndexedView)
+        assert isinstance(ev["x"], _IndexedView)
+        assert len(train["x"]) == 48 and len(ev["x"]) == 16
+        got = set(np.asarray(train["x"])) | set(np.asarray(ev["x"]))
+        assert got == set(range(64))
+
+    def test_single_npz_file_is_not_its_own_val_split(self, tmp_path):
+        f = tmp_path / "data.npz"
+        np.savez(f, **tiny_arrays(16))
+        assert load_npz(str(f), "train") is not None
+        # eval == train would silently report training accuracy
+        assert load_npz(str(f), "val") is None
+
+    def test_mmap_train_with_npz_val(self, tmp_path):
+        """Split formats mix: mmap .npy train + .npz val shards."""
+        np.save(tmp_path / "train_image.npy", tiny_arrays(32)["image"])
+        np.save(
+            tmp_path / "train_label.npy", tiny_arrays(32)["label"]
+        )
+        np.savez(tmp_path / "val-000.npz", **tiny_arrays(8))
+        cfg = TrainingConfig(
+            model="mlp",
+            global_batch_size=8,
+            steps=1,
+            data=DataConfig(name="npz", path=str(tmp_path)),
+        )
+        from kubeflow_tpu.training.tasks import task_for_model
+
+        train, ev = build_data(cfg, task_for_model("mlp", cfg))
+        assert train.num_examples == 32 and ev.num_examples == 8
+
+    def test_lazy_batch_matches_eager(self):
+        ds = ArrayDataset(tiny_arrays(32), 8, seed=5)
+        eager = ds.batch_at(3)
+        lazy = ds.lazy_batch_at(3)
+        for k in eager:
+            assert lazy[k].shape == eager[k].shape
+            assert lazy[k].dtype == eager[k].dtype
+            np.testing.assert_array_equal(np.asarray(lazy[k]), eager[k])
+            # device-style index tuple slices just those rows
+            np.testing.assert_array_equal(
+                lazy[k][(slice(2, 6),)], eager[k][2:6]
+            )
+
+    def test_lazy_batch_decodes_uint8(self):
+        arrays = {
+            "image": np.arange(8 * 2 * 2 * 3, dtype=np.uint8).reshape(
+                8, 2, 2, 3
+            ),
+            "label": np.arange(8, dtype=np.int32),
+        }
+        ds = ArrayDataset(arrays, 4, shuffle=False)
+        col = ds.lazy_batch_at(0)["image"]
+        assert col.dtype == np.float32
+        np.testing.assert_allclose(
+            col[(slice(0, 2),)],
+            arrays["image"][:2].astype(np.float32) / 127.5 - 1.0,
+        )
+
     def test_eval_requested_without_eval_source_is_rejected(self, tmp_path):
         from kubeflow_tpu.config.core import ConfigError
 

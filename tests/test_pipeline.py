@@ -1,0 +1,201 @@
+"""Pipeline parallelism: GPipe schedule numerics + mesh equivalence.
+
+SURVEY.md §2.5 maps PP to a stage-sharded ppermute microbatch pipeline; the
+proof obligations are (a) the schedule computes exactly what sequential
+layer application computes, and (b) training losses are invariant to moving
+work onto a real `pipeline` mesh axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel.pipeline import (
+    gpipe,
+    microbatch,
+    pipeline_stage_slices,
+    unmicrobatch,
+)
+
+
+class TestGpipeSchedule:
+    def test_matches_sequential_composition(self):
+        """Each microbatch must pass through every stage, in order."""
+        s, m, mb = 3, 4, 2
+        factors = jnp.asarray([2.0, 3.0, 5.0])  # stage i multiplies by f[i]
+        offsets = jnp.asarray([1.0, 10.0, 100.0])
+
+        def stage_call(state):
+            # vmapped-stack semantics: slot i gets stage i's params
+            return state * factors[:, None] + offsets[:, None]
+
+        x = jnp.arange(m * mb, dtype=jnp.float32).reshape(m, mb)
+        got = gpipe(stage_call, x, num_stages=s)
+        want = x
+        for i in range(s):
+            want = want * factors[i] + offsets[i]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_travel_arrays_ride_with_their_microbatch(self):
+        """Side inputs (masks) must stay aligned with their microbatch."""
+        s, m, mb = 2, 3, 1
+
+        def stage_call(state, tag):
+            # output encodes the tag so misalignment is detectable
+            return state + tag
+
+        x = jnp.zeros((m, mb))
+        tags = jnp.asarray([[1.0], [10.0], [100.0]])
+        got = gpipe(stage_call, x, [tags], num_stages=s)
+        # each microbatch accumulates its own tag once per stage
+        np.testing.assert_allclose(got, tags * s, rtol=1e-6)
+
+    def test_microbatch_roundtrip(self):
+        x = jnp.arange(24.0).reshape(12, 2)
+        np.testing.assert_array_equal(unmicrobatch(microbatch(x, 4)), x)
+        with pytest.raises(ValueError, match="not divisible"):
+            microbatch(x, 5)
+        with pytest.raises(ValueError, match="not divisible"):
+            pipeline_stage_slices(12, 5)
+
+
+class TestPipelinedBert:
+    def make_model(self, stages=2):
+        from kubeflow_tpu.models.registry import get_model
+
+        return get_model(
+            "bert_tiny",
+            dtype=jnp.float32,
+            pipeline_stages=stages,
+            num_layers=2,
+        )
+
+    def test_pipelined_encoder_equals_sequential_stages(self):
+        """PipelinedEncoder output == applying the same stacked stage params
+        one after the other (the GPipe schedule is exact, not approximate)."""
+        from kubeflow_tpu.models.bert import (
+            BertConfig,
+            PipelinedEncoder,
+            StageBlock,
+        )
+
+        cfg = BertConfig(
+            vocab_size=128,
+            hidden_size=32,
+            num_layers=2,
+            num_heads=2,
+            mlp_dim=64,
+            max_len=32,
+            dropout_rate=0.0,
+            dtype=jnp.float32,
+            pipeline_stages=2,
+        )
+        enc = PipelinedEncoder(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 32))
+        mask = jnp.ones((4, 16), bool)
+        params = enc.init(jax.random.PRNGKey(1), x, mask, True)["params"]
+        got = enc.apply({"params": params}, x, mask, True)
+
+        stage = StageBlock(cfg, layers_per_stage=1)
+        want = x
+        for i in range(2):
+            stage_params = jax.tree.map(lambda a, i=i: a[i], params["stages"])
+            want = stage.apply({"params": stage_params}, want, mask, True)
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_loss_invariant_to_pipeline_mesh(self, devices8):
+        """Same model + seed: training on (data=4) and (data=2, pipeline=2)
+        meshes produces the same losses — the pipeline axis changes layout,
+        not math (SURVEY.md §2.5 PP row)."""
+        from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.data import make_global_batch
+        from kubeflow_tpu.training.tasks import MlmTask
+        from kubeflow_tpu.training.trainer import Trainer
+
+        losses = {}
+        for label, mesh_cfg in {
+            "flat": MeshConfig(data=4),
+            "pp": MeshConfig(data=2, pipeline=2),
+        }.items():
+            cfg = TrainingConfig(
+                model="bert_tiny",
+                global_batch_size=8,
+                steps=2,
+                warmup_steps=1,
+                learning_rate=1e-3,
+                dtype="float32",
+                seed=7,
+                mesh=mesh_cfg,
+                checkpoint={"enabled": False},
+            )
+            mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:4])
+            task = MlmTask(cfg, seq_len=32, vocab_size=128)
+            trainer = Trainer(
+                cfg,
+                mesh=mesh,
+                task=task,
+                model_kwargs={"pipeline_stages": 2, "num_layers": 2},
+            )
+            state = trainer.init_state()
+            rng = jax.random.PRNGKey(0)
+            got = []
+            for step in range(2):
+                batch = make_global_batch(
+                    task.synthetic_data().batch_at(step), mesh
+                )
+                state, metrics = trainer.train_step(state, batch, rng)
+                got.append(float(jax.device_get(metrics["loss"])))
+            losses[label] = got
+        np.testing.assert_allclose(
+            losses["flat"], losses["pp"], rtol=2e-4, atol=2e-4
+        )
+
+    def test_pipeline_params_sharded_over_pipeline_axis(self, devices8):
+        """Stage-stacked params actually land sharded on the pipeline axis."""
+        from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.tasks import MlmTask
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = TrainingConfig(
+            model="bert_tiny",
+            global_batch_size=8,
+            steps=1,
+            warmup_steps=1,
+            dtype="float32",
+            mesh=MeshConfig(data=2, pipeline=2),
+            checkpoint={"enabled": False},
+        )
+        mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:4])
+        task = MlmTask(cfg, seq_len=32, vocab_size=128)
+        trainer = Trainer(
+            cfg,
+            mesh=mesh,
+            task=task,
+            model_kwargs={"pipeline_stages": 2, "num_layers": 2},
+        )
+        state = trainer.init_state()
+        kernel = state.params["encoder"]["stages"]["layer_0"]["attention"][
+            "query"
+        ]["kernel"]
+        assert kernel.shape[0] == 2  # stacked stage dim
+        spec = kernel.sharding.spec
+        assert spec and spec[0] == "pipeline"
+
+    def test_unsupported_model_raises(self, devices8):
+        from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = TrainingConfig(
+            model="mlp",
+            global_batch_size=8,
+            steps=1,
+            mesh=MeshConfig(pipeline=2),
+            checkpoint={"enabled": False},
+        )
+        mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:2])
+        with pytest.raises(TypeError):
+            Trainer(cfg, mesh=mesh)
