@@ -46,6 +46,12 @@ class BertConfig:
     # (parallel/pipeline.py). num_layers must divide evenly into stages.
     pipeline_stages: int = 1
     num_microbatches: int = 0  # 0 = pipeline_stages
+    # expert parallelism: >0 replaces every MLP with a Switch-routed MoE of
+    # that many experts, stacked on the `expert` mesh axis
+    # (parallel/moe.py). Dropped-token residuals follow Switch semantics.
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
 
 def _dense_attention(q, k, v, mask, dtype):
@@ -113,6 +119,66 @@ class Mlp(nn.Module):
         return h
 
 
+class MoeMlp(nn.Module):
+    """Switch-routed expert MLP over the `expert` mesh axis.
+
+    Expert weights are stacked [E, ...] (logical axis "expert"); the
+    dispatch/combine einsums against the routing tensor reshard tokens
+    batch-major → expert-major and back, which XLA lowers to all_to_all
+    when the expert axis is real. See parallel/moe.py.
+    """
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        from kubeflow_tpu.parallel.moe import expert_capacity, switch_route
+
+        cfg = self.cfg
+        b, s, d = x.shape
+        e = cfg.num_experts
+        c = expert_capacity(s, e, cfg.expert_capacity_factor)
+
+        router = self.param(
+            "router",
+            nn.initializers.normal(stddev=0.02),
+            (d, e),
+            jnp.float32,
+        )
+        logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), router)
+        route = switch_route(logits, c)
+
+        init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal", in_axis=-2, out_axis=-1
+        )
+        wi = self.param("wi", init, (e, d, cfg.mlp_dim), jnp.float32)
+        wo = self.param("wo", init, (e, cfg.mlp_dim, d), jnp.float32)
+
+        dispatch = route.dispatch.astype(cfg.dtype)
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+        expert_in = shard_constraint(
+            expert_in, ("act_expert", "batch", None, None)
+        )
+        h = jnp.einsum("ebcd,edf->ebcf", expert_in, wi.astype(cfg.dtype))
+        h = nn.gelu(h, approximate=True)
+        out_e = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(cfg.dtype))
+        out_e = shard_constraint(out_e, ("act_expert", "batch", None, None))
+        y = jnp.einsum("bsec,ebcd->bsd", route.combine.astype(cfg.dtype), out_e)
+
+        # weighted load-balance loss, summed into the task loss via the
+        # mutable "losses" collection (a no-op when not mutable: eval/serve)
+        self.sow(
+            "losses",
+            "moe_aux",
+            cfg.moe_aux_weight * route.aux_loss,
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
+        if cfg.dropout_rate > 0:
+            y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
+        return y
+
+
 class EncoderLayer(nn.Module):
     cfg: BertConfig
 
@@ -121,7 +187,10 @@ class EncoderLayer(nn.Module):
         cfg = self.cfg
         y = SelfAttention(cfg, name="attention")(x, mask, deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x + y)
-        y = Mlp(cfg, name="mlp")(x, deterministic)
+        if cfg.num_experts > 0:
+            y = MoeMlp(cfg, name="moe")(x, deterministic)
+        else:
+            y = Mlp(cfg, name="mlp")(x, deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y)
         return shard_constraint(x, ("batch", "seq", "act_embed"))
 
@@ -229,6 +298,12 @@ class Bert(nn.Module):
         x = x.astype(cfg.dtype)
         x = shard_constraint(x, ("batch", "seq", "act_embed"))
 
+        if cfg.pipeline_stages > 1 and cfg.num_experts > 0:
+            raise ValueError(
+                "pipeline_stages > 1 with num_experts > 0 is not supported: "
+                "the stacked-stage vmap does not map the MoE 'losses' "
+                "collection; run EP with data/fsdp/tensor axes instead"
+            )
         if cfg.pipeline_stages > 1:
             x = PipelinedEncoder(cfg, name="encoder")(
                 x, attention_mask, deterministic
@@ -267,6 +342,14 @@ def bert_large(**kwargs) -> Bert:
     return Bert(BertConfig(**defaults))
 
 
+@register_model("bert_base_moe")
+def bert_base_moe(**kwargs) -> Bert:
+    """BERT-base with every MLP a Switch MoE (8 experts by default)."""
+    defaults = dict(num_experts=8)
+    defaults.update(kwargs)
+    return Bert(BertConfig(**defaults))
+
+
 @register_model("bert_tiny")
 def bert_tiny(**kwargs) -> Bert:
     """Test-scale config (CI runs on a virtual CPU mesh)."""
@@ -278,6 +361,23 @@ def bert_tiny(**kwargs) -> Bert:
         mlp_dim=128,
         max_len=128,
         dropout_rate=0.0,
+    )
+    defaults.update(kwargs)
+    return Bert(BertConfig(**defaults))
+
+
+@register_model("bert_tiny_moe")
+def bert_tiny_moe(**kwargs) -> Bert:
+    """Test-scale MoE config (4 experts on the virtual mesh)."""
+    defaults = dict(
+        vocab_size=512,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        mlp_dim=128,
+        max_len=128,
+        dropout_rate=0.0,
+        num_experts=4,
     )
     defaults.update(kwargs)
     return Bert(BertConfig(**defaults))

@@ -1,0 +1,79 @@
+"""Expert parallelism — Switch-style top-1 MoE routing in pure GSPMD.
+
+The reference has no expert parallelism (SURVEY.md §2.5: absent); the
+TPU-native equivalent maps experts onto an `expert` mesh axis. As with the
+pipeline (parallel/pipeline.py) the design stays one SPMD XLA program:
+
+- expert weights are *stacked* with a leading [E] dim annotated with the
+  "expert" logical axis → each expert group holds only its experts' weights,
+- tokens are routed per batch row (the "group"): a float32 router picks the
+  top-1 expert per token, tokens beyond an expert's capacity are dropped
+  (residual connection carries them unchanged — Switch Transformer
+  semantics),
+- dispatch/combine are einsum contractions against a [B, S, E, C] one-hot
+  tensor; the expert-major intermediate [E, B, C, D] carries a sharding
+  constraint on ("expert", "batch") so XLA lowers the reshard to an
+  `all_to_all` across the expert axis and back,
+- the load-balance auxiliary loss (E · Σ_e f_e·P_e) keeps routing uniform;
+  it is differentiable through the router probabilities.
+
+Everything is static-shaped (capacity is a compile-time constant), MXU-sized
+(expert matmuls stay batched [E, B·C, D]×[E, D, F]), and bfloat16 on the
+compute path with a float32 router.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Routing(NamedTuple):
+    dispatch: jax.Array  # [B, S, E, C] float, one-hot over (E, C) per token
+    combine: jax.Array   # [B, S, E, C] float, dispatch * router gate
+    aux_loss: jax.Array  # scalar load-balance loss (Switch: E * Σ f_e P_e)
+    fraction_dropped: jax.Array  # scalar, tokens over capacity / tokens
+
+
+def expert_capacity(
+    tokens_per_group: int, num_experts: int, capacity_factor: float
+) -> int:
+    """Per-expert token slots, static at compile time."""
+    return max(1, math.ceil(tokens_per_group / num_experts * capacity_factor))
+
+
+def switch_route(router_logits: jax.Array, capacity: int) -> Routing:
+    """Top-1 (Switch) routing with per-group capacity.
+
+    router_logits: [B, S, E] float32 — B batch rows are the routing groups,
+    S tokens per group, E experts. Position within an expert is assigned in
+    token order (cumsum), so routing is deterministic.
+    """
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                      # [B, S]
+    gate = jnp.take_along_axis(probs, expert_idx[..., None], -1)[..., 0]
+    num_experts = router_logits.shape[-1]
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)
+
+    # position of each token within its expert's queue (0-based)
+    pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0              # [B, S, E]
+    kept = (pos >= 0) & (pos < capacity)
+    pos_c = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    slot = jax.nn.one_hot(pos_c, capacity, dtype=jnp.float32)    # [B, S, E, C]
+    dispatch = slot * kept[..., None].astype(jnp.float32)
+    combine = dispatch * gate[..., None, None]
+
+    # Switch load-balance loss over all tokens in the batch: f_e is the
+    # fraction of tokens argmax-routed to e (pre-capacity), P_e the mean
+    # router probability; perfectly uniform routing gives loss = 1.0.
+    f = onehot.mean(axis=(0, 1))                                  # [E]
+    p = probs.mean(axis=(0, 1))                                   # [E]
+    aux_loss = num_experts * jnp.sum(f * p)
+
+    routed = onehot.max(axis=-1)  # 1.0 for every token (top-1 always routes)
+    kept_any = dispatch.sum(axis=(-1, -2))
+    fraction_dropped = 1.0 - kept_any.sum() / jnp.maximum(routed.sum(), 1.0)
+    return Routing(dispatch, combine, aux_loss, fraction_dropped)

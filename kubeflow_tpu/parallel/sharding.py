@@ -27,6 +27,7 @@ LOGICAL_RULES: Dict[str, Union[None, str, Tuple[str, ...]]] = {
     "act_embed": None,
     "act_mlp": "tensor",
     "act_heads": "tensor",
+    "act_expert": "expert",
     # params
     "embed": "fsdp",           # FSDP shards the embed dim of weights
     "mlp": "tensor",

@@ -123,12 +123,17 @@ class InProcessTrainerRunner(PodRunner):
             mesh = build_mesh(
                 MeshSpec.from_config(cfg.mesh), devices=jax.devices()[:needed]
             )
-        result = run_training(
-            cfg,
-            restore=bool(env.get("KFT_RESTORE_DIR")),
-            steps_override=self.steps_override,
-            mesh=mesh,
-        )
+        try:
+            result = run_training(
+                cfg,
+                restore=bool(env.get("KFT_RESTORE_DIR")),
+                steps_override=self.steps_override,
+                mesh=mesh,
+            )
+        except FloatingPointError as e:
+            # diverged training is a real failure, not a Succeeded job with
+            # a NaN in the log (trainer.fit raises on non-finite loss)
+            return FAILED, {"reason": "NonFiniteLoss", "message": str(e)}
         self.last_metrics = {
             "items_per_sec": result["items_per_sec"],
             "loss": result["loss"],

@@ -27,6 +27,11 @@ _PATTERNS: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
     (r".*/mlp/wi/kernel$", ("embed", "mlp")),
     (r".*/mlp/wo/kernel$", ("mlp", "embed")),
     (r".*/mlp/wi/bias$", ("mlp",)),
+    # MoE expert stacks [E, ...] (parallel/moe.py); router stays replicated
+    # so every token group computes identical routing
+    (r".*/moe/wi$", ("expert", "embed", "mlp")),
+    (r".*/moe/wo$", ("expert", "mlp", "embed")),
+    (r".*/moe/router$", (None, None)),
     # Embeddings + vocab projections
     (r".*/(tok_emb|seg_emb)/embedding$", ("vocab", "embed")),
     (r".*/pos_emb/embedding$", (None, "embed")),

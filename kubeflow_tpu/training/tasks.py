@@ -123,17 +123,26 @@ class MlmTask:
         )
 
     def loss(self, model, params, extra_vars, batch, train: bool, rngs):
-        out = model.apply(
+        # "losses" is mutable so MoE layers can sow their load-balance
+        # auxiliary loss (models/bert.py MoeMlp); empty for dense models.
+        out, sown = model.apply(
             {"params": params, **extra_vars},
             batch["input_ids"],
             attention_mask=batch["attention_mask"],
             deterministic=not train,
             rngs=rngs if train else None,
+            mutable=["losses"],
         )
         mlm = cross_entropy(out["mlm_logits"], batch["labels"], ignore=-100)
         nsp = cross_entropy(out["nsp_logits"], batch["nsp_labels"])
         loss = mlm + nsp
-        return loss, {"aux": {"mlm_loss": mlm, "nsp_loss": nsp}, "var_updates": {}}
+        aux = {"mlm_loss": mlm, "nsp_loss": nsp}
+        sown_losses = jax.tree.leaves(sown.get("losses", {}))
+        if sown_losses:
+            moe_aux = sum(sown_losses)
+            loss = loss + moe_aux
+            aux["moe_aux_loss"] = moe_aux
+        return loss, {"aux": aux, "var_updates": {}}
 
     def count_items(self, batch) -> int:
         # tokens/step is the BERT throughput unit

@@ -85,6 +85,15 @@ class Trainer:
             cfg.model, dtype=dtype, **kwargs
         )
         self.task = task if task is not None else task_for_model(cfg.model, cfg)
+        # clamp the task's data dims to the model's actual table sizes —
+        # synthetic MLM ids beyond the model's vocab (e.g. bert_tiny's 512
+        # vs the BERT-base default 30522) train on clamped-gather garbage
+        mcfg = getattr(self.model, "cfg", None)
+        if task is None and mcfg is not None:
+            if hasattr(self.task, "vocab_size") and hasattr(mcfg, "vocab_size"):
+                self.task.vocab_size = min(self.task.vocab_size, mcfg.vocab_size)
+            if hasattr(self.task, "seq_len") and hasattr(mcfg, "max_len"):
+                self.task.seq_len = min(self.task.seq_len, mcfg.max_len)
         self.tx, self.schedule = make_optimizer(cfg, cfg.model)
         self._train_step = None
         self._eval_step = None
@@ -102,7 +111,11 @@ class Trainer:
         def init_fn(rng):
             variables = self.task.init_variables(self.model, rng, sample)
             params = variables["params"]
-            extra = {k: v for k, v in variables.items() if k != "params"}
+            # "losses" holds per-apply sown values (MoE aux loss), not state
+            extra = {
+                k: v for k, v in variables.items()
+                if k not in ("params", "losses")
+            }
             opt_state = self.tx.init(params)
             return TrainState(
                 step=jnp.zeros((), jnp.int32),
@@ -356,6 +369,13 @@ class Trainer:
                     is_last = True
             if (i + 1) % log_every == 0 or is_last:
                 metrics = jax.device_get(metrics)
+                if not np.isfinite(float(metrics["loss"])):
+                    # diverged: stop now — a "Succeeded" job with NaN loss
+                    # is a silent failure (runtime/train_run.py turns this
+                    # into a Failed pod with reason NonFiniteLoss)
+                    raise FloatingPointError(
+                        f"non-finite loss at step {i + 1}"
+                    )
                 now = time.monotonic()
                 dt = (now - t_last) / steps_since_log
                 t_last = now

@@ -121,6 +121,51 @@ class TestTrainerTP:
         assert m_dp.loss == pytest.approx(m_tp.loss, rel=2e-2)
 
 
+class TestDivergenceAndTaskClamp:
+    def test_non_finite_loss_raises(self, devices8):
+        """A diverged run must not report success (VERIFY finding: lr=0.1
+        on a transformer produced a 'Succeeded' job with loss=nan)."""
+        cfg = TrainingConfig(
+            model="resnet18",
+            global_batch_size=16,
+            steps=6,
+            warmup_steps=1,
+            learning_rate=1e12,
+            mesh=MeshConfig(data=8),
+        )
+        tr = Trainer(cfg, model_kwargs={"num_classes": 10})
+        tr.task.image_size = 32
+        tr.task.num_classes = 10
+        with pytest.raises(FloatingPointError, match="non-finite loss"):
+            tr.fit(steps=6, log_every=1)
+
+    def test_mlm_task_clamped_to_model_dims(self, devices8):
+        """Default MlmTask dims (BERT-base scale) shrink to the model's
+        actual vocab/max_len so synthetic ids stay in range."""
+        cfg = TrainingConfig(
+            model="bert_tiny",
+            global_batch_size=8,
+            steps=1,
+            warmup_steps=1,
+            mesh=MeshConfig(data=8),
+        )
+        tr = Trainer(cfg)
+        assert tr.task.vocab_size == 512
+        assert tr.task.seq_len <= 128
+
+    def test_explicit_task_not_clamped(self, devices8):
+        cfg = TrainingConfig(
+            model="bert_tiny",
+            global_batch_size=8,
+            steps=1,
+            warmup_steps=1,
+            mesh=MeshConfig(data=8),
+        )
+        task = MlmTask(cfg, seq_len=32, vocab_size=4096)
+        tr = Trainer(cfg, task=task)
+        assert tr.task.vocab_size == 4096
+
+
 class TestCheckpoint:
     def test_save_restore_roundtrip(self, devices8, tmp_path):
         tr = tiny_image_trainer(MeshConfig(data=8))
