@@ -225,6 +225,11 @@ class TrainingConfig(ConfigNode):
     data: DataConfig = config_field(default_factory=DataConfig)
     checkpoint: CheckpointConfig = config_field(default_factory=CheckpointConfig)
     remat: bool = config_field(default=False, help="jax.checkpoint rematerialisation")
+    profiler_logdir: str = config_field(
+        default="",
+        help="non-empty: serve the jax.profiler capture endpoint "
+        "(runtime/profiler.py) writing TB-readable traces here",
+    )
 
     def validate(self) -> None:
         if self.global_batch_size < 1:
@@ -284,6 +289,18 @@ DEFAULT_COMPONENTS = [
 
 
 @dataclasses.dataclass
+class AuthConfig(ConfigNode):
+    """Basic-auth gate (reference: gatekeeper + the password secret,
+    scripts/create_password_secret.sh). Empty username = no gatekeeper;
+    identity comes from the trusted header alone (IAP-style)."""
+
+    username: str = config_field(default="")
+    password_hash: str = config_field(
+        default="", help="salted hash from api.gatekeeper.hash_password"
+    )
+
+
+@dataclasses.dataclass
 class PlatformDef(ConfigNode):
     """The whole-platform deployment config (KfDef-equivalent)."""
 
@@ -299,6 +316,7 @@ class PlatformDef(ConfigNode):
     slice: SliceConfig = config_field(default_factory=SliceConfig)
     training: TrainingConfig = config_field(default_factory=TrainingConfig)
     notebooks: NotebookDefaults = config_field(default_factory=NotebookDefaults)
+    auth: AuthConfig = config_field(default_factory=AuthConfig)
     components: List[ComponentSpec] = config_field(
         default_factory=lambda: [ComponentSpec(name=n) for n in DEFAULT_COMPONENTS]
     )

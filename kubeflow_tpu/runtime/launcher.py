@@ -26,6 +26,35 @@ log = get_logger(__name__)
 
 ENV_TRAINING_SPEC = "KFT_TRAINING_SPEC"
 ENV_RESTORE_DIR = "KFT_RESTORE_DIR"
+# profiler capture endpoint (runtime/profiler.py): set the logdir to enable;
+# traces land TensorBoard-readable so a Tensorboard CR can front them
+ENV_PROFILER_LOGDIR = "KFT_PROFILER_LOGDIR"
+ENV_PROFILER_PORT = "KFT_PROFILER_PORT"
+DEFAULT_PROFILER_PORT = 9431
+
+
+def maybe_start_profiler_server(environ=None):
+    """Start the jax.profiler REST endpoint when the env asks for one.
+
+    Returns the Server (caller owns shutdown) or None. Port 0 picks a free
+    port (tests); the rendered pod env uses DEFAULT_PROFILER_PORT.
+    """
+    env = os.environ if environ is None else environ
+    logdir = env.get(ENV_PROFILER_LOGDIR, "")
+    if not logdir:
+        return None
+    if env.get("KFT_PROCESS_ID", "0") != "0":
+        # one endpoint per gang: only the coordinator serves — same-host
+        # gang members would otherwise race for the port
+        return None
+    from kubeflow_tpu.api.wsgi import Server
+    from kubeflow_tpu.runtime.profiler import ProfilerService, build_app
+
+    port = int(env.get(ENV_PROFILER_PORT, str(DEFAULT_PROFILER_PORT)))
+    server = Server(build_app(ProfilerService(logdir)), port=port)
+    server.start()
+    log.info("profiler endpoint on :%d → %s", server.port, logdir)
+    return server
 
 
 def run(config_path: Optional[str] = None, steps: Optional[int] = None) -> int:
@@ -55,11 +84,16 @@ def run(config_path: Optional[str] = None, steps: Optional[int] = None) -> int:
         len(jax.devices()),
         cfg.model,
     )
-    result = run_training(
-        cfg,
-        restore=bool(os.environ.get(ENV_RESTORE_DIR)),
-        steps_override=steps,
-    )
+    profiler_server = maybe_start_profiler_server()
+    try:
+        result = run_training(
+            cfg,
+            restore=bool(os.environ.get(ENV_RESTORE_DIR)),
+            steps_override=steps,
+        )
+    finally:
+        if profiler_server is not None:
+            profiler_server.stop()
     print(json.dumps({"job": gang.job_name, **result}))
     return 0
 

@@ -1,0 +1,128 @@
+"""Profiler capture service — `jax.profiler` traces on demand.
+
+SURVEY.md §5 (tracing): the reference's dashboard charts ride a pluggable
+MetricsService (reference: centraldashboard/app/metrics_service.ts:17-50);
+the TPU-native delta is device-level tracing — XLA/TPU timelines captured
+with `jax.profiler.start_trace`/`stop_trace` into a TensorBoard-readable
+logdir (the `plugins/profile/<run>` layout the TB profile plugin serves).
+
+The service runs inside the training runtime (runtime/launcher.py mounts it
+next to the metrics port) and is driven over REST:
+
+  POST /profiler/start            {"logdir": optional override}
+  POST /profiler/stop             → {"trace_dirs": [...]}
+  POST /profiler/capture          {"duration_ms": N} — blocking one-shot
+  GET  /profiler/status           → {"active": bool, "logdir": ..., "runs": N}
+
+A Tensorboard CR pointed at the same logdir fronts the captured traces
+(controllers/tensorboard.py); the dashboard's job view links there.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.api.wsgi import App, BadRequest
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import default_registry
+
+log = get_logger(__name__)
+
+
+class ProfilerService:
+    """Wraps jax.profiler start/stop with state + trace-dir discovery."""
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        self._lock = threading.Lock()
+        self._active: Optional[str] = None
+        reg = default_registry()
+        self._captures = reg.counter(
+            "profiler_captures_total", "completed trace captures", []
+        )
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, logdir: Optional[str] = None) -> Dict[str, Any]:
+        import jax
+
+        with self._lock:
+            if self._active is not None:
+                raise BadRequest(f"trace already active in {self._active}")
+            target = logdir or self.logdir
+            os.makedirs(target, exist_ok=True)
+            jax.profiler.start_trace(target)
+            self._active = target
+            log.info("profiler trace started → %s", target)
+            return {"active": True, "logdir": target}
+
+    def stop(self) -> Dict[str, Any]:
+        import jax
+
+        with self._lock:
+            if self._active is None:
+                raise BadRequest("no active trace")
+            target = self._active
+            jax.profiler.stop_trace()
+            self._active = None
+            self._captures.inc()
+            log.info("profiler trace stopped → %s", target)
+            return {"active": False, "trace_dirs": self.trace_runs(target)}
+
+    def capture(self, duration_ms: float = 1000.0) -> Dict[str, Any]:
+        """Blocking one-shot: start, let the training loop run, stop."""
+        self.start()
+        time.sleep(max(0.0, duration_ms) / 1e3)
+        return self.stop()
+
+    # -- introspection ----------------------------------------------------
+
+    def trace_runs(self, logdir: Optional[str] = None) -> List[str]:
+        """TensorBoard profile-plugin run dirs under the logdir."""
+        root = os.path.join(logdir or self.logdir, "plugins", "profile")
+        if not os.path.isdir(root):
+            return []
+        return sorted(
+            os.path.join(root, d)
+            for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "active": self._active is not None,
+                "logdir": self._active or self.logdir,
+                "runs": len(self.trace_runs()),
+            }
+
+
+def build_app(service: ProfilerService, authorizer=None) -> App:
+    app = App("profiler", authorizer=authorizer)
+
+    @app.post("/profiler/start")
+    def start(req):
+        body = req.body or {}
+        return service.start(logdir=body.get("logdir"))
+
+    @app.post("/profiler/stop")
+    def stop(req):
+        return service.stop()
+
+    @app.post("/profiler/capture")
+    def capture(req):
+        body = req.body or {}
+        try:
+            duration = float(body.get("duration_ms", 1000.0))
+        except (TypeError, ValueError):
+            raise BadRequest("duration_ms must be a number")
+        return service.capture(duration_ms=duration)
+
+    @app.get("/profiler/status")
+    def status(req):
+        return service.status()
+
+    return app

@@ -1,0 +1,144 @@
+"""Profiler capture path: REST API → jax.profiler trace → tensorboard mount.
+
+SURVEY.md §5 tracing: the rebuild promises trace capture endpoints backed by
+jax.profiler. These tests capture a real XLA trace through the API (on the
+CPU backend) and check the Tensorboard CR fronts the same logdir.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.api.wsgi import Server
+from kubeflow_tpu.runtime.launcher import maybe_start_profiler_server
+from kubeflow_tpu.runtime.profiler import ProfilerService, build_app
+
+
+def do_device_work():
+    x = jnp.ones((64, 64))
+    return float(jax.jit(lambda a: (a @ a).sum())(x))
+
+
+class TestProfilerService:
+    def test_capture_produces_tb_trace(self, tmp_path):
+        logdir = str(tmp_path / "traces")
+        svc = ProfilerService(logdir)
+        app = build_app(svc)
+
+        status, body = app.handle("GET", "/profiler/status")
+        assert status == 200 and body == {
+            "active": False, "logdir": logdir, "runs": 0,
+        }
+
+        status, body = app.handle("POST", "/profiler/start", body={})
+        assert status == 200 and body["active"]
+        do_device_work()
+        status, body = app.handle("POST", "/profiler/stop")
+        assert status == 200
+        assert body["trace_dirs"], "no trace run directory produced"
+        run_dir = body["trace_dirs"][0]
+        # the TB profile plugin layout: <logdir>/plugins/profile/<run>/
+        assert os.sep + os.path.join("plugins", "profile") + os.sep in run_dir
+        files = os.listdir(run_dir)
+        assert any(f.endswith((".xplane.pb", ".trace.json.gz")) for f in files), files
+
+    def test_double_start_and_stray_stop_rejected(self, tmp_path):
+        app = build_app(ProfilerService(str(tmp_path)))
+        status, _ = app.handle("POST", "/profiler/stop")
+        assert status == 400
+        assert app.handle("POST", "/profiler/start", body={})[0] == 200
+        status, body = app.handle("POST", "/profiler/start", body={})
+        assert status == 400 and "already active" in body["log"]
+        assert app.handle("POST", "/profiler/stop")[0] == 200
+
+    def test_oneshot_capture(self, tmp_path):
+        app = build_app(ProfilerService(str(tmp_path)))
+        do_device_work()
+        status, body = app.handle(
+            "POST", "/profiler/capture", body={"duration_ms": 50}
+        )
+        assert status == 200 and not body["active"]
+
+
+class TestLauncherWiring:
+    def test_disabled_without_env(self):
+        assert maybe_start_profiler_server(environ={}) is None
+
+    def test_env_serves_real_socket(self, tmp_path):
+        import json
+        import urllib.request
+
+        server = maybe_start_profiler_server(
+            environ={
+                "KFT_PROFILER_LOGDIR": str(tmp_path / "traces"),
+                "KFT_PROFILER_PORT": "0",
+            }
+        )
+        assert isinstance(server, Server)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/profiler/status", timeout=5
+            ) as resp:
+                body = json.loads(resp.read())
+            assert body["active"] is False
+        finally:
+            server.stop()
+
+
+class TestTensorboardFronting:
+    def test_job_env_and_tensorboard_mount_share_logdir(self):
+        """A profiled job's trace dir is servable by a Tensorboard CR."""
+        from kubeflow_tpu.cluster.reconciler import ControllerManager
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.tensorboard import (
+            TensorboardController,
+            new_tensorboard,
+        )
+        from kubeflow_tpu.controllers.tpujob import (
+            TPUTrainJobController,
+            new_tpu_train_job,
+        )
+
+        logdir = "/jobs/exp1/traces"
+        store = StateStore()
+        cm = ControllerManager(store)
+        cm.register(TPUTrainJobController())
+        cm.register(TensorboardController())
+
+        job = new_tpu_train_job(
+            "exp1",
+            slice_spec={"topology": "v5e-4"},
+            training={
+                "model": "mlp",
+                "global_batch_size": 8,
+                "steps": 1,
+                "mesh": {"data": 4},
+                "profiler_logdir": logdir,
+                "checkpoint": {"enabled": False},
+            },
+        )
+        store.create(job)
+        store.create(new_tensorboard("exp1-tb", logdir=logdir))
+        cm.run_until_idle(max_seconds=10)
+
+        pods = [
+            p for p in store.list("Pod", "default")
+            if p["metadata"]["name"].startswith("exp1-")
+            and "worker" in p["metadata"]["name"]
+        ]
+        assert pods, [p["metadata"]["name"] for p in store.list("Pod", "default")]
+        env = {
+            e["name"]: e.get("value", "")
+            for c in pods[0]["spec"]["containers"]
+            for e in c.get("env", [])
+        }
+        assert env["KFT_PROFILER_LOGDIR"] == logdir
+        assert env["KFT_PROFILER_PORT"] == "9431"
+
+        dep = store.get("Deployment", "exp1-tb", "default")
+        container = dep["spec"]["template"]["spec"]["containers"][0]
+        assert f"--logdir={logdir}" in container["command"]
+        mounts = container.get("volumeMounts", [])
+        assert any(m["mountPath"] == logdir for m in mounts)
