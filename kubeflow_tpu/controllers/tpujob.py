@@ -54,6 +54,10 @@ from kubeflow_tpu.parallel.distributed import (
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import default_registry
 
+# slice_agent TCP gang barrier on the coordinator pod — one above the
+# jax.distributed coordinator port so both servers coexist on process 0
+BARRIER_PORT = DEFAULT_COORDINATOR_PORT + 1
+
 log = get_logger(__name__)
 
 KIND = "TPUTrainJob"
@@ -317,6 +321,30 @@ class TPUTrainJobController(Controller):
         set_owner(svc, job)
         store.apply(svc)
 
+    @staticmethod
+    def _barrier_args(
+        spec: Dict[str, Any],
+        slice_cfg: SliceConfig,
+        index: int,
+        env: Dict[str, str],
+    ) -> List[str]:
+        """slice_agent barrier flags for one gang member.
+
+        Single host: barrier is trivially local (one process). Multi-host:
+        TCP against the coordinator pod's DNS name on BARRIER_PORT —
+        correct with no shared storage (the round-1 file barrier was inert
+        cross-host unless a sharedVolume was configured). sharedVolume
+        keeps the signal-file barrier for clusters that have one.
+        """
+        n = slice_cfg.total_hosts
+        if n <= 1:
+            return ["--process-id", "0", "--num-processes", "1"]
+        args = ["--process-id", str(index), "--num-processes", str(n)]
+        if spec.get("sharedVolume"):
+            return args
+        coord_host = env.get("KFT_COORDINATOR_ADDRESS", "").rsplit(":", 1)[0]
+        return args + ["--coordinator", f"{coord_host}:{BARRIER_PORT}"]
+
     def _build_pod(
         self,
         job: Dict[str, Any],
@@ -335,6 +363,12 @@ class TPUTrainJobController(Controller):
         if ckpt_dir and restarts > 0:
             # resume-on-gang-restart: the in-pod runner restores latest step
             env["KFT_RESTORE_DIR"] = ckpt_dir
+        profiler_logdir = (spec.get("training") or {}).get("profiler_logdir")
+        if profiler_logdir:
+            # coordinator serves the jax.profiler capture endpoint
+            # (runtime/profiler.py); a Tensorboard CR fronts the logdir
+            env["KFT_PROFILER_LOGDIR"] = profiler_logdir
+            env.setdefault("KFT_PROFILER_PORT", "9431")
         pod = new_object(
             "Pod",
             pod_name,
@@ -353,22 +387,17 @@ class TPUTrainJobController(Controller):
                     {
                         "name": "trainer",
                         "image": spec.get("image", DEFAULT_IMAGE),
-                        # slice_agent (native sidecar): TPU device gate +
-                        # supervision; the file barrier spans the gang only
-                        # when a genuinely shared volume backs /var/run/gang
-                        # (otherwise per-pod, and the cross-host barrier is
-                        # jax.distributed.initialize in the launcher)
+                        # slice_agent (native sidecar): TPU device gate,
+                        # gang barrier, supervision. Multi-host gangs use
+                        # the TCP barrier against the coordinator pod (works
+                        # with no shared storage); a sharedVolume opts into
+                        # the signal-file barrier instead.
                         "command": [
                             "slice_agent",
                             # attempt-scoped dir: a gang restart must never
                             # see the previous attempt's signal files
                             "--shared-dir", f"/var/run/gang/attempt-{restarts}",
-                            "--process-id",
-                            str(index) if spec.get("sharedVolume") else "0",
-                            "--num-processes",
-                            str(slice_cfg.total_hosts)
-                            if spec.get("sharedVolume")
-                            else "1",
+                            *self._barrier_args(spec, slice_cfg, index, env),
                             "--min-devices", str(slice_cfg.chips_per_host),
                             # bound the gate+barrier wait (pod-skew budget) so
                             # a half-placed gang can't hold chips forever

@@ -125,3 +125,112 @@ class TestSupervision:
         (tmp_path / "terminate").write_text("1")
         p = run_agent(agent, tmp_path, 1, 2, payload=["true"], timeout_ms=0)
         assert p.wait(timeout=10) == 5
+
+
+def free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestTcpBarrier:
+    """Cross-host gang barrier over TCP — no shared storage required
+    (each agent gets its OWN tmp dir, proving nothing rides the volume)."""
+
+    def test_gang_of_three_over_tcp(self, agent, tmp_path):
+        port = free_port()
+        coord = ["--coordinator", f"127.0.0.1:{port}"]
+        procs = [
+            run_agent(
+                agent, tmp_path / f"own-{i}", i, 3, payload=["true"],
+                timeout_ms=8000, extra=coord,
+            )
+            for i in range(3)
+        ]
+        for p in procs:
+            assert p.wait(timeout=15) == 0, p.stderr.read()
+        for i in range(3):
+            assert (
+                tmp_path / f"own-{i}" / f"phase.{i}"
+            ).read_text() == "Succeeded"
+
+    def test_worker_times_out_without_coordinator(self, agent, tmp_path):
+        port = free_port()
+        w = run_agent(
+            agent, tmp_path, 1, 2, payload=["true"], timeout_ms=400,
+            extra=["--coordinator", f"127.0.0.1:{port}"],
+        )
+        assert w.wait(timeout=10) == 4
+
+    def test_coordinator_times_out_without_workers(self, agent, tmp_path):
+        port = free_port()
+        c = run_agent(
+            agent, tmp_path, 0, 2, payload=["true"], timeout_ms=400,
+            extra=["--coordinator", f"127.0.0.1:{port}"],
+        )
+        assert c.wait(timeout=10) == 4
+
+    def test_worker_stops_when_coordinator_finishes(self, agent, tmp_path):
+        """Master-phase watch over TCP: the coordinator's success pushes a
+        phase message; the long-running worker payload stops with success
+        (normal teardown skew, reference controller.py:92-102 semantics)."""
+        port = free_port()
+        coord = ["--coordinator", f"127.0.0.1:{port}"]
+        c = run_agent(
+            agent, tmp_path / "c", 0, 2, payload=["true"],
+            timeout_ms=8000, extra=coord,
+        )
+        w = run_agent(
+            agent, tmp_path / "w", 1, 2, payload=["sleep", "60"],
+            timeout_ms=8000, extra=coord,
+        )
+        assert c.wait(timeout=15) == 0
+        assert w.wait(timeout=15) == 0  # stopped, counted as success
+        assert (tmp_path / "w" / "phase.1").read_text() == "Succeeded"
+
+    def test_worker_fails_when_coordinator_payload_fails(self, agent, tmp_path):
+        port = free_port()
+        coord = ["--coordinator", f"127.0.0.1:{port}"]
+        c = run_agent(
+            agent, tmp_path / "c", 0, 2, payload=["false"],
+            timeout_ms=8000, extra=coord,
+        )
+        w = run_agent(
+            agent, tmp_path / "w", 1, 2, payload=["sleep", "60"],
+            timeout_ms=8000, extra=coord,
+        )
+        assert c.wait(timeout=15) == 1
+        assert w.wait(timeout=15) == 5  # gang failure propagates
+        assert (tmp_path / "w" / "phase.1").read_text() == "Failed"
+
+
+class TestBarrierArgsRendering:
+    """The controller's barrier flag rendering (tpujob._barrier_args)."""
+
+    def _args(self, spec, topology):
+        from kubeflow_tpu.config.platform import SliceConfig
+        from kubeflow_tpu.controllers.tpujob import TPUTrainJobController
+
+        cfg = SliceConfig(topology=topology)
+        env = {"KFT_COORDINATOR_ADDRESS": "job-worker-0.job-gang:8476"}
+        return TPUTrainJobController._barrier_args(spec, cfg, 2, env)
+
+    def test_single_host_is_local(self):
+        args = self._args({}, "v5e-8")
+        assert args == ["--process-id", "0", "--num-processes", "1"]
+
+    def test_multi_host_defaults_to_tcp(self):
+        args = self._args({}, "v5e-16")  # 4 hosts
+        assert "--coordinator" in args
+        assert args[args.index("--coordinator") + 1] == "job-worker-0.job-gang:8477"
+        assert args[args.index("--process-id") + 1] == "2"
+        assert args[args.index("--num-processes") + 1] == "4"
+
+    def test_shared_volume_keeps_file_barrier(self):
+        args = self._args({"sharedVolume": {"nfs": {"server": "x"}}}, "v5e-16")
+        assert "--coordinator" not in args
+        assert args[args.index("--num-processes") + 1] == "4"
