@@ -184,6 +184,40 @@ def build_app(
             raise BadRequest("window_s must be a number")
         return {"metric": metric, "points": metrics_service.query(ns, metric, window)}
 
+    @app.get("/api/resources/<ns>")
+    def resources(req):
+        # the data behind the dashboard's resource cards (reference:
+        # centraldashboard public/components/notebooks-card.js,
+        # pipelines-card.js — each card lists one kind's CRs)
+        ns = req.params["ns"]
+        require_member(req, ns)
+
+        def conditions_summary(obj):
+            conds = [
+                c["type"]
+                for c in obj.get("status", {}).get("conditions", [])
+                if c.get("status") == "True"
+            ]
+            return conds[-1] if conds else "Pending"
+
+        out = {}
+        for kind, key in (
+            ("TPUTrainJob", "jobs"),
+            ("StudyJob", "studies"),
+            ("Notebook", "notebooks"),
+            ("Tensorboard", "tensorboards"),
+            ("InferenceService", "models"),
+        ):
+            out[key] = [
+                {
+                    "name": o["metadata"]["name"],
+                    "status": conditions_summary(o),
+                    "age": o["metadata"].get("creationTimestamp", ""),
+                }
+                for o in store.list(kind, ns)
+            ]
+        return {"success": True, **out}
+
     @app.get("/api/dashboard-links")
     def links(req):
         # the sub-app registry the dashboard iframes (main-page.js)

@@ -74,16 +74,15 @@ class Gatekeeper:
         self._sessions[token] = (user, now + self.session_ttl_s)
         return token
 
-    def _basic_auth_user(self, req) -> Optional[str]:
+    def _basic_auth_user(self, authorization: str) -> Optional[str]:
         """Authorization: Basic support for programmatic clients (the
         reference's header path, AuthServer.go:62-117)."""
-        header = req.headers.get("authorization", "")
-        if not header.lower().startswith("basic "):
+        if not authorization.lower().startswith("basic "):
             return None
         import base64
 
         try:
-            decoded = base64.b64decode(header[6:]).decode()
+            decoded = base64.b64decode(authorization[6:]).decode()
             username, _, password = decoded.partition(":")
         except Exception:
             return None
@@ -92,6 +91,22 @@ class Gatekeeper:
         ):
             return username
         return None
+
+    def authenticate(self, headers: Dict[str, str]) -> Optional[str]:
+        """Resolve the authenticated user from raw request headers
+        (session cookie or Basic auth) — the gateway-filter entry point."""
+        from http.cookies import SimpleCookie
+
+        jar = SimpleCookie()
+        try:
+            jar.load(headers.get("cookie", ""))
+        except Exception:
+            jar = SimpleCookie()
+        if COOKIE_NAME in jar:
+            user = self._session_user(jar[COOKIE_NAME].value)
+            if user is not None:
+                return user
+        return self._basic_auth_user(headers.get("authorization", ""))
 
     def _session_user(self, token: str) -> Optional[str]:
         entry = self._sessions.get(token)
@@ -129,15 +144,19 @@ class Gatekeeper:
         @app.get("/auth")
         def auth(req):
             # the Ambassador auth-service contract: 200 passes the original
-            # request through (with identity attached), 301 sends to login.
+            # request through (with identity attached), 302 sends to login
+            # (302 not 301: browsers cache permanent redirects, which would
+            # lock a logged-in user out of pages visited while logged out).
             # Cookie (browser) or Basic header (programmatic) both pass.
             token = req.cookies().get(COOKIE_NAME, "")
             user = self._session_user(token) if token else None
             if user is None:
-                user = self._basic_auth_user(req)
+                user = self._basic_auth_user(
+                    req.headers.get("authorization", "")
+                )
             if user is None:
                 req.response_headers.append(("Location", LOGIN_PATH))
-                return {"success": False, "log": "login required"}, 301
+                return {"success": False, "log": "login required"}, 302
             req.response_headers.append((self.user_header, user))
             return {"success": True, "user": user}
 

@@ -87,6 +87,27 @@ class Request:
         return {k: morsel.value for k, morsel in jar.items()}
 
 
+class Response:
+    """Non-JSON response (HTML pages, static assets, redirects).
+
+    Handlers returning a Response bypass JSON serialization — the UI layer
+    (kubeflow_tpu/ui) serves browser pages through the same route table the
+    JSON BFFs use.
+    """
+
+    def __init__(
+        self,
+        body,
+        content_type: str = "text/html; charset=utf-8",
+        status: int = 200,
+        headers: Optional[List[Tuple[str, str]]] = None,
+    ):
+        self.body = body.encode() if isinstance(body, str) else bytes(body)
+        self.content_type = content_type
+        self.status = status
+        self.headers = list(headers or [])
+
+
 # SubjectAccessReview-shaped authorizer: (user, verb, resource, namespace)
 Authorizer = Callable[[str, str, str, str], bool]
 
@@ -200,6 +221,9 @@ class App:
                 status = 200
                 if isinstance(result, tuple):
                     result, status = result
+                if isinstance(result, Response):
+                    status = result.status
+                    req.response_headers.extend(result.headers)
             except HttpError as e:
                 result, status = {"success": False, "log": e.message}, e.status
             except Exception:
@@ -235,43 +259,101 @@ class App:
     # -- WSGI -------------------------------------------------------------
 
     def __call__(self, environ, start_response):
-        from urllib.parse import parse_qsl
+        return _wsgi_adapter(self.handle_full, environ, start_response)
 
-        method = environ["REQUEST_METHOD"]
-        path = environ.get("PATH_INFO", "/")
-        query: Dict[str, str] = dict(
-            parse_qsl(environ.get("QUERY_STRING", ""))
-        )
-        headers = {
-            k[5:].replace("_", "-").lower(): v
-            for k, v in environ.items()
-            if k.startswith("HTTP_")
-        }
-        body = None
+
+def _wsgi_adapter(handle_full, environ, start_response):
+    """environ → handle_full → start_response bridge, shared by App and Mux."""
+    from urllib.parse import parse_qsl
+
+    method = environ["REQUEST_METHOD"]
+    path = environ.get("PATH_INFO", "/")
+    query: Dict[str, str] = dict(parse_qsl(environ.get("QUERY_STRING", "")))
+    headers = {
+        k[5:].replace("_", "-").lower(): v
+        for k, v in environ.items()
+        if k.startswith("HTTP_")
+    }
+    body = None
+    try:
+        length = int(environ.get("CONTENT_LENGTH") or 0)
+    except ValueError:
+        length = 0
+    if length:
+        raw = environ["wsgi.input"].read(length)
         try:
-            length = int(environ.get("CONTENT_LENGTH") or 0)
-        except ValueError:
-            length = 0
-        if length:
-            raw = environ["wsgi.input"].read(length)
-            try:
-                body = json.loads(raw)
-            except json.JSONDecodeError:
-                start_response(_STATUS_TEXT[400], [("Content-Type", "application/json")])
-                return [json.dumps({"success": False, "log": "invalid JSON"}).encode()]
-        status, result, extra_headers = self.handle_full(
-            method, path, body, headers, query
-        )
-        payload = json.dumps(result).encode()
-        start_response(
-            _STATUS_TEXT.get(status, f"{status} Unknown"),
-            [
-                ("Content-Type", "application/json"),
-                ("Content-Length", str(len(payload))),
+            body = json.loads(raw)
+        except json.JSONDecodeError:
+            start_response(
+                _STATUS_TEXT[400], [("Content-Type", "application/json")]
+            )
+            return [
+                json.dumps({"success": False, "log": "invalid JSON"}).encode()
             ]
-            + list(extra_headers),
-        )
-        return [payload]
+    status, result, extra_headers = handle_full(
+        method, path, body, headers, query
+    )
+    if isinstance(result, Response):
+        payload, content_type = result.body, result.content_type
+    else:
+        payload, content_type = json.dumps(result).encode(), "application/json"
+    start_response(
+        _STATUS_TEXT.get(status, f"{status} Unknown"),
+        [
+            ("Content-Type", content_type),
+            ("Content-Length", str(len(payload))),
+        ]
+        + list(extra_headers),
+    )
+    return [payload]
+
+
+class Mux:
+    """Route requests across several Apps — the Istio-gateway analog.
+
+    The reference fronts every backend with one gateway host and routes by
+    path (SURVEY.md §1 L7: iframed sub-apps behind one gateway). The Mux
+    dispatches to the first app whose route table matches the path, so the
+    whole platform — UI pages, dashboard/spawner/KFAM BFFs, login — serves
+    from one socket.
+    """
+
+    def __init__(self, apps: List[App], name: str = "gateway", auth=None):
+        """`auth(method, path, headers)` is the gateway auth filter (the
+        Ambassador-/Istio-authn analog). It returns either the headers dict
+        to forward — with the trusted identity header set by the gateway,
+        never by the client — or a (status, body, extra_headers) short-
+        circuit response (redirect to login, 401)."""
+        self.apps = list(apps)
+        self.name = name
+        self.auth = auth
+
+    def _app_for(self, path: str) -> Optional[App]:
+        for app in self.apps:
+            for _, regex, _ in app._routes:
+                if regex.match(path):
+                    return app
+        return None
+
+    def handle(self, method, path, body=None, headers=None, query=None):
+        status, result, _ = self.handle_full(method, path, body, headers, query)
+        return status, result
+
+    def handle_full(self, method, path, body=None, headers=None, query=None):
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        if self.auth is not None:
+            verdict = self.auth(method, path, headers)
+            if isinstance(verdict, tuple):
+                return verdict
+            headers = verdict
+        app = self._app_for(path)
+        if app is None:
+            return 404, {"success": False, "log": f"no route for {path}"}, []
+        return app.handle_full(method, path, body, headers, query)
+
+    def __call__(self, environ, start_response):
+        # funnel WSGI through handle_full so the auth filter always runs
+        return _wsgi_adapter(self.handle_full, environ, start_response)
 
 
 class Server:

@@ -109,7 +109,56 @@ class Platform:
         )
         self.metrics_service = self.dashboard.metrics_service
         self.coordinator = Coordinator(self.store)
+
+        # L7: browser pages + single-gateway mux (the Istio-gateway analog —
+        # reference serves dashboard/spawner/login behind one host)
+        from kubeflow_tpu.api.gatekeeper import Gatekeeper
+        from kubeflow_tpu.api.wsgi import Mux
+        from kubeflow_tpu.ui import build_app as build_ui
+
+        self.ui = build_ui()
+        gateway_apps = [self.ui, self.dashboard, self.spawner, self.kfam]
+        self.gatekeeper = None
+        auth_filter = None
+        if self.platform_def.auth.username:
+            self.gatekeeper = Gatekeeper(
+                self.platform_def.auth.username,
+                self.platform_def.auth.password_hash,
+                user_header=hdr,
+            )
+            gateway_apps.append(self.gatekeeper.app)
+            auth_filter = self._make_auth_filter(hdr)
+        self.gateway = Mux(gateway_apps, auth=auth_filter)
         self._sampler_stop = None
+
+    # paths reachable without a session: the login flow + its assets
+    _AUTH_EXEMPT = ("/kflogin", "/apikflogin", "/auth", "/logout", "/static/")
+
+    def _make_auth_filter(self, user_header: str):
+        """Gateway authn (the Ambassador auth-service placement): every
+        request is resolved against the gatekeeper session, the trusted
+        identity header is set BY the gateway (client-supplied values are
+        stripped), and anonymous requests bounce to the login page."""
+        gatekeeper = self.gatekeeper
+
+        def auth(method, path, headers):
+            headers = dict(headers)
+            headers.pop(user_header.lower(), None)  # never trust the client
+            user = gatekeeper.authenticate(headers)
+            if user is not None:
+                headers[user_header.lower()] = user
+                return headers
+            if path in self._AUTH_EXEMPT[:4] or path.startswith(
+                self._AUTH_EXEMPT[4]
+            ):
+                return headers
+            return (
+                302,
+                {"success": False, "log": "login required"},
+                [("Location", "/kflogin")],
+            )
+
+        return auth
 
     # -- lifecycle --------------------------------------------------------
 

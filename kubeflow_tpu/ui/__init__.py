@@ -1,0 +1,3 @@
+"""Browser UI layer (L7) — dashboard, spawner, login, deploy pages."""
+
+from kubeflow_tpu.ui.app import build_app  # noqa: F401

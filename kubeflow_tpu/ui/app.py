@@ -1,0 +1,79 @@
+"""The L7 UI server: static pages over the platform BFFs.
+
+The reference ships four browser frontends — central dashboard (Polymer,
+reference: components/centraldashboard/public/components/main-page.js),
+notebook spawner (Angular, jupyter-web-app/frontend/src/app/resource-form),
+login (React, kflogin/src/login.js) and click-to-deploy (React,
+gcp-click-to-deploy/src/DeployForm.tsx). This rebuild keeps capability
+parity in framework-free HTML/JS served by the same stdlib router as the
+BFFs: every page drives the existing REST APIs (api/dashboard.py,
+api/spawner.py, api/kfam.py, api/gatekeeper.py, deploy/server.py).
+
+Routes mirror the reference gateway layout: `/` dashboard, `/kflogin`
+login, `/jupyter/` spawner, `/jobs/` job watch, `/deploy/` click-to-deploy,
+`/static/<asset>` shared css/js.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from kubeflow_tpu.api.wsgi import App, NotFoundError, Response
+
+STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
+
+_CONTENT_TYPES = {
+    ".html": "text/html; charset=utf-8",
+    ".css": "text/css; charset=utf-8",
+    ".js": "application/javascript; charset=utf-8",
+    ".svg": "image/svg+xml",
+    ".png": "image/png",
+}
+
+_PAGES = {
+    "/": "index.html",
+    "/kflogin": "login.html",
+    "/jupyter/": "spawner.html",
+    "/jobs/": "jobs.html",
+    "/deploy/": "deploy.html",
+}
+
+
+def _read_static(filename: str) -> bytes:
+    # filename comes from the route table or a single <asset> path segment
+    # (no "/" can appear in it), so traversal cannot escape STATIC_DIR;
+    # normalize + verify anyway.
+    path = os.path.normpath(os.path.join(STATIC_DIR, filename))
+    if not path.startswith(STATIC_DIR + os.sep):
+        raise NotFoundError(filename)
+    if not os.path.isfile(path):
+        raise NotFoundError(f"no static asset {filename!r}")
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _content_type(filename: str) -> str:
+    return _CONTENT_TYPES.get(
+        os.path.splitext(filename)[1], "application/octet-stream"
+    )
+
+
+def build_app(name: str = "ui") -> App:
+    app = App(name)
+
+    def page_handler(filename: str):
+        def handler(req):
+            return Response(_read_static(filename), _content_type(filename))
+
+        return handler
+
+    for route, filename in _PAGES.items():
+        app.get(route)(page_handler(filename))
+
+    @app.get("/static/<asset>")
+    def static_asset(req):
+        asset = req.params["asset"]
+        return Response(_read_static(asset), _content_type(asset))
+
+    return app

@@ -1,0 +1,148 @@
+/* Shared client for the platform UI.
+ *
+ * Identity: in a production mesh the gateway's auth filter injects the
+ * trusted identity header after /auth (reference: gatekeeper AuthServer.go,
+ * attach_user_middleware.ts). When the UI talks to the BFFs directly (dev /
+ * single-host mode) the logged-in identity from /kflogin is replayed on
+ * every request in the same header the mesh would set.
+ */
+
+const KFT = {
+  userKey: "kft-user",
+
+  user() {
+    return window.localStorage.getItem(this.userKey) || "";
+  },
+
+  setUser(email) {
+    window.localStorage.setItem(this.userKey, email);
+  },
+
+  logout() {
+    window.localStorage.removeItem(this.userKey);
+    fetch("/logout", { method: "POST" }).finally(() => {
+      window.location.href = "/kflogin";
+    });
+  },
+
+  async api(method, path, body) {
+    const headers = { "Content-Type": "application/json" };
+    const user = this.user();
+    if (user) headers["x-auth-user-email"] = user;
+    const resp = await fetch(path, {
+      method: method,
+      headers: headers,
+      body: body === undefined ? undefined : JSON.stringify(body),
+    });
+    const data = await resp.json().catch(() => ({}));
+    if (!resp.ok) {
+      const msg = data.log || resp.status + " " + resp.statusText;
+      if (resp.status === 401 || resp.status === 403) {
+        if (!user) {
+          window.location.href = "/kflogin";
+          return Promise.reject(new Error(msg));
+        }
+      }
+      throw new Error(msg);
+    }
+    return data;
+  },
+
+  get(path) { return this.api("GET", path); },
+  post(path, body) { return this.api("POST", path, body || {}); },
+  del(path) { return this.api("DELETE", path); },
+
+  // topbar helpers ----------------------------------------------------
+
+  requireLogin() {
+    if (!this.user()) window.location.href = "/kflogin";
+  },
+
+  namespaceKey: "kft-namespace",
+
+  namespace() {
+    return window.localStorage.getItem(this.namespaceKey) || "";
+  },
+
+  setNamespace(ns) {
+    window.localStorage.setItem(this.namespaceKey, ns);
+  },
+
+  /* Fill the topbar: user chip + namespace selector from env-info.
+   * Returns the selected namespace ("" when the user has none yet). */
+  async initTopbar(onNamespace) {
+    this.requireLogin();
+    const userEl = document.getElementById("kf-user");
+    if (userEl) userEl.textContent = this.user();
+    const env = await this.get("/api/workgroup/env-info");
+    const sel = document.getElementById("kf-namespace");
+    const namespaces = env.namespaces.map((n) => n.namespace);
+    let current = this.namespace();
+    if (!namespaces.includes(current)) current = namespaces[0] || "";
+    if (sel) {
+      sel.innerHTML = "";
+      namespaces.forEach((ns) => {
+        const opt = document.createElement("option");
+        opt.value = ns;
+        opt.textContent = ns;
+        if (ns === current) opt.selected = true;
+        sel.appendChild(opt);
+      });
+      sel.onchange = () => {
+        this.setNamespace(sel.value);
+        if (onNamespace) onNamespace(sel.value);
+      };
+    }
+    if (current) this.setNamespace(current);
+    return current;
+  },
+
+  msg(id, text, ok) {
+    const el = document.getElementById(id);
+    if (!el) return;
+    el.textContent = text;
+    el.className = "kf-msg " + (ok ? "ok" : "err");
+  },
+
+  statusCell(status) {
+    return '<span class="status ' + status + '">' + status + "</span>";
+  },
+
+  /* Minimal time-series chart as inline SVG (resource-chart.js analog). */
+  renderChart(svgId, points) {
+    const svg = document.getElementById(svgId);
+    if (!svg) return;
+    svg.innerHTML = "";
+    if (!points || points.length < 2) {
+      const t = document.createElementNS("http://www.w3.org/2000/svg", "text");
+      t.setAttribute("x", "8");
+      t.setAttribute("y", "20");
+      t.textContent = "no samples yet";
+      svg.appendChild(t);
+      return;
+    }
+    const w = 520, h = 120, pad = 24;
+    svg.setAttribute("viewBox", "0 0 " + w + " " + h);
+    const ts = points.map((p) => p.t);
+    const vs = points.map((p) => p.value);
+    const t0 = Math.min.apply(null, ts), t1 = Math.max.apply(null, ts);
+    const v0 = Math.min.apply(null, vs), v1 = Math.max.apply(null, vs);
+    const sx = (t) => pad + ((t - t0) / Math.max(t1 - t0, 1e-9)) * (w - 2 * pad);
+    const sy = (v) => h - pad - ((v - v0) / Math.max(v1 - v0, 1e-9)) * (h - 2 * pad);
+    const axis = document.createElementNS("http://www.w3.org/2000/svg", "line");
+    axis.setAttribute("x1", pad); axis.setAttribute("y1", h - pad);
+    axis.setAttribute("x2", w - pad); axis.setAttribute("y2", h - pad);
+    svg.appendChild(axis);
+    const line = document.createElementNS("http://www.w3.org/2000/svg", "polyline");
+    line.setAttribute(
+      "points",
+      points.map((p) => sx(p.t) + "," + sy(p.value)).join(" ")
+    );
+    svg.appendChild(line);
+    const label = document.createElementNS("http://www.w3.org/2000/svg", "text");
+    label.setAttribute("x", "4");
+    label.setAttribute("y", "12");
+    label.textContent = v1.toFixed(1);
+    svg.appendChild(label);
+  },
+};

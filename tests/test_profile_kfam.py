@@ -240,7 +240,7 @@ class TestGatekeeper:
     def test_unauthenticated_redirects_to_login(self):
         gk = Gatekeeper("admin", hash_password("pw"))
         status, _, headers = gk.app.handle_full("GET", "/auth")
-        assert status == 301
+        assert status == 302
         assert dict(headers)["Location"] == "/kflogin"
 
     def test_bad_credentials_401(self):
@@ -258,7 +258,7 @@ class TestGatekeeper:
         token = dict(headers)["Set-Cookie"].split(";")[0]
         gk.app.handle("POST", "/logout", headers={"cookie": token})
         status, _, _ = gk.app.handle_full("GET", "/auth", headers={"cookie": token})
-        assert status == 301
+        assert status == 302
 
 
 class TestReviewRegressions:
@@ -337,4 +337,4 @@ class TestReviewRegressions:
         status, _, _ = gk.app.handle_full(
             "GET", "/auth", headers={"authorization": f"Basic {bad}"}
         )
-        assert status == 301
+        assert status == 302
