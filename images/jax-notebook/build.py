@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Build the jax-notebook image matrix locally.
+
+The reference releases its notebook matrix through Argo workflows
+(components/image-releaser/components/tf-notebook-workflow.jsonnet); this is
+the local-builder equivalent: read versions/versions.json, emit one
+`docker build` per row, tag aliases last. `--dry-run` prints the commands
+(used by tests and CI linting); `--tag <t>` builds a single row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def load_matrix(path: str | None = None) -> dict:
+    with open(path or os.path.join(HERE, "versions", "versions.json")) as f:
+        matrix = json.load(f)
+    tags = [v["tag"] for v in matrix["versions"]]
+    if len(tags) != len(set(tags)):
+        raise ValueError("duplicate tags in versions.json")
+    for alias, target in matrix.get("aliases", {}).items():
+        if target not in tags:
+            raise ValueError(f"alias {alias!r} points at unknown tag {target!r}")
+    return matrix
+
+
+def build_commands(matrix: dict, only_tag: str | None = None) -> list:
+    repo = f"{matrix['registry']}/{matrix['name']}"
+    cmds = []
+    for row in matrix["versions"]:
+        if only_tag and row["tag"] != only_tag:
+            continue
+        args = [
+            "docker", "build", HERE,
+            "-t", f"{repo}:{row['tag']}",
+            "--build-arg", f"BASE_IMAGE={row['base_image']}",
+            "--build-arg", f"JAX_VERSION={row['jax_version']}",
+            "--build-arg", f"JAX_EXTRA={row['flavor']}",
+        ]
+        if row.get("extra_pip"):
+            args += ["--build-arg", f"EXTRA_PIP={row['extra_pip']}"]
+        cmds.append(args)
+    for alias, target in matrix.get("aliases", {}).items():
+        if only_tag and target != only_tag:
+            continue
+        cmds.append(["docker", "tag", f"{repo}:{target}", f"{repo}:{alias}"])
+    return cmds
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument("--tag", default=None, help="build one matrix row")
+    args = ap.parse_args(argv)
+    for cmd in build_commands(load_matrix(), only_tag=args.tag):
+        print(" ".join(cmd))
+        if not args.dry_run:
+            subprocess.run(cmd, check=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

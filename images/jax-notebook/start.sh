@@ -1,0 +1,28 @@
+#!/bin/bash
+# Notebook entrypoint — honors the controller's routing contract.
+#
+# The notebook controller injects NB_PREFIX=/notebook/<ns>/<name> into the
+# pod (reference: notebook_controller.go:325 generateStatefulSet), and the
+# gateway rewrites that path prefix to the pod. Jupyter must serve under
+# the same base URL or every redirect escapes the route (reference:
+# components/tensorflow-notebook-image/start.sh).
+set -e
+
+NB_PREFIX="${NB_PREFIX:-/}"
+NB_PORT="${NB_PORT:-8888}"
+
+# TPU-VM niceties: surface the slice topology to the kernel environment so
+# jax.device_count() diagnostics are meaningful in user notebooks.
+if [ -n "${TPU_WORKER_HOSTNAMES:-}" ]; then
+  echo "TPU slice: ${TPU_WORKER_HOSTNAMES} (worker ${TPU_WORKER_ID:-0})"
+fi
+
+exec jupyter lab \
+  --ip=0.0.0.0 \
+  --port="${NB_PORT}" \
+  --no-browser \
+  --ServerApp.base_url="${NB_PREFIX}" \
+  --ServerApp.token='' \
+  --ServerApp.password='' \
+  --ServerApp.allow_origin='*' \
+  "$@"

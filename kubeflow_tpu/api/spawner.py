@@ -75,6 +75,13 @@ def build_app(
     @app.get("/api/config")
     def get_config(req):
         cfg = to_dict(defaults)
+        # the curated image matrix (images/jax-notebook/versions) extends the
+        # admin-config list, deduped, aliases first
+        from kubeflow_tpu.images import notebook_images
+
+        cfg["images"] = list(
+            dict.fromkeys(cfg.get("images", []) + notebook_images())
+        )
         cfg["tpu_topologies"] = [""] + sorted(
             TPU_TOPOLOGIES, key=lambda t: (t.split("-")[0], TPU_TOPOLOGIES[t]["chips"])
         )

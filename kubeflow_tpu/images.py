@@ -1,0 +1,51 @@
+"""Notebook image matrix loader — the curated-version list the spawner offers.
+
+The reference curates 30 notebook image versions as version-config.json
+files consumed by its release workflows (reference: components/
+tensorflow-notebook-image/versions/, image-releaser). Here the matrix lives
+at images/jax-notebook/versions/versions.json; this loader turns it into
+the image list the spawner form presents (api/spawner.py /api/config), with
+aliases (latest, latest-cpu) listed first.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+ENV_MATRIX_PATH = "KFT_IMAGE_MATRIX"
+
+_REPO_RELATIVE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "images", "jax-notebook", "versions", "versions.json",
+)
+
+
+def image_matrix_path() -> Optional[str]:
+    """The matrix file: env override, else the in-repo location."""
+    override = os.environ.get(ENV_MATRIX_PATH)
+    if override:
+        return override if os.path.isfile(override) else None
+    return _REPO_RELATIVE if os.path.isfile(_REPO_RELATIVE) else None
+
+
+def notebook_images(path: Optional[str] = None) -> List[str]:
+    """Full image refs from the matrix, aliases first; [] if no matrix."""
+    path = path or image_matrix_path()
+    if not path:
+        return []
+    try:
+        with open(path) as f:
+            matrix = json.load(f)
+        repo = f"{matrix['registry']}/{matrix['name']}"
+        aliases = [f"{repo}:{a}" for a in matrix.get("aliases", {})]
+        tags = [f"{repo}:{v['tag']}" for v in matrix.get("versions", [])]
+        return aliases + tags
+    except (OSError, ValueError, KeyError) as e:
+        log.warning("unreadable image matrix %s: %s", path, e)
+        return []

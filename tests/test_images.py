@@ -1,0 +1,104 @@
+"""Notebook image matrix: versions file, build commands, spawner offering.
+
+VERDICT round-1 item 8 (reference: components/tensorflow-notebook-image/
+Dockerfile + versions matrix + start.sh honoring NB_PREFIX).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+
+import pytest
+
+from kubeflow_tpu.images import notebook_images, ENV_MATRIX_PATH
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+IMAGE_DIR = os.path.join(REPO, "images", "jax-notebook")
+
+
+def load_builder():
+    spec = importlib.util.spec_from_file_location(
+        "nb_build", os.path.join(IMAGE_DIR, "build.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestMatrix:
+    def test_matrix_valid_and_covers_flavors(self):
+        builder = load_builder()
+        matrix = builder.load_matrix()
+        flavors = {v["flavor"] for v in matrix["versions"]}
+        assert flavors == {"tpu", "cpu"}
+        assert "latest" in matrix["aliases"]
+
+    def test_build_commands_pin_args(self):
+        builder = load_builder()
+        matrix = builder.load_matrix()
+        cmds = builder.build_commands(matrix)
+        builds = [c for c in cmds if c[1] == "build"]
+        assert len(builds) == len(matrix["versions"])
+        joined = " ".join(builds[0])
+        assert "BASE_IMAGE=" in joined and "JAX_EXTRA=" in joined
+        tags = [c for c in cmds if c[1] == "tag"]
+        assert len(tags) == len(matrix["aliases"])
+        # aliases resolve after their target builds
+        assert cmds.index(tags[0]) > cmds.index(builds[-1])
+
+    def test_single_tag_filter(self):
+        builder = load_builder()
+        matrix = builder.load_matrix()
+        target = matrix["aliases"]["latest"]
+        cmds = builder.build_commands(matrix, only_tag=target)
+        assert any(f":{target}" in " ".join(c) for c in cmds)
+        assert all(c[1] != "build" or f":{target}" in " ".join(c) for c in cmds)
+
+    def test_alias_to_unknown_tag_rejected(self, tmp_path):
+        builder = load_builder()
+        bad = {
+            "registry": "r", "name": "n",
+            "versions": [{"tag": "a", "base_image": "b", "jax_version": "", "flavor": "tpu"}],
+            "aliases": {"latest": "nope"},
+        }
+        p = tmp_path / "versions.json"
+        p.write_text(json.dumps(bad))
+        with pytest.raises(ValueError, match="unknown tag"):
+            builder.load_matrix(str(p))
+
+
+class TestStartScript:
+    def test_start_sh_honors_nb_prefix(self):
+        with open(os.path.join(IMAGE_DIR, "start.sh")) as f:
+            script = f.read()
+        assert "NB_PREFIX" in script
+        assert "base_url" in script
+        # must be valid shell
+        subprocess.run(
+            ["bash", "-n", os.path.join(IMAGE_DIR, "start.sh")], check=True
+        )
+
+    def test_dockerfile_copies_start_script(self):
+        with open(os.path.join(IMAGE_DIR, "Dockerfile")) as f:
+            df = f.read()
+        assert "COPY start.sh" in df
+        assert "ARG BASE_IMAGE" in df and "ARG JAX_VERSION" in df
+
+
+class TestSpawnerOffersMatrix:
+    def test_config_lists_matrix_images(self):
+        from kubeflow_tpu.api.spawner import build_app
+        from kubeflow_tpu.cluster.store import StateStore
+
+        app = build_app(StateStore())
+        status, body = app.handle("GET", "/api/config")
+        assert status == 200
+        images = body["config"]["images"]
+        assert "kubeflow-tpu/jax-notebook:latest" in images
+        assert any(":jax" in i and i.endswith("-tpu") for i in images), images
+        assert len(images) == len(set(images))
+
+    def test_loader_absent_matrix_is_empty(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(ENV_MATRIX_PATH, str(tmp_path / "missing.json"))
+        assert notebook_images() == []
