@@ -126,6 +126,11 @@ class _Workqueue:
                     return None
                 self._lock.wait(timeout=min(waits) if waits else None)
 
+    def next_deadline(self) -> Optional[float]:
+        """Monotonic time of the earliest delayed item, or None."""
+        with self._lock:
+            return self._delayed[0][0] if self._delayed else None
+
     def done(self, key: ReconcileKey) -> None:
         with self._lock:
             self._in_flight.discard(key)
@@ -249,10 +254,9 @@ class ControllerManager:
                 # nothing ready; are delayed items pending soon?
                 soonest = None
                 for _, q in self._controllers:
-                    with q._lock:
-                        if q._delayed:
-                            at = q._delayed[0][0]
-                            soonest = at if soonest is None else min(soonest, at)
+                    at = q.next_deadline()
+                    if at is not None:
+                        soonest = at if soonest is None else min(soonest, at)
                 if soonest is not None and soonest - time.monotonic() < 0.25:
                     time.sleep(max(0.0, soonest - time.monotonic()))
                     continue

@@ -1,0 +1,139 @@
+"""kfctl-equivalent CLI client.
+
+The reference ships a Go CLI that drives the bootstrap REST API — load a
+KfDef, POST it to the router, poll status until the deployment lands
+(reference: bootstrap/cmd/kfctlClient/main.go). This is the same client
+against the TPU platform's deploy router (deploy/server.py), plus a
+`--local` mode that runs the two-phase Coordinator apply in process (the
+kfctl-binary-on-a-laptop path, no server needed).
+
+  python -m kubeflow_tpu.deploy.cli apply  -f platform.yaml [--server URL | --local]
+  python -m kubeflow_tpu.deploy.cli status --name kubeflow-tpu --server URL
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict
+
+from kubeflow_tpu.config.platform import PlatformDef, load_platformdef
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+TERMINAL_STATES = ("Succeeded", "Failed")
+
+
+def _request(
+    method: str, url: str, body: Dict[str, Any] = None, timeout: float = 30.0
+) -> Dict[str, Any]:
+    req = urllib.request.Request(
+        url,
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            detail = json.loads(e.read()).get("log", "")
+        except Exception:
+            detail = ""
+        raise RuntimeError(f"{method} {url}: HTTP {e.code} {detail}")
+
+
+def apply_remote(
+    platform: PlatformDef,
+    server: str,
+    poll_interval_s: float = 2.0,
+    timeout_s: float = 900.0,
+) -> Dict[str, Any]:
+    """POST the PlatformDef and poll until a terminal state (the
+    kfctlClient CreateDeployment + GetLatestKfDef loop)."""
+    from kubeflow_tpu.config.core import to_dict
+
+    base = server.rstrip("/")
+    out = _request(
+        "POST",
+        f"{base}/kfctl/apps/v1beta1/create",
+        {"name": platform.name, "spec": to_dict(platform)},
+    )
+    log.info("deployment %s: %s", out.get("name"), out.get("state"))
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        st = _request(
+            "GET", f"{base}/kfctl/apps/v1beta1/status?name={platform.name}"
+        )
+        state = st.get("state", "")
+        log.info("deployment %s: %s", platform.name, state)
+        if state in TERMINAL_STATES:
+            return st
+        time.sleep(poll_interval_s)
+    raise TimeoutError(
+        f"deployment {platform.name} not terminal after {timeout_s}s"
+    )
+
+
+def apply_local(platform: PlatformDef) -> Dict[str, Any]:
+    """Two-phase apply in process (platform then k8s, with retries)."""
+    from kubeflow_tpu.cluster.store import StateStore
+    from kubeflow_tpu.deploy.coordinator import Coordinator
+
+    coordinator = Coordinator(StateStore())
+    return coordinator.apply(platform)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kft-deploy", description="kubeflow-tpu deployment client"
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    ap_apply = sub.add_parser("apply", help="create/update a deployment")
+    ap_apply.add_argument("-f", "--file", required=True, help="PlatformDef yaml")
+    ap_apply.add_argument("--server", default="", help="deploy router URL")
+    ap_apply.add_argument(
+        "--local", action="store_true", help="apply in process (no server)"
+    )
+    ap_apply.add_argument("--timeout", type=float, default=900.0)
+
+    ap_status = sub.add_parser("status", help="deployment status")
+    ap_status.add_argument("--name", required=True)
+    ap_status.add_argument("--server", required=True)
+
+    args = ap.parse_args(argv)
+    try:
+        if args.cmd == "apply":
+            platform = load_platformdef(args.file)
+            platform.validate()
+            if args.local or not args.server:
+                result = apply_local(platform)
+            else:
+                result = apply_remote(
+                    platform, args.server, timeout_s=args.timeout
+                )
+            print(json.dumps(result))
+            return 0 if result.get("state", "Succeeded") != "Failed" else 1
+        if args.cmd == "status":
+            st = _request(
+                "GET",
+                f"{args.server.rstrip('/')}/kfctl/apps/v1beta1/status"
+                f"?name={args.name}",
+            )
+            print(json.dumps(st))
+            return 0
+    except (RuntimeError, TimeoutError, OSError, ValueError) as e:
+        print(json.dumps({"success": False, "log": str(e)}))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

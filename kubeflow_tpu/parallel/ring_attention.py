@@ -81,11 +81,18 @@ def ring_attention_inner(
         return (o_new, m_new, l_new, k_nxt, v_nxt, mask_nxt), None
 
     b, qs, h, d = q.shape
-    # pvary: mark the fresh accumulators as device-varying over the ring axis
-    # so the scan carry type matches the ppermute-produced K/V blocks.
-    o0 = jax.lax.pvary(jnp.zeros((b, qs, h, d), jnp.float32), (axis_name,))
-    m0 = jax.lax.pvary(jnp.full((b, h, qs), -jnp.inf, jnp.float32), (axis_name,))
-    l0 = jax.lax.pvary(jnp.zeros((b, h, qs), jnp.float32), (axis_name,))
+    # mark the fresh accumulators as device-varying over the ring axis
+    # so the scan carry type matches the ppermute-produced K/V blocks
+    # (pcast supersedes the deprecated jax.lax.pvary).
+    def _varying(x):
+        pcast = getattr(jax.lax, "pcast", None)
+        if pcast is not None:
+            return pcast(x, (axis_name,), to="varying")
+        return jax.lax.pvary(x, (axis_name,))  # pre-pcast jax
+
+    o0 = _varying(jnp.zeros((b, qs, h, d), jnp.float32))
+    m0 = _varying(jnp.full((b, h, qs), -jnp.inf, jnp.float32))
+    l0 = _varying(jnp.zeros((b, h, qs), jnp.float32))
 
     carry = (o0, m0, l0, k, v, mask)
     # The ring has a fixed, static length — unroll via scan for one traced body.

@@ -1,0 +1,101 @@
+"""kfctl-equivalent CLI client tests (reference: bootstrap/cmd/kfctlClient).
+
+Local mode runs the Coordinator in process; remote mode drives a real
+Router over a socket — POST create, poll status to terminal.
+"""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.deploy.cli import apply_remote, main
+
+
+@pytest.fixture()
+def platform_yaml(tmp_path):
+    p = tmp_path / "platform.yaml"
+    p.write_text("name: cli-test\nkind: PlatformDef\n")
+    return str(p)
+
+
+class TestLocalApply:
+    def test_apply_local_succeeds(self, platform_yaml, capsys):
+        rc = main(["apply", "-f", platform_yaml, "--local"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["name"] == "cli-test"
+        assert out["objects_applied"] > 0
+
+    def test_invalid_spec_fails(self, tmp_path, capsys):
+        p = tmp_path / "bad.yaml"
+        p.write_text("name: x\nkind: NotAPlatform\n")
+        rc = main(["apply", "-f", str(p), "--local"])
+        assert rc == 1
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["success"] is False and "PlatformDef" in out["log"]
+
+
+class TestRemoteApply:
+    @pytest.fixture()
+    def router_url(self):
+        from kubeflow_tpu.api.wsgi import Server
+        from kubeflow_tpu.deploy.server import Router
+
+        router = Router()
+        server = Server(router.app, port=0)
+        server.start()
+        yield f"http://127.0.0.1:{server.port}"
+        server.stop()
+        router.shutdown()
+
+    def test_apply_and_status_roundtrip(self, platform_yaml, router_url, capsys):
+        rc = main([
+            "apply", "-f", platform_yaml, "--server", router_url,
+            "--timeout", "60",
+        ])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["state"] == "Succeeded"
+
+        rc = main(["status", "--name", "cli-test", "--server", router_url])
+        assert rc == 0
+        st = json.loads(capsys.readouterr().out.strip())
+        assert st["state"] == "Succeeded"
+
+    def test_unknown_deployment_status_errors(self, router_url, capsys):
+        rc = main(["status", "--name", "nope", "--server", router_url])
+        assert rc == 1
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["success"] is False
+
+    def test_connection_refused_is_clean_failure(self, platform_yaml, capsys):
+        rc = main([
+            "apply", "-f", platform_yaml,
+            "--server", "http://127.0.0.1:9",  # discard port: refused
+        ])
+        assert rc == 1
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["success"] is False
+
+
+class TestPollLoop:
+    def test_apply_remote_polls_to_terminal(self, monkeypatch):
+        from kubeflow_tpu.config.platform import PlatformDef
+        import kubeflow_tpu.deploy.cli as cli
+
+        states = iter(["Queued", "Deploying", "Succeeded"])
+        calls = []
+
+        def fake_request(method, url, body=None, timeout=30.0):
+            calls.append((method, url))
+            if method == "POST":
+                return {"name": "x", "state": "Queued"}
+            return {"name": "x", "state": next(states)}
+
+        monkeypatch.setattr(cli, "_request", fake_request)
+        st = apply_remote(
+            PlatformDef(name="x"), "http://example", poll_interval_s=0.0
+        )
+        assert st["state"] == "Succeeded"
+        assert calls[0][0] == "POST"
+        assert len([c for c in calls if c[0] == "GET"]) == 3
