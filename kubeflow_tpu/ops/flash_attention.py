@@ -355,7 +355,7 @@ def flash_attention(
     v: jax.Array,
     mask: Optional[jax.Array] = None,
     causal: bool = False,
-    block_q: int = 512,
+    block_q: int = 1024,
     block_k: int = 1024,
     scale: Optional[float] = None,
 ) -> jax.Array:
