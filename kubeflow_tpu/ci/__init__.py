@@ -1,0 +1,4 @@
+"""CI machinery: junit artifacts, workflow DAGs, trigger config."""
+
+from kubeflow_tpu.ci.junit import JunitSuite  # noqa: F401
+from kubeflow_tpu.ci.workflow import Step, Workflow  # noqa: F401

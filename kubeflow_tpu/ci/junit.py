@@ -1,0 +1,86 @@
+"""JUnit XML artifact emission.
+
+The reference's CI copies junit XML to GCS for testgrid after every workflow
+step, success or failure (reference: testing/workflows/components/
+unit_tests.jsonnet:162-186 exit handler; helpers from the external
+kubeflow/testing repo's test_util). This is the in-tree equivalent: a tiny
+writer the workflow runner calls per step, plus an aggregator the exit
+handler uses. Output parses with stdlib ElementTree and matches the testgrid
+schema subset (testsuite/testcase/failure/time).
+"""
+
+from __future__ import annotations
+
+import time
+import xml.sax.saxutils as saxutils
+from typing import List, Optional
+
+
+class JunitCase:
+    def __init__(
+        self,
+        name: str,
+        time_s: float = 0.0,
+        failure: Optional[str] = None,
+        classname: str = "",
+    ):
+        self.name = name
+        self.time_s = time_s
+        self.failure = failure
+        self.classname = classname
+
+    def to_xml(self) -> str:
+        attrs = (
+            f'name={saxutils.quoteattr(self.name)} '
+            f'classname={saxutils.quoteattr(self.classname)} '
+            f'time="{self.time_s:.3f}"'
+        )
+        if self.failure is None:
+            return f"  <testcase {attrs}/>"
+        msg = saxutils.escape(self.failure)
+        return (
+            f"  <testcase {attrs}>\n"
+            f'    <failure message="step failed">{msg}</failure>\n'
+            f"  </testcase>"
+        )
+
+
+class JunitSuite:
+    """One testsuite = one workflow (steps are cases)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.cases: List[JunitCase] = []
+        self._start = time.monotonic()
+
+    def add(
+        self,
+        name: str,
+        time_s: float,
+        failure: Optional[str] = None,
+        classname: str = "",
+    ) -> None:
+        self.cases.append(JunitCase(name, time_s, failure, classname))
+
+    @property
+    def failures(self) -> int:
+        return sum(1 for c in self.cases if c.failure is not None)
+
+    def to_xml(self) -> str:
+        total = time.monotonic() - self._start
+        body = "\n".join(c.to_xml() for c in self.cases)
+        return (
+            '<?xml version="1.0" encoding="utf-8"?>\n'
+            f'<testsuite name={saxutils.quoteattr(self.name)} '
+            f'tests="{len(self.cases)}" failures="{self.failures}" '
+            f'time="{total:.3f}">\n'
+            f"{body}\n"
+            "</testsuite>\n"
+        )
+
+    def write(self, path: str) -> None:
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_xml())

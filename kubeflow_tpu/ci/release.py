@@ -1,0 +1,125 @@
+"""Release automation — versioned manifest bundles + image pinning.
+
+The reference's release machinery is Argo workflows that build component
+images and jsonnet/kustomize helpers that pin image tags into manifests
+(reference: releasing/releaser/components/workflows.libsonnet,
+components/image-releaser/, py/kubeflow/kubeflow/ci/application_util.py:12
+set_kustomize_image). Rebuild, TPU-platform-shaped:
+
+- `set_image` / `pin_images`: rewrite container image refs across rendered
+  manifest objects (the kustomize-edit-set-image analog),
+- `cut_release`: render the default platform manifests, pin every in-house
+  image to the release version, and write the release bundle — one
+  manifests yaml + the image list a builder pushes (images/jax-notebook's
+  builder consumes the same registry naming).
+
+  python -m kubeflow_tpu.ci.release --version v0.2.0 --out dist/
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+IN_HOUSE_PREFIX = "kubeflow-tpu/"
+
+
+def _containers(obj: Dict[str, Any]):
+    spec = obj.get("spec", {})
+    pod = spec.get("template", {}).get("spec", {}) or spec.get("podSpec", {})
+    return pod.get("containers", [])
+
+
+def set_image(
+    objs: List[Dict[str, Any]], name: str, new_ref: str
+) -> int:
+    """Point every container whose image repo is `name` at `new_ref`
+    (set_kustomize_image analog). Returns the number of edits."""
+    edits = 0
+    for obj in objs:
+        for c in _containers(obj):
+            repo = c.get("image", "").rsplit(":", 1)[0]
+            if repo == name:
+                c["image"] = new_ref
+                edits += 1
+    return edits
+
+
+def pin_images(objs: List[Dict[str, Any]], version: str) -> List[str]:
+    """Pin every in-house image to `version`; returns the pinned refs
+    (the image list the release builder must push)."""
+    pinned: List[str] = []
+    for obj in objs:
+        for c in _containers(obj):
+            image = c.get("image", "")
+            if image.startswith(IN_HOUSE_PREFIX):
+                repo = image.rsplit(":", 1)[0]
+                c["image"] = f"{repo}:{version}"
+                if c["image"] not in pinned:
+                    pinned.append(c["image"])
+    return sorted(pinned)
+
+
+def cut_release(
+    version: str, out_dir: str, platform=None
+) -> Dict[str, Any]:
+    """Write the release bundle: pinned manifests + image list.
+
+    Returns {manifests_path, images_path, images, objects}."""
+    import yaml
+
+    from kubeflow_tpu.config.platform import PlatformDef
+    from kubeflow_tpu.deploy import manifests
+
+    if not version.startswith("v"):
+        raise ValueError(f"version must look like v1.2.3, got {version!r}")
+    platform = platform or PlatformDef()
+    objs = manifests.render(platform)
+    images = pin_images(objs, version)
+    os.makedirs(out_dir, exist_ok=True)
+    manifests_path = os.path.join(out_dir, f"kubeflow-tpu-{version}.yaml")
+    with open(manifests_path, "w") as f:
+        yaml.safe_dump_all(objs, f, sort_keys=False)
+    images_path = os.path.join(out_dir, f"images-{version}.txt")
+    with open(images_path, "w") as f:
+        f.write("\n".join(images) + "\n")
+    log.info(
+        "release %s: %d objects, %d images → %s",
+        version,
+        len(objs),
+        len(images),
+        out_dir,
+    )
+    return {
+        "manifests_path": manifests_path,
+        "images_path": images_path,
+        "images": images,
+        "objects": len(objs),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(prog="kft-release")
+    ap.add_argument("--version", required=True, help="release tag, e.g. v0.2.0")
+    ap.add_argument("--out", default="dist")
+    args = ap.parse_args(argv)
+    try:
+        out = cut_release(args.version, args.out)
+    except ValueError as e:
+        print(json.dumps({"success": False, "log": str(e)}))
+        return 1
+    print(json.dumps({"success": True, **out}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,261 @@
+"""Workflow DAGs — the Argo-workflow-equivalent CI runner.
+
+The reference's CI is Argo DAGs of buildTemplate containers sharing one
+volume, driven by Prow, with junit artifacts always exported by an exit
+handler (reference: testing/workflows/components/unit_tests.jsonnet:46-83
+buildTemplate, :162-186 exitHandler). Rebuild: a typed Step/Workflow DAG
+executed with process-level parallelism — dependency-ordered, per-step
+timeout and logs, junit artifact per workflow written success OR failure.
+
+Trigger config (the prow_config.yaml role) lives in ci/config.yaml at the
+repo root: each entry maps a workflow to `include_dirs` filters; `
+should_run(changed_files)` reproduces the reference's run-only-what-changed
+behavior (reference: prow_config.yaml:1-26).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import os
+import subprocess
+import time
+from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
+from typing import Dict, List, Optional, Sequence
+
+from kubeflow_tpu.ci.junit import JunitSuite
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class Step:
+    """One DAG node: a command with dependencies (buildTemplate analog)."""
+
+    name: str
+    command: Sequence[str]
+    deps: Sequence[str] = ()
+    timeout_s: float = 1800.0  # the reference's per-step budget
+    env: Optional[Dict[str, str]] = None
+
+
+@dataclasses.dataclass
+class StepResult:
+    name: str
+    ok: bool
+    time_s: float
+    log_path: str
+    detail: str = ""
+
+
+class Workflow:
+    """Dependency-ordered step execution with always-written artifacts."""
+
+    def __init__(
+        self,
+        name: str,
+        steps: Sequence[Step],
+        artifacts_dir: str = "artifacts",
+        parallelism: int = 2,
+    ):
+        self.name = name
+        self.steps = {s.name: s for s in steps}
+        if len(self.steps) != len(steps):
+            raise ValueError("duplicate step names")
+        for s in steps:
+            for d in s.deps:
+                if d not in self.steps:
+                    raise ValueError(f"step {s.name!r} depends on unknown {d!r}")
+        self._assert_acyclic()
+        self.artifacts_dir = artifacts_dir
+        self.parallelism = parallelism
+
+    def _assert_acyclic(self) -> None:
+        seen: Dict[str, int] = {}  # 1 = visiting, 2 = done
+
+        def visit(name: str) -> None:
+            state = seen.get(name)
+            if state == 1:
+                raise ValueError(f"dependency cycle through {name!r}")
+            if state == 2:
+                return
+            seen[name] = 1
+            for d in self.steps[name].deps:
+                visit(d)
+            seen[name] = 2
+
+        for name in self.steps:
+            visit(name)
+
+    # -- execution --------------------------------------------------------
+
+    def _run_step(self, step: Step) -> StepResult:
+        os.makedirs(os.path.join(self.artifacts_dir, "logs"), exist_ok=True)
+        log_path = os.path.join(self.artifacts_dir, "logs", f"{step.name}.log")
+        env = dict(os.environ)
+        env.update(step.env or {})
+        t0 = time.monotonic()
+        detail = ""
+        try:
+            with open(log_path, "w") as logf:
+                proc = subprocess.run(
+                    list(step.command),
+                    stdout=logf,
+                    stderr=subprocess.STDOUT,
+                    timeout=step.timeout_s,
+                    env=env,
+                )
+            ok = proc.returncode == 0
+            if not ok:
+                detail = f"exit code {proc.returncode}"
+        except subprocess.TimeoutExpired:
+            ok = False
+            detail = f"timeout after {step.timeout_s}s"
+        except OSError as e:
+            ok = False
+            detail = str(e)
+        return StepResult(
+            step.name, ok, time.monotonic() - t0, log_path, detail
+        )
+
+    def run(self) -> Dict[str, StepResult]:
+        """Execute the DAG; a failed step skips its dependents (recorded as
+        failures) but independent branches keep running. The junit artifact
+        is written unconditionally (the exit-handler contract)."""
+        suite = JunitSuite(self.name)
+        results: Dict[str, StepResult] = {}
+        try:
+            pending = dict(self.steps)
+            running: Dict[Future, str] = {}
+            with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
+                while pending or running:
+                    for name, step in list(pending.items()):
+                        deps = [results.get(d) for d in step.deps]
+                        if any(d is None for d in deps):
+                            continue  # a dep hasn't finished yet
+                        del pending[name]
+                        failed = [
+                            d.name for d in deps if d is not None and not d.ok
+                        ]
+                        if failed:
+                            results[name] = StepResult(
+                                name,
+                                False,
+                                0.0,
+                                "",
+                                f"skipped: dependency {failed[0]} failed",
+                            )
+                            continue
+                        running[pool.submit(self._run_step, step)] = name
+                    if not running:
+                        continue
+                    done, _ = wait(running, return_when=FIRST_COMPLETED)
+                    for fut in done:
+                        res = fut.result()
+                        results[res.name] = res
+                        del running[fut]
+                        log.info(
+                            "step %s/%s: %s (%.1fs)",
+                            self.name,
+                            res.name,
+                            "ok" if res.ok else f"FAILED ({res.detail})",
+                            res.time_s,
+                        )
+        finally:
+            for name in self.steps:
+                res = results.get(name)
+                if res is None:
+                    suite.add(name, 0.0, failure="never ran", classname=self.name)
+                else:
+                    suite.add(
+                        name,
+                        res.time_s,
+                        failure=None if res.ok else res.detail,
+                        classname=self.name,
+                    )
+            suite.write(
+                os.path.join(self.artifacts_dir, f"junit_{self.name}.xml")
+            )
+        return results
+
+    def succeeded(self, results: Dict[str, StepResult]) -> bool:
+        return all(r.ok for r in results.values())
+
+
+# -- trigger config (the prow_config.yaml role) ---------------------------
+
+
+def should_run(
+    include_dirs: Sequence[str], changed_files: Sequence[str]
+) -> bool:
+    """Run a workflow iff any changed file falls under its include_dirs
+    (glob patterns; empty include_dirs = always run)."""
+    if not include_dirs:
+        return True
+    for f in changed_files:
+        for pattern in include_dirs:
+            if fnmatch.fnmatch(f, pattern) or fnmatch.fnmatch(
+                f, pattern.rstrip("/") + "/*"
+            ) or f.startswith(pattern.rstrip("/*") + "/"):
+                return True
+    return False
+
+
+def load_workflows(config_path: str) -> List[Dict]:
+    """Parse ci/config.yaml: [{name, include_dirs, steps: [{name, command,
+    deps, timeout_s}]}]."""
+    import yaml
+
+    with open(config_path) as f:
+        cfg = yaml.safe_load(f)
+    return cfg.get("workflows", [])
+
+
+def build_workflow(
+    entry: Dict, artifacts_dir: str = "artifacts", parallelism: int = 2
+) -> Workflow:
+    steps = [
+        Step(
+            name=s["name"],
+            command=s["command"],
+            deps=tuple(s.get("deps", ())),
+            timeout_s=float(s.get("timeout_s", 1800.0)),
+            env=s.get("env"),
+        )
+        for s in entry.get("steps", [])
+    ]
+    return Workflow(
+        entry["name"], steps, artifacts_dir=artifacts_dir, parallelism=parallelism
+    )
+
+
+def main(argv=None) -> int:
+    """CLI: `python -m kubeflow_tpu.ci.workflow --config ci/config.yaml
+    --workflow unit-tests [--changed-files f1,f2] [--artifacts DIR]`."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="kft-ci")
+    ap.add_argument("--config", default="ci/config.yaml")
+    ap.add_argument("--workflow", required=True)
+    ap.add_argument("--changed-files", default="")
+    ap.add_argument("--artifacts", default="artifacts")
+    args = ap.parse_args(argv)
+    entries = {e["name"]: e for e in load_workflows(args.config)}
+    if args.workflow not in entries:
+        log.error("unknown workflow %r; known: %s", args.workflow, sorted(entries))
+        return 2
+    entry = entries[args.workflow]
+    changed = [f for f in args.changed_files.split(",") if f]
+    if changed and not should_run(entry.get("include_dirs", []), changed):
+        log.info("workflow %s skipped: no changed files match", args.workflow)
+        return 0
+    wf = build_workflow(entry, artifacts_dir=args.artifacts)
+    results = wf.run()
+    return 0 if wf.succeeded(results) else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

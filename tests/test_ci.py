@@ -1,0 +1,227 @@
+"""CI machinery: junit emission, workflow DAG execution, trigger filters.
+
+Reference behavior contract: Argo DAG of steps with junit artifacts written
+by an exit handler success-or-failure (unit_tests.jsonnet:162-186), Prow
+include_dirs triggering (prow_config.yaml:1-26).
+"""
+
+import os
+import sys
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from kubeflow_tpu.ci.junit import JunitSuite
+from kubeflow_tpu.ci.workflow import (
+    Step,
+    Workflow,
+    build_workflow,
+    load_workflows,
+    should_run,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+class TestJunit:
+    def test_xml_roundtrip(self, tmp_path):
+        suite = JunitSuite("wf")
+        suite.add("a", 1.5)
+        suite.add("b", 0.2, failure="exit code 1 <&>")
+        path = str(tmp_path / "junit_wf.xml")
+        suite.write(path)
+        root = ET.parse(path).getroot()
+        assert root.tag == "testsuite"
+        assert root.get("tests") == "2" and root.get("failures") == "1"
+        cases = root.findall("testcase")
+        assert cases[0].get("name") == "a"
+        fail = cases[1].find("failure")
+        assert "exit code 1 <&>" in fail.text
+
+
+class TestWorkflowDag:
+    def test_dependency_order_and_success(self, tmp_path):
+        order_file = tmp_path / "order"
+        wf = Workflow(
+            "wf",
+            [
+                Step("first", ["sh", "-c", f"echo first >> {order_file}"]),
+                Step(
+                    "second",
+                    ["sh", "-c", f"echo second >> {order_file}"],
+                    deps=["first"],
+                ),
+            ],
+            artifacts_dir=str(tmp_path / "artifacts"),
+        )
+        results = wf.run()
+        assert wf.succeeded(results)
+        assert order_file.read_text().splitlines() == ["first", "second"]
+        root = ET.parse(
+            str(tmp_path / "artifacts" / "junit_wf.xml")
+        ).getroot()
+        assert root.get("failures") == "0"
+
+    def test_failure_skips_dependents_not_siblings(self, tmp_path):
+        marker = tmp_path / "sibling-ran"
+        wf = Workflow(
+            "wf",
+            [
+                Step("bad", ["false"]),
+                Step("child", ["true"], deps=["bad"]),
+                Step("sibling", ["sh", "-c", f"touch {marker}"]),
+            ],
+            artifacts_dir=str(tmp_path / "artifacts"),
+        )
+        results = wf.run()
+        assert not wf.succeeded(results)
+        assert not results["bad"].ok
+        assert not results["child"].ok
+        assert "skipped" in results["child"].detail
+        assert results["sibling"].ok and marker.exists()
+        # exit-handler contract: junit written despite failure
+        root = ET.parse(str(tmp_path / "artifacts" / "junit_wf.xml")).getroot()
+        assert root.get("failures") == "2"
+
+    def test_step_logs_captured(self, tmp_path):
+        wf = Workflow(
+            "wf",
+            [Step("echo", ["sh", "-c", "echo hello-artifact"])],
+            artifacts_dir=str(tmp_path / "artifacts"),
+        )
+        results = wf.run()
+        assert "hello-artifact" in open(results["echo"].log_path).read()
+
+    def test_timeout_is_failure(self, tmp_path):
+        wf = Workflow(
+            "wf",
+            [Step("slow", ["sleep", "30"], timeout_s=0.3)],
+            artifacts_dir=str(tmp_path / "artifacts"),
+        )
+        results = wf.run()
+        assert not results["slow"].ok
+        assert "timeout" in results["slow"].detail
+
+    def test_cycle_rejected(self):
+        with pytest.raises(ValueError, match="cycle"):
+            Workflow(
+                "wf",
+                [Step("a", ["true"], deps=["b"]), Step("b", ["true"], deps=["a"])],
+            )
+
+    def test_unknown_dep_rejected(self):
+        with pytest.raises(ValueError, match="unknown"):
+            Workflow("wf", [Step("a", ["true"], deps=["ghost"])])
+
+
+class TestTriggerConfig:
+    def test_should_run_include_dirs(self):
+        assert should_run(["kubeflow_tpu"], ["kubeflow_tpu/models/bert.py"])
+        assert should_run(["tests"], ["tests/test_ci.py"])
+        assert not should_run(["images"], ["kubeflow_tpu/models/bert.py"])
+        assert should_run([], ["anything"])  # empty = always
+
+    def test_repo_config_parses_and_builds(self):
+        entries = load_workflows(os.path.join(REPO, "ci", "config.yaml"))
+        names = {e["name"] for e in entries}
+        assert {"unit-tests", "e2e", "images"} <= names
+        for e in entries:
+            wf = build_workflow(e)  # validates DAG + step shapes
+            assert wf.steps
+
+    def test_config_step_files_exist(self):
+        """Every pytest path in ci/config.yaml must exist (no drift)."""
+        for e in load_workflows(os.path.join(REPO, "ci", "config.yaml")):
+            for s in e["steps"]:
+                for arg in s["command"]:
+                    if str(arg).startswith("tests/") or str(arg).endswith(".py"):
+                        assert os.path.exists(os.path.join(REPO, str(arg))), arg
+
+
+class TestRunnerCli:
+    def test_images_workflow_end_to_end(self, tmp_path):
+        """The images workflow actually runs (dry-run lint, fast)."""
+        from kubeflow_tpu.ci.workflow import main
+
+        rc = main([
+            "--config", os.path.join(REPO, "ci", "config.yaml"),
+            "--workflow", "images",
+            "--artifacts", str(tmp_path / "artifacts"),
+        ])
+        assert rc == 0
+        assert (tmp_path / "artifacts" / "junit_images.xml").exists()
+
+    def test_skip_when_no_changed_files_match(self, tmp_path):
+        from kubeflow_tpu.ci.workflow import main
+
+        rc = main([
+            "--config", os.path.join(REPO, "ci", "config.yaml"),
+            "--workflow", "images",
+            "--changed-files", "kubeflow_tpu/models/bert.py",
+            "--artifacts", str(tmp_path / "artifacts"),
+        ])
+        assert rc == 0
+        assert not (tmp_path / "artifacts").exists()  # nothing ran
+
+    def test_unknown_workflow_errors(self):
+        from kubeflow_tpu.ci.workflow import main
+
+        assert main([
+            "--config", os.path.join(REPO, "ci", "config.yaml"),
+            "--workflow", "nope",
+        ]) == 2
+
+
+class TestRelease:
+    """Release bundle: image pinning + manifest emission (reference:
+    ci/application_util.py set_kustomize_image, image-releaser)."""
+
+    def test_set_image(self):
+        from kubeflow_tpu.ci.release import set_image
+        from kubeflow_tpu.config.platform import PlatformDef
+        from kubeflow_tpu.deploy import manifests
+
+        objs = manifests.render(PlatformDef())
+        n = set_image(
+            objs, "kubeflow-tpu/central-dashboard",
+            "kubeflow-tpu/central-dashboard:v9",
+        )
+        assert n == 1
+        images = [
+            c["image"]
+            for o in objs
+            for c in o.get("spec", {}).get("template", {}).get("spec", {}).get(
+                "containers", []
+            )
+        ]
+        assert "kubeflow-tpu/central-dashboard:v9" in images
+
+    def test_cut_release_bundle(self, tmp_path):
+        import yaml
+
+        from kubeflow_tpu.ci.release import cut_release
+
+        out = cut_release("v0.2.0", str(tmp_path))
+        assert out["objects"] > 10
+        assert all(i.endswith(":v0.2.0") for i in out["images"])
+        docs = list(
+            yaml.safe_load_all(open(out["manifests_path"]))
+        )
+        assert len(docs) == out["objects"]
+        listed = open(out["images_path"]).read().splitlines()
+        assert listed == out["images"]
+        # no in-house :latest survives pinning
+        for d in docs:
+            for c in (
+                d.get("spec", {}).get("template", {}).get("spec", {}).get(
+                    "containers", []
+                )
+            ):
+                if c["image"].startswith("kubeflow-tpu/"):
+                    assert c["image"].endswith(":v0.2.0"), c["image"]
+
+    def test_bad_version_rejected(self, tmp_path):
+        from kubeflow_tpu.ci.release import main
+
+        assert main(["--version", "0.2.0", "--out", str(tmp_path)]) == 1
