@@ -1,0 +1,104 @@
+"""Auxiliary servers: auth echo, https redirect, static config.
+
+Small deployment helpers the reference ships as standalone images:
+
+- echo-server (reference: components/echo-server/main.py:27-40): returns
+  the decoded identity/JWT claims the proxy attached — the IAP-debugging
+  aid. Here: decodes the JWT payload from `x-goog-iap-jwt-assertion` (or
+  Authorization Bearer) WITHOUT signature verification — it is a debugging
+  mirror, never an authenticator — plus the trusted identity header.
+- https-redirect (reference: components/https-redirect/main.py:30-40):
+  301 every request to the https:// equivalent.
+- static-config-server (reference: components/static-config-server/
+  main.go:16-40): serves one file (the IAP JWK public key) at a fixed path.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Dict, Optional
+
+from kubeflow_tpu.api.wsgi import App, NotFoundError, Response
+
+
+def _decode_jwt_claims(token: str) -> Optional[Dict[str, Any]]:
+    """Decode (NOT verify) a JWT's payload segment for echo/debugging."""
+    parts = token.split(".")
+    if len(parts) != 3:
+        return None
+    payload = parts[1]
+    payload += "=" * (-len(payload) % 4)
+    try:
+        return json.loads(base64.urlsafe_b64decode(payload))
+    except Exception:
+        return None
+
+
+def build_echo_app(user_header: str = "x-auth-user-email") -> App:
+    app = App("echo-server", user_header=user_header)
+
+    @app.get("/")
+    def echo(req):
+        token = req.headers.get("x-goog-iap-jwt-assertion", "")
+        if not token:
+            auth = req.headers.get("authorization", "")
+            if auth.lower().startswith("bearer "):
+                token = auth[7:]
+        return {
+            "user": req.user,
+            "jwt_claims": _decode_jwt_claims(token) if token else None,
+            "headers_seen": sorted(
+                k for k in req.headers if k.startswith(("x-goog-", "x-auth-"))
+            ),
+        }
+
+    @app.get("/healthz")
+    def healthz(req):
+        return {"ok": True}
+
+    return app
+
+
+def build_https_redirect_app() -> App:
+    app = App("https-redirect")
+
+    def _redirect(req, path: str):
+        from urllib.parse import urlencode
+
+        host = req.headers.get("host", "localhost")
+        qs = urlencode(req.query)
+        location = f"https://{host}/{path}" + (f"?{qs}" if qs else "")
+        req.response_headers.append(("Location", location))
+        return {"success": False, "log": "use https"}, 301
+
+    @app.get("/<path:path>")
+    def redirect(req):
+        return _redirect(req, req.params["path"])
+
+    @app.get("/")
+    def redirect_root(req):
+        return _redirect(req, "")
+
+    return app
+
+
+def build_static_config_app(file_path: str, serve_path: str = "/jwks") -> App:
+    """Serve one config file at a fixed path (JWK public key server)."""
+    app = App("static-config-server")
+
+    @app.get(serve_path)
+    def serve(req):
+        try:
+            with open(file_path, "rb") as f:
+                content = f.read()
+        except OSError:
+            raise NotFoundError(f"config file missing: {file_path}")
+        content_type = (
+            "application/json"
+            if file_path.endswith((".json", ".jwk", ".jwks"))
+            else "text/plain; charset=utf-8"
+        )
+        return Response(content, content_type)
+
+    return app

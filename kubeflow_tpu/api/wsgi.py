@@ -155,8 +155,16 @@ class App:
         )
 
     def route(self, method: str, pattern: str):
+        # <name> matches one path segment; <name:path> matches the rest
+        # (including slashes) — the catch-all for redirect/proxy handlers
         regex = re.compile(
-            "^" + re.sub(r"<([a-zA-Z_]+)>", r"(?P<\1>[^/]+)", pattern) + "$"
+            "^"
+            + re.sub(
+                r"<([a-zA-Z_]+):path>",
+                r"(?P<\1>.+)",
+                re.sub(r"<([a-zA-Z_]+)>", r"(?P<\1>[^/]+)", pattern),
+            )
+            + "$"
         )
 
         def deco(fn: Handler):

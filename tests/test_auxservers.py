@@ -1,0 +1,105 @@
+"""Aux servers: auth echo, https redirect, static config file server."""
+
+import base64
+import json
+
+from kubeflow_tpu.api.auxservers import (
+    build_echo_app,
+    build_https_redirect_app,
+    build_static_config_app,
+)
+
+
+def fake_jwt(claims):
+    seg = lambda d: base64.urlsafe_b64encode(  # noqa: E731
+        json.dumps(d).encode()
+    ).rstrip(b"=").decode()
+    return f"{seg({'alg': 'none'})}.{seg(claims)}.sig"
+
+
+class TestEchoServer:
+    def test_echoes_identity_and_claims(self):
+        app = build_echo_app()
+        token = fake_jwt({"email": "alice@example.com", "aud": "iap"})
+        status, body = app.handle(
+            "GET",
+            "/",
+            headers={
+                "x-auth-user-email": "alice@example.com",
+                "x-goog-iap-jwt-assertion": token,
+            },
+        )
+        assert status == 200
+        assert body["user"] == "alice@example.com"
+        assert body["jwt_claims"]["email"] == "alice@example.com"
+        assert "x-goog-iap-jwt-assertion" in body["headers_seen"]
+
+    def test_bearer_fallback_and_garbage_token(self):
+        app = build_echo_app()
+        status, body = app.handle(
+            "GET", "/", headers={"authorization": "Bearer not.a.jwt"}
+        )
+        assert status == 200 and body["jwt_claims"] is None
+        status, body = app.handle("GET", "/healthz")
+        assert status == 200 and body["ok"]
+
+
+class TestHttpsRedirect:
+    def test_redirects_preserving_path_and_query(self):
+        app = build_https_redirect_app()
+        status, _, headers = app.handle_full(
+            "GET",
+            "/dashboard",
+            headers={"host": "kf.example.com"},
+            query={"ns": "alice"},
+        )
+        assert status == 301
+        assert dict(headers)["Location"] == "https://kf.example.com/dashboard?ns=alice"
+
+    def test_root_redirect(self):
+        app = build_https_redirect_app()
+        status, _, headers = app.handle_full(
+            "GET", "/", headers={"host": "kf.example.com"}
+        )
+        assert status == 301
+        assert dict(headers)["Location"] == "https://kf.example.com/"
+
+
+class TestStaticConfigServer:
+    def test_serves_jwk_file(self, tmp_path):
+        jwk = tmp_path / "keys.json"
+        jwk.write_text('{"keys": []}')
+        app = build_static_config_app(str(jwk))
+        status, body = app.handle("GET", "/jwks")
+        assert status == 200
+        assert body.content_type == "application/json"
+        assert json.loads(body.body) == {"keys": []}
+
+    def test_missing_file_404(self, tmp_path):
+        app = build_static_config_app(str(tmp_path / "nope.json"))
+        status, body = app.handle("GET", "/jwks")
+        assert status == 404
+
+
+class TestHttpsRedirectEdgeCases:
+    def test_multi_segment_path(self):
+        app = build_https_redirect_app()
+        status, _, headers = app.handle_full(
+            "GET", "/pipeline/apis/list", headers={"host": "kf.example.com"}
+        )
+        assert status == 301
+        assert (
+            dict(headers)["Location"]
+            == "https://kf.example.com/pipeline/apis/list"
+        )
+
+    def test_query_values_url_encoded(self):
+        app = build_https_redirect_app()
+        status, _, headers = app.handle_full(
+            "GET",
+            "/search",
+            headers={"host": "h"},
+            query={"q": "a b&c"},
+        )
+        assert status == 301
+        assert dict(headers)["Location"] == "https://h/search?q=a+b%26c"
