@@ -100,17 +100,74 @@ class WorkloadIdentityPlugin:
         if sa is None:
             return
         ann = sa["metadata"].setdefault("annotations", {})
-        if ann.get("iam.gke.io/gcp-service-account") == gcp_sa:
+        prev = ann.get("iam.gke.io/gcp-service-account")
+        if prev == gcp_sa:
             return  # already applied; reconciles are level-triggered
+        # cloud call FIRST: annotating before a failed bind would satisfy
+        # the level-trigger gate on retry and never bind. A changed SA also
+        # unbinds the previous one (stale grants must not outlive the spec).
+        if prev:
+            self.iam.unbind_workload_identity(prev, ns, "default-editor")
+        self.iam.bind_workload_identity(gcp_sa, ns, "default-editor")
         ann["iam.gke.io/gcp-service-account"] = gcp_sa
         store.update(sa)
-        self.iam.bind_workload_identity(gcp_sa, ns, "default-editor")
 
     def revoke(self, store: StateStore, profile: Dict[str, Any], spec: Dict[str, Any]):
         gcp_sa = spec.get("gcpServiceAccount", "")
         if gcp_sa:
             self.iam.unbind_workload_identity(
                 gcp_sa, profile["metadata"]["name"], "default-editor"
+            )
+
+
+class AwsIamClient(Protocol):
+    """The IAM surface the AWS plugin needs (role trust-policy editing,
+    reference: plugin_iam.go's aws-sdk-go calls)."""
+
+    def add_trust_entry(self, role_arn: str, namespace: str, ksa: str) -> None: ...
+
+    def remove_trust_entry(self, role_arn: str, namespace: str, ksa: str) -> None: ...
+
+
+class AwsIamForServiceAccountPlugin:
+    """kind: AwsIamForServiceAccount — annotate default-editor with the IAM
+    role ARN and add the namespace's federated subject to the role's trust
+    policy (reference: profile-controller plugin_iam.go:21-48,66 — IRSA:
+    eks.amazonaws.com/role-arn annotation + AssumeRoleWithWebIdentity
+    trust entry)."""
+
+    kind = "AwsIamForServiceAccount"
+    ROLE_ANNOTATION = "eks.amazonaws.com/role-arn"
+
+    def __init__(self, iam: AwsIamClient):
+        self.iam = iam
+
+    def apply(self, store: StateStore, profile: Dict[str, Any], spec: Dict[str, Any]):
+        ns = profile["metadata"]["name"]
+        role_arn = spec.get("awsIamRole", "")
+        if not role_arn:
+            return
+        sa = store.try_get("ServiceAccount", "default-editor", ns)
+        if sa is None:
+            return
+        ann = sa["metadata"].setdefault("annotations", {})
+        prev = ann.get(self.ROLE_ANNOTATION)
+        if prev == role_arn:
+            return  # level-triggered: already applied
+        # cloud call FIRST (see WorkloadIdentityPlugin.apply); a changed
+        # role also drops the old trust entry — otherwise the previous
+        # role's policy grants this namespace access forever
+        if prev:
+            self.iam.remove_trust_entry(prev, ns, "default-editor")
+        self.iam.add_trust_entry(role_arn, ns, "default-editor")
+        ann[self.ROLE_ANNOTATION] = role_arn
+        store.update(sa)
+
+    def revoke(self, store: StateStore, profile: Dict[str, Any], spec: Dict[str, Any]):
+        role_arn = spec.get("awsIamRole", "")
+        if role_arn:
+            self.iam.remove_trust_entry(
+                role_arn, profile["metadata"]["name"], "default-editor"
             )
 
 

@@ -82,11 +82,17 @@ def apply_remote(
 
 
 def apply_local(platform: PlatformDef) -> Dict[str, Any]:
-    """Two-phase apply in process (platform then k8s, with retries)."""
+    """Two-phase apply in process (platform then k8s, with retries).
+
+    The provider comes from the PlatformDef: project+zone selects GKE —
+    which raises here, since the laptop path carries no cloud client; the
+    operator points --server at a deploy router instead (the reference's
+    click-to-deploy split)."""
     from kubeflow_tpu.cluster.store import StateStore
     from kubeflow_tpu.deploy.coordinator import Coordinator
+    from kubeflow_tpu.deploy.gke import provider_for
 
-    coordinator = Coordinator(StateStore())
+    coordinator = Coordinator(StateStore(), provider=provider_for(platform))
     return coordinator.apply(platform)
 
 

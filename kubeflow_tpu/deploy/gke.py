@@ -1,0 +1,197 @@
+"""GKE platform provider — the cloud side of the two-phase apply.
+
+The reference's PLATFORM phase drives GCP Deployment Manager to a GKE
+cluster, then builds a rest.Config from the Container API (reference:
+bootstrap/cmd/bootstrap/app/kfctlServer.go:221 Apply(PLATFORM),
+:595 BuildClusterConfig). The TPU-native delta: the node pools it provisions
+are TPU slice pools (`google.com/tpu` capacity + gke-tpu-topology
+placement), not GPU pools.
+
+The cloud API hides behind `ContainerApi` exactly as the reference injects
+fake coordinator builders for tests (kfctlServer.go:66-67): production
+wires a real client; tests and air-gapped runs wire `FakeContainerApi`.
+Everything is idempotent — the second-apply contract
+(testing/kfctl/kfctl_second_apply.py) holds: an existing, matching cluster
+or pool is left alone; drift (wrong topology) is an error, not a silent
+mutate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Protocol
+
+from kubeflow_tpu.config.platform import PlatformDef
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class ContainerApi(Protocol):
+    """The Container-API surface the provider needs (BuildClusterConfig's
+    `container.Service` analog)."""
+
+    def get_cluster(self, project: str, zone: str, name: str) -> Optional[Dict[str, Any]]: ...
+
+    def create_cluster(self, project: str, zone: str, spec: Dict[str, Any]) -> Dict[str, Any]: ...
+
+    def create_node_pool(self, project: str, zone: str, cluster: str, spec: Dict[str, Any]) -> Dict[str, Any]: ...
+
+    def delete_cluster(self, project: str, zone: str, name: str) -> None: ...
+
+
+class FakeContainerApi:
+    """In-memory Container API for tests/dry-runs (fake-client tier)."""
+
+    def __init__(self) -> None:
+        self.clusters: Dict[str, Dict[str, Any]] = {}
+        self.calls: List[str] = []
+
+    def _key(self, project: str, zone: str, name: str) -> str:
+        return f"{project}/{zone}/{name}"
+
+    def get_cluster(self, project, zone, name):
+        self.calls.append(f"get {name}")
+        return self.clusters.get(self._key(project, zone, name))
+
+    def create_cluster(self, project, zone, spec):
+        self.calls.append(f"create-cluster {spec['name']}")
+        cluster = {
+            **spec,
+            "status": "RUNNING",
+            "endpoint": f"10.0.0.{len(self.clusters) + 1}",
+            "nodePools": list(spec.get("nodePools", [])),
+        }
+        self.clusters[self._key(project, zone, spec["name"])] = cluster
+        return cluster
+
+    def create_node_pool(self, project, zone, cluster, spec):
+        self.calls.append(f"create-pool {spec['name']}")
+        c = self.clusters[self._key(project, zone, cluster)]
+        c["nodePools"].append(spec)
+        return spec
+
+    def delete_cluster(self, project, zone, name):
+        self.calls.append(f"delete-cluster {name}")
+        self.clusters.pop(self._key(project, zone, name), None)
+
+
+# TPU generation -> GKE machine type family (per-host VM shape)
+_MACHINE_TYPES = {
+    "v4": "ct4p-hightpu-4t",
+    "v5e": "ct5lp-hightpu-4t",
+    "v5p": "ct5p-hightpu-4t",
+}
+
+
+def tpu_node_pool_spec(platform: PlatformDef) -> Dict[str, Any]:
+    """The TPU slice node pool (replaces the reference's GPU pools):
+    one node per slice host, machine placement pinned by topology."""
+    s = platform.slice
+    gen = s.topology.split("-")[0]
+    return {
+        "name": f"tpu-{s.topology.replace('.', '-')}",
+        "initialNodeCount": s.total_hosts,
+        "config": {
+            "machineType": _MACHINE_TYPES.get(gen, f"ct-{gen}-hightpu"),
+            "labels": {"kubeflow-tpu/slice": s.topology},
+            "resourceLabels": {"kubeflow-tpu": "true"},
+        },
+        "placementPolicy": {
+            "tpuTopology": s.node_selectors()[
+                "cloud.google.com/gke-tpu-topology"
+            ],
+            "type": "COMPACT",
+        },
+        "spot": bool(s.spot),
+        "reservation": s.reserved or None,
+    }
+
+
+class GkeProvider:
+    """Apply(PLATFORM) against GKE: cluster + TPU slice node pool."""
+
+    name = "gke"
+
+    def __init__(self, api: ContainerApi):
+        self.api = api
+
+    def apply_platform(self, platform: PlatformDef) -> Dict[str, Any]:
+        if not platform.project or not platform.zone:
+            raise ValueError("gke provider requires project and zone")
+        platform.slice.validate()
+        cluster_name = platform.name
+        pool = tpu_node_pool_spec(platform)
+        existing = self.api.get_cluster(
+            platform.project, platform.zone, cluster_name
+        )
+        if existing is None:
+            cluster = self.api.create_cluster(
+                platform.project,
+                platform.zone,
+                {
+                    "name": cluster_name,
+                    "initialClusterVersion": "latest",
+                    "nodePools": [
+                        {"name": "default", "initialNodeCount": 2},
+                        pool,
+                    ],
+                },
+            )
+            log.info(
+                "created cluster %s (%s) with pool %s",
+                cluster_name,
+                cluster["endpoint"],
+                pool["name"],
+            )
+        else:
+            cluster = existing
+            pools = {p["name"]: p for p in cluster.get("nodePools", [])}
+            current = pools.get(pool["name"])
+            if current is None:
+                self.api.create_node_pool(
+                    platform.project, platform.zone, cluster_name, pool
+                )
+                log.info("added TPU pool %s to existing cluster", pool["name"])
+            elif (
+                current.get("placementPolicy", {}).get("tpuTopology")
+                != pool["placementPolicy"]["tpuTopology"]
+            ):
+                # drift is an error, not a silent mutate: re-shaping a TPU
+                # pool recreates physical slices — the operator must opt in
+                raise ValueError(
+                    f"node pool {pool['name']} exists with topology "
+                    f"{current.get('placementPolicy', {}).get('tpuTopology')!r}"
+                    f" != requested "
+                    f"{pool['placementPolicy']['tpuTopology']!r}"
+                )
+        return {
+            "provider": self.name,
+            "cluster": cluster_name,
+            "endpoint": cluster.get("endpoint", ""),
+            "topology": platform.slice.topology,
+            "chips": platform.slice.total_chips,
+            "node_pool": pool["name"],
+        }
+
+    def delete_platform(self, platform: PlatformDef) -> None:
+        self.api.delete_cluster(platform.project, platform.zone, platform.name)
+
+
+def provider_for(platform: PlatformDef, container_api=None):
+    """Pick the provider from the PlatformDef (the kfctl plugin-detect
+    analog, reference kf_is_ready_test.py:26-44): a project+zone selects
+    GKE; otherwise local. A GKE selection REQUIRES a real container_api —
+    defaulting to the in-memory fake would report clusters created while
+    provisioning nothing."""
+    from kubeflow_tpu.deploy.coordinator import LocalProvider
+
+    if platform.project and platform.zone:
+        if container_api is None:
+            raise ValueError(
+                f"PlatformDef {platform.name!r} selects the gke provider "
+                "(project+zone set) but no container API client was "
+                "supplied; pass container_api= (FakeContainerApi only for "
+                "tests/dry-runs)"
+            )
+        return GkeProvider(container_api)
+    return LocalProvider()

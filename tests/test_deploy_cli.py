@@ -99,3 +99,17 @@ class TestPollLoop:
         assert st["state"] == "Succeeded"
         assert calls[0][0] == "POST"
         assert len([c for c in calls if c[0] == "GET"]) == 3
+
+
+class TestLocalApplyProviderSelection:
+    def test_gke_platformdef_refuses_local_apply(self, tmp_path, capsys):
+        """--local with a GKE PlatformDef must fail loudly, not fake-deploy
+        (the laptop path has no cloud client; use --server)."""
+        p = tmp_path / "gke.yaml"
+        p.write_text(
+            "name: kf\nkind: PlatformDef\nproject: proj\nzone: us-central2-b\n"
+        )
+        rc = main(["apply", "-f", str(p), "--local"])
+        assert rc == 1
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["success"] is False and "container API" in out["log"]

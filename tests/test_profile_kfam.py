@@ -338,3 +338,53 @@ class TestReviewRegressions:
             "GET", "/auth", headers={"authorization": f"Basic {bad}"}
         )
         assert status == 302
+
+
+class TestAwsIamPlugin:
+    """Second cloud-IAM plugin proving the Plugin interface holds
+    (reference: profile-controller plugin_iam.go:21-48,66 — IRSA)."""
+
+    class FakeAwsIam:
+        def __init__(self):
+            self.trust = []
+
+        def add_trust_entry(self, role_arn, ns, ksa):
+            self.trust.append((role_arn, ns, ksa))
+
+        def remove_trust_entry(self, role_arn, ns, ksa):
+            self.trust.remove((role_arn, ns, ksa))
+
+    ROLE = "arn:aws:iam::123456789012:role/kf-team-c"
+
+    def _profile_with_plugin(self):
+        p = new_profile("team-c", ALICE)
+        p["spec"]["plugins"] = [
+            {"kind": "AwsIamForServiceAccount", "spec": {"awsIamRole": self.ROLE}}
+        ]
+        return p
+
+    def test_apply_annotates_sa_and_adds_trust(self):
+        from kubeflow_tpu.controllers.profile import AwsIamForServiceAccountPlugin
+
+        iam = self.FakeAwsIam()
+        store, cm = make_harness(plugins=[AwsIamForServiceAccountPlugin(iam)])
+        store.create(self._profile_with_plugin())
+        cm.run_until_idle(max_seconds=5)
+        assert iam.trust == [(self.ROLE, "team-c", "default-editor")]
+        sa = store.get("ServiceAccount", "default-editor", "team-c")
+        assert sa["metadata"]["annotations"]["eks.amazonaws.com/role-arn"] == self.ROLE
+        # level-triggered: a second reconcile must not re-bind
+        cm.enqueue_all()
+        cm.run_until_idle(max_seconds=5)
+        assert len(iam.trust) == 1
+
+    def test_deletion_revokes_trust(self):
+        from kubeflow_tpu.controllers.profile import AwsIamForServiceAccountPlugin
+
+        iam = self.FakeAwsIam()
+        store, cm = make_harness(plugins=[AwsIamForServiceAccountPlugin(iam)])
+        store.create(self._profile_with_plugin())
+        cm.run_until_idle(max_seconds=5)
+        store.delete("Profile", "team-c", "kubeflow")
+        cm.run_until_idle(max_seconds=5)
+        assert iam.trust == []
