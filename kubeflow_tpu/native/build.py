@@ -26,12 +26,14 @@ def have_toolchain() -> bool:
     return shutil.which("g++") is not None and shutil.which("make") is not None
 
 
-def ensure_built(component: str, binary: Optional[str] = None) -> str:
+def ensure_built(
+    component: str, binary: Optional[str] = None, source: Optional[str] = None
+) -> str:
     """Build native/<component> if its binary is missing/stale; return path."""
     binary = binary or component
     src_dir = os.path.join(REPO_ROOT, "native", component)
     out = os.path.join(BUILD_DIR, binary)
-    src = os.path.join(src_dir, f"{binary}.cc")
+    src = os.path.join(src_dir, source or f"{binary}.cc")
     if os.path.exists(out) and os.path.exists(src):
         if os.path.getmtime(out) >= os.path.getmtime(src):
             return out
@@ -54,3 +56,11 @@ def ensure_built(component: str, binary: Optional[str] = None) -> str:
 
 def slice_agent_path() -> str:
     return ensure_built("slice_agent")
+
+
+def shard_loader_lib_path() -> str:
+    return ensure_built(
+        "shard_loader",
+        binary="libshard_loader.so",
+        source="shard_loader.cc",
+    )

@@ -287,11 +287,20 @@ def load_npz(path: str, split: str = "train") -> Optional[Dict[str, np.ndarray]]
     files = _npz_files(path, split)
     if not files:
         return None
+    # shard reads go through the native prefetcher (native/shard_loader):
+    # disk/NFS IO overlaps the numpy decode of the previous shard, and
+    # shards arrive strictly in order (epoch determinism). Falls back to
+    # sequential Python reads without the toolchain.
+    import io
+
+    from kubeflow_tpu.native.shard_prefetch import ShardPrefetcher
+
     parts: Dict[str, List[np.ndarray]] = {}
-    for f in files:
-        with np.load(f) as z:
-            for k in z.files:
-                parts.setdefault(k, []).append(z[k])
+    with ShardPrefetcher(files) as shards:
+        for _path, blob in shards:
+            with np.load(io.BytesIO(blob)) as z:
+                for k in z.files:
+                    parts.setdefault(k, []).append(z[k])
     return {
         k: (v[0] if len(v) == 1 else np.concatenate(v, axis=0))
         for k, v in parts.items()

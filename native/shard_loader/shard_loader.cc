@@ -1,0 +1,229 @@
+// shard_loader — native prefetching data loader for training shards.
+//
+// The reference delegates its input pipeline to TF inside the training
+// container (SURVEY.md §3.3: the tf_cnn_benchmarks hot loop) and stages
+// data with a sidecar (reference: components/openmpi-controller/controller/
+// controller.py:104-116 S3 download-before lifecycle). The TPU-native
+// rebuild streams shard files instead: this library overlaps disk/NFS/FUSE
+// reads with the XLA step so the accelerator never waits on IO — the
+// data-loader member of the platform's native runtime (slice_agent is the
+// gang-lifecycle member).
+//
+// Design:
+// - a pool of reader threads claims shard indices in order and reads whole
+//   files into malloc'd buffers (shards are the unit the Python side
+//   decodes — npz/npy parsing stays in numpy),
+// - consumers receive shards STRICTLY IN INDEX ORDER regardless of read
+//   completion order — epoch determinism (seed + epoch → batch sequence)
+//   is load-bearing for gang restart/resume, so the loader must not
+//   reorder,
+// - `prefetch_depth` bounds resident buffers: readers stall when they get
+//   too far ahead of the consumer (bounded memory, imagenet-scale safe),
+// - C ABI for ctypes: sl_open / sl_next / sl_release / sl_close. No
+//   Python.h dependency; the binding copies each shard into Python bytes
+//   before release (the prefetch overlap is the win, not zero-copy).
+//
+// Build: make (shared library build/libshard_loader.so) — plus a `tsan`
+// target; the loader is the concurrency-heavy native component, and the
+// race-detection tier (SURVEY.md §5) exercises it under ThreadSanitizer.
+
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Buffer {
+  std::string path;
+  uint8_t* data = nullptr;
+  int64_t size = -1;  // -1 = read failed
+};
+
+struct Loader {
+  std::vector<std::string> paths;
+  int prefetch_depth = 4;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<int, Buffer> ready;   // index -> buffer, waiting to be emitted
+  int next_claim = 0;            // next index a reader thread takes
+  int next_emit = 0;             // next index sl_next hands out
+  bool closing = false;
+  std::vector<std::thread> readers;
+};
+
+// Read one whole file. Returns size or -1.
+int64_t read_file(const std::string& path, uint8_t** out) {
+  FILE* f = ::fopen(path.c_str(), "rb");
+  if (!f) return -1;
+  ::fseeko(f, 0, SEEK_END);
+  int64_t size = ::ftello(f);
+  if (size < 0) {
+    ::fclose(f);
+    return -1;
+  }
+  ::fseeko(f, 0, SEEK_SET);
+  uint8_t* buf = static_cast<uint8_t*>(::malloc(size ? size : 1));
+  if (!buf) {
+    ::fclose(f);
+    return -1;
+  }
+  int64_t got = (int64_t)::fread(buf, 1, size, f);
+  ::fclose(f);
+  if (got != size) {
+    ::free(buf);
+    return -1;
+  }
+  *out = buf;
+  return size;
+}
+
+void reader_loop(Loader* L) {
+  for (;;) {
+    int idx;
+    {
+      std::unique_lock<std::mutex> lock(L->mu);
+      // stall while the window [next_emit, next_emit+depth) is full
+      L->cv.wait(lock, [L] {
+        return L->closing ||
+               (L->next_claim < (int)L->paths.size() &&
+                L->next_claim < L->next_emit + L->prefetch_depth);
+      });
+      if (L->closing || L->next_claim >= (int)L->paths.size()) return;
+      idx = L->next_claim++;
+    }
+    Buffer b;
+    b.path = L->paths[idx];
+    b.size = read_file(b.path, &b.data);
+    {
+      std::lock_guard<std::mutex> lock(L->mu);
+      if (L->closing) {
+        ::free(b.data);
+        return;
+      }
+      L->ready.emplace(idx, b);
+    }
+    L->cv.notify_all();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// paths: array of n C strings. prefetch_depth >= 1, n_threads >= 1.
+void* sl_open(const char** paths, int n, int prefetch_depth, int n_threads) {
+  if (n < 0 || prefetch_depth < 1 || n_threads < 1) return nullptr;
+  Loader* L = new Loader();
+  for (int i = 0; i < n; i++) L->paths.emplace_back(paths[i]);
+  L->prefetch_depth = prefetch_depth;
+  int workers = n_threads < n ? n_threads : (n > 0 ? n : 1);
+  for (int i = 0; i < workers; i++) {
+    L->readers.emplace_back(reader_loop, L);
+  }
+  return L;
+}
+
+// Blocks until shard `next_emit` is resident; emits strictly in order.
+// Returns 1 and fills outputs; 0 at end of shard list; -1 on read error
+// (path still reported). The buffer stays valid until sl_release(handle,
+// index).
+int sl_next(void* handle, const char** path, const uint8_t** data,
+            int64_t* size, int* index) {
+  Loader* L = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lock(L->mu);
+  if (L->next_emit >= (int)L->paths.size()) return 0;
+  int idx = L->next_emit;
+  L->cv.wait(lock, [L, idx] {
+    return L->closing || L->ready.count(idx) > 0;
+  });
+  if (L->closing) return 0;
+  Buffer& b = L->ready[idx];
+  *path = L->paths[idx].c_str();
+  *data = b.data;
+  *size = b.size;
+  *index = idx;
+  L->next_emit++;
+  lock.unlock();
+  L->cv.notify_all();  // window advanced: readers may claim more
+  return b.size < 0 ? -1 : 1;
+}
+
+// Return shard `index`'s buffer to the loader (frees it).
+void sl_release(void* handle, int index) {
+  Loader* L = static_cast<Loader*>(handle);
+  std::lock_guard<std::mutex> lock(L->mu);
+  auto it = L->ready.find(index);
+  if (it != L->ready.end()) {
+    ::free(it->second.data);
+    L->ready.erase(it);
+  }
+}
+
+void sl_close(void* handle) {
+  Loader* L = static_cast<Loader*>(handle);
+  {
+    std::lock_guard<std::mutex> lock(L->mu);
+    L->closing = true;
+  }
+  L->cv.notify_all();
+  for (auto& t : L->readers) t.join();
+  for (auto& kv : L->ready) ::free(kv.second.data);
+  delete L;
+}
+
+}  // extern "C"
+
+#ifdef SHARD_LOADER_TSAN_MAIN
+// Standalone driver for the ThreadSanitizer tier (a TSan .so cannot be
+// dlopen'd into a non-TSan python — static TLS): stream every file given
+// on argv through a small window with many readers, twice.
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s shard files...\n", argv[0]);
+    return 2;
+  }
+  for (int round = 0; round < 2; round++) {
+    std::vector<const char*> paths;
+    for (int i = 1; i < argc; i++) paths.push_back(argv[i]);
+    void* h = sl_open(paths.data(), (int)paths.size(), 2, 4);
+    if (!h) return 2;
+    const char* p;
+    const uint8_t* d;
+    int64_t size;
+    int idx;
+    int n = 0;
+    int rc;
+    while ((rc = sl_next(h, &p, &d, &size, &idx)) != 0) {
+      if (rc < 0) {
+        sl_close(h);
+        return 3;
+      }
+      // touch the buffer so TSan sees the cross-thread read
+      volatile uint8_t sum = 0;
+      for (int64_t j = 0; j < size; j += 997) sum = (uint8_t)(sum + d[j]);
+      (void)sum;
+      sl_release(h, idx);
+      n++;
+    }
+    // early-exit path: claim a few then close with readers in flight
+    void* h2 = sl_open(paths.data(), (int)paths.size(), 2, 4);
+    if (h2) {
+      if (sl_next(h2, &p, &d, &size, &idx) == 1) sl_release(h2, idx);
+      sl_close(h2);
+    }
+    sl_close(h);
+    if (n != (int)paths.size()) return 4;
+  }
+  std::printf("tsan-run-ok\n");
+  return 0;
+}
+#endif
