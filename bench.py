@@ -247,6 +247,69 @@ def bench_long_context(seq_len: int = 32768) -> dict:
     }
 
 
+def bench_serving(batch: int = 8, requests: int = 30) -> dict:
+    """Serving smoke latency (BASELINE.md's serving config): ResNet-50
+    inference over a real socket against the model server — HTTP + JSON
+    decode, bucket padding, jitted apply, JSON encode — per-request wall
+    time as a client sees it (the reference's smoke test measures the same
+    path, testing/test_tf_serving.py:112-127)."""
+    import json as _json
+    import time
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.api.wsgi import Server
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.server import ModelServer, ServedModel
+
+    model = get_model("resnet50", dtype=jnp.bfloat16)
+    x0 = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x0, train=False)
+    served = ServedModel(
+        "resnet50",
+        lambda v, x: model.apply(v, x, train=False),
+        variables,
+    )
+    model_server = ModelServer()
+    model_server.add(served)
+    server = Server(model_server.app, port=0)
+    server.start()
+    try:
+        url = (
+            f"http://127.0.0.1:{server.port}/v1/models/resnet50:predict"
+        )
+        payload = _json.dumps(
+            {"instances": np.zeros((batch, 224, 224, 3), np.float32).tolist()}
+        ).encode()
+
+        def call():
+            req = urllib.request.Request(
+                url, data=payload, headers={"Content-Type": "application/json"}
+            )
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return _json.loads(resp.read())
+
+        out = call()  # warmup: compile + materialize
+        assert "predictions" in out, out
+        lat = []
+        for _ in range(requests):
+            t0 = time.monotonic()
+            call()
+            lat.append(time.monotonic() - t0)
+    finally:
+        server.stop()
+    lat.sort()
+    return {
+        "batch": batch,
+        "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+        "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 2),
+        "qps": round(requests / sum(lat), 1),
+    }
+
+
 def bench_studyjob_trials(n_trials: int = 4) -> dict:
     """Trials/hr through the real control plane (Katib-equivalent metric)."""
     import jax
@@ -329,7 +392,7 @@ def main() -> int:
 
     resnet = bench_resnet(batch, steps)
 
-    bert = trials = long_ctx = None
+    bert = trials = long_ctx = serving = None
     if suite == "all":
         try:
             bert = bench_bert(max(5, steps // 2))
@@ -339,6 +402,10 @@ def main() -> int:
             trials = bench_studyjob_trials()
         except Exception as e:  # noqa: BLE001
             trials = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            serving = bench_serving()
+        except Exception as e:  # noqa: BLE001
+            serving = {"error": f"{type(e).__name__}: {e}"}
         if jax.default_backend() == "tpu":
             # last: the compiled-kernel path only exists on TPU
             try:
@@ -358,6 +425,7 @@ def main() -> int:
                 "resnet50": resnet,
                 "bert_base_pretrain": bert,
                 "studyjob": trials,
+                "serving": serving,
                 "long_context_attention": long_ctx,
                 "device_kind": getattr(jax.devices()[0], "device_kind", "cpu"),
             }
