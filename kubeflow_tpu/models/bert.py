@@ -39,7 +39,9 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     dtype: Any = jnp.bfloat16
-    attention_impl: str = "dense"  # "dense" | "ring" | "flash"
+    # "dense" | "ring" (SP: KV rotation) | "ulysses" (SP: head all_to_all)
+    # | "flash" (pallas kernel) | "auto" (flash on TPU at long seq)
+    attention_impl: str = "dense"
     remat: bool = False
     # pipeline parallelism: >1 stacks the encoder into stages sharded over
     # the `pipeline` mesh axis and runs a GPipe microbatch schedule
@@ -54,15 +56,12 @@ class BertConfig:
     moe_aux_weight: float = 0.01
 
 
+from kubeflow_tpu.ops.attention import dense_attention as _dense_attention_core
+
+
 def _dense_attention(q, k, v, mask, dtype):
     """Plain attention; XLA fuses softmax into the MXU matmuls."""
-    depth = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(dtype)
-    if mask is not None:
-        big_neg = jnp.finfo(jnp.float32).min
-        scores = jnp.where(mask[:, None, None, :], scores, big_neg)
-    probs = nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return _dense_attention_core(q, k, v, mask=mask, dtype=dtype)
 
 
 class SelfAttention(nn.Module):
@@ -83,11 +82,26 @@ class SelfAttention(nn.Module):
         q = shard_constraint(q, ("batch", "seq", "act_heads", None))
         k = shard_constraint(k, ("batch", "seq", "act_heads", None))
         v = shard_constraint(v, ("batch", "seq", "act_heads", None))
-        if cfg.attention_impl == "ring":
+        impl = cfg.attention_impl
+        if impl == "auto":
+            # policy: the pallas flash kernel wins on memory (dense
+            # materializes O(S^2) scores and OOMs ~32k on one v5e chip)
+            # but XLA's fused dense wins raw step time at short lengths —
+            # measured crossover documented in bench.py bench_long_context
+            import jax
+
+            s_len = x.shape[1]
+            on_tpu = jax.default_backend() == "tpu"
+            impl = "flash" if (on_tpu and s_len >= 4096) else "dense"
+        if impl == "ring":
             from kubeflow_tpu.parallel.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, mask=mask, dtype=cfg.dtype)
-        elif cfg.attention_impl == "flash":
+        elif impl == "ulysses":
+            from kubeflow_tpu.parallel.ulysses import ulysses_attention
+
+            out = ulysses_attention(q, k, v, mask=mask, dtype=cfg.dtype)
+        elif impl == "flash":
             from kubeflow_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, mask=mask).astype(cfg.dtype)

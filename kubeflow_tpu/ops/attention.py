@@ -1,0 +1,35 @@
+"""Shared dense-attention core.
+
+One implementation of the einsum/scale/mask/float32-softmax sequence, used
+by the BERT model's "dense" path (models/bert.py) and wrapped between
+sharding constraints by Ulysses SP (parallel/ulysses.py) — the SP variants
+are layout changes, not math changes, so the math lives in exactly one
+place.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Plain attention over [B, S, H, D]; XLA fuses softmax into the MXU
+    matmuls. `mask` is a [B, S] key-padding mask (True = attend)."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(
+        dtype
+    )
+    if mask is not None:
+        big_neg = jnp.finfo(jnp.float32).min
+        scores = jnp.where(mask[:, None, None, :], scores, big_neg)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,85 @@
+"""Ulysses-style sequence parallelism — head-scatter all_to_all attention.
+
+The second member of the SP menu (SURVEY.md §5 long-context: "optional
+Ulysses-style head-scatter all-to-all for intra-host"), complementing ring
+attention (parallel/ring_attention.py):
+
+- ring: KV blocks rotate around ICI neighbors; attention stays blockwise
+  local. Best across chips with fast neighbor links and very long
+  sequences (memory never holds the full KV).
+- Ulysses: one all_to_all converts sequence-sharding into HEAD-sharding,
+  each device runs *dense* attention over the full sequence for its head
+  subset, and a second all_to_all restores sequence-sharding. Two
+  collectives total per attention — cheaper than a ring pass when the
+  head count divides the mesh axis and the full-sequence scores fit
+  per-device memory (intra-host / moderate lengths).
+
+Pure GSPMD: the all_to_alls are *implied* by moving the `sequence` mesh
+axis from the seq dim to the heads dim with sharding constraints — XLA
+partitions head-sharded dense attention with no communication inside the
+attention itself. No manual collectives, so the same code runs unsharded
+(constraints no-op) and composes with DP/FSDP on the batch dim.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P, get_abstract_mesh
+
+from kubeflow_tpu.ops.attention import dense_attention
+
+# [batch, seq, heads, head_dim] with the sequence axis on...
+SEQ_SHARDED = (("data", "fsdp"), "sequence", None, None)     # ...seq dim
+HEAD_SHARDED = (("data", "fsdp"), None, "sequence", None)    # ...heads dim
+
+
+def _constrain(x, template: Tuple[Union[None, str, Tuple[str, ...]], ...]):
+    """Constrain against the ambient mesh, dropping axes it doesn't have.
+
+    No mesh context → no-op. Axes absent from the mesh are trimmed (the
+    same tolerance as parallel/sharding.py) rather than swallowing
+    constraint errors — a genuinely invalid constraint still raises, so a
+    disabled all_to_all can't silently degrade to replicated dense
+    attention at sequence lengths where that OOMs.
+    """
+    mesh = get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return x
+    out = []
+    for entry in template:
+        axes = (
+            (entry,)
+            if isinstance(entry, str)
+            else tuple(entry)
+            if entry is not None
+            else ()
+        )
+        axes = tuple(a for a in axes if a in mesh.axis_names)
+        out.append(axes if len(axes) > 1 else (axes[0] if axes else None))
+    return jax.lax.with_sharding_constraint(x, P(*out))
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Attention over [B, S, H, D] inputs sharded on the sequence axis.
+
+    heads must be divisible by the `sequence` mesh axis size (checked by
+    the partitioner at compile time — e.g. 12 heads on sequence=4).
+    """
+    # scatter: seq-sharded -> head-sharded (XLA inserts the all_to_all)
+    q = _constrain(q, HEAD_SHARDED)
+    k = _constrain(k, HEAD_SHARDED)
+    v = _constrain(v, HEAD_SHARDED)
+
+    out = dense_attention(q, k, v, mask=mask, dtype=dtype)
+
+    # gather: head-sharded -> seq-sharded (the second all_to_all)
+    return _constrain(out, SEQ_SHARDED)
