@@ -56,12 +56,7 @@ class BertConfig:
     moe_aux_weight: float = 0.01
 
 
-from kubeflow_tpu.ops.attention import dense_attention as _dense_attention_core
-
-
-def _dense_attention(q, k, v, mask, dtype):
-    """Plain attention; XLA fuses softmax into the MXU matmuls."""
-    return _dense_attention_core(q, k, v, mask=mask, dtype=dtype)
+ATTENTION_IMPLS = ("dense", "ring", "ulysses", "flash", "auto")
 
 
 class SelfAttention(nn.Module):
@@ -83,6 +78,10 @@ class SelfAttention(nn.Module):
         k = shard_constraint(k, ("batch", "seq", "act_heads", None))
         v = shard_constraint(v, ("batch", "seq", "act_heads", None))
         impl = cfg.attention_impl
+        if impl not in ATTENTION_IMPLS:
+            raise ValueError(
+                f"unknown attention_impl {impl!r}; known: {ATTENTION_IMPLS}"
+            )
         if impl == "auto":
             # policy: the pallas flash kernel wins on memory (dense
             # materializes O(S^2) scores and OOMs ~32k on one v5e chip)
@@ -106,7 +105,9 @@ class SelfAttention(nn.Module):
 
             out = flash_attention(q, k, v, mask=mask).astype(cfg.dtype)
         else:
-            out = _dense_attention(q, k, v, mask, cfg.dtype)
+            from kubeflow_tpu.ops.attention import dense_attention
+
+            out = dense_attention(q, k, v, mask=mask, dtype=cfg.dtype)
         out = nn.DenseGeneral(
             cfg.hidden_size,
             axis=(-2, -1),

@@ -125,9 +125,9 @@ def ring_attention(
         and mesh.shape[axis_name] > 1
     )
     if not seq_real:
-        from kubeflow_tpu.models.bert import _dense_attention
+        from kubeflow_tpu.ops.attention import dense_attention
 
-        return _dense_attention(q, k, v, mask, dtype)
+        return dense_attention(q, k, v, mask=mask, dtype=dtype)
 
     qkv_spec = P(None, axis_name, None, None)
     mask_spec = P(None, axis_name)

@@ -1,0 +1,61 @@
+#!/usr/bin/env python3
+"""Create the platform auth secret (reference: scripts/
+create_password_secret.sh — builds the basic-auth k8s secret the gatekeeper
+reads). Here: emits the AuthConfig fragment for PlatformDef.auth with a
+salted PBKDF2 hash, either as yaml to stdout or merged into a PlatformDef
+file in place.
+
+  python scripts/create_password_secret.py --username admin
+  python scripts/create_password_secret.py --username admin -f platform.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import getpass
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.api.gatekeeper import hash_password  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--username", required=True)
+    ap.add_argument(
+        "--password",
+        default=None,
+        help="omit to be prompted (never lands in shell history)",
+    )
+    ap.add_argument(
+        "-f", "--file", default=None, help="PlatformDef yaml to update in place"
+    )
+    args = ap.parse_args(argv)
+    password = args.password or getpass.getpass("password: ")
+    if not password:
+        print("empty password refused", file=sys.stderr)
+        return 1
+    auth = {
+        "auth": {
+            "username": args.username,
+            "password_hash": hash_password(password),
+        }
+    }
+    import yaml
+
+    if args.file:
+        with open(args.file) as f:
+            doc = yaml.safe_load(f) or {}
+        doc.update(auth)
+        with open(args.file, "w") as f:
+            yaml.safe_dump(doc, f, sort_keys=False)
+        print(f"updated {args.file}")
+    else:
+        yaml.safe_dump(auth, sys.stdout, sort_keys=False)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

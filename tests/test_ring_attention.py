@@ -12,7 +12,7 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.config.platform import MeshConfig
-from kubeflow_tpu.models.bert import _dense_attention
+from kubeflow_tpu.ops.attention import dense_attention
 from kubeflow_tpu.parallel.mesh import mesh_from_config
 from kubeflow_tpu.parallel.ring_attention import ring_attention
 
@@ -29,7 +29,7 @@ class TestRingAttention:
     def test_matches_dense_no_mask(self, devices8):
         mesh = mesh_from_config(MeshConfig(sequence=8))
         q, k, v = _rand_qkv(jax.random.PRNGKey(0))
-        dense = _dense_attention(q, k, v, None, jnp.float32)
+        dense = dense_attention(q, k, v, mask=None, dtype=jnp.float32)
 
         spec = NamedSharding(mesh, P(None, "sequence"))
         with jax.set_mesh(mesh):
@@ -50,7 +50,7 @@ class TestRingAttention:
         mask = jax.random.bernoulli(jax.random.PRNGKey(2), 0.8, (2, 32))
         # keep at least one valid key per row
         mask = mask.at[:, 0].set(True)
-        dense = _dense_attention(q, k, v, mask, jnp.float32)
+        dense = dense_attention(q, k, v, mask=mask, dtype=jnp.float32)
         spec = NamedSharding(mesh, P(None, "sequence"))
         mspec = NamedSharding(mesh, P(None, "sequence"))
         with jax.set_mesh(mesh):
@@ -69,7 +69,7 @@ class TestRingAttention:
     def test_fallback_without_sequence_axis(self, devices8):
         mesh = mesh_from_config(MeshConfig(data=8))
         q, k, v = _rand_qkv(jax.random.PRNGKey(3))
-        dense = _dense_attention(q, k, v, None, jnp.float32)
+        dense = dense_attention(q, k, v, mask=None, dtype=jnp.float32)
         with jax.set_mesh(mesh):
             out = ring_attention(q, k, v, dtype=jnp.float32)
         np.testing.assert_allclose(

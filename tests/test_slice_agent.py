@@ -234,3 +234,49 @@ class TestBarrierArgsRendering:
         args = self._args({"sharedVolume": {"nfs": {"server": "x"}}}, "v5e-16")
         assert "--coordinator" not in args
         assert args[args.index("--num-processes") + 1] == "4"
+
+
+class TestSliceAgentTsan:
+    def test_tcp_gang_race_free_under_tsan(self, tmp_path):
+        """Race-detection tier: a 3-member TCP-barrier gang (threads +
+        sockets + fork/exec supervision) runs under ThreadSanitizer."""
+        import subprocess
+
+        src_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "native", "slice_agent",
+        )
+        build = subprocess.run(
+            ["make", "-s", "tsan", f"BUILD={tmp_path}"],
+            cwd=src_dir, capture_output=True, text=True,
+        )
+        if build.returncode != 0 and "tsan" in (build.stderr or "").lower():
+            pytest.skip(f"libtsan unavailable: {build.stderr.splitlines()[-1]}")
+        assert build.returncode == 0, build.stderr
+        agent = str(tmp_path / "slice_agent_tsan")
+        port = free_port()
+        env = {**os.environ, "TSAN_OPTIONS": "exitcode=66"}
+        procs = [
+            subprocess.Popen(
+                [agent,
+                 "--shared-dir", str(tmp_path / f"own-{i}"),
+                 "--process-id", str(i), "--num-processes", "3",
+                 "--coordinator", f"127.0.0.1:{port}",
+                 "--poll-ms", "10", "--timeout-ms", "10000",
+                 "--", "true"],
+                stderr=subprocess.PIPE, text=True, env=env,
+            )
+            for i in range(3)
+        ]
+        try:
+            # communicate() drains stderr concurrently — a large TSan race
+            # report must not fill the pipe and deadlock the agent
+            results = [p.communicate(timeout=30) for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for p, (_, err) in zip(procs, results):
+            assert p.returncode == 0, (
+                f"exit {p.returncode} (66=TSan race):\n{err}"
+            )
