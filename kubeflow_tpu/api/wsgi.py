@@ -154,9 +154,12 @@ class App:
             "http_request_seconds", "request latency", ["app"]
         )
 
-    def route(self, method: str, pattern: str):
+    def route(self, method: str, pattern: str, binary: bool = False):
         # <name> matches one path segment; <name:path> matches the rest
-        # (including slashes) — the catch-all for redirect/proxy handlers
+        # (including slashes) — the catch-all for redirect/proxy handlers.
+        # binary=True opts the route into raw octet-stream bodies; other
+        # routes reject binary bodies with 400 (a JSON handler calling
+        # .get() on bytes would 500 otherwise).
         regex = re.compile(
             "^"
             + re.sub(
@@ -168,7 +171,7 @@ class App:
         )
 
         def deco(fn: Handler):
-            self._routes.append((method.upper(), regex, fn))
+            self._routes.append((method.upper(), regex, fn, binary))
             return fn
 
         return deco
@@ -176,8 +179,8 @@ class App:
     def get(self, pattern: str):
         return self.route("GET", pattern)
 
-    def post(self, pattern: str):
-        return self.route("POST", pattern)
+    def post(self, pattern: str, binary: bool = False):
+        return self.route("POST", pattern, binary=binary)
 
     def delete(self, pattern: str):
         return self.route("DELETE", pattern)
@@ -212,13 +215,22 @@ class App:
             self.user_prefix
         ) else raw_user
         matched_path = False
-        for m, regex, fn in self._routes:
+        for m, regex, fn, binary in self._routes:
             match = regex.match(path)
             if match is None:
                 continue
             matched_path = True
             if m != method.upper():
                 continue
+            if isinstance(body, (bytes, bytearray)) and not binary:
+                return (
+                    400,
+                    {
+                        "success": False,
+                        "log": "binary body not accepted by this endpoint",
+                    },
+                    [],
+                )
             req = Request(
                 method.upper(), path, match.groupdict(), body, headers, user,
                 dict(query or {}),
@@ -289,15 +301,21 @@ def _wsgi_adapter(handle_full, environ, start_response):
         length = 0
     if length:
         raw = environ["wsgi.input"].read(length)
-        try:
-            body = json.loads(raw)
-        except json.JSONDecodeError:
-            start_response(
-                _STATUS_TEXT[400], [("Content-Type", "application/json")]
-            )
-            return [
-                json.dumps({"success": False, "log": "invalid JSON"}).encode()
-            ]
+        content_type = environ.get("CONTENT_TYPE", "") or ""
+        if content_type.startswith("application/octet-stream"):
+            body = raw  # binary endpoints (e.g. serving :predict_npy)
+        else:
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                start_response(
+                    _STATUS_TEXT[400], [("Content-Type", "application/json")]
+                )
+                return [
+                    json.dumps(
+                        {"success": False, "log": "invalid JSON"}
+                    ).encode()
+                ]
     status, result, extra_headers = handle_full(
         method, path, body, headers, query
     )
@@ -338,7 +356,7 @@ class Mux:
 
     def _app_for(self, path: str) -> Optional[App]:
         for app in self.apps:
-            for _, regex, _ in app._routes:
+            for _, regex, _, _ in app._routes:
                 if regex.match(path):
                     return app
         return None

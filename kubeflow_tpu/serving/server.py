@@ -96,25 +96,34 @@ class ServedModel:
 
         return cls(served_name or model_name, apply_fn, params)
 
-    def predict(self, instances: Sequence) -> List:
-        n = len(instances)
+    def predict_array(self, x: np.ndarray) -> np.ndarray:
+        """Array-in/array-out predict: bucket pad, jitted apply, unpad.
+        The binary (:predict_npy) path — no per-row Python conversion."""
+        n = x.shape[0]
         if n == 0:
-            return []
-        x = np.asarray(instances, dtype=np.float32)
-        padded_n = bucket_for(n)
+            return x[:0]
         if n > BATCH_BUCKETS[-1]:
             # large request: chunk through the biggest bucket
-            out: List = []
-            for i in range(0, n, BATCH_BUCKETS[-1]):
-                out.extend(self.predict(instances[i : i + BATCH_BUCKETS[-1]]))
-            return out
+            return np.concatenate(
+                [
+                    self.predict_array(x[i : i + BATCH_BUCKETS[-1]])
+                    for i in range(0, n, BATCH_BUCKETS[-1])
+                ],
+                axis=0,
+            )
+        padded_n = bucket_for(n)
         if padded_n != n:
             pad = np.repeat(x[:1], padded_n - n, axis=0)
             x = np.concatenate([x, pad], axis=0)
         self._requests.inc(model=self.name)
         with self._latency.time(model=self.name), self._lock:
             y = np.asarray(jax.device_get(self._jitted(self.params, jnp.asarray(x))))
-        y = y[:n]
+        return y[:n]
+
+    def predict(self, instances: Sequence) -> List:
+        if len(instances) == 0:
+            return []
+        y = self.predict_array(np.asarray(instances, dtype=np.float32))
         if self.postprocess is not None:
             return [self.postprocess(row) for row in y]
         return [row.tolist() for row in y]
@@ -165,6 +174,41 @@ class ModelServer:
             except (ValueError, TypeError) as e:
                 raise BadRequest(f"bad instances: {e}")
             return {"predictions": predictions}
+
+        @app.post("/v1/models/<name>:predict_npy", binary=True)
+        def predict_npy(req):
+            """Binary fast path: request body is one .npy array (the
+            instances tensor), response body one .npy array of
+            predictions. The JSON wire costs ~10 MB and dominates latency
+            for image batches (bench.py serving entry); npy is ~50x
+            lighter to move and parse. TPU-native addition — the
+            reference's REST surface is JSON-only and delegates fast
+            serving to gRPC."""
+            import io
+
+            from kubeflow_tpu.api.wsgi import Response
+
+            model = self._models.get(req.params["name"])
+            if model is None:
+                raise NotFoundError(f"model {req.params['name']} not loaded")
+            if not isinstance(req.body, (bytes, bytearray)):
+                raise BadRequest(
+                    "send the instances tensor as one .npy body with "
+                    "Content-Type: application/octet-stream"
+                )
+            try:
+                x = np.load(io.BytesIO(req.body), allow_pickle=False)
+            except (ValueError, OSError, EOFError) as e:
+                raise BadRequest(f"bad npy payload: {e}")
+            if getattr(x, "ndim", 0) < 1:
+                raise BadRequest("instances tensor must be at least rank 1")
+            try:
+                y = model.predict_array(np.asarray(x, dtype=np.float32))
+            except (ValueError, TypeError) as e:
+                raise BadRequest(f"bad instances: {e}")
+            buf = io.BytesIO()
+            np.save(buf, y, allow_pickle=False)
+            return Response(buf.getvalue(), "application/octet-stream")
 
         @app.get("/v1/models")
         def list_models(req):

@@ -180,3 +180,103 @@ class TestInferenceServiceController:
         isvc = store.get("InferenceService", "resnet-serve", "team-a")
         conds = {c["type"]: c["status"] for c in isvc["status"]["conditions"]}
         assert conds["Ready"] == "True"
+
+
+class TestNpyFastPath:
+    """Binary predict endpoint: one .npy body each way (the JSON wire
+    dominates latency for image batches — bench.py serving entry)."""
+
+    def _roundtrip(self, app, name, x):
+        import io
+
+        import numpy as np
+
+        buf = io.BytesIO()
+        np.save(buf, x, allow_pickle=False)
+        status, body = app.handle(
+            "POST",
+            f"/v1/models/{name}:predict_npy",
+            body=buf.getvalue(),
+        )
+        return status, body
+
+    def test_npy_matches_json_predictions(self, mlp_served):
+        import io
+
+        import numpy as np
+
+        from kubeflow_tpu.api.wsgi import Response
+        from kubeflow_tpu.serving.server import ModelServer
+
+        server = ModelServer()
+        server.add(mlp_served)
+        x = np.random.RandomState(0).rand(3, 8).astype(np.float32)
+        status, body = self._roundtrip(server.app, mlp_served.name, x)
+        assert status == 200 and isinstance(body, Response)
+        assert body.content_type == "application/octet-stream"
+        y = np.load(io.BytesIO(body.body), allow_pickle=False)
+        want = np.asarray(mlp_served.predict(x.tolist()), dtype=y.dtype)
+        np.testing.assert_allclose(y, want, rtol=1e-5, atol=1e-5)
+
+    def test_json_body_rejected_on_npy_route(self, mlp_served):
+        from kubeflow_tpu.serving.server import ModelServer
+
+        server = ModelServer()
+        server.add(mlp_served)
+        status, body = server.app.handle(
+            "POST",
+            f"/v1/models/{mlp_served.name}:predict_npy",
+            body={"instances": [[0.0] * 8]},
+        )
+        assert status == 400
+
+    def test_garbage_npy_rejected(self, mlp_served):
+        from kubeflow_tpu.serving.server import ModelServer
+
+        server = ModelServer()
+        server.add(mlp_served)
+        status, body = server.app.handle(
+            "POST",
+            f"/v1/models/{mlp_served.name}:predict_npy",
+            body=b"not-an-npy",
+        )
+        assert status == 400
+
+    def test_unknown_model_404(self):
+        import numpy as np
+
+        from kubeflow_tpu.serving.server import ModelServer
+
+        server = ModelServer()
+        status, _ = self._roundtrip(server.app, "ghost", np.zeros((1, 8)))
+        assert status == 404
+
+    def test_octet_stream_passes_wsgi_raw(self, mlp_served):
+        """Through the real socket: binary body reaches the route intact."""
+        import io
+        import urllib.request
+
+        import numpy as np
+
+        from kubeflow_tpu.api.wsgi import Server
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model_server = ModelServer()
+        model_server.add(mlp_served)
+        server = Server(model_server.app, port=0)
+        server.start()
+        try:
+            buf = io.BytesIO()
+            np.save(buf, np.zeros((2, 8), np.float32), allow_pickle=False)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/models/"
+                f"{mlp_served.name}:predict_npy",
+                data=buf.getvalue(),
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.headers["Content-Type"] == "application/octet-stream"
+                y = np.load(io.BytesIO(resp.read()), allow_pickle=False)
+            assert y.shape[0] == 2
+        finally:
+            server.stop()

@@ -250,7 +250,7 @@ class TestSliceAgentTsan:
             ["make", "-s", "tsan", f"BUILD={tmp_path}"],
             cwd=src_dir, capture_output=True, text=True,
         )
-        if build.returncode != 0 and "tsan" in (build.stderr or "").lower():
+        if build.returncode != 0 and "libtsan" in (build.stderr or "").lower():
             pytest.skip(f"libtsan unavailable: {build.stderr.splitlines()[-1]}")
         assert build.returncode == 0, build.stderr
         agent = str(tmp_path / "slice_agent_tsan")

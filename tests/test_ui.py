@@ -89,7 +89,7 @@ class TestUiApp:
             assert app is not None, f"UI references unrouted path {path}"
             assert any(
                 m == method and regex.match(path)
-                for m, regex, _ in app._routes
+                for m, regex, _, _ in app._routes
             ), f"{method} {path} not handled by {app.name}"
 
 
