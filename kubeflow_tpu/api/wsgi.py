@@ -145,7 +145,8 @@ class App:
         self.user_header = user_header
         self.user_prefix = user_prefix
         self.authorizer: Authorizer = authorizer or allow_all
-        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        # (method, pattern, handler, accepts-binary-body)
+        self._routes: List[Tuple[str, re.Pattern, Handler, bool]] = []
         reg = default_registry()
         self._requests = reg.counter(
             "http_requests_total", "requests", ["app", "method", "status"]

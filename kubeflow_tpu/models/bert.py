@@ -48,10 +48,12 @@ class BertConfig:
     # (parallel/pipeline.py). num_layers must divide evenly into stages.
     pipeline_stages: int = 1
     num_microbatches: int = 0  # 0 = pipeline_stages
-    # expert parallelism: >0 replaces every MLP with a Switch-routed MoE of
-    # that many experts, stacked on the `expert` mesh axis
-    # (parallel/moe.py). Dropped-token residuals follow Switch semantics.
+    # expert parallelism: >0 replaces every MLP with a routed MoE of that
+    # many experts, stacked on the `expert` mesh axis (parallel/moe.py).
+    # moe_top_k=1 is Switch routing, 2 is GShard top-2; dropped-token
+    # residuals pass through unchanged either way.
     num_experts: int = 0
+    moe_top_k: int = 1
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
 
@@ -147,12 +149,15 @@ class MoeMlp(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic: bool):
-        from kubeflow_tpu.parallel.moe import expert_capacity, switch_route
+        from kubeflow_tpu.parallel.moe import expert_capacity, topk_route
 
         cfg = self.cfg
         b, s, d = x.shape
         e = cfg.num_experts
-        c = expert_capacity(s, e, cfg.expert_capacity_factor)
+        # top-2 tokens occupy two slots each: scale capacity with k
+        c = expert_capacity(
+            s * cfg.moe_top_k, e, cfg.expert_capacity_factor
+        )
 
         router = self.param(
             "router",
@@ -161,7 +166,7 @@ class MoeMlp(nn.Module):
             jnp.float32,
         )
         logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), router)
-        route = switch_route(logits, c)
+        route = topk_route(logits, c, k=cfg.moe_top_k)
 
         init = nn.initializers.variance_scaling(
             1.0, "fan_in", "truncated_normal", in_axis=-2, out_axis=-1

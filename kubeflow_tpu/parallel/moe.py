@@ -35,7 +35,9 @@ class Routing(NamedTuple):
     dispatch: jax.Array  # [B, S, E, C] float, one-hot over (E, C) per token
     combine: jax.Array   # [B, S, E, C] float, dispatch * router gate
     aux_loss: jax.Array  # scalar load-balance loss (Switch: E * Σ f_e P_e)
-    fraction_dropped: jax.Array  # scalar, tokens over capacity / tokens
+    fraction_dropped: jax.Array  # scalar: dropped (token, choice)
+    #   assignments / (tokens * k) — a token losing only its 2nd choice
+    #   under top-2 contributes 0.5
 
 
 def expert_capacity(
@@ -45,35 +47,70 @@ def expert_capacity(
     return max(1, math.ceil(tokens_per_group / num_experts * capacity_factor))
 
 
-def switch_route(router_logits: jax.Array, capacity: int) -> Routing:
-    """Top-1 (Switch) routing with per-group capacity.
+def topk_route(router_logits: jax.Array, capacity: int, k: int = 1) -> Routing:
+    """Top-k routing with per-group capacity (k=1: Switch; k=2: GShard).
 
     router_logits: [B, S, E] float32 — B batch rows are the routing groups,
-    S tokens per group, E experts. Position within an expert is assigned in
-    token order (cumsum), so routing is deterministic.
+    S tokens per group, E experts. Rank 0 choices get expert slots before
+    rank 1 (GShard priority), and within a rank positions follow token
+    order (cumsum) — fully deterministic.
+
+    Combine weights: k=1 uses the raw top-1 probability (Switch — the gate
+    carries the router gradient); k>1 renormalizes over the chosen experts
+    so a fully-kept token's expert outputs sum to weight 1 (GShard).
     """
-    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)                      # [B, S]
-    gate = jnp.take_along_axis(probs, expert_idx[..., None], -1)[..., 0]
     num_experts = router_logits.shape[-1]
-    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)
+    if not 1 <= k <= num_experts:
+        raise ValueError(f"k={k} must be in [1, {num_experts}]")
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    top_p, top_i = jax.lax.top_k(probs, k)                       # [B, S, k]
+    if k == 1:
+        # Switch: the raw router probability is the gate — normalizing
+        # would make it a constant 1.0 and cut the router's gradient
+        gates = top_p
+    else:
+        gates = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
 
-    # position of each token within its expert's queue (0-based)
-    pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0              # [B, S, E]
-    kept = (pos >= 0) & (pos < capacity)
-    pos_c = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
-    slot = jax.nn.one_hot(pos_c, capacity, dtype=jnp.float32)    # [B, S, E, C]
-    dispatch = slot * kept[..., None].astype(jnp.float32)
-    combine = dispatch * gate[..., None, None]
+    dispatch = jnp.zeros(router_logits.shape + (capacity,), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    # per-expert slots already taken by earlier ranks (per group)
+    counts = jnp.zeros(
+        (router_logits.shape[0], 1, num_experts), jnp.float32
+    )
+    rank0_onehot = None
+    for r in range(k):
+        onehot = jax.nn.one_hot(top_i[..., r], num_experts, dtype=jnp.float32)
+        if r == 0:
+            rank0_onehot = onehot
+        # position within the expert queue: earlier-rank occupancy first,
+        # then token order within this rank
+        pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0 + counts
+        kept = (pos >= 0) & (pos < capacity) & (onehot > 0)
+        pos_c = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+        slot = jax.nn.one_hot(pos_c, capacity, dtype=jnp.float32)
+        dispatch_r = slot * kept[..., None].astype(jnp.float32)
+        dispatch = dispatch + dispatch_r
+        combine = combine + dispatch_r * gates[..., r][..., None, None]
+        counts = counts + (
+            kept.astype(jnp.float32).sum(axis=1, keepdims=True)
+        )
 
-    # Switch load-balance loss over all tokens in the batch: f_e is the
-    # fraction of tokens argmax-routed to e (pre-capacity), P_e the mean
-    # router probability; perfectly uniform routing gives loss = 1.0.
-    f = onehot.mean(axis=(0, 1))                                  # [E]
+    # Load-balance loss on first choices (Switch/GShard convention): f_e is
+    # the fraction of tokens argmax-routed to e (pre-capacity), P_e the
+    # mean router probability; perfectly uniform routing gives loss = 1.0.
+    f = rank0_onehot.mean(axis=(0, 1))                            # [E]
     p = probs.mean(axis=(0, 1))                                   # [E]
     aux_loss = num_experts * jnp.sum(f * p)
 
-    routed = onehot.max(axis=-1)  # 1.0 for every token (top-1 always routes)
-    kept_any = dispatch.sum(axis=(-1, -2))
-    fraction_dropped = 1.0 - kept_any.sum() / jnp.maximum(routed.sum(), 1.0)
+    # fraction of (token, choice) assignments dropped by capacity
+    total_slots = dispatch.sum()
+    wanted = jnp.float32(
+        router_logits.shape[0] * router_logits.shape[1] * k
+    )
+    fraction_dropped = 1.0 - total_slots / jnp.maximum(wanted, 1.0)
     return Routing(dispatch, combine, aux_loss, fraction_dropped)
+
+
+def switch_route(router_logits: jax.Array, capacity: int) -> Routing:
+    """Top-1 (Switch) routing — the k=1 special case of `topk_route`."""
+    return topk_route(router_logits, capacity, k=1)

@@ -101,7 +101,9 @@ class ServedModel:
         The binary (:predict_npy) path — no per-row Python conversion."""
         n = x.shape[0]
         if n == 0:
-            return x[:0]
+            # prediction-shaped empty: run one zero row, keep zero rows
+            probe = np.zeros((1,) + x.shape[1:], x.dtype)
+            return self.predict_array(probe)[:0]
         if n > BATCH_BUCKETS[-1]:
             # large request: chunk through the biggest bucket
             return np.concatenate(
@@ -191,6 +193,12 @@ class ModelServer:
             model = self._models.get(req.params["name"])
             if model is None:
                 raise NotFoundError(f"model {req.params['name']} not loaded")
+            if model.postprocess is not None:
+                # postprocess emits per-row Python objects, which have no
+                # .npy encoding — the binary path serves raw tensors only
+                raise BadRequest(
+                    f"model {model.name} has a postprocessor; use :predict"
+                )
             if not isinstance(req.body, (bytes, bytearray)):
                 raise BadRequest(
                     "send the instances tensor as one .npy body with "

@@ -123,3 +123,69 @@ class TestMoeTrainer:
                 jnp.zeros((2, 8), jnp.int32),
                 deterministic=True,
             )
+
+
+class TestTopKRouting:
+    """GShard-style top-2 (parallel/moe.py topk_route)."""
+
+    def test_top2_two_slots_per_token(self):
+        from kubeflow_tpu.parallel.moe import topk_route
+
+        logits = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 4))
+        r = topk_route(logits, capacity=16, k=2)
+        # generous capacity: every token lands in exactly 2 experts
+        np.testing.assert_allclose(
+            np.asarray(r.dispatch.sum(axis=(2, 3))), 2.0
+        )
+        # renormalized gates: each token's combine weights sum to 1
+        np.testing.assert_allclose(
+            np.asarray(r.combine.sum(axis=(2, 3))), 1.0, rtol=1e-5
+        )
+        # no expert slot double-booked
+        assert np.asarray(r.dispatch.sum(axis=1)).max() <= 1.0
+
+    def test_rank0_has_priority_over_rank1(self):
+        from kubeflow_tpu.parallel.moe import topk_route
+
+        # every token's first choice is expert 0, second expert 1;
+        # capacity 2 keeps rank-0 assignments of the first two tokens
+        logits = jnp.tile(jnp.array([3.0, 2.0, -9.0, -9.0]), (1, 4, 1))
+        r = topk_route(logits, capacity=2, k=2)
+        d = np.asarray(r.dispatch)
+        # expert 0: tokens 0,1 (rank-0, token order); tokens 2,3 dropped
+        assert d[0, 0, 0].sum() == 1 and d[0, 1, 0].sum() == 1
+        assert d[0, 2, 0].sum() == 0 and d[0, 3, 0].sum() == 0
+        # expert 1 (everyone's 2nd choice): first two tokens keep slots
+        assert d[0, :, 1].sum() == 2
+
+    def test_switch_is_k1_special_case(self):
+        from kubeflow_tpu.parallel.moe import switch_route, topk_route
+
+        logits = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 4))
+        a = switch_route(logits, capacity=8)
+        b = topk_route(logits, capacity=8, k=1)
+        np.testing.assert_allclose(np.asarray(a.dispatch), np.asarray(b.dispatch))
+        np.testing.assert_allclose(np.asarray(a.combine), np.asarray(b.combine))
+
+    def test_invalid_k_rejected(self):
+        from kubeflow_tpu.parallel.moe import topk_route
+
+        with pytest.raises(ValueError, match="k="):
+            topk_route(jnp.zeros((1, 4, 4)), capacity=2, k=5)
+
+    def test_top2_model_trains_ep(self, devices8):
+        cfg = TrainingConfig(
+            model="bert_tiny_moe",
+            global_batch_size=8,
+            steps=2,
+            warmup_steps=1,
+            learning_rate=1e-3,
+            mesh=MeshConfig(data=2, expert=4),
+        )
+        tr = Trainer(
+            cfg,
+            task=MlmTask(cfg, seq_len=32, vocab_size=512),
+            model_kwargs={"moe_top_k": 2},
+        )
+        m = tr.fit(steps=2, log_every=1)
+        assert np.isfinite(m.loss)

@@ -99,7 +99,9 @@ class TestTsan:
             ["make", "-s", "tsan", f"BUILD={tmp_path}"],
             cwd=src_dir, capture_output=True, text=True,
         )
-        if build.returncode != 0 and "libtsan" in (build.stderr or "").lower():
+        if build.returncode != 0 and any(
+            s in (build.stderr or "").lower() for s in ("libtsan", "-ltsan")
+        ):
             pytest.skip(f"libtsan unavailable: {build.stderr.splitlines()[-1]}")
         assert build.returncode == 0, build.stderr
         paths = write_shards(tmp_path, n=12)

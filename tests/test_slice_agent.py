@@ -250,7 +250,9 @@ class TestSliceAgentTsan:
             ["make", "-s", "tsan", f"BUILD={tmp_path}"],
             cwd=src_dir, capture_output=True, text=True,
         )
-        if build.returncode != 0 and "libtsan" in (build.stderr or "").lower():
+        if build.returncode != 0 and any(
+            s in (build.stderr or "").lower() for s in ("libtsan", "-ltsan")
+        ):
             pytest.skip(f"libtsan unavailable: {build.stderr.splitlines()[-1]}")
         assert build.returncode == 0, build.stderr
         agent = str(tmp_path / "slice_agent_tsan")
