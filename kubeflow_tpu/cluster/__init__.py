@@ -1,3 +1,5 @@
+"""Re-export index for kubeflow_tpu.cluster."""
+
 from kubeflow_tpu.cluster.objects import (
     Condition,
     get_condition,

@@ -1,3 +1,5 @@
+"""Re-export index for kubeflow_tpu.config."""
+
 from kubeflow_tpu.config.core import (
     ConfigError,
     config_field,

@@ -1,3 +1,5 @@
+"""Re-export index for kubeflow_tpu.models."""
+
 from kubeflow_tpu.models.registry import get_model, list_models, register_model
 
 __all__ = ["get_model", "list_models", "register_model"]

@@ -1,3 +1,5 @@
+"""Re-export index for kubeflow_tpu.parallel."""
+
 from kubeflow_tpu.parallel.mesh import (
     MESH_AXIS_ORDER,
     MeshSpec,

@@ -53,6 +53,7 @@ class Platform:
         pod_runner: Optional[PodRunner] = None,
         activity_probe=None,
         profile_plugins=None,
+        deploy_router=None,
     ) -> None:
         self.platform_def = platform_def or PlatformDef()
         self.store = StateStore()
@@ -118,6 +119,12 @@ class Platform:
 
         self.ui = build_ui()
         gateway_apps = [self.ui, self.dashboard, self.spawner, self.kfam]
+        # optional: the deploy router behind the same socket, so the UI's
+        # click-to-deploy page works in dev mode (production keeps the
+        # router on its own public endpoint, reference: router.go)
+        self.deploy_router = deploy_router
+        if deploy_router is not None:
+            gateway_apps.append(deploy_router.app)
         self.gatekeeper = None
         auth_filter = None
         if self.platform_def.auth.username:

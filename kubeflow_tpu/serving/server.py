@@ -101,9 +101,13 @@ class ServedModel:
         The binary (:predict_npy) path — no per-row Python conversion."""
         n = x.shape[0]
         if n == 0:
-            # prediction-shaped empty: run one zero row, keep zero rows
-            probe = np.zeros((1,) + x.shape[1:], x.dtype)
-            return self.predict_array(probe)[:0]
+            # prediction-shaped empty: trace (not run) a 1-row batch
+            out = jax.eval_shape(
+                self._jitted,
+                self.params,
+                jax.ShapeDtypeStruct((bucket_for(1),) + x.shape[1:], x.dtype),
+            )
+            return np.zeros((0,) + out.shape[1:], out.dtype)
         if n > BATCH_BUCKETS[-1]:
             # large request: chunk through the biggest bucket
             return np.concatenate(

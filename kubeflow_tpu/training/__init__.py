@@ -1,3 +1,5 @@
+"""Re-export index for kubeflow_tpu.training."""
+
 from kubeflow_tpu.training.trainer import Trainer, TrainState
 from kubeflow_tpu.training.data import SyntheticData
 

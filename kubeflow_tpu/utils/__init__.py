@@ -1,3 +1,5 @@
+"""Re-export index for kubeflow_tpu.utils."""
+
 from kubeflow_tpu.utils.logging import get_logger, configure_logging
 from kubeflow_tpu.utils.metrics import (
     Counter,

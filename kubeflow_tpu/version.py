@@ -1,1 +1,3 @@
+"""Package version (bumped by ci/release.py cut_release)."""
+
 __version__ = "0.1.0"

@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""Boilerplate check (reference: build/check_boilerplate.sh): every source
+file opens with a docstring/comment header explaining what it is. Run by
+the unit-tests CI workflow; exits 1 listing offenders."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SKIP_DIRS = {
+    "build", ".git", "__pycache__", "node_modules", ".claude",
+    ".venv", "venv", ".tox", ".eggs", ".mypy_cache", ".pytest_cache",
+    "dist", "artifacts",
+}
+
+
+def py_has_header(path: str) -> bool:
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            s = line.strip()
+            if not s or s.startswith("#!") or s.startswith("# "):
+                continue
+            return s.startswith(('"""', "'''", 'r"""'))
+    return True  # empty file
+
+
+def cc_has_header(path: str) -> bool:
+    with open(path, encoding="utf-8", errors="replace") as f:
+        first = f.readline().strip()
+    return first.startswith("//") or first.startswith("/*")
+
+
+def main() -> int:
+    bad = []
+    for root, dirs, files in os.walk(REPO):
+        dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
+        for fname in files:
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, REPO)
+            if fname == "__init__.py" and os.path.getsize(path) == 0:
+                continue
+            if fname.endswith(".py") and not py_has_header(path):
+                bad.append(rel)
+            elif fname.endswith((".cc", ".h")) and not cc_has_header(path):
+                bad.append(rel)
+    if bad:
+        print("files missing a header docstring/comment:")
+        for b in sorted(bad):
+            print(f"  {b}")
+        return 1
+    print("boilerplate ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -249,3 +249,41 @@ class TestMux:
                 assert e.headers["Location"] == "/kflogin"
         finally:
             server.stop()
+
+
+class TestDeployRouterBehindGateway:
+    def test_deploy_page_flow_on_one_socket(self):
+        """Dev mode: the click-to-deploy page's API calls resolve on the
+        same gateway socket when a deploy Router is wired in."""
+        from kubeflow_tpu.deploy.server import Router
+
+        router = Router()
+        try:
+            p = Platform(deploy_router=router)
+            gw = p.gateway
+            status, page = gw.handle("GET", "/deploy/")
+            assert status == 200 and b"Create deployment" in page.body
+            status, body = gw.handle(
+                "POST",
+                "/kfctl/apps/v1beta1/create",
+                body={"name": "dev", "spec": {"name": "dev"}},
+            )
+            assert status == 201, body
+            import time
+
+            for _ in range(100):
+                status, st = gw.handle(
+                    "GET", "/kfctl/apps/v1beta1/status", query={"name": "dev"}
+                )
+                if st.get("state") in ("Succeeded", "Failed"):
+                    break
+                time.sleep(0.1)
+            assert st["state"] == "Succeeded", st
+        finally:
+            router.shutdown()
+
+    def test_no_router_no_kfctl_routes(self, platform_noauth):
+        status, _ = platform_noauth.gateway.handle(
+            "POST", "/kfctl/apps/v1beta1/create", body={}
+        )
+        assert status == 404
