@@ -20,7 +20,7 @@ def py_has_header(path: str) -> bool:
     with open(path, encoding="utf-8", errors="replace") as f:
         for line in f:
             s = line.strip()
-            if not s or s.startswith("#!") or s.startswith("# "):
+            if not s or s.startswith("#"):  # blank, shebang, any comment
                 continue
             return s.startswith(('"""', "'''", 'r"""'))
     return True  # empty file
