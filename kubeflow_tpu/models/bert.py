@@ -85,31 +85,11 @@ class SelfAttention(nn.Module):
                 f"unknown attention_impl {impl!r}; known: {ATTENTION_IMPLS}"
             )
         if impl == "auto":
-            # policy: XLA's fused dense attention wins raw step time at
-            # every length we measured (fwd+bwd, v5e) — the flash kernel's
-            # value is MEMORY: dense materializes [B,H,S,S] scores (fwd +
-            # residual for bwd) and OOMs near 32k on one v5e chip. Gate on
-            # score-tensor bytes, not sequence length.
-            import jax
-            from jax.sharding import get_abstract_mesh
+            from kubeflow_tpu.ops.attention import auto_attention_impl
 
-            b_sz, s_len = x.shape[0], x.shape[1]
-            # under pjit the traced batch dim is GLOBAL; divide by the
-            # mesh's batch sharding to estimate per-device bytes
-            mesh = get_abstract_mesh()
-            dp = 1
-            if mesh is not None and mesh.axis_names:
-                for a in ("data", "fsdp"):
-                    if a in mesh.axis_names:
-                        dp *= mesh.shape[a]
-            per_dev_b = max(1, b_sz // dp)
-            itemsize = max(2, jnp.dtype(cfg.dtype).itemsize)
-            # x2: fwd scores + the bwd residual copy
-            score_bytes = (
-                2 * per_dev_b * cfg.num_heads * s_len * s_len * itemsize
+            impl = auto_attention_impl(
+                x.shape[0], x.shape[1], cfg.num_heads, cfg.dtype
             )
-            on_tpu = jax.default_backend() == "tpu"
-            impl = "flash" if (on_tpu and score_bytes > 2 << 30) else "dense"
         if impl == "ring":
             from kubeflow_tpu.parallel.ring_attention import ring_attention
 

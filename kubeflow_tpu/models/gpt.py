@@ -1,0 +1,188 @@
+"""Decoder-only causal LM — the autoregressive member of the model menu.
+
+The reference's model vocabulary is a flag into tf_cnn_benchmarks (vision
+only; reference: tf-controller-examples/tf-cnn/create_job_specs.py:56-59);
+the TPU rebuild's north-star configs add transformer pretraining
+(BASELINE.md BERT row). This decoder completes the family for causal
+pretraining, built mesh-first exactly like models/bert.py:
+
+- logical-axis annotations reuse the same one rules table
+  (parallel/sharding.py) — DP/FSDP/TP/SP layouts without touching the
+  model,
+- attention is pluggable: "dense" (XLA-fused causal), "flash" (the pallas
+  kernel's causal path, ops/flash_attention.py), or "auto" (memory-gated
+  like BERT's),
+- pre-LN residual blocks, bfloat16 compute with float32 layernorm/logits,
+  static shapes throughout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.registry import register_model
+from kubeflow_tpu.parallel.sharding import shard_constraint
+
+GPT_ATTENTION_IMPLS = ("dense", "flash", "auto")
+
+
+@dataclasses.dataclass(frozen=True)
+class GptConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 1024
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "dense"  # "dense" | "flash" | "auto"
+    remat: bool = False
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GptConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, head_dim), dtype=cfg.dtype, name=name
+        )
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        q = shard_constraint(q, ("batch", "seq", "act_heads", None))
+        k = shard_constraint(k, ("batch", "seq", "act_heads", None))
+        v = shard_constraint(v, ("batch", "seq", "act_heads", None))
+
+        impl = cfg.attention_impl
+        if impl not in GPT_ATTENTION_IMPLS:
+            raise ValueError(
+                f"unknown attention_impl {impl!r}; known: {GPT_ATTENTION_IMPLS}"
+            )
+        if impl == "auto":
+            from kubeflow_tpu.ops.attention import auto_attention_impl
+
+            impl = auto_attention_impl(
+                x.shape[0], x.shape[1], cfg.num_heads, cfg.dtype
+            )
+
+        if impl == "flash":
+            from kubeflow_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, mask=mask, causal=True).astype(
+                cfg.dtype
+            )
+        else:
+            from kubeflow_tpu.ops.attention import dense_attention
+
+            out = dense_attention(
+                q, k, v, mask=mask, dtype=cfg.dtype, causal=True
+            )
+        out = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
+        )(out)
+        if cfg.dropout_rate > 0:
+            out = nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
+        return out
+
+
+class DecoderBlock(nn.Module):
+    """Pre-LN residual block (the modern decoder idiom)."""
+
+    cfg: GptConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x)
+        x = x + CausalSelfAttention(cfg, name="attention")(
+            h.astype(cfg.dtype), mask, deterministic
+        )
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="mlp_wi")(
+            h.astype(cfg.dtype)
+        )
+        h = shard_constraint(h, ("batch", "seq", "act_mlp"))
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_wo")(h)
+        if cfg.dropout_rate > 0:
+            h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        x = x + h
+        return shard_constraint(x, ("batch", "seq", "act_embed"))
+
+
+class Gpt(nn.Module):
+    """Decoder-only LM: token+position embeddings → N blocks → LM head."""
+
+    cfg: GptConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        *,
+        attention_mask=None,
+        deterministic: bool = True,
+    ):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        mask = (
+            attention_mask.astype(bool)
+            if attention_mask is not None
+            else jnp.ones((b, s), dtype=bool)
+        )
+        tok = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok_emb"
+        )(input_ids)
+        pos = nn.Embed(
+            cfg.max_len, cfg.hidden_size, dtype=cfg.dtype, name="pos_emb"
+        )(jnp.arange(s)[None, :])
+        x = (tok + pos).astype(cfg.dtype)
+        x = shard_constraint(x, ("batch", "seq", "act_embed"))
+
+        block_cls = DecoderBlock
+        if cfg.remat:
+            block_cls = nn.remat(DecoderBlock, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"layer_{i}")(x, mask, deterministic)
+
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, dtype=jnp.float32, use_bias=False, name="head"
+        )(x)
+        return {"logits": logits}
+
+
+@register_model("gpt_small")
+def gpt_small(**kwargs) -> Gpt:
+    """GPT-2-small-shaped decoder (~124M params)."""
+    return Gpt(GptConfig(**kwargs))
+
+
+@register_model("gpt_medium")
+def gpt_medium(**kwargs) -> Gpt:
+    defaults = dict(hidden_size=1024, num_layers=24, num_heads=16, mlp_dim=4096)
+    defaults.update(kwargs)
+    return Gpt(GptConfig(**defaults))
+
+
+@register_model("gpt_tiny")
+def gpt_tiny(**kwargs) -> Gpt:
+    """Test-scale config (CI runs on a virtual CPU mesh)."""
+    defaults = dict(
+        vocab_size=512,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        mlp_dim=128,
+        max_len=128,
+    )
+    defaults.update(kwargs)
+    return Gpt(GptConfig(**defaults))

@@ -26,6 +26,7 @@ def register_model(name: str):
 def _import_builtin_models() -> None:
     # Imported lazily so `import kubeflow_tpu` stays light.
     import kubeflow_tpu.models.bert  # noqa: F401
+    import kubeflow_tpu.models.gpt  # noqa: F401
     import kubeflow_tpu.models.mlp  # noqa: F401
     import kubeflow_tpu.models.resnet  # noqa: F401
 

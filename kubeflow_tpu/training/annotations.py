@@ -27,6 +27,10 @@ _PATTERNS: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
     (r".*/mlp/wi/kernel$", ("embed", "mlp")),
     (r".*/mlp/wo/kernel$", ("mlp", "embed")),
     (r".*/mlp/wi/bias$", ("mlp",)),
+    # GPT decoder MLP (models/gpt.py DecoderBlock)
+    (r".*/mlp_wi/kernel$", ("embed", "mlp")),
+    (r".*/mlp_wo/kernel$", ("mlp", "embed")),
+    (r".*/mlp_wi/bias$", ("mlp",)),
     # MoE expert stacks [E, ...] (parallel/moe.py); router stays replicated
     # so every token group computes identical routing
     (r".*/moe/wi$", ("expert", "embed", "mlp")),

@@ -53,6 +53,15 @@ class SyntheticData:
                 ),
                 "label": rng.integers(0, self.num_classes, (b,), dtype=np.int32),
             }
+        if self.task == "lm":
+            # causal LM: next-token prediction over the full sequence
+            ids = rng.integers(
+                0, self.vocab_size, (b, self.seq_len), dtype=np.int32
+            )
+            return {
+                "input_ids": ids,
+                "attention_mask": np.ones((b, self.seq_len), dtype=np.int32),
+            }
         if self.task == "mlm":
             ids = rng.integers(0, self.vocab_size, (b, self.seq_len), dtype=np.int32)
             labels = ids.copy()

@@ -35,6 +35,22 @@ def _nll(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
 
 
+def _masked_token_stats(
+    logits: jax.Array, labels: jax.Array, row_valid: jax.Array, ignore: int
+) -> Dict[str, jax.Array]:
+    """Summable eval statistics over labels != ignore (shared by the MLM and
+    causal-LM tasks): top-1 numerator/denominator + loss sum, with
+    `row_valid` masking padded rows of a ragged final eval batch."""
+    valid = (labels != ignore).astype(jnp.float32) * row_valid[:, None]
+    safe = jnp.where(labels == ignore, 0, labels)
+    logits = logits.astype(jnp.float32)
+    correct = jnp.sum(
+        (jnp.argmax(logits, -1) == safe).astype(jnp.float32) * valid
+    )
+    loss_sum = jnp.sum(_nll(logits, safe) * valid)
+    return {"correct": correct, "count": valid.sum(), "loss_sum": loss_sum}
+
+
 class ImageClassificationTask:
     """ResNet-style: batch {image, label}; mutable batch_stats (BatchNorm)."""
 
@@ -159,15 +175,80 @@ class MlmTask:
         labels = batch["labels"]
         row_valid = batch.get(
             "eval_mask", jnp.ones(labels.shape[0], jnp.float32)
-        )[:, None]
-        valid = (labels != -100).astype(jnp.float32) * row_valid
-        safe = jnp.where(labels == -100, 0, labels)
-        logits = out["mlm_logits"].astype(jnp.float32)
-        correct = jnp.sum(
-            (jnp.argmax(logits, -1) == safe).astype(jnp.float32) * valid
         )
-        loss_sum = jnp.sum(_nll(logits, safe) * valid)
-        return {"correct": correct, "count": valid.sum(), "loss_sum": loss_sum}
+        return _masked_token_stats(
+            out["mlm_logits"], labels, row_valid, ignore=-100
+        )
+
+
+class CausalLmTask:
+    """Decoder-only pretrain: next-token cross-entropy over the sequence."""
+
+    name = "lm"
+    has_batch_stats = False
+
+    def __init__(self, cfg: TrainingConfig, seq_len: int = 1024, vocab_size: int = 50257):
+        self.cfg = cfg
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+
+    def synthetic_data(self) -> SyntheticData:
+        return SyntheticData(
+            "lm",
+            self.cfg.global_batch_size,
+            seed=self.cfg.seed,
+            seq_len=self.seq_len,
+            vocab_size=self.vocab_size,
+        )
+
+    def init_variables(self, model, rng, batch) -> Dict[str, Any]:
+        return model.init(
+            rng, jnp.asarray(batch["input_ids"][:1]), deterministic=True
+        )
+
+    @staticmethod
+    def _shift(logits, input_ids, attention_mask):
+        """Next-token pairs: logits[:, :-1] predict input_ids[:, 1:].
+
+        A pair counts only when BOTH ends are visible: a padded query
+        position's attention row is fully masked and degenerates to a
+        uniform mix (including future tokens), so its logit must not
+        contribute to loss or accuracy."""
+        targets = input_ids[:, 1:]
+        valid = (attention_mask[:, 1:] != 0) & (attention_mask[:, :-1] != 0)
+        return logits[:, :-1], jnp.where(valid, targets, -100)
+
+    def loss(self, model, params, extra_vars, batch, train: bool, rngs):
+        out = model.apply(
+            {"params": params, **extra_vars},
+            batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=not train,
+            rngs=rngs if train else None,
+        )
+        logits, targets = self._shift(
+            out["logits"], batch["input_ids"], batch["attention_mask"]
+        )
+        loss = cross_entropy(logits, targets, ignore=-100)
+        return loss, {"aux": {}, "var_updates": {}}
+
+    def count_items(self, batch) -> int:
+        return batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
+
+    def eval_stats(self, model, params, extra_vars, batch) -> Dict[str, jax.Array]:
+        out = model.apply(
+            {"params": params, **extra_vars},
+            batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=True,
+        )
+        logits, targets = self._shift(
+            out["logits"], batch["input_ids"], batch["attention_mask"]
+        )
+        row_valid = batch.get(
+            "eval_mask", jnp.ones(targets.shape[0], jnp.float32)
+        )
+        return _masked_token_stats(logits, targets, row_valid, ignore=-100)
 
 
 def task_for_model(model_name: str, cfg: TrainingConfig, **kwargs):
@@ -175,6 +256,8 @@ def task_for_model(model_name: str, cfg: TrainingConfig, **kwargs):
         return ImageClassificationTask(cfg, **kwargs)
     if model_name.startswith("bert"):
         return MlmTask(cfg, **kwargs)
+    if model_name.startswith("gpt"):
+        return CausalLmTask(cfg, **kwargs)
     if model_name.startswith("mlp"):
         kwargs.setdefault("image_size", 8)
         kwargs.setdefault("num_classes", 10)

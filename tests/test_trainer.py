@@ -65,8 +65,9 @@ class TestTaskAdapters:
         cfg = TrainingConfig()
         assert task_for_model("resnet50", cfg).name == "image"
         assert task_for_model("bert_base", cfg).name == "mlm"
+        assert task_for_model("gpt_small", cfg).name == "lm"
         with pytest.raises(KeyError):
-            task_for_model("gpt5", cfg)
+            task_for_model("diffusion9000", cfg)
 
 
 class TestTrainerDP(object):
