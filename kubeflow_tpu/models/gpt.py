@@ -22,6 +22,7 @@ import dataclasses
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.models.registry import register_model
@@ -47,8 +48,29 @@ class GptConfig:
 class CausalSelfAttention(nn.Module):
     cfg: GptConfig
 
+    def _cache_vars(self, batch: int, head_dim: int):
+        cfg = self.cfg
+        shape = (batch, cfg.max_len, cfg.num_heads, head_dim)
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros, shape, cfg.dtype
+        )
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros, shape, cfg.dtype
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        return cached_k, cached_v, cache_index
+
     @nn.compact
-    def __call__(self, x, mask, deterministic: bool):
+    def __call__(
+        self,
+        x,
+        mask,
+        deterministic: bool,
+        decode: bool = False,
+        prefill: bool = False,
+    ):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
@@ -60,6 +82,49 @@ class CausalSelfAttention(nn.Module):
         q = shard_constraint(q, ("batch", "seq", "act_heads", None))
         k = shard_constraint(k, ("batch", "seq", "act_heads", None))
         v = shard_constraint(v, ("batch", "seq", "act_heads", None))
+
+        if prefill:
+            # one causal pass over the whole prompt that ALSO seeds the KV
+            # cache — generation then costs exactly one decode step per
+            # new token (serving/generate.py)
+            cached_k, cached_v, cache_index = self._cache_vars(
+                x.shape[0], head_dim
+            )
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cfg.dtype), (0, 0, 0, 0)
+            )
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cfg.dtype), (0, 0, 0, 0)
+            )
+            cache_index.value = jnp.full((), x.shape[1], jnp.int32)
+            # attention itself is the ordinary causal path below
+
+        if decode:
+            # single-token autoregressive step over the KV cache (the
+            # flax decode idiom): write this step's K/V at `index`, attend
+            # over positions <= index. x is [B, 1, D].
+            cached_k, cached_v, cache_index = self._cache_vars(
+                x.shape[0], head_dim
+            )
+            idx = cache_index.value
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)
+            )
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
+            )
+            cache_index.value = idx + 1
+            k, v = cached_k.value, cached_v.value
+            # visible = cache positions written so far (<= idx)
+            visible = (jnp.arange(cfg.max_len) <= idx)[None, :]
+            from kubeflow_tpu.ops.attention import dense_attention
+
+            out = dense_attention(
+                q, k, v, mask=visible, dtype=cfg.dtype, causal=False
+            )
+            return nn.DenseGeneral(
+                cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
+            )(out)
 
         impl = cfg.attention_impl
         if impl not in GPT_ATTENTION_IMPLS:
@@ -99,11 +164,19 @@ class DecoderBlock(nn.Module):
     cfg: GptConfig
 
     @nn.compact
-    def __call__(self, x, mask, deterministic: bool):
+    def __call__(
+        self,
+        x,
+        mask,
+        deterministic: bool,
+        decode: bool = False,
+        prefill: bool = False,
+    ):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x)
         x = x + CausalSelfAttention(cfg, name="attention")(
-            h.astype(cfg.dtype), mask, deterministic
+            h.astype(cfg.dtype), mask, deterministic, decode=decode,
+            prefill=prefill,
         )
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
         h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="mlp_wi")(
@@ -130,6 +203,8 @@ class Gpt(nn.Module):
         *,
         attention_mask=None,
         deterministic: bool = True,
+        decode: bool = False,
+        prefill: bool = False,
     ):
         cfg = self.cfg
         b, s = input_ids.shape
@@ -141,17 +216,31 @@ class Gpt(nn.Module):
         tok = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok_emb"
         )(input_ids)
+        if decode or prefill:
+            # the decode cursor lives IN the cache (one source of truth —
+            # a restored cache cannot disagree with a caller-passed
+            # position): prefill sets it to the prompt length, each decode
+            # step advances it by one
+            pos_var = self.variable(
+                "cache", "position", lambda: jnp.zeros((), jnp.int32)
+            )
+            positions = pos_var.value + jnp.arange(s)[None, :]
+            pos_var.value = pos_var.value + s
+        else:
+            positions = jnp.arange(s)[None, :]
         pos = nn.Embed(
             cfg.max_len, cfg.hidden_size, dtype=cfg.dtype, name="pos_emb"
-        )(jnp.arange(s)[None, :])
+        )(positions)
         x = (tok + pos).astype(cfg.dtype)
         x = shard_constraint(x, ("batch", "seq", "act_embed"))
 
         block_cls = DecoderBlock
         if cfg.remat:
-            block_cls = nn.remat(DecoderBlock, static_argnums=(3,))
+            block_cls = nn.remat(DecoderBlock, static_argnums=(3, 4, 5))
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"layer_{i}")(x, mask, deterministic)
+            x = block_cls(cfg, name=f"layer_{i}")(
+                x, mask, deterministic, decode, prefill
+            )
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         logits = nn.Dense(

@@ -24,7 +24,6 @@ def auto_attention_impl(
     residual and OOMs near 32k on one chip). Gate on per-device score
     bytes — under pjit the traced batch dim is GLOBAL, so divide by the
     ambient mesh's batch sharding."""
-    import jax
     from jax.sharding import get_abstract_mesh
 
     mesh = get_abstract_mesh()

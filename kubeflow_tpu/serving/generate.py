@@ -1,0 +1,82 @@
+"""Autoregressive generation — KV-cache greedy decode for the GPT family.
+
+Serving-side capability beyond the reference's surface (its serving story
+is stateless TF-Serving predict): one causal PREFILL pass over the prompt
+seeds the KV cache (models/gpt.py CausalSelfAttention prefill path), then
+each new token costs exactly one single-token decode step, the whole loop
+one `lax.scan` inside one jit — no per-token Python round trips, no
+recompute, no wasted forward.
+
+Contract: `prompt_ids` has no padding (generation starts from the full
+prompt); sampling is greedy (argmax). Temperature/top-k sampling layers on
+by swapping the argmax.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _init_cache(model, batch: int):
+    """Zero-initialized decode cache with the model's shapes (no forward
+    pass: eval_shape traces init, then zeros materialize)."""
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch, 1), jnp.int32),
+            decode=True,
+        )["cache"]
+    )
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def greedy_generate(
+    model,
+    params,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+) -> jax.Array:
+    """[B, P] int32 prompt → [B, P + max_new_tokens] greedy continuation."""
+    b, p = prompt_ids.shape
+    cfg = model.cfg
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if p + max_new_tokens > cfg.max_len:
+        raise ValueError(
+            f"prompt {p} + {max_new_tokens} new tokens exceeds "
+            f"max_len {cfg.max_len}"
+        )
+    cache = _init_cache(model, b)
+
+    # prefill: ONE causal forward over the prompt, seeding the cache
+    out, mutated = model.apply(
+        {"params": params, "cache": cache},
+        prompt_ids,
+        prefill=True,
+        mutable=["cache"],
+    )
+    cache = mutated["cache"]
+    first = jnp.argmax(out["logits"][:, -1], axis=-1).astype(jnp.int32)
+
+    def gen_step(carry, _):
+        cache, tok = carry
+        out, mutated = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            decode=True,
+            mutable=["cache"],
+        )
+        nxt = jnp.argmax(out["logits"][:, 0], axis=-1).astype(jnp.int32)
+        return (mutated["cache"], nxt), nxt
+
+    # feeding new token i yields token i+1; the prefill already produced
+    # token 1, so max_new_tokens-1 steps remain — every forward is used
+    _, rest = jax.lax.scan(
+        gen_step, (cache, first), None, length=max_new_tokens - 1
+    )
+    return jnp.concatenate(
+        [prompt_ids, first[:, None]]
+        + ([rest.T] if max_new_tokens > 1 else []),
+        axis=1,
+    )
