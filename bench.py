@@ -267,7 +267,9 @@ def bench_serving(batch: int = 8, requests: int = 30) -> dict:
 
     model = get_model("resnet50", dtype=jnp.bfloat16)
     x0 = jnp.zeros((1, 224, 224, 3), jnp.float32)
-    variables = model.init(jax.random.PRNGKey(0), x0, train=False)
+    variables = jax.jit(
+        lambda rng: model.init(rng, x0, train=False)
+    )(jax.random.PRNGKey(0))
     served = ServedModel(
         "resnet50",
         lambda v, x: model.apply(v, x, train=False),
@@ -328,6 +330,54 @@ def bench_serving(batch: int = 8, requests: int = 30) -> dict:
         "batch": batch,
         **json_stats,
         **{f"npy_{k}": v for k, v in npy_stats.items()},
+    }
+
+
+def bench_generate(
+    batch: int = 8, prompt_len: int = 64, new_tokens: int = 64
+) -> dict:
+    """Autoregressive decode throughput: GPT greedy generation with the KV
+    cache (serving/generate.py) — prefill + one step per token. Opt-in via
+    KFT_BENCH_GENERATE=1 (XLA lowering of the deep decode scan is slow)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.generate import greedy_generate
+
+    model = get_model("gpt_small", dtype=jnp.bfloat16)
+    prompt = (
+        jax.random.randint(
+            jax.random.PRNGKey(0), (batch, prompt_len), 0, 50257
+        ).astype(jnp.int32)
+    )
+    # jit the init: eager init dispatches thousands of tiny ops one round
+    # trip at a time over a remote-device transport
+    params = jax.jit(
+        lambda rng: model.init(
+            rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
+        )
+    )(jax.random.PRNGKey(0))["params"]
+    fn = jax.jit(lambda p: greedy_generate(model, params, p, new_tokens))
+    out = fn(prompt)
+    _ = int(jax.device_get(out[0, -1]))  # compile + materialize
+    iters = 3
+    t0 = time.monotonic()
+    for _ in range(iters):
+        out = fn(prompt)
+    _ = int(jax.device_get(out[0, -1]))
+    dt = (time.monotonic() - t0) / iters
+    # end-to-end: dt includes the prompt prefill pass + new_tokens-1
+    # decode steps, so this is generate throughput, not pure decode
+    return {
+        "model": "gpt_small",
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "generate_tokens_per_sec": round(batch * new_tokens / dt, 1),
+        "ms_per_new_token_e2e": round(dt / new_tokens * 1e3, 3),
     }
 
 
@@ -413,7 +463,7 @@ def main() -> int:
 
     resnet = bench_resnet(batch, steps)
 
-    bert = trials = long_ctx = serving = None
+    bert = trials = long_ctx = serving = generate = None
     if suite == "all":
         try:
             bert = bench_bert(max(5, steps // 2))
@@ -427,6 +477,13 @@ def main() -> int:
             serving = bench_serving()
         except Exception as e:  # noqa: BLE001
             serving = {"error": f"{type(e).__name__}: {e}"}
+        if os.environ.get("KFT_BENCH_GENERATE") == "1":
+            # opt-in: XLA lowering of the 12-layer decode scan takes
+            # minutes — too slow for the default battery's budget
+            try:
+                generate = bench_generate()
+            except Exception as e:  # noqa: BLE001
+                generate = {"error": f"{type(e).__name__}: {e}"}
         if jax.default_backend() == "tpu":
             # last: the compiled-kernel path only exists on TPU
             try:
@@ -447,6 +504,7 @@ def main() -> int:
                 "bert_base_pretrain": bert,
                 "studyjob": trials,
                 "serving": serving,
+                "generate": generate,
                 "long_context_attention": long_ctx,
                 "device_kind": getattr(jax.devices()[0], "device_kind", "cpu"),
             }

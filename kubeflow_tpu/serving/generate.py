@@ -18,19 +18,6 @@ import jax
 import jax.numpy as jnp
 
 
-def _init_cache(model, batch: int):
-    """Zero-initialized decode cache with the model's shapes (no forward
-    pass: eval_shape traces init, then zeros materialize)."""
-    shapes = jax.eval_shape(
-        lambda: model.init(
-            jax.random.PRNGKey(0),
-            jnp.zeros((batch, 1), jnp.int32),
-            decode=True,
-        )["cache"]
-    )
-    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
-
-
 def greedy_generate(
     model,
     params,
@@ -47,11 +34,11 @@ def greedy_generate(
             f"prompt {p} + {max_new_tokens} new tokens exceeds "
             f"max_len {cfg.max_len}"
         )
-    cache = _init_cache(model, b)
-
-    # prefill: ONE causal forward over the prompt, seeding the cache
+    # prefill: ONE causal forward over the prompt; flax creates and seeds
+    # the cache collection on this apply (mutable=["cache"], no priming
+    # init needed)
     out, mutated = model.apply(
-        {"params": params, "cache": cache},
+        {"params": params},
         prompt_ids,
         prefill=True,
         mutable=["cache"],
@@ -80,3 +67,74 @@ def greedy_generate(
         + ([rest.T] if max_new_tokens > 1 else []),
         axis=1,
     )
+
+
+class ServedLm:
+    """A named generative model for the server's :generate endpoint.
+
+    Compile management: max_new_tokens is rounded UP to a power of two
+    (extra tokens generated then sliced off) so request-length jitter
+    doesn't mint new XLA programs, and the compiled-fn cache is a bounded
+    LRU — a client sweeping shapes costs recompiles, never unbounded
+    memory. Prompt length remains an exact shape key (padding a prompt
+    would change its content; the decode scan is lowered per length)."""
+
+    def __init__(
+        self, name: str, model, params, max_batch: int = 8, max_cached: int = 16
+    ):
+        from collections import OrderedDict
+
+        self.name = name
+        self.model = model
+        self.params = params
+        self.max_batch = max_batch
+        self.max_cached = max_cached
+        self._compiled = OrderedDict()
+
+    @staticmethod
+    def _bucket_tokens(n: int, headroom: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, headroom)
+
+    def generate(self, prompt_ids, max_new_tokens: int):
+        import numpy as np
+
+        x = np.asarray(prompt_ids, dtype=np.int32)
+        if x.ndim != 2:
+            raise ValueError("prompt_ids must be [batch, prompt_len]")
+        if x.shape[0] > self.max_batch:
+            raise ValueError(
+                f"batch {x.shape[0]} exceeds max_batch {self.max_batch}"
+            )
+        vocab = self.model.cfg.vocab_size
+        if x.size and (x.min() < 0 or x.max() >= vocab):
+            # nn.Embed clamps out-of-range gathers — a tokenizer bug would
+            # otherwise return confident garbage with HTTP 200
+            raise ValueError(f"prompt ids must be in [0, {vocab})")
+        n = int(max_new_tokens)
+        if n < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        headroom = self.model.cfg.max_len - x.shape[1]
+        if n > headroom:
+            raise ValueError(
+                f"prompt {x.shape[1]} + {n} new tokens exceeds "
+                f"max_len {self.model.cfg.max_len}"
+            )
+        n_bucket = self._bucket_tokens(n, headroom)
+        key = (x.shape[0], x.shape[1], n_bucket)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda p: greedy_generate(
+                    self.model, self.params, p, n_bucket
+                )
+            )
+            self._compiled[key] = fn
+            if len(self._compiled) > self.max_cached:
+                self._compiled.popitem(last=False)
+        else:
+            self._compiled.move_to_end(key)
+        out = np.asarray(jax.device_get(fn(jnp.asarray(x))))
+        return out[:, : x.shape[1] + n]

@@ -140,26 +140,34 @@ class ModelServer:
 
     def __init__(self) -> None:
         self._models: Dict[str, ServedModel] = {}
+        self._lms: Dict[str, Any] = {}  # ServedLm (serving/generate.py)
         self.app = self._build()
 
     def add(self, model: ServedModel) -> None:
         self._models[model.name] = model
 
+    def add_lm(self, lm) -> None:
+        """Register a generative model for :generate (ServedLm)."""
+        self._lms[lm.name] = lm
+
     def remove(self, name: str) -> None:
         self._models.pop(name, None)
+        self._lms.pop(name, None)
 
     def _build(self) -> App:
         app = App("model-server")
 
         @app.get("/v1/models/<name>")
         def model_status(req):
-            model = self._models.get(req.params["name"])
-            if model is None:
-                raise NotFoundError(f"model {req.params['name']} not loaded")
+            name = req.params["name"]
+            model = self._models.get(name)
+            if model is None and name not in self._lms:
+                raise NotFoundError(f"model {name} not loaded")
+            version = model.version if model is not None else "1"
             return {
                 "model_version_status": [
                     {
-                        "version": model.version,
+                        "version": version,
                         "state": "AVAILABLE",
                         "status": {"error_code": "OK", "error_message": ""},
                     }
@@ -222,12 +230,37 @@ class ModelServer:
             np.save(buf, y, allow_pickle=False)
             return Response(buf.getvalue(), "application/octet-stream")
 
+        @app.post("/v1/models/<name>:generate")
+        def generate(req):
+            """Autoregressive continuation (serving/generate.py): body
+            {"prompt_ids": [[...]], "max_new_tokens": N} → {"sequences":
+            [[prompt + continuation]]}. Greedy; KV-cache decode."""
+            lm = self._lms.get(req.params["name"])
+            if lm is None:
+                raise NotFoundError(
+                    f"generative model {req.params['name']} not loaded"
+                )
+            body = req.body or {}
+            prompt = body.get("prompt_ids")
+            if prompt is None:
+                raise BadRequest("request body must contain 'prompt_ids'")
+            try:
+                n = int(body.get("max_new_tokens", 16))
+                sequences = lm.generate(prompt, n)
+            except (ValueError, TypeError) as e:
+                raise BadRequest(f"bad generate request: {e}")
+            return {"sequences": sequences.tolist()}
+
         @app.get("/v1/models")
         def list_models(req):
             return {
                 "models": [
                     {"name": m.name, "version": m.version}
                     for m in self._models.values()
+                ]
+                + [
+                    {"name": lm.name, "version": "1", "generative": True}
+                    for lm in self._lms.values()
                 ]
             }
 

@@ -69,3 +69,106 @@ class TestGreedyGenerate:
         prompt = jnp.ones((1, 120), jnp.int32)
         with pytest.raises(ValueError, match="max_len"):
             greedy_generate(model, params, prompt, 32)
+
+
+class TestGenerateEndpoint:
+    """REST :generate over the model server (serving/server.py)."""
+
+    def _server(self, gpt_and_params):
+        from kubeflow_tpu.serving.generate import ServedLm
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model, params = gpt_and_params
+        server = ModelServer()
+        server.add_lm(ServedLm("gpt", model, params))
+        return server
+
+    def test_generate_roundtrip_matches_library(self, gpt_and_params):
+        model, params = gpt_and_params
+        server = self._server(gpt_and_params)
+        prompt = [[1, 2, 3, 4]]
+        status, body = server.app.handle(
+            "POST",
+            "/v1/models/gpt:generate",
+            body={"prompt_ids": prompt, "max_new_tokens": 5},
+        )
+        assert status == 200, body
+        seqs = body["sequences"]
+        assert len(seqs) == 1 and len(seqs[0]) == 9
+        want = greedy_generate(
+            model, params, jnp.asarray(prompt, jnp.int32), 5
+        )
+        assert seqs == np.asarray(want).tolist()
+
+    def test_missing_prompt_400(self, gpt_and_params):
+        server = self._server(gpt_and_params)
+        status, _ = server.app.handle(
+            "POST", "/v1/models/gpt:generate", body={}
+        )
+        assert status == 400
+
+    def test_overflow_400(self, gpt_and_params):
+        server = self._server(gpt_and_params)
+        status, body = server.app.handle(
+            "POST",
+            "/v1/models/gpt:generate",
+            body={"prompt_ids": [[1] * 120], "max_new_tokens": 64},
+        )
+        assert status == 400 and "max_len" in body["log"]
+
+    def test_unknown_model_404(self, gpt_and_params):
+        server = self._server(gpt_and_params)
+        status, _ = server.app.handle(
+            "POST", "/v1/models/ghost:generate", body={"prompt_ids": [[1]]}
+        )
+        assert status == 404
+
+    def test_compiled_shape_cache_reused(self, gpt_and_params):
+        from kubeflow_tpu.serving.generate import ServedLm
+
+        model, params = gpt_and_params
+        lm = ServedLm("gpt", model, params)
+        lm.generate([[1, 2, 3]], 4)
+        lm.generate([[4, 5, 6]], 4)  # same shape: no new compile
+        assert len(lm._compiled) == 1
+        lm.generate([[1, 2, 3, 4]], 4)  # new prompt length
+        assert len(lm._compiled) == 2
+
+    def test_vocab_bounds_rejected(self, gpt_and_params):
+        server = self._server(gpt_and_params)
+        status, body = server.app.handle(
+            "POST",
+            "/v1/models/gpt:generate",
+            body={"prompt_ids": [[700]], "max_new_tokens": 2},  # vocab 512
+        )
+        assert status == 400 and "ids must be in" in body["log"]
+
+    def test_discovery_lists_generative_models(self, gpt_and_params):
+        server = self._server(gpt_and_params)
+        status, body = server.app.handle("GET", "/v1/models")
+        assert status == 200
+        assert {"name": "gpt", "version": "1", "generative": True} in body["models"]
+        status, body = server.app.handle("GET", "/v1/models/gpt")
+        assert status == 200
+        assert body["model_version_status"][0]["state"] == "AVAILABLE"
+
+    def test_token_bucketing_bounds_compiles(self, gpt_and_params):
+        from kubeflow_tpu.serving.generate import ServedLm
+
+        model, params = gpt_and_params
+        lm = ServedLm("gpt", model, params)
+        a = lm.generate([[1, 2, 3]], 3)   # bucket 4
+        b = lm.generate([[1, 2, 3]], 4)   # same bucket: no new compile
+        assert len(lm._compiled) == 1
+        assert a.shape == (1, 6) and b.shape == (1, 7)
+        # greedy prefix stability: the 3-token result is a prefix of the 4
+        np.testing.assert_array_equal(a[0], b[0, :6])
+
+    def test_compile_cache_is_lru_bounded(self, gpt_and_params):
+        from kubeflow_tpu.serving.generate import ServedLm
+
+        model, params = gpt_and_params
+        lm = ServedLm("gpt", model, params, max_cached=2)
+        for p in (2, 3, 4):
+            lm.generate([list(range(p))], 2)
+        assert len(lm._compiled) == 2  # oldest evicted
