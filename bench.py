@@ -91,9 +91,10 @@ def _timed_steps(trainer, state, batch, rng, steps: int):
     t0 = time.monotonic()
     for _ in range(steps):
         state, metrics = trainer.train_step(state, batch, rng)
-    jax.block_until_ready(metrics["loss"])
-    dt = (time.monotonic() - t0) / steps
+    # the host round-trip is the fence: block_until_ready can return early
+    # over remote-device transports (tunnel), silently inflating throughput
     loss = float(jax.device_get(metrics["loss"]))
+    dt = (time.monotonic() - t0) / steps
     assert np.isfinite(loss), "non-finite loss in benchmark"
     return dt, state
 

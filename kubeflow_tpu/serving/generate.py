@@ -82,6 +82,7 @@ class ServedLm:
     def __init__(
         self, name: str, model, params, max_batch: int = 8, max_cached: int = 16
     ):
+        import threading
         from collections import OrderedDict
 
         self.name = name
@@ -90,6 +91,9 @@ class ServedLm:
         self.max_batch = max_batch
         self.max_cached = max_cached
         self._compiled = OrderedDict()
+        # the LRU (move_to_end/popitem) and device execution are not
+        # thread-safe; any threaded WSGI container would race without this
+        self._lock = threading.Lock()
 
     @staticmethod
     def _bucket_tokens(n: int, headroom: int) -> int:
@@ -124,17 +128,22 @@ class ServedLm:
             )
         n_bucket = self._bucket_tokens(n, headroom)
         key = (x.shape[0], x.shape[1], n_bucket)
-        fn = self._compiled.get(key)
-        if fn is None:
-            fn = jax.jit(
-                lambda p: greedy_generate(
-                    self.model, self.params, p, n_bucket
+        # lock covers only the LRU mutation; jax.jit() is lazy, so inserting
+        # the wrapper is cheap, and the actual compile + device execution run
+        # unlocked (jax dispatch is thread-safe) — a new shape compiling must
+        # not stall cache-hit requests behind it
+        with self._lock:
+            fn = self._compiled.get(key)
+            if fn is None:
+                fn = jax.jit(
+                    lambda p: greedy_generate(
+                        self.model, self.params, p, n_bucket
+                    )
                 )
-            )
-            self._compiled[key] = fn
-            if len(self._compiled) > self.max_cached:
-                self._compiled.popitem(last=False)
-        else:
-            self._compiled.move_to_end(key)
+                self._compiled[key] = fn
+                if len(self._compiled) > self.max_cached:
+                    self._compiled.popitem(last=False)
+            else:
+                self._compiled.move_to_end(key)
         out = np.asarray(jax.device_get(fn(jnp.asarray(x))))
         return out[:, : x.shape[1] + n]

@@ -241,6 +241,8 @@ class ModelServer:
                     f"generative model {req.params['name']} not loaded"
                 )
             body = req.body or {}
+            if not isinstance(body, dict):
+                raise BadRequest("request body must be a JSON object")
             prompt = body.get("prompt_ids")
             if prompt is None:
                 raise BadRequest("request body must contain 'prompt_ids'")

@@ -146,15 +146,19 @@ int sl_next(void* handle, const char** path, const uint8_t** data,
     return L->closing || L->ready.count(idx) > 0;
   });
   if (L->closing) return 0;
+  // copy out under the lock: once we unlock, a concurrent sl_release for
+  // this index may free the Buffer, so the reference must not outlive it
   Buffer& b = L->ready[idx];
+  const uint8_t* out_data = b.data;
+  int64_t out_size = b.size;
   *path = L->paths[idx].c_str();
-  *data = b.data;
-  *size = b.size;
+  *data = out_data;
+  *size = out_size;
   *index = idx;
   L->next_emit++;
   lock.unlock();
   L->cv.notify_all();  // window advanced: readers may claim more
-  return b.size < 0 ? -1 : 1;
+  return out_size < 0 ? -1 : 1;
 }
 
 // Return shard `index`'s buffer to the loader (frees it).

@@ -62,6 +62,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <map>
 #include <string>
 #include <vector>
 
@@ -251,13 +252,21 @@ bool tcp_barrier_coordinator(const Options& o, TcpGang* g, long start) {
   }
   set_nonblocking(fd);
   g->listen_fd = fd;
-  std::vector<int> conns;
-  std::vector<bool> got_ready;
-  int ready = 0;
-  while (ready < o.num_processes - 1) {
+  // Readiness is tracked per worker *id*, not per connection: a worker that
+  // restarts and reconnects replaces its old socket instead of double-
+  // counting, and a stray client (health probe, port scan) that never sends
+  // a well-formed `ready <id>` line can never release the barrier.
+  struct Conn {
+    int fd;
+    std::string buf;   // partial-line accumulator
+    int id = -1;       // worker id once its `ready <id>` line parsed
+  };
+  std::vector<Conn> conns;
+  std::map<int, int> ready_fd;  // worker id → fd (the live connection)
+  while ((int)ready_fd.size() < o.num_processes - 1) {
     if (g_signaled || gang_terminated(o)) return false;
     if (deadline_passed(o, start)) {
-      logmsg("tcp barrier timeout: %d/%d workers ready", ready,
+      logmsg("tcp barrier timeout: %zu/%d workers ready", ready_fd.size(),
              o.num_processes - 1);
       return false;
     }
@@ -265,22 +274,72 @@ bool tcp_barrier_coordinator(const Options& o, TcpGang* g, long start) {
     if (c >= 0) {
       set_nonblocking(c);
       ::setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      conns.push_back(c);
-      got_ready.push_back(false);
+      conns.push_back(Conn{c});
     }
-    for (size_t i = 0; i < conns.size(); i++) {
-      if (got_ready[i]) continue;
+    for (size_t i = 0; i < conns.size();) {
       char buf[64];
-      ssize_t n = ::recv(conns[i], buf, sizeof(buf), 0);
-      if (n > 0) {  // any line counts as that worker's `ready`
-        got_ready[i] = true;
-        ready++;
+      ssize_t n = ::recv(conns[i].fd, buf, sizeof(buf), 0);
+      bool dead = n == 0 ||
+                  (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                   errno != EINTR);  // RST from a crashed worker, not just FIN
+      if (dead) {  // peer gone: prune instead of waiting out the timeout
+        if (conns[i].id >= 0) {
+          logmsg("worker %d dropped before start; awaiting reconnect",
+                 conns[i].id);
+          ready_fd.erase(conns[i].id);
+        }
+        ::close(conns[i].fd);
+        conns.erase(conns.begin() + i);
+        continue;
       }
+      if (n > 0) {
+        conns[i].buf.append(buf, (size_t)n);
+        size_t nl;
+        while ((nl = conns[i].buf.find('\n')) != std::string::npos) {
+          std::string line = conns[i].buf.substr(0, nl);
+          conns[i].buf.erase(0, nl + 1);
+          int id = -1;
+          if (std::sscanf(line.c_str(), "ready %d", &id) == 1 && id >= 1 &&
+              id < o.num_processes) {
+            // one id per connection: a socket re-identifying under a new id
+            // relinquishes its old slot (otherwise one client could claim
+            // several readiness slots and release the barrier alone)
+            if (conns[i].id >= 0 && conns[i].id != id &&
+                ready_fd.count(conns[i].id) &&
+                ready_fd[conns[i].id] == conns[i].fd) {
+              ready_fd.erase(conns[i].id);
+            }
+            auto prev = ready_fd.find(id);
+            if (prev != ready_fd.end() && prev->second != conns[i].fd) {
+              // restarted worker: the fresh socket supersedes the stale one
+              int stale = prev->second;
+              for (size_t j = 0; j < conns.size(); j++) {
+                if (conns[j].fd == stale) {
+                  ::close(stale);
+                  conns.erase(conns.begin() + j);
+                  if (j < i) i--;  // keep pointing at the current conn
+                  break;
+                }
+              }
+            }
+            conns[i].id = id;
+            ready_fd[id] = conns[i].fd;
+          } else {
+            logmsg("ignoring malformed barrier line: %.40s", line.c_str());
+          }
+        }
+      }
+      i++;
     }
     ::usleep(o.poll_ms * 1000);
   }
-  for (int c : conns) send_line(c, "start\n");
-  g->peers = conns;
+  for (auto& kv : ready_fd) send_line(kv.second, "start\n");
+  g->peers.clear();
+  for (auto& kv : ready_fd) g->peers.push_back(kv.second);
+  // close any connection that never identified itself
+  for (auto& c : conns) {
+    if (c.id < 0 || ready_fd[c.id] != c.fd) ::close(c.fd);
+  }
   logmsg("tcp gang of %d ready; start sent", o.num_processes);
   return true;
 }

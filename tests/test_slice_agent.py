@@ -207,6 +207,107 @@ class TestTcpBarrier:
         assert w.wait(timeout=15) == 5  # gang failure propagates
         assert (tmp_path / "w" / "phase.1").read_text() == "Failed"
 
+    def test_stray_client_cannot_release_barrier(self, agent, tmp_path):
+        """A connection that never sends a well-formed `ready <id>` line
+        (health probe, port scan) must not count toward readiness: with one
+        real worker absent the coordinator times out instead of starting."""
+        import socket
+
+        port = free_port()
+        coord = ["--coordinator", f"127.0.0.1:{port}"]
+        c = run_agent(
+            agent, tmp_path / "c", 0, 3, payload=["true"],
+            timeout_ms=2500, extra=coord,
+        )
+        w = run_agent(
+            agent, tmp_path / "w", 1, 3, payload=["true"],
+            timeout_ms=2500, extra=coord,
+        )
+        time.sleep(0.3)
+        stray = socket.create_connection(("127.0.0.1", port), timeout=5)
+        stray.sendall(b"GET /healthz HTTP/1.0\r\n\r\n")
+        try:
+            assert c.wait(timeout=10) == 4  # barrier timeout, not start
+            assert w.wait(timeout=10) == 4
+        finally:
+            stray.close()
+
+    def test_restarted_worker_does_not_double_count(self, agent, tmp_path):
+        """Two connections carrying the same worker id are one ready vote:
+        a restarted worker reconnecting must not stand in for a missing
+        gang member."""
+        import socket
+
+        port = free_port()
+        coord = ["--coordinator", f"127.0.0.1:{port}"]
+        c = run_agent(
+            agent, tmp_path / "c", 0, 3, payload=["true"],
+            timeout_ms=2500, extra=coord,
+        )
+        time.sleep(0.3)
+        first = socket.create_connection(("127.0.0.1", port), timeout=5)
+        first.sendall(b"ready 1\n")
+        time.sleep(0.3)
+        first.close()  # worker 1 "restarts"
+        second = socket.create_connection(("127.0.0.1", port), timeout=5)
+        second.sendall(b"ready 1\n")
+        try:
+            # worker 2 never arrives: the duplicate id must not release it
+            assert c.wait(timeout=10) == 4
+        finally:
+            second.close()
+
+    def test_one_socket_cannot_claim_multiple_ids(self, agent, tmp_path):
+        """A single connection sending `ready 1\\nready 2\\n` holds ONE
+        readiness slot (the last id), so it can never release a barrier
+        that is short a real gang member."""
+        import socket
+
+        port = free_port()
+        coord = ["--coordinator", f"127.0.0.1:{port}"]
+        c = run_agent(
+            agent, tmp_path / "c", 0, 3, payload=["true"],
+            timeout_ms=2500, extra=coord,
+        )
+        time.sleep(0.3)
+        imposter = socket.create_connection(("127.0.0.1", port), timeout=5)
+        imposter.sendall(b"ready 1\nready 2\n")
+        try:
+            assert c.wait(timeout=10) == 4  # still 1/2 ready → timeout
+        finally:
+            imposter.close()
+
+    def test_worker_restart_then_full_gang_completes(self, agent, tmp_path):
+        """A worker that drops before the barrier fills and rejoins completes
+        the gang (the fresh socket supersedes the stale one). 3-process gang:
+        the ghost's drop happens while worker 2 is still absent, so the
+        barrier is provably re-armed for the rejoined worker."""
+        import socket
+
+        port = free_port()
+        coord = ["--coordinator", f"127.0.0.1:{port}"]
+        c = run_agent(
+            agent, tmp_path / "c", 0, 3, payload=["true"],
+            timeout_ms=8000, extra=coord,
+        )
+        time.sleep(0.3)
+        ghost = socket.create_connection(("127.0.0.1", port), timeout=5)
+        ghost.sendall(b"ready 1\n")
+        time.sleep(0.3)
+        ghost.close()
+        time.sleep(0.3)
+        workers = [
+            run_agent(
+                agent, tmp_path / f"w{i}", i, 3, payload=["true"],
+                timeout_ms=8000, extra=coord,
+            )
+            for i in (1, 2)
+        ]
+        assert c.wait(timeout=15) == 0
+        for i, w in zip((1, 2), workers):
+            assert w.wait(timeout=15) == 0
+            assert (tmp_path / f"w{i}" / f"phase.{i}").read_text() == "Succeeded"
+
 
 class TestBarrierArgsRendering:
     """The controller's barrier flag rendering (tpujob._barrier_args)."""
