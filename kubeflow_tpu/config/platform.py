@@ -178,6 +178,11 @@ class DataConfig(ConfigNode):
     num_examples: int = config_field(
         default=4096, help="generated dataset size (blobs)"
     )
+    augment: str = config_field(
+        default="none",
+        help="training augmentation: none | crop_flip (device-side "
+        "random-resized-crop + horizontal flip, training/augment.py)",
+    )
 
     def validate(self) -> None:
         if self.name not in ("synthetic", "blobs", "npz"):
@@ -186,6 +191,10 @@ class DataConfig(ConfigNode):
             )
         if not 0.0 <= self.eval_fraction < 1.0:
             raise ConfigError("data.eval_fraction must be in [0, 1)")
+        if self.augment not in ("none", "crop_flip"):
+            raise ConfigError(
+                f"data.augment must be none|crop_flip, got {self.augment!r}"
+            )
         if not 0.0 <= self.target_accuracy <= 1.0:
             raise ConfigError("data.target_accuracy must be in [0, 1]")
         if self.name == "npz" and not self.path:
@@ -225,6 +234,11 @@ class TrainingConfig(ConfigNode):
     data: DataConfig = config_field(default_factory=DataConfig)
     checkpoint: CheckpointConfig = config_field(default_factory=CheckpointConfig)
     remat: bool = config_field(default=False, help="jax.checkpoint rematerialisation")
+    label_smoothing: float = config_field(
+        default=0.0,
+        help="label-smoothing epsilon for classification losses "
+        "(0.1 in the ImageNet 76% recipe)",
+    )
     profiler_logdir: str = config_field(
         default="",
         help="non-empty: serve the jax.profiler capture endpoint "
@@ -236,6 +250,21 @@ class TrainingConfig(ConfigNode):
             raise ConfigError("global_batch_size must be >= 1")
         if self.dtype not in ("float32", "bfloat16"):
             raise ConfigError(f"dtype must be float32|bfloat16, got {self.dtype}")
+        if not 0.0 <= self.label_smoothing < 1.0:
+            raise ConfigError("label_smoothing must be in [0, 1)")
+        # these knobs are read only by the image-classification task; a
+        # BERT/GPT config carrying them would silently train without either
+        is_image = self.model.startswith(("resnet", "mlp"))
+        if self.label_smoothing > 0 and not is_image:
+            raise ConfigError(
+                f"label_smoothing applies to image-classification models "
+                f"only (model={self.model!r})"
+            )
+        if self.data.augment != "none" and not is_image:
+            raise ConfigError(
+                f"data.augment applies to image-classification models only "
+                f"(model={self.model!r})"
+            )
         dp = self.mesh.data * self.mesh.fsdp
         if self.global_batch_size % dp != 0:
             raise ConfigError(

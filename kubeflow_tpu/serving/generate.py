@@ -112,6 +112,10 @@ class ServedLm:
             raise ValueError(
                 f"batch {x.shape[0]} exceeds max_batch {self.max_batch}"
             )
+        if x.shape[1] < 1:
+            # an empty prompt would IndexError inside the prefill ([:, -1]
+            # on a size-0 axis) → opaque 500 instead of a 400
+            raise ValueError("prompt must contain at least one token")
         vocab = self.model.cfg.vocab_size
         if x.size and (x.min() < 0 or x.max() >= vocab):
             # nn.Embed clamps out-of-range gathers — a tokenizer bug would

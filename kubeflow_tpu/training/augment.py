@@ -1,0 +1,104 @@
+"""Device-side training augmentation: random-resized-crop + horizontal flip.
+
+The reference's benchmark harness gets ImageNet augmentation for free inside
+tf_cnn_benchmarks (reference: tf-controller-examples/tf-cnn/
+create_job_specs.py:101-121 launches it; README.md:9-20 points at the
+upstream harness whose input pipeline does distorted-bounding-box crops and
+flips on the CPU host). The TPU-native design moves augmentation ONTO the
+device, inside the jitted train step:
+
+- every op is static-shape (`jax.image.scale_and_translate` keeps the
+  output HxW fixed while the crop box is a traced per-image scale/translate
+  pair), so XLA fuses the whole thing into the step program — no host
+  round-trip, no dynamic shapes, no per-image Python;
+- randomness is `jax.random` keyed by fold_in(step_rng, image_index):
+  a pure function of (seed, step, index). A restarted gang replays the
+  exact same crops — the same checkpoint/resume determinism contract
+  ArrayDataset gives batches (training/datasets.py);
+- the resample itself lowers to two small per-image matmul contractions
+  (separable linear resampling), which is MXU work, not gather soup.
+
+The recipe matches the standard ResNet ImageNet setup: crop area sampled
+uniform in [0.08, 1] of the image, aspect ratio log-uniform in [3/4, 4/3],
+resized back to the native resolution, then a 50% horizontal flip. Eval
+stays un-augmented (datasets are stored pre-resized center images).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def random_resized_crop_flip(
+    rng: jax.Array,
+    images: jax.Array,
+    scale: Tuple[float, float] = (0.08, 1.0),
+    ratio: Tuple[float, float] = (3.0 / 4.0, 4.0 / 3.0),
+    flip_prob: float = 0.5,
+) -> jax.Array:
+    """Batched random-resized-crop + horizontal flip, [B,H,W,C] → [B,H,W,C].
+
+    Pure in (rng, images): the same key always produces the same crops.
+    Image i uses fold_in(rng, i), so the transform of a given example is
+    independent of its position-neighbours and reproducible across restarts
+    and resharding.
+    """
+    b, h, w, c = images.shape
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        rng, jnp.arange(b, dtype=jnp.uint32)
+    )
+
+    def one(key: jax.Array, img: jax.Array) -> jax.Array:
+        k_area, k_ratio, k_y, k_x, k_flip = jax.random.split(key, 5)
+        area = (
+            jax.random.uniform(k_area, minval=scale[0], maxval=scale[1])
+            * h
+            * w
+        )
+        log_ratio = jax.random.uniform(
+            k_ratio,
+            minval=jnp.log(jnp.float32(ratio[0])),
+            maxval=jnp.log(jnp.float32(ratio[1])),
+        )
+        r = jnp.exp(log_ratio)
+        # crop box (float sizes are fine: the resample is continuous)
+        crop_h = jnp.clip(jnp.sqrt(area / r), 1.0, h)
+        crop_w = jnp.clip(jnp.sqrt(area * r), 1.0, w)
+        off_y = jax.random.uniform(k_y) * (h - crop_h)
+        off_x = jax.random.uniform(k_x) * (w - crop_w)
+        # scale_and_translate maps input coord i → output coord
+        # scale*i + translation; crop [off, off+crop) must fill [0, size)
+        sy = h / crop_h
+        sx = w / crop_w
+        out = jax.image.scale_and_translate(
+            img,
+            (h, w, c),
+            (0, 1),
+            jnp.stack([sy, sx]),
+            jnp.stack([-off_y * sy, -off_x * sx]),
+            method="linear",
+            antialias=False,  # crops only upscale (area <= 1.0 of source)
+        )
+        flip = jax.random.bernoulli(k_flip, flip_prob)
+        return jnp.where(flip, out[:, ::-1, :], out)
+
+    return jax.vmap(one)(keys, images).astype(images.dtype)
+
+
+def augment_image_batch(rng: jax.Array, batch: dict, kind: str) -> dict:
+    """Apply the configured augmentation to a {image, label} batch.
+
+    `kind` comes from DataConfig.augment: "none" passes through,
+    "crop_flip" is the ResNet ImageNet recipe above. Labels are untouched
+    (crop/flip are label-preserving transforms).
+    """
+    if kind == "none" or "image" not in batch:
+        return batch
+    if kind != "crop_flip":  # validated upstream; defensive
+        raise ValueError(f"unknown augmentation {kind!r}")
+    out = dict(batch)
+    out["image"] = random_resized_crop_flip(rng, batch["image"])
+    return out

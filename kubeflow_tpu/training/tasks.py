@@ -18,12 +18,26 @@ from kubeflow_tpu.config.platform import TrainingConfig
 from kubeflow_tpu.training.data import SyntheticData
 
 
-def cross_entropy(logits: jax.Array, labels: jax.Array, ignore: int = -1000000):
-    """Mean CE over labels != ignore; logits float32 [..., C], labels int."""
+def cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    ignore: int = -1000000,
+    label_smoothing: float = 0.0,
+):
+    """Mean CE over labels != ignore; logits float32 [..., C], labels int.
+
+    With label_smoothing ε the target is (1-ε)·onehot + ε/K uniform, i.e.
+    loss = (1-ε)·NLL + ε·mean_classes(-log p) — the ImageNet 76% recipe
+    uses ε=0.1 (VERDICT r2 item 1; the reference harness applied it inside
+    tf_cnn_benchmarks)."""
     valid = labels != ignore
     safe_labels = jnp.where(valid, labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    if label_smoothing:
+        ll = (1.0 - label_smoothing) * ll + label_smoothing * jnp.mean(
+            logp, axis=-1
+        )
     ll = jnp.where(valid, ll, 0.0)
     count = jnp.maximum(valid.sum(), 1)
     return -ll.sum() / count
@@ -79,13 +93,23 @@ class ImageClassificationTask:
     ) -> Tuple[jax.Array, Dict[str, Any]]:
         variables = {"params": params, **extra_vars}
         if train:
+            if self.cfg.data.augment != "none" and rngs:
+                from kubeflow_tpu.training.augment import augment_image_batch
+
+                batch = augment_image_batch(
+                    rngs["augment"], batch, self.cfg.data.augment
+                )
             logits, updates = model.apply(
                 variables, batch["image"], train=True, mutable=["batch_stats"]
             )
         else:
             logits = model.apply(variables, batch["image"], train=False)
             updates = {}
-        loss = cross_entropy(logits, batch["label"])
+        loss = cross_entropy(
+            logits,
+            batch["label"],
+            label_smoothing=self.cfg.label_smoothing if train else 0.0,
+        )
         acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
         return loss, {"aux": {"accuracy": acc}, "var_updates": updates}
 

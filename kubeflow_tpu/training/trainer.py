@@ -187,7 +187,14 @@ class Trainer:
         shardings = self._state_shardings
 
         def step_fn(state: TrainState, batch, rng):
-            rngs = {"dropout": jax.random.fold_in(rng, state.step)}
+            # every stream is a pure function of (seed rng, step): a
+            # restarted gang resuming from a checkpoint replays identical
+            # dropout masks and augmentation crops (resume determinism)
+            step_rng = jax.random.fold_in(rng, state.step)
+            rngs = {
+                "dropout": step_rng,
+                "augment": jax.random.fold_in(step_rng, 1),
+            }
 
             def loss_fn(params):
                 loss, out = task.loss(

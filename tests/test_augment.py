@@ -1,0 +1,220 @@
+"""Device-side augmentation: determinism, recipe correctness, restart replay.
+
+The 76% ImageNet recipe (configs/resnet50_imagenet_v5e16.yaml) depends on
+random-resized-crop + flip + label smoothing; these tests pin down the
+properties the recipe and checkpoint/resume rely on (VERDICT r2 item 1).
+Reference precedent: the tf-cnn harness inherited augmentation from
+tf_cnn_benchmarks (tf-controller-examples/tf-cnn/README.md:9-20).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.config.platform import ConfigError, MeshConfig, TrainingConfig
+from kubeflow_tpu.training.augment import (
+    augment_image_batch,
+    random_resized_crop_flip,
+)
+from kubeflow_tpu.training.tasks import cross_entropy
+
+
+def images(b=8, h=16, w=16, c=3, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), (b, h, w, c))
+
+
+class TestRandomResizedCropFlip:
+    def test_shape_and_dtype_preserved(self):
+        x = images()
+        y = random_resized_crop_flip(jax.random.PRNGKey(1), x)
+        assert y.shape == x.shape and y.dtype == x.dtype
+
+    def test_deterministic_in_key(self):
+        x = images()
+        a = random_resized_crop_flip(jax.random.PRNGKey(7), x)
+        b = random_resized_crop_flip(jax.random.PRNGKey(7), x)
+        c = random_resized_crop_flip(jax.random.PRNGKey(8), x)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    def test_identity_when_crop_disabled(self):
+        """scale=(1,1) ratio=(1,1) flip_prob=0 is the identity transform —
+        the resample path itself must not distort pixels."""
+        x = images()
+        y = random_resized_crop_flip(
+            jax.random.PRNGKey(3), x, scale=(1.0, 1.0), ratio=(1.0, 1.0),
+            flip_prob=0.0,
+        )
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-5)
+
+    def test_pure_flip_produces_mirrored_or_identical_images(self):
+        """With the crop fixed to the full image, every output row is either
+        the original or its exact horizontal mirror — and with 64 images
+        both outcomes occur."""
+        x = images(b=64)
+        y = np.asarray(
+            random_resized_crop_flip(
+                jax.random.PRNGKey(5), x, scale=(1.0, 1.0), ratio=(1.0, 1.0)
+            )
+        )
+        xn = np.asarray(x)
+        flipped = xn[:, :, ::-1, :]
+        kinds = []
+        for i in range(64):
+            if np.allclose(y[i], xn[i], atol=1e-5):
+                kinds.append("id")
+            elif np.allclose(y[i], flipped[i], atol=1e-5):
+                kinds.append("flip")
+            else:
+                kinds.append("other")
+        assert "other" not in kinds
+        assert 10 < kinds.count("flip") < 54  # ~Binomial(64, 0.5)
+
+    def test_per_image_independence(self):
+        """Image i's transform depends on fold_in(rng, i), not on its
+        neighbours: the first image of a 2-batch and an 8-batch match."""
+        x = images(b=8)
+        small = random_resized_crop_flip(jax.random.PRNGKey(9), x[:2])
+        big = random_resized_crop_flip(jax.random.PRNGKey(9), x)
+        np.testing.assert_allclose(
+            np.asarray(small), np.asarray(big[:2]), atol=1e-6
+        )
+
+    def test_crops_stay_in_range(self):
+        """Augmented pixels are convex combinations of source pixels (linear
+        resample, no antialias ringing beyond the value range)."""
+        x = jnp.clip(images(b=16), -1.0, 1.0)
+        y = np.asarray(random_resized_crop_flip(jax.random.PRNGKey(11), x))
+        assert y.min() >= -1.0 - 1e-4 and y.max() <= 1.0 + 1e-4
+
+    def test_augment_image_batch_dispatch(self):
+        x = images()
+        batch = {"image": x, "label": jnp.zeros((8,), jnp.int32)}
+        out = augment_image_batch(jax.random.PRNGKey(0), batch, "none")
+        assert out["image"] is x
+        out = augment_image_batch(jax.random.PRNGKey(0), batch, "crop_flip")
+        assert out["image"].shape == x.shape
+        np.testing.assert_array_equal(
+            np.asarray(out["label"]), np.asarray(batch["label"])
+        )
+        with pytest.raises(ValueError):
+            augment_image_batch(jax.random.PRNGKey(0), batch, "cutmix")
+
+
+class TestLabelSmoothing:
+    def test_matches_manual(self):
+        logits = jnp.array([[2.0, 0.0, -1.0], [0.0, 1.0, 3.0]])
+        labels = jnp.array([0, 2])
+        eps = 0.1
+        logp = jax.nn.log_softmax(logits)
+        onehot = jax.nn.one_hot(labels, 3)
+        target = (1 - eps) * onehot + eps / 3.0
+        expected = float(-(target * logp).sum(-1).mean())
+        got = float(cross_entropy(logits, labels, label_smoothing=eps))
+        assert got == pytest.approx(expected, rel=1e-6)
+
+    def test_zero_smoothing_unchanged(self):
+        logits = jnp.array([[2.0, 0.0], [0.0, 2.0]])
+        labels = jnp.array([0, 1])
+        assert float(cross_entropy(logits, labels)) == pytest.approx(
+            float(cross_entropy(logits, labels, label_smoothing=0.0))
+        )
+
+    def test_config_validates_range(self):
+        with pytest.raises(ConfigError):
+            TrainingConfig(label_smoothing=1.0).validate()
+
+    def test_config_rejects_recipe_knobs_for_non_image_models(self):
+        from kubeflow_tpu.config.platform import DataConfig
+
+        with pytest.raises(ConfigError):
+            TrainingConfig(model="bert_base", label_smoothing=0.1).validate()
+        with pytest.raises(ConfigError):
+            TrainingConfig(
+                model="gpt_small", data=DataConfig(augment="crop_flip")
+            ).validate()
+        TrainingConfig(
+            model="resnet50",
+            label_smoothing=0.1,
+            data=DataConfig(augment="crop_flip"),
+        ).validate()
+
+
+class TestTrainStepAugmentation:
+    """The recipe wired through the Trainer: augmentation runs inside the
+    jitted step, is deterministic in (seed, step), and replays identically
+    across a simulated restart."""
+
+    def _trainer(self, tmp_path=None, **data_kw):
+        from kubeflow_tpu.config.platform import (
+            CheckpointConfig,
+            DataConfig,
+        )
+        from kubeflow_tpu.training.trainer import Trainer
+
+        ckpt = (
+            CheckpointConfig(
+                enabled=True, directory=str(tmp_path), interval_steps=1,
+                async_save=False,
+            )
+            if tmp_path
+            else CheckpointConfig(enabled=False)
+        )
+        cfg = TrainingConfig(
+            model="mlp",
+            global_batch_size=8,
+            steps=3,
+            warmup_steps=1,
+            learning_rate=0.05,
+            label_smoothing=0.1,
+            mesh=MeshConfig(data=8),
+            data=DataConfig(name="blobs", augment="crop_flip", **data_kw),
+            checkpoint=ckpt,
+        )
+        return Trainer(cfg)
+
+    def test_augmented_step_deterministic(self, devices8):
+        from kubeflow_tpu.training.datasets import build_data
+
+        tr = self._trainer()
+        data, _ = build_data(tr.cfg, tr.task)
+        batch = data.batch_at(0)
+        rng = jax.random.PRNGKey(0)
+        s1, m1 = tr.train_step(tr.init_state(), batch, rng)
+        s2, m2 = tr.train_step(tr.init_state(), batch, rng)
+        assert float(m1["loss"]) == pytest.approx(float(m2["loss"]))
+
+    def test_restart_replays_identical_augmentation(self, devices8, tmp_path):
+        """Train 3 steps straight vs. restart-from-step-1 checkpoint: the
+        step-2/3 losses match exactly — crops are a pure function of
+        (seed, step, index), so resume does not fork the data distribution."""
+        from kubeflow_tpu.training.checkpoint import CheckpointManager
+        from kubeflow_tpu.training.datasets import build_data
+
+        tr = self._trainer(tmp_path)
+        data, _ = build_data(tr.cfg, tr.task)
+        rng = jax.random.PRNGKey(0)
+        state = tr.init_state()
+        losses = []
+        for step in range(3):
+            state, m = tr.train_step(state, data.batch_at(step), rng)
+            losses.append(float(m["loss"]))
+            if step == 0:
+                mgr = CheckpointManager(str(tmp_path), async_save=False)
+                mgr.save(int(jax.device_get(state.step)), state)
+                mgr.wait()
+                mgr.close()
+
+        tr2 = self._trainer(tmp_path)
+        data2, _ = build_data(tr2.cfg, tr2.task)
+        state2 = tr2.init_state()
+        mgr2 = CheckpointManager(str(tmp_path), async_save=False)
+        state2 = mgr2.restore(state2)
+        mgr2.close()
+        assert int(jax.device_get(state2.step)) == 1
+        relosses = []
+        for step in range(1, 3):
+            state2, m = tr2.train_step(state2, data2.batch_at(step), rng)
+            relosses.append(float(m["loss"]))
+        assert relosses == pytest.approx(losses[1:], rel=1e-5)

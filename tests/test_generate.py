@@ -143,6 +143,22 @@ class TestGenerateEndpoint:
         )
         assert status == 400 and "ids must be in" in body["log"]
 
+    def test_empty_prompt_rejected(self, gpt_and_params):
+        server = self._server(gpt_and_params)
+        status, body = server.app.handle(
+            "POST",
+            "/v1/models/gpt:generate",
+            body={"prompt_ids": [[]], "max_new_tokens": 2},
+        )
+        assert status == 400 and "at least one token" in body["log"]
+
+    def test_non_object_body_rejected(self, gpt_and_params):
+        server = self._server(gpt_and_params)
+        status, body = server.app.handle(
+            "POST", "/v1/models/gpt:generate", body=[1, 2, 3]
+        )
+        assert status == 400
+
     def test_discovery_lists_generative_models(self, gpt_and_params):
         server = self._server(gpt_and_params)
         status, body = server.app.handle("GET", "/v1/models")
