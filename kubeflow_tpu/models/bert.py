@@ -24,6 +24,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+from kubeflow_tpu.models.layers import MoeMlp as SharedMoeMlp
 from kubeflow_tpu.models.registry import register_model
 from kubeflow_tpu.parallel.sharding import shard_constraint
 
@@ -132,67 +133,20 @@ class Mlp(nn.Module):
         return h
 
 
-class MoeMlp(nn.Module):
-    """Switch-routed expert MLP over the `expert` mesh axis.
-
-    Expert weights are stacked [E, ...] (logical axis "expert"); the
-    dispatch/combine einsums against the routing tensor reshard tokens
-    batch-major → expert-major and back, which XLA lowers to all_to_all
-    when the expert axis is real. See parallel/moe.py.
-    """
-
-    cfg: BertConfig
-
-    @nn.compact
-    def __call__(self, x, deterministic: bool):
-        from kubeflow_tpu.parallel.moe import expert_capacity, topk_route
-
-        cfg = self.cfg
-        b, s, d = x.shape
-        e = cfg.num_experts
-        # top-2 tokens occupy two slots each: scale capacity with k
-        c = expert_capacity(
-            s * cfg.moe_top_k, e, cfg.expert_capacity_factor
-        )
-
-        router = self.param(
-            "router",
-            nn.initializers.normal(stddev=0.02),
-            (d, e),
-            jnp.float32,
-        )
-        logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32), router)
-        route = topk_route(logits, c, k=cfg.moe_top_k)
-
-        init = nn.initializers.variance_scaling(
-            1.0, "fan_in", "truncated_normal", in_axis=-2, out_axis=-1
-        )
-        wi = self.param("wi", init, (e, d, cfg.mlp_dim), jnp.float32)
-        wo = self.param("wo", init, (e, cfg.mlp_dim, d), jnp.float32)
-
-        dispatch = route.dispatch.astype(cfg.dtype)
-        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
-        expert_in = shard_constraint(
-            expert_in, ("act_expert", "batch", None, None)
-        )
-        h = jnp.einsum("ebcd,edf->ebcf", expert_in, wi.astype(cfg.dtype))
-        h = nn.gelu(h, approximate=True)
-        out_e = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(cfg.dtype))
-        out_e = shard_constraint(out_e, ("act_expert", "batch", None, None))
-        y = jnp.einsum("bsec,ebcd->bsd", route.combine.astype(cfg.dtype), out_e)
-
-        # weighted load-balance loss, summed into the task loss via the
-        # mutable "losses" collection (a no-op when not mutable: eval/serve)
-        self.sow(
-            "losses",
-            "moe_aux",
-            cfg.moe_aux_weight * route.aux_loss,
-            reduce_fn=lambda a, b: a + b,
-            init_fn=lambda: jnp.zeros((), jnp.float32),
-        )
-        if cfg.dropout_rate > 0:
-            y = nn.Dropout(cfg.dropout_rate)(y, deterministic=deterministic)
-        return y
+def _moe_mlp(cfg: BertConfig, name: str = "moe") -> SharedMoeMlp:
+    """Bind the shared routed-expert MLP (models/layers.py, also used by
+    the GPT family) to a BertConfig; the param tree stays
+    `moe/{router,wi,wo}`."""
+    return SharedMoeMlp(
+        mlp_dim=cfg.mlp_dim,
+        num_experts=cfg.num_experts,
+        top_k=cfg.moe_top_k,
+        capacity_factor=cfg.expert_capacity_factor,
+        aux_weight=cfg.moe_aux_weight,
+        dtype=cfg.dtype,
+        dropout_rate=cfg.dropout_rate,
+        name=name,
+    )
 
 
 class EncoderLayer(nn.Module):
@@ -204,7 +158,7 @@ class EncoderLayer(nn.Module):
         y = SelfAttention(cfg, name="attention")(x, mask, deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x + y)
         if cfg.num_experts > 0:
-            y = MoeMlp(cfg, name="moe")(x, deterministic)
+            y = _moe_mlp(cfg)(x, deterministic)
         else:
             y = Mlp(cfg, name="mlp")(x, deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y)
@@ -231,16 +185,17 @@ class PipelinedEncoder(nn.Module):
     """Encoder stack as a GPipe pipeline over the `pipeline` mesh axis.
 
     Stage params are stacked [S, ...] by nn.vmap (annotated "stage" →
-    pipeline by training/annotations.py); execution is the microbatch
-    schedule in parallel/pipeline.py.
+    pipeline by training/annotations.py); execution is the scanned
+    microbatch schedule in models/layers.py (one traced tick — compile
+    cost is schedule-length-independent).
     """
 
     cfg: BertConfig
 
     @nn.compact
     def __call__(self, x, mask, deterministic: bool):
+        from kubeflow_tpu.models.layers import clamp_microbatches, pipeline_scan
         from kubeflow_tpu.parallel.pipeline import (
-            gpipe,
             microbatch,
             pipeline_stage_slices,
             unmicrobatch,
@@ -251,24 +206,14 @@ class PipelinedEncoder(nn.Module):
         layers_per_stage, s = pipeline_stage_slices(
             cfg.num_layers, cfg.pipeline_stages
         )
-        # clamp microbatches to a divisor of the batch (init traces the
-        # model with a single example; param shapes don't depend on m)
-        m = min(cfg.num_microbatches or s, x.shape[0])
-        while x.shape[0] % m:
-            m -= 1
-        stack = nn.vmap(
+        m = clamp_microbatches(cfg.num_microbatches, s, x.shape[0])
+        out = pipeline_scan(
+            self,
             StageBlock,
-            in_axes=(0, 0, None),
-            out_axes=0,
-            variable_axes={"params": 0},
-            split_rngs={"params": True, "dropout": True},
-        )(cfg, layers_per_stage, name="stages")
-        x_mb = microbatch(x, m)
-        mask_mb = microbatch(mask, m)
-        out = gpipe(
-            lambda st, mk: stack(st, mk, deterministic),
-            x_mb,
-            [mask_mb],
+            (cfg, layers_per_stage),
+            microbatch(x, m),
+            [microbatch(mask, m)],
+            deterministic,
             num_stages=s,
             state_spec=logical_to_spec(
                 ("stage", "batch", "seq", "act_embed")
@@ -314,12 +259,6 @@ class Bert(nn.Module):
         x = x.astype(cfg.dtype)
         x = shard_constraint(x, ("batch", "seq", "act_embed"))
 
-        if cfg.pipeline_stages > 1 and cfg.num_experts > 0:
-            raise ValueError(
-                "pipeline_stages > 1 with num_experts > 0 is not supported: "
-                "the stacked-stage vmap does not map the MoE 'losses' "
-                "collection; run EP with data/fsdp/tensor axes instead"
-            )
         if cfg.pipeline_stages > 1:
             x = PipelinedEncoder(cfg, name="encoder")(
                 x, attention_mask, deterministic

@@ -25,10 +25,14 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from kubeflow_tpu.models.layers import MoeMlp
 from kubeflow_tpu.models.registry import register_model
 from kubeflow_tpu.parallel.sharding import shard_constraint
 
-GPT_ATTENTION_IMPLS = ("dense", "flash", "auto")
+# "ring" (SP: KV rotation with global-position causal masking) and
+# "ulysses" (SP: head all_to_all) complete the causal family's parallelism
+# menu — the same strategies the encoder family has (models/bert.py).
+GPT_ATTENTION_IMPLS = ("dense", "flash", "auto", "ring", "ulysses")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,8 +45,20 @@ class GptConfig:
     max_len: int = 1024
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
-    attention_impl: str = "dense"  # "dense" | "flash" | "auto"
+    # "dense" | "flash" | "auto" | "ring" | "ulysses"
+    attention_impl: str = "dense"
     remat: bool = False
+    # pipeline parallelism: >1 stacks the decoder into stages sharded over
+    # the `pipeline` mesh axis, run by the scanned microbatch schedule
+    # (models/layers.py pipeline_scan). num_layers % stages == 0.
+    pipeline_stages: int = 1
+    num_microbatches: int = 0  # 0 = pipeline_stages
+    # expert parallelism: >0 replaces every MLP with a routed MoE stacked
+    # on the `expert` mesh axis (models/layers.py MoeMlp).
+    num_experts: int = 0
+    moe_top_k: int = 1
+    expert_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
 
 class CausalSelfAttention(nn.Module):
@@ -144,6 +160,18 @@ class CausalSelfAttention(nn.Module):
             out = flash_attention(q, k, v, mask=mask, causal=True).astype(
                 cfg.dtype
             )
+        elif impl == "ring":
+            from kubeflow_tpu.parallel.ring_attention import ring_attention
+
+            out = ring_attention(
+                q, k, v, mask=mask, dtype=cfg.dtype, causal=True
+            )
+        elif impl == "ulysses":
+            from kubeflow_tpu.parallel.ulysses import ulysses_attention
+
+            out = ulysses_attention(
+                q, k, v, mask=mask, dtype=cfg.dtype, causal=True
+            )
         else:
             from kubeflow_tpu.ops.attention import dense_attention
 
@@ -179,16 +207,88 @@ class DecoderBlock(nn.Module):
             prefill=prefill,
         )
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
-        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="mlp_wi")(
-            h.astype(cfg.dtype)
-        )
-        h = shard_constraint(h, ("batch", "seq", "act_mlp"))
-        h = nn.gelu(h, approximate=True)
-        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_wo")(h)
-        if cfg.dropout_rate > 0:
-            h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        if cfg.num_experts > 0:
+            h = MoeMlp(
+                mlp_dim=cfg.mlp_dim,
+                num_experts=cfg.num_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.expert_capacity_factor,
+                aux_weight=cfg.moe_aux_weight,
+                dtype=cfg.dtype,
+                dropout_rate=cfg.dropout_rate,
+                name="moe",
+            )(h.astype(cfg.dtype), deterministic)
+        else:
+            h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="mlp_wi")(
+                h.astype(cfg.dtype)
+            )
+            h = shard_constraint(h, ("batch", "seq", "act_mlp"))
+            h = nn.gelu(h, approximate=True)
+            h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_wo")(h)
+            if cfg.dropout_rate > 0:
+                h = nn.Dropout(cfg.dropout_rate)(
+                    h, deterministic=deterministic
+                )
         x = x + h
         return shard_constraint(x, ("batch", "seq", "act_embed"))
+
+
+class DecoderStage(nn.Module):
+    """One pipeline stage: a contiguous run of decoder blocks."""
+
+    cfg: GptConfig
+    layers_per_stage: int
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        block_cls = DecoderBlock
+        if self.cfg.remat:
+            block_cls = nn.remat(DecoderBlock, static_argnums=(3,))
+        for i in range(self.layers_per_stage):
+            x = block_cls(self.cfg, name=f"layer_{i}")(x, mask, deterministic)
+        return x
+
+
+class PipelinedDecoder(nn.Module):
+    """Decoder stack as a GPipe pipeline over the `pipeline` mesh axis.
+
+    Stage params are stacked [S, ...] by nn.vmap (annotated "stage" →
+    pipeline by training/annotations.py); execution is the scanned
+    microbatch schedule shared with the encoder family
+    (models/layers.py pipeline_scan).
+    """
+
+    cfg: GptConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        from kubeflow_tpu.models.layers import clamp_microbatches, pipeline_scan
+        from kubeflow_tpu.parallel.pipeline import (
+            microbatch,
+            pipeline_stage_slices,
+            unmicrobatch,
+        )
+        from kubeflow_tpu.parallel.sharding import logical_to_spec
+
+        cfg = self.cfg
+        layers_per_stage, s = pipeline_stage_slices(
+            cfg.num_layers, cfg.pipeline_stages
+        )
+        m = clamp_microbatches(cfg.num_microbatches, s, x.shape[0])
+        out = pipeline_scan(
+            self,
+            DecoderStage,
+            (cfg, layers_per_stage),
+            microbatch(x, m),
+            [microbatch(mask, m)],
+            deterministic,
+            num_stages=s,
+            state_spec=logical_to_spec(
+                ("stage", "batch", "seq", "act_embed")
+            ),
+            travel_specs=[logical_to_spec(("stage", "batch", "seq"))],
+        )
+        return unmicrobatch(out)
 
 
 class Gpt(nn.Module):
@@ -234,13 +334,22 @@ class Gpt(nn.Module):
         x = (tok + pos).astype(cfg.dtype)
         x = shard_constraint(x, ("batch", "seq", "act_embed"))
 
-        block_cls = DecoderBlock
-        if cfg.remat:
-            block_cls = nn.remat(DecoderBlock, static_argnums=(3, 4, 5))
-        for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"layer_{i}")(
-                x, mask, deterministic, decode, prefill
-            )
+        if cfg.pipeline_stages > 1:
+            if decode or prefill:
+                raise ValueError(
+                    "pipelined decoding is not supported: serve with "
+                    "pipeline_stages=1 (the KV-cache decode path has no "
+                    "microbatch schedule)"
+                )
+            x = PipelinedDecoder(cfg, name="decoder")(x, mask, deterministic)
+        else:
+            block_cls = DecoderBlock
+            if cfg.remat:
+                block_cls = nn.remat(DecoderBlock, static_argnums=(3, 4, 5))
+            for i in range(cfg.num_layers):
+                x = block_cls(cfg, name=f"layer_{i}")(
+                    x, mask, deterministic, decode, prefill
+                )
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         logits = nn.Dense(
@@ -262,6 +371,14 @@ def gpt_medium(**kwargs) -> Gpt:
     return Gpt(GptConfig(**defaults))
 
 
+@register_model("gpt_small_moe")
+def gpt_small_moe(**kwargs) -> Gpt:
+    """GPT-2-small with every MLP a Switch MoE (8 experts by default)."""
+    defaults = dict(num_experts=8)
+    defaults.update(kwargs)
+    return Gpt(GptConfig(**defaults))
+
+
 @register_model("gpt_tiny")
 def gpt_tiny(**kwargs) -> Gpt:
     """Test-scale config (CI runs on a virtual CPU mesh)."""
@@ -272,6 +389,22 @@ def gpt_tiny(**kwargs) -> Gpt:
         num_heads=4,
         mlp_dim=128,
         max_len=128,
+    )
+    defaults.update(kwargs)
+    return Gpt(GptConfig(**defaults))
+
+
+@register_model("gpt_tiny_moe")
+def gpt_tiny_moe(**kwargs) -> Gpt:
+    """Test-scale MoE config (4 experts on the virtual mesh)."""
+    defaults = dict(
+        vocab_size=512,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        mlp_dim=128,
+        max_len=128,
+        num_experts=4,
     )
     defaults.update(kwargs)
     return Gpt(GptConfig(**defaults))

@@ -68,18 +68,21 @@ def ulysses_attention(
     v: jax.Array,
     mask: Optional[jax.Array] = None,
     dtype=jnp.bfloat16,
+    causal: bool = False,
 ) -> jax.Array:
     """Attention over [B, S, H, D] inputs sharded on the sequence axis.
 
     heads must be divisible by the `sequence` mesh axis size (checked by
     the partitioner at compile time — e.g. 12 heads on sequence=4).
+    causal=True works unchanged: each device holds its heads' FULL
+    sequence after the all_to_all, so the autoregressive mask is local.
     """
     # scatter: seq-sharded -> head-sharded (XLA inserts the all_to_all)
     q = _constrain(q, HEAD_SHARDED)
     k = _constrain(k, HEAD_SHARDED)
     v = _constrain(v, HEAD_SHARDED)
 
-    out = dense_attention(q, k, v, mask=mask, dtype=dtype)
+    out = dense_attention(q, k, v, mask=mask, dtype=dtype, causal=causal)
 
     # gather: head-sharded -> seq-sharded (the second all_to_all)
     return _constrain(out, SEQ_SHARDED)

@@ -8,7 +8,7 @@ synthetic batches — the Trainer is task-agnostic.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +63,19 @@ def _masked_token_stats(
     )
     loss_sum = jnp.sum(_nll(logits, safe) * valid)
     return {"correct": correct, "count": valid.sum(), "loss_sum": loss_sum}
+
+
+def _sown_loss_sum(sown) -> Optional[jax.Array]:
+    """Total of the sown "losses" collection (MoE load-balance aux).
+
+    Leaves are scalars for a flat stack but [S]-stacked under the stage
+    vmap and [T, S] under the pipeline tick scan — sum each to a scalar so
+    the task loss stays rank-0 whatever the parallelism layout.
+    """
+    leaves = jax.tree.leaves(sown.get("losses", {}))
+    if not leaves:
+        return None
+    return sum(jnp.sum(leaf) for leaf in leaves)
 
 
 class ImageClassificationTask:
@@ -177,9 +190,8 @@ class MlmTask:
         nsp = cross_entropy(out["nsp_logits"], batch["nsp_labels"])
         loss = mlm + nsp
         aux = {"mlm_loss": mlm, "nsp_loss": nsp}
-        sown_losses = jax.tree.leaves(sown.get("losses", {}))
-        if sown_losses:
-            moe_aux = sum(sown_losses)
+        moe_aux = _sown_loss_sum(sown)
+        if moe_aux is not None:
             loss = loss + moe_aux
             aux["moe_aux_loss"] = moe_aux
         return loss, {"aux": aux, "var_updates": {}}
@@ -243,18 +255,26 @@ class CausalLmTask:
         return logits[:, :-1], jnp.where(valid, targets, -100)
 
     def loss(self, model, params, extra_vars, batch, train: bool, rngs):
-        out = model.apply(
+        # "losses" is mutable so MoE decoder blocks can sow their
+        # load-balance auxiliary loss (models/gpt.py); empty for dense.
+        out, sown = model.apply(
             {"params": params, **extra_vars},
             batch["input_ids"],
             attention_mask=batch["attention_mask"],
             deterministic=not train,
             rngs=rngs if train else None,
+            mutable=["losses"],
         )
         logits, targets = self._shift(
             out["logits"], batch["input_ids"], batch["attention_mask"]
         )
         loss = cross_entropy(logits, targets, ignore=-100)
-        return loss, {"aux": {}, "var_updates": {}}
+        aux = {}
+        moe_aux = _sown_loss_sum(sown)
+        if moe_aux is not None:
+            loss = loss + moe_aux
+            aux["moe_aux_loss"] = moe_aux
+        return loss, {"aux": aux, "var_updates": {}}
 
     def count_items(self, batch) -> int:
         return batch["input_ids"].shape[0] * batch["input_ids"].shape[1]

@@ -47,13 +47,27 @@ class TestCausality:
         )
 
     def test_unknown_attention_impl_rejected(self):
-        model = get_model("gpt_tiny", attention_impl="ring")
+        model = get_model("gpt_tiny", attention_impl="bogus")
         with pytest.raises(ValueError, match="attention_impl"):
             model.init(
                 jax.random.PRNGKey(0),
                 jnp.zeros((1, 8), jnp.int32),
                 deterministic=True,
             )
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_sp_impls_match_dense_unsharded(self, impl):
+        """Without a real sequence axis both SP impls fall back to the same
+        causal dense math — logits must match exactly."""
+        ids = (jnp.arange(32)[None, :] * 7 + 3) % 512
+        dense = get_model("gpt_tiny", dtype=jnp.float32)
+        variables = dense.init(jax.random.PRNGKey(0), ids, deterministic=True)
+        want = dense.apply(variables, ids, deterministic=True)["logits"]
+        sp = get_model("gpt_tiny", dtype=jnp.float32, attention_impl=impl)
+        got = sp.apply(variables, ids, deterministic=True)["logits"]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
 
 
 class TestCausalLmTask:
@@ -108,6 +122,201 @@ class TestGptTrainer:
             for p, leaf in jax.tree_util.tree_leaves_with_path(state.params)
         }
         assert any("tensor" in str(s) for s in specs.values()), specs
+
+    def test_causal_ring_matches_dense_on_sequence_mesh(self, devices8):
+        """GPT with ring attention on a real `sequence` axis computes the
+        same training losses as the dense model on a pure-data mesh — the
+        global-position causal masking is exact (VERDICT r2 item 3)."""
+        losses = {}
+        for label, (mesh_cfg, impl) in {
+            "dense": (MeshConfig(data=4), "dense"),
+            "ring": (MeshConfig(data=1, sequence=4), "ring"),
+            "ulysses": (MeshConfig(data=1, sequence=4), "ulysses"),
+        }.items():
+            cfg = TrainingConfig(
+                model="gpt_tiny",
+                global_batch_size=4,
+                steps=2,
+                warmup_steps=1,
+                learning_rate=1e-3,
+                dtype="float32",
+                seed=3,
+                mesh=mesh_cfg,
+                checkpoint={"enabled": False},
+            )
+            from kubeflow_tpu.parallel.mesh import mesh_from_config
+            from kubeflow_tpu.training.data import make_global_batch
+
+            mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:4])
+            task = CausalLmTask(cfg, seq_len=32, vocab_size=512)
+            tr = Trainer(
+                cfg, mesh=mesh, task=task,
+                model_kwargs={"attention_impl": impl},
+            )
+            state = tr.init_state()
+            rng = jax.random.PRNGKey(0)
+            got = []
+            for step in range(2):
+                gb = make_global_batch(task.synthetic_data().batch_at(step), mesh)
+                state, m = tr.train_step(state, gb, rng)
+                got.append(float(jax.device_get(m["loss"])))
+            losses[label] = got
+        np.testing.assert_allclose(
+            losses["ring"], losses["dense"], rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            losses["ulysses"], losses["dense"], rtol=2e-4, atol=2e-4
+        )
+
+    def test_pipelined_decoder_equals_sequential_stages(self):
+        """PipelinedDecoder output == applying the same stacked stage
+        params one after the other (the schedule is exact, not
+        approximate) — the true pipelined-vs-unpipelined numerics check."""
+        from kubeflow_tpu.models.gpt import (
+            DecoderStage,
+            GptConfig,
+            PipelinedDecoder,
+        )
+
+        cfg = GptConfig(
+            vocab_size=128,
+            hidden_size=32,
+            num_layers=2,
+            num_heads=2,
+            mlp_dim=64,
+            max_len=32,
+            dtype=jnp.float32,
+            pipeline_stages=2,
+        )
+        dec = PipelinedDecoder(cfg)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 32))
+        mask = jnp.ones((4, 16), bool)
+        params = dec.init(jax.random.PRNGKey(1), x, mask, True)["params"]
+        got = dec.apply({"params": params}, x, mask, True)
+
+        stage = DecoderStage(cfg, layers_per_stage=1)
+        want = x
+        for i in range(2):
+            stage_params = jax.tree.map(lambda a, i=i: a[i], params["stages"])
+            want = stage.apply({"params": stage_params}, want, mask, True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+        )
+
+    def test_pp_loss_invariant_to_pipeline_mesh(self, devices8):
+        """Same pipelined model + seed on (data=4) vs (data=2, pipeline=2):
+        the pipeline mesh axis changes layout, not math."""
+        losses = {}
+        for label, mesh_cfg in {
+            "flat": MeshConfig(data=4),
+            "pp": MeshConfig(data=2, pipeline=2),
+        }.items():
+            cfg = TrainingConfig(
+                model="gpt_tiny",
+                global_batch_size=8,
+                steps=2,
+                warmup_steps=1,
+                learning_rate=1e-3,
+                dtype="float32",
+                seed=7,
+                mesh=mesh_cfg,
+                checkpoint={"enabled": False},
+            )
+            from kubeflow_tpu.parallel.mesh import mesh_from_config
+            from kubeflow_tpu.training.data import make_global_batch
+
+            mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:4])
+            task = CausalLmTask(cfg, seq_len=32, vocab_size=512)
+            tr = Trainer(
+                cfg, mesh=mesh, task=task,
+                model_kwargs={"pipeline_stages": 2, "num_layers": 2},
+            )
+            state = tr.init_state()
+            rng = jax.random.PRNGKey(0)
+            got = []
+            for step in range(2):
+                gb = make_global_batch(task.synthetic_data().batch_at(step), mesh)
+                state, m = tr.train_step(state, gb, rng)
+                got.append(float(jax.device_get(m["loss"])))
+            losses[label] = got
+        np.testing.assert_allclose(
+            losses["flat"], losses["pp"], rtol=2e-4, atol=2e-4
+        )
+
+    def test_moe_ep_matches_dp_loss(self, devices8):
+        """MoE-GPT on a real expert axis == the same model replicated —
+        expert sharding changes layout, not math."""
+        losses = {}
+        for label, mesh_cfg in {
+            "dp": MeshConfig(data=4),
+            "ep": MeshConfig(data=2, expert=2),
+        }.items():
+            cfg = TrainingConfig(
+                model="gpt_tiny_moe",
+                global_batch_size=8,
+                steps=2,
+                warmup_steps=1,
+                learning_rate=1e-3,
+                dtype="float32",
+                seed=11,
+                mesh=mesh_cfg,
+                checkpoint={"enabled": False},
+            )
+            from kubeflow_tpu.parallel.mesh import mesh_from_config
+            from kubeflow_tpu.training.data import make_global_batch
+
+            mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:4])
+            task = CausalLmTask(cfg, seq_len=16, vocab_size=512)
+            tr = Trainer(cfg, mesh=mesh, task=task)
+            state = tr.init_state()
+            rng = jax.random.PRNGKey(0)
+            got = []
+            for step in range(2):
+                gb = make_global_batch(task.synthetic_data().batch_at(step), mesh)
+                state, m = tr.train_step(state, gb, rng)
+                assert "moe_aux_loss" in m
+                got.append(float(jax.device_get(m["loss"])))
+            losses[label] = got
+        np.testing.assert_allclose(
+            losses["dp"], losses["ep"], rtol=2e-4, atol=2e-4
+        )
+
+    def test_pp_times_ep_trains(self, devices8):
+        """PP × EP composes for the causal family too."""
+        cfg = TrainingConfig(
+            model="gpt_tiny_moe",
+            global_batch_size=8,
+            steps=1,
+            warmup_steps=1,
+            learning_rate=1e-3,
+            dtype="float32",
+            mesh=MeshConfig(data=2, pipeline=2, expert=2),
+            checkpoint={"enabled": False},
+        )
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.data import make_global_batch
+
+        mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:8])
+        task = CausalLmTask(cfg, seq_len=16, vocab_size=512)
+        tr = Trainer(
+            cfg, mesh=mesh, task=task,
+            model_kwargs={"pipeline_stages": 2, "num_layers": 2},
+        )
+        state = tr.init_state()
+        gb = make_global_batch(task.synthetic_data().batch_at(0), mesh)
+        state, m = tr.train_step(state, gb, jax.random.PRNGKey(0))
+        assert np.isfinite(float(jax.device_get(m["loss"])))
+        assert "moe_aux_loss" in m
+
+    def test_pipelined_decode_rejected(self):
+        model = get_model("gpt_tiny", pipeline_stages=2)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), ids, deterministic=True)
+        with pytest.raises(ValueError, match="pipelined decoding"):
+            model.apply(
+                variables, ids, deterministic=True, prefill=True,
+                mutable=["cache"],
+            )
 
     def test_task_dims_clamped_to_model(self):
         cfg = TrainingConfig(
