@@ -248,6 +248,68 @@ def bench_long_context(seq_len: int = 32768) -> dict:
     }
 
 
+def bench_attention_sweep(lens=(2048, 4096, 8192, 16384, 32768)) -> dict:
+    """Flash-vs-dense fwd+bwd across sequence lengths (the crossover table
+    VERDICT r2 item 2 asks for): BERT-shaped [1, S, 12, 64] bf16. Dense
+    entries go null where the [B,H,S,S] score tensor OOMs — that null IS
+    the datapoint (flash is the only feasible impl there)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.ops.attention import dense_attention
+    from kubeflow_tpu.ops.flash_attention import flash_attention
+
+    b, h, d = 1, 12, 64
+    key = jax.random.PRNGKey(0)
+
+    def timed(fn, *args):
+        g = jax.jit(
+            jax.grad(
+                lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2),
+            )
+        )
+        out = g(*args)
+        _ = float(jax.device_get(out[0][0, 0, 0, 0]))
+        iters = 4
+        t0 = time.monotonic()
+        for _ in range(iters):
+            out = g(*args)
+        _ = float(jax.device_get(out[0][0, 0, 0, 0]))
+        return (time.monotonic() - t0) / iters
+
+    rows = {}
+    for s in lens:
+        q, k, v = (
+            jax.random.normal(
+                jax.random.fold_in(key, i), (b, s, h, d), jnp.bfloat16
+            )
+            for i in range(3)
+        )
+        row = {}
+        try:
+            row["flash_ms"] = round(timed(flash_attention, q, k, v) * 1e3, 2)
+        except Exception as e:  # noqa: BLE001
+            row["flash_ms"] = None
+            row["flash_error"] = type(e).__name__
+        try:
+            row["dense_ms"] = round(
+                timed(
+                    lambda q, k, v: dense_attention(q, k, v, dtype=jnp.bfloat16),
+                    q, k, v,
+                ) * 1e3, 2,
+            )
+        except Exception as e:  # noqa: BLE001 - OOM expected at long S
+            row["dense_ms"] = None
+            row["dense_error"] = type(e).__name__
+        if row.get("flash_ms") and row.get("dense_ms"):
+            row["flash_speedup"] = round(row["dense_ms"] / row["flash_ms"], 3)
+        rows[str(s)] = row
+    return rows
+
+
 def bench_serving(batch: int = 8, requests: int = 30) -> dict:
     """Serving smoke latency (BASELINE.md's serving config): ResNet-50
     inference over a real socket against the model server — HTTP + JSON
@@ -275,6 +337,7 @@ def bench_serving(batch: int = 8, requests: int = 30) -> dict:
         "resnet50",
         lambda v, x: model.apply(v, x, train=False),
         variables,
+        batch_window_ms=2.0,  # fuse concurrent clients' rows on-device
     )
     model_server = ModelServer()
     model_server.add(served)
@@ -305,6 +368,80 @@ def bench_serving(batch: int = 8, requests: int = 30) -> dict:
             "qps": round(requests / sum(lat), 1),
         }
 
+    def concurrent_npy(url, payload, clients: int, per_client: int):
+        """4× concurrent clients on the binary path (threaded server +
+        micro-batcher): per-request latency under contention, plus the
+        server's own parse/compute/serialize decomposition from the
+        X-*-Ms response headers (VERDICT r2 weak #8: decompose before
+        optimizing)."""
+        import threading
+
+        lat, decomp = [], {"parse": [], "compute": [], "serialize": []}
+        errors = []
+        lock = threading.Lock()
+
+        def client():
+            for _ in range(per_client):
+                req = urllib.request.Request(
+                    url,
+                    data=payload,
+                    headers={"Content-Type": "application/octet-stream"},
+                )
+                t0 = time.monotonic()
+                try:
+                    with urllib.request.urlopen(req, timeout=120) as resp:
+                        resp.read()
+                        hdr = resp.headers
+                except Exception as e:  # noqa: BLE001 - recorded, not lost
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    continue
+                dt = time.monotonic() - t0
+                with lock:
+                    lat.append(dt)
+                    for k, h in (
+                        ("parse", "X-Parse-Ms"),
+                        ("compute", "X-Compute-Ms"),
+                        ("serialize", "X-Serialize-Ms"),
+                    ):
+                        if hdr.get(h):
+                            decomp[k].append(float(hdr[h]))
+
+        threads = [threading.Thread(target=client) for _ in range(clients)]
+        t_all = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t_all
+        if not lat:
+            raise RuntimeError(
+                f"all {clients * per_client} concurrent requests failed; "
+                f"first error: {errors[0] if errors else 'unknown'}"
+            )
+        lat.sort()
+        med = lambda xs: round(sorted(xs)[len(xs) // 2], 2) if xs else None  # noqa: E731
+        stats = {
+            "clients": clients,
+            "failed_requests": len(errors),
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+            "p99_ms": round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 2
+            ),
+            "qps": round(len(lat) / wall, 1),
+            "server_parse_ms_p50": med(decomp["parse"]),
+            "server_compute_ms_p50": med(decomp["compute"]),
+            "server_serialize_ms_p50": med(decomp["serialize"]),
+        }
+        if stats["server_compute_ms_p50"] is not None:
+            onwire = stats["p50_ms"] - (
+                (stats["server_parse_ms_p50"] or 0)
+                + stats["server_compute_ms_p50"]
+                + (stats["server_serialize_ms_p50"] or 0)
+            )
+            stats["transport_overhead_ms_p50"] = round(onwire, 2)
+        return stats
+
     try:
         import io
 
@@ -325,12 +462,18 @@ def bench_serving(batch: int = 8, requests: int = 30) -> dict:
             "application/octet-stream",
             lambda raw: np.load(io.BytesIO(raw), allow_pickle=False),
         )
+        concurrent_stats = concurrent_npy(
+            url + "_npy", buf.getvalue(), clients=4,
+            per_client=max(4, requests // 4),
+        )
     finally:
         server.stop()
+        served.close()
     return {
         "batch": batch,
         **json_stats,
         **{f"npy_{k}": v for k, v in npy_stats.items()},
+        "concurrent_npy": concurrent_stats,
     }
 
 
@@ -338,8 +481,10 @@ def bench_generate(
     batch: int = 8, prompt_len: int = 64, new_tokens: int = 64
 ) -> dict:
     """Autoregressive decode throughput: GPT greedy generation with the KV
-    cache (serving/generate.py) — prefill + one step per token. Opt-in via
-    KFT_BENCH_GENERATE=1 (XLA lowering of the deep decode scan is slow)."""
+    cache (serving/generate.py) — prefill + one step per token. In the
+    default battery since round 3: scan_layers=True lowers ONE decoder
+    body instead of 12 inlined layers, collapsing the compile cost that
+    kept this opt-in in round 2 (VERDICT r2 item 6)."""
     import time
 
     import jax
@@ -348,7 +493,7 @@ def bench_generate(
     from kubeflow_tpu.models.registry import get_model
     from kubeflow_tpu.serving.generate import greedy_generate
 
-    model = get_model("gpt_small", dtype=jnp.bfloat16)
+    model = get_model("gpt_small", dtype=jnp.bfloat16, scan_layers=True)
     prompt = (
         jax.random.randint(
             jax.random.PRNGKey(0), (batch, prompt_len), 0, 50257
@@ -383,7 +528,17 @@ def bench_generate(
 
 
 def bench_studyjob_trials(n_trials: int = 4) -> dict:
-    """Trials/hr through the real control plane (Katib-equivalent metric)."""
+    """Trials/hr through the real control plane (Katib-equivalent metric).
+
+    The trial vehicle is the NORTH-STAR model on TPU — an LR-sweep over
+    ResNet-50 (BASELINE.md names "LR-sweep ResNet StudyJob on v5e";
+    round 2 measured an MLP study, which proved the control plane but
+    wasn't comparable — VERDICT r2 weak #3). CI (CPU mesh) keeps the MLP
+    vehicle so the control-plane path stays covered in seconds. A
+    persistent XLA compilation cache lets trials after the first restore
+    the compiled step instead of re-paying the full ResNet compile."""
+    import tempfile
+
     import jax
 
     from kubeflow_tpu.cluster.reconciler import ControllerManager
@@ -393,6 +548,17 @@ def bench_studyjob_trials(n_trials: int = 4) -> dict:
     from kubeflow_tpu.controllers.tpujob import TPUTrainJobController
     from kubeflow_tpu.runtime.executor import InProcessTrainerRunner, PodExecutor
 
+    on_tpu = jax.default_backend() == "tpu"
+    vehicle = "resnet50" if on_tpu else "mlp"
+    try:  # best-effort: trials share compiled programs via the disk cache
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("KFT_COMPILE_CACHE", tempfile.mkdtemp("kft-cache")),
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 - cache flags vary across jax versions
+        pass
     n_dev = len(jax.devices())
     topo = {1: "v5e-1", 4: "v5e-4", 8: "v5e-8"}.get(n_dev, "v5e-1")
     mesh_dev = n_dev if topo != "v5e-1" else 1
@@ -405,9 +571,10 @@ def bench_studyjob_trials(n_trials: int = 4) -> dict:
         "image": "kubeflow-tpu/trainer:latest",
         "slice": {"topology": topo, "num_slices": 1},
         "training": {
-            "model": "mlp",
-            "global_batch_size": 8 * mesh_dev,
+            "model": vehicle,
+            "global_batch_size": (128 if on_tpu else 8) * mesh_dev,
             "steps": 10,
+            "learning_rate": 0.1,
             "mesh": {"data": mesh_dev},
             "checkpoint": {"enabled": False},
         },
@@ -446,6 +613,7 @@ def bench_studyjob_trials(n_trials: int = 4) -> dict:
     )
     elapsed = time.monotonic() - t0
     return {
+        "vehicle": vehicle,
         "trials": int(done["status"]["trialsSucceeded"]),
         "trials_per_hr": round(3600.0 * n_trials / elapsed, 1),
         "best_items_per_sec": round(
@@ -464,7 +632,7 @@ def main() -> int:
 
     resnet = bench_resnet(batch, steps)
 
-    bert = trials = long_ctx = serving = generate = None
+    bert = trials = long_ctx = serving = generate = attn_sweep = None
     if suite == "all":
         try:
             bert = bench_bert(max(5, steps // 2))
@@ -478,9 +646,9 @@ def main() -> int:
             serving = bench_serving()
         except Exception as e:  # noqa: BLE001
             serving = {"error": f"{type(e).__name__}: {e}"}
-        if os.environ.get("KFT_BENCH_GENERATE") == "1":
-            # opt-in: XLA lowering of the 12-layer decode scan takes
-            # minutes — too slow for the default battery's budget
+        if os.environ.get("KFT_BENCH_GENERATE") != "0":
+            # default since round 3: scan_layers makes the decode program
+            # cheap to lower (one traced layer body)
             try:
                 generate = bench_generate()
             except Exception as e:  # noqa: BLE001
@@ -491,6 +659,10 @@ def main() -> int:
                 long_ctx = bench_long_context()
             except Exception as e:  # noqa: BLE001
                 long_ctx = {"error": f"{type(e).__name__}: {e}"}
+            try:
+                attn_sweep = bench_attention_sweep()
+            except Exception as e:  # noqa: BLE001
+                attn_sweep = {"error": f"{type(e).__name__}: {e}"}
 
     per_chip = resnet["images_per_sec_per_chip"]
     print(
@@ -507,6 +679,7 @@ def main() -> int:
                 "serving": serving,
                 "generate": generate,
                 "long_context_attention": long_ctx,
+                "attention_sweep": attn_sweep,
                 "device_kind": getattr(jax.devices()[0], "device_kind", "cpu"),
             }
         )

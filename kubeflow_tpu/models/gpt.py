@@ -59,6 +59,13 @@ class GptConfig:
     moe_top_k: int = 1
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # scan over layers: stack layer params [L, ...] and run the block as
+    # one nn.scan — XLA traces ONE layer body instead of N, collapsing
+    # trace+lowering time for deep models (the round-2 ":generate lowering
+    # takes minutes" defect — VERDICT r2 item 6) at identical math. The
+    # serving path turns this on; training defaults to named layers so
+    # per-layer TP sharding patterns stay addressable.
+    scan_layers: bool = False
 
 
 class CausalSelfAttention(nn.Module):
@@ -76,7 +83,15 @@ class CausalSelfAttention(nn.Module):
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
-        return cached_k, cached_v, cache_index
+        # which cache slots hold REAL tokens: padded prompt positions stay
+        # False so ragged batches decode correctly (slots past the cursor
+        # are excluded by the cursor check, so init-True is safe there)
+        valid_mask = self.variable(
+            "cache",
+            "valid_mask",
+            lambda: jnp.ones((batch, cfg.max_len), bool),
+        )
+        return cached_k, cached_v, cache_index, valid_mask
 
     @nn.compact
     def __call__(
@@ -103,7 +118,7 @@ class CausalSelfAttention(nn.Module):
             # one causal pass over the whole prompt that ALSO seeds the KV
             # cache — generation then costs exactly one decode step per
             # new token (serving/generate.py)
-            cached_k, cached_v, cache_index = self._cache_vars(
+            cached_k, cached_v, cache_index, valid_mask = self._cache_vars(
                 x.shape[0], head_dim
             )
             cached_k.value = jax.lax.dynamic_update_slice(
@@ -113,13 +128,18 @@ class CausalSelfAttention(nn.Module):
                 cached_v.value, v.astype(cfg.dtype), (0, 0, 0, 0)
             )
             cache_index.value = jnp.full((), x.shape[1], jnp.int32)
+            # remember which prompt slots are padding so later decode
+            # steps never attend to them (ragged-batch serving)
+            valid_mask.value = jax.lax.dynamic_update_slice(
+                valid_mask.value, mask.astype(bool), (0, 0)
+            )
             # attention itself is the ordinary causal path below
 
         if decode:
             # single-token autoregressive step over the KV cache (the
             # flax decode idiom): write this step's K/V at `index`, attend
             # over positions <= index. x is [B, 1, D].
-            cached_k, cached_v, cache_index = self._cache_vars(
+            cached_k, cached_v, cache_index, valid_mask = self._cache_vars(
                 x.shape[0], head_dim
             )
             idx = cache_index.value
@@ -131,8 +151,10 @@ class CausalSelfAttention(nn.Module):
             )
             cache_index.value = idx + 1
             k, v = cached_k.value, cached_v.value
-            # visible = cache positions written so far (<= idx)
-            visible = (jnp.arange(cfg.max_len) <= idx)[None, :]
+            # visible = real (non-pad) cache positions written so far
+            visible = (
+                (jnp.arange(cfg.max_len) <= idx)[None, :] & valid_mask.value
+            )
             from kubeflow_tpu.ops.attention import dense_attention
 
             out = dense_attention(
@@ -233,6 +255,48 @@ class DecoderBlock(nn.Module):
         return shard_constraint(x, ("batch", "seq", "act_embed"))
 
 
+class ScanDecoderBlock(nn.Module):
+    """nn.scan body: one DecoderBlock with params stacked on the scan axis.
+
+    The extra "block" level keeps the per-layer tree shape identical to the
+    named-layer layout, so `stack_layer_params` is a pure restack.
+    """
+
+    cfg: GptConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic, decode, prefill):
+        block_cls = DecoderBlock
+        if self.cfg.remat:
+            block_cls = nn.remat(DecoderBlock, static_argnums=(3, 4, 5))
+        x = block_cls(self.cfg, name="block")(
+            x, mask, deterministic, decode, prefill
+        )
+        return x, None
+
+
+def stack_layer_params(params, num_layers: int):
+    """Convert a named-layer param tree (layer_0..layer_{N-1}) to the
+    scan_layers layout (layers/block with a leading [L] dim) — train with
+    addressable layers, serve with the scanned block (one traced layer
+    body: lowering cost is depth-independent)."""
+    layers = [params[f"layer_{i}"] for i in range(num_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs, 0), *layers)
+    rest = {
+        k: v for k, v in params.items() if not k.startswith("layer_")
+    }
+    return {**rest, "layers": {"block": stacked}}
+
+
+def unstack_layer_params(params, num_layers: int):
+    """Inverse of `stack_layer_params`."""
+    stacked = params["layers"]["block"]
+    rest = {k: v for k, v in params.items() if k != "layers"}
+    for i in range(num_layers):
+        rest[f"layer_{i}"] = jax.tree.map(lambda a, i=i: a[i], stacked)
+    return rest
+
+
 class DecoderStage(nn.Module):
     """One pipeline stage: a contiguous run of decoder blocks."""
 
@@ -319,13 +383,19 @@ class Gpt(nn.Module):
         if decode or prefill:
             # the decode cursor lives IN the cache (one source of truth —
             # a restored cache cannot disagree with a caller-passed
-            # position): prefill sets it to the prompt length, each decode
-            # step advances it by one
+            # position). It is PER ROW: padded prompts give each row its
+            # own token count, so position embeddings index real-token
+            # order (cumsum over the mask), not buffer slots.
             pos_var = self.variable(
-                "cache", "position", lambda: jnp.zeros((), jnp.int32)
+                "cache", "position", lambda: jnp.zeros((b,), jnp.int32)
             )
-            positions = pos_var.value + jnp.arange(s)[None, :]
-            pos_var.value = pos_var.value + s
+            if prefill:
+                m32 = mask.astype(jnp.int32)
+                positions = jnp.maximum(jnp.cumsum(m32, axis=1) - 1, 0)
+                pos_var.value = m32.sum(axis=1)
+            else:
+                positions = pos_var.value[:, None] + jnp.arange(s)[None, :]
+                pos_var.value = pos_var.value + s
         else:
             positions = jnp.arange(s)[None, :]
         pos = nn.Embed(
@@ -342,6 +412,15 @@ class Gpt(nn.Module):
                     "microbatch schedule)"
                 )
             x = PipelinedDecoder(cfg, name="decoder")(x, mask, deterministic)
+        elif cfg.scan_layers:
+            scan = nn.scan(
+                ScanDecoderBlock,
+                variable_axes={"params": 0, "cache": 0, "losses": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast,) * 4,
+                length=cfg.num_layers,
+            )(cfg, name="layers")
+            x, _ = scan(x, mask, deterministic, decode, prefill)
         else:
             block_cls = DecoderBlock
             if cfg.remat:

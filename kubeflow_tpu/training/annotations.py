@@ -74,15 +74,19 @@ def logical_axes_for(
     def annotate(path, leaf):
         p = _path_str(path)
         # pipeline-stacked params (nn.vmap'd stage stack): leading [S] dim
-        # is the "stage" axis; match the remaining dims against the table
-        stacked = "/stages/" in f"/{p}"
-        ndim = leaf.ndim - 1 if stacked else leaf.ndim
-        lead = ("stage",) if stacked else ()
+        # is the "stage" axis; scan-stacked layers (nn.scan over the
+        # decoder, models/gpt.py scan_layers): leading [L] dim is a scan
+        # axis, replicated. Either way match remaining dims on the table.
+        slashed = f"/{p}"
+        stacked = "/stages/" in slashed
+        scanned = "/layers/" in slashed
+        ndim = leaf.ndim - 1 if (stacked or scanned) else leaf.ndim
+        lead = ("stage",) if stacked else (None,) if scanned else ()
         for pattern, axes in _PATTERNS:
             if re.match(pattern, p) and len(axes) == ndim:
                 return lead + axes
         if ndim >= 2 and fsdp_size > 1:
-            shape = leaf.shape[1:] if stacked else leaf.shape
+            shape = leaf.shape[1:] if (stacked or scanned) else leaf.shape
             dims = sorted(range(ndim), key=lambda i: shape[i], reverse=True)
             for d in dims:
                 if shape[d] % fsdp_size == 0:

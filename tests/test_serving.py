@@ -280,3 +280,180 @@ class TestNpyFastPath:
             assert y.shape[0] == 2
         finally:
             server.stop()
+
+
+class TestMicroBatching:
+    def test_concurrent_submits_fuse_into_fewer_device_calls(self):
+        import threading
+
+        from kubeflow_tpu.serving.batching import MicroBatcher
+
+        calls = []
+
+        def run(x):
+            calls.append(x.shape[0])
+            return x * 2.0
+
+        mb = MicroBatcher(run, max_rows=64, window_ms=30.0)
+        try:
+            results = {}
+
+            def client(i):
+                x = np.full((2, 3), float(i), np.float32)
+                results[i] = mb.submit(x)
+
+            threads = [
+                threading.Thread(target=client, args=(i,)) for i in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # every client got ITS rows back, doubled
+            for i in range(6):
+                np.testing.assert_allclose(results[i], np.full((2, 3), 2.0 * i))
+            # 12 rows in 6 requests fused into fewer device calls
+            assert sum(calls) == 12
+            assert len(calls) < 6
+        finally:
+            mb.close()
+
+    def test_mixed_shapes_batched_separately(self):
+        import threading
+
+        from kubeflow_tpu.serving.batching import MicroBatcher
+
+        def run(x):
+            return x.sum(axis=tuple(range(1, x.ndim)))
+
+        mb = MicroBatcher(run, window_ms=20.0)
+        try:
+            out = {}
+
+            def client(key, shape):
+                out[key] = mb.submit(np.ones(shape, np.float32))
+
+            threads = [
+                threading.Thread(target=client, args=("a", (2, 4))),
+                threading.Thread(target=client, args=("b", (3, 5))),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            np.testing.assert_allclose(out["a"], [4.0, 4.0])
+            np.testing.assert_allclose(out["b"], [5.0, 5.0, 5.0])
+        finally:
+            mb.close()
+
+    def test_errors_propagate_to_the_failing_request(self):
+        from kubeflow_tpu.serving.batching import MicroBatcher
+
+        def run(x):
+            raise ValueError("device exploded")
+
+        mb = MicroBatcher(run, window_ms=1.0)
+        try:
+            with pytest.raises(ValueError, match="device exploded"):
+                mb.submit(np.ones((1, 2), np.float32))
+        finally:
+            mb.close()
+
+    def test_served_model_with_batching_matches_direct(self):
+        import threading
+
+        model = get_model("mlp", hidden=(16,), num_classes=4)
+        x0 = jnp.zeros((1, 8), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), x0)["params"]
+
+        def apply_fn(p, xb):
+            return model.apply({"params": p}, xb)
+
+        direct = ServedModel("d", apply_fn, params)
+        batched = ServedModel("b", apply_fn, params, batch_window_ms=10.0)
+        try:
+            rng = np.random.default_rng(0)
+            xs = [rng.normal(size=(2, 8)).astype(np.float32) for _ in range(5)]
+            want = [direct.predict_array(x) for x in xs]
+            got = [None] * 5
+
+            def client(i):
+                got[i] = batched.predict_array(xs[i])
+
+            threads = [
+                threading.Thread(target=client, args=(i,)) for i in range(5)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for w, g in zip(want, got):
+                np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+        finally:
+            batched.close()
+
+
+class TestThreadedWire:
+    def test_concurrent_clients_over_socket(self, mlp_served):
+        import json as jsonlib
+        import threading
+        import urllib.request
+
+        from kubeflow_tpu.api.wsgi import Server
+
+        server = ModelServer()
+        server.add(mlp_served)
+        srv = Server(server.app)  # threaded by default
+        srv.start()
+        try:
+            results = []
+
+            def client():
+                body = jsonlib.dumps(
+                    {"instances": [[0.0] * 8, [1.0] * 8]}
+                ).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/v1/models/mlp:predict",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    results.append(
+                        (resp.status, jsonlib.loads(resp.read()))
+                    )
+
+            threads = [threading.Thread(target=client) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(results) == 8
+            assert all(s == 200 for s, _ in results)
+            assert all(len(r["predictions"]) == 2 for _, r in results)
+        finally:
+            srv.stop()
+
+    def test_npy_latency_decomposition_headers(self, mlp_served):
+        import io
+        import urllib.request
+
+        from kubeflow_tpu.api.wsgi import Server
+
+        server = ModelServer()
+        server.add(mlp_served)
+        srv = Server(server.app)
+        srv.start()
+        try:
+            buf = io.BytesIO()
+            np.save(buf, np.zeros((2, 8), np.float32), allow_pickle=False)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/models/mlp:predict_npy",
+                data=buf.getvalue(),
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+                for h in ("X-Parse-Ms", "X-Compute-Ms", "X-Serialize-Ms"):
+                    assert float(resp.headers[h]) >= 0.0
+        finally:
+            srv.stop()
