@@ -121,6 +121,14 @@ def build_app(
         ns = req.query.get("namespace", "")
         if not ns:
             raise BadRequest("namespace query param required")
+        # the namespace owner, so UIs can mark that row (the owner's access
+        # comes from the Profile; their binding is reconciler-managed)
+        ns_obj = store.try_get("Namespace", ns, ns)
+        owner = (
+            ns_obj["metadata"].get("annotations", {}).get(OWNER_ANNOTATION, "")
+            if ns_obj is not None
+            else ""
+        )
         out = []
         for rb in store.list("RoleBinding", ns):
             role_ref = rb.get("spec", {}).get("roleRef", {}).get("name", "")
@@ -137,7 +145,7 @@ def build_app(
                             "role": role,
                         }
                     )
-        return {"bindings": out}
+        return {"bindings": out, "owner": owner}
 
     @app.post("/kfam/v1/bindings")
     def create_binding(req):

@@ -151,6 +151,235 @@ class InProcessTrainerRunner(PodRunner):
         return SUCCEEDED, info
 
 
+class SubprocessPodRunner(PodRunner):
+    """Executes TPUJob gang pods as REAL OS processes.
+
+    The in-process runner (above) collapses a gang onto one process; this
+    runner gives every gang pod its own `kubeflow_tpu.runtime.launcher`
+    child — the pod's rendered KFT_* env, a real
+    `jax.distributed.initialize` against a localhost coordinator, XLA
+    collectives across processes, optional slice_agent supervision with
+    the TCP barrier — so the platform e2e exercises the same machinery a
+    real multi-host slice runs (VERDICT r2 item 4; reference analog:
+    tf-controller-examples/tf-cnn/launcher.py:68-80 driven by a real
+    operator, openmpi-controller/controller/controller.py:92-102).
+
+    Asynchronous by design: run() SPAWNS on first sight of a Running pod
+    and then polls — a blocking run would deadlock the gang (member 0
+    waits at the distributed barrier for member 1, which the executor
+    hasn't started yet). Children of deleted pods are reaped each tick,
+    which is what makes gang restart kill-and-respawn real processes.
+    """
+
+    def __init__(
+        self,
+        store: StateStore,
+        devices_per_proc: int = 2,
+        use_slice_agent: bool = False,
+        steps_override: Optional[int] = None,
+    ) -> None:
+        import tempfile
+
+        self.store = store
+        self.devices_per_proc = devices_per_proc
+        self.use_slice_agent = use_slice_agent
+        self.steps_override = steps_override
+        self._procs: Dict[str, Dict[str, Any]] = {}  # pod uid → proc meta
+        self._gang_ports: Dict[Tuple[str, str, int], Tuple[int, int]] = {}
+        self._workdir = tempfile.mkdtemp(prefix="kft-gang-")
+        self._lock = threading.Lock()
+
+    # -- helpers ----------------------------------------------------------
+
+    @staticmethod
+    def _free_port() -> int:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _gang_ports_for(self, ns: str, job: str) -> Tuple[int, int, int]:
+        """(coordinator_port, barrier_port, incarnation) for this gang
+        generation.
+
+        Both ports are independently bound-then-released allocations per
+        (job, restarts) generation — deriving the barrier port as
+        coordinator+1 could land on another gang's allocation. Every
+        member of a generation gets the same pair; a restarted gang gets
+        fresh ports so it can never collide with a dying predecessor."""
+        try:
+            restarts = int(
+                self.store.get("TPUTrainJob", job, ns)
+                .get("status", {})
+                .get("restarts", 0)
+            )
+        except NotFound:
+            restarts = 0
+        key = (ns, job, restarts)
+        if key not in self._gang_ports:
+            self._gang_ports[key] = (self._free_port(), self._free_port())
+        coord, barrier = self._gang_ports[key]
+        return coord, barrier, restarts
+
+    def _reap_orphans(self) -> None:
+        """Kill children whose pods were deleted (gang teardown/restart)."""
+        for uid, meta in list(self._procs.items()):
+            proc = meta["proc"]
+            try:
+                pod = self.store.get("Pod", meta["name"], meta["namespace"])
+                alive = (
+                    pod["metadata"].get("uid") == uid
+                    and not pod["metadata"].get("deletionTimestamp")
+                )
+            except NotFound:
+                alive = False
+            if not alive:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10)
+                for f in (meta["stdout"], meta["stderr"]):
+                    f.close()
+                del self._procs[uid]
+
+    def _spawn(self, pod: Dict[str, Any], env_block: Dict[str, str]):
+        import os
+        import subprocess
+        import sys
+        import tempfile
+
+        m = pod["metadata"]
+        ns, job = m["namespace"], env_block.get("KFT_JOB_NAME", "job")
+        port, barrier_port, incarnation = self._gang_ports_for(ns, job)
+        nprocs = max(1, int(env_block.get("KFT_NUM_PROCESSES", "1")))
+
+        child_env = dict(os.environ)
+        child_env.update(env_block)
+        # all gang members run on THIS host: coordinator rides localhost,
+        # each process gets its own virtual CPU devices
+        child_env["KFT_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        child_env["JAX_PLATFORMS"] = "cpu"
+        child_env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={self.devices_per_proc}"
+        )
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        child_env["PYTHONPATH"] = (
+            repo + os.pathsep + child_env.get("PYTHONPATH", "")
+        )
+        wrapper = (
+            "import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import sys; from kubeflow_tpu.runtime.launcher import main; "
+            "sys.exit(main())"
+        )
+        payload = [sys.executable, "-c", wrapper]
+        if self.steps_override is not None:
+            payload += ["--steps", str(self.steps_override)]
+        if self.use_slice_agent and nprocs > 1:
+            from kubeflow_tpu.native import slice_agent_path
+
+            shared = os.path.join(
+                self._workdir, f"{ns}.{job}.{incarnation}"
+            )
+            os.makedirs(shared, exist_ok=True)
+            payload = [
+                slice_agent_path(),
+                "--shared-dir", shared,
+                "--process-id", env_block.get("KFT_PROCESS_ID", "0"),
+                "--num-processes", str(nprocs),
+                "--poll-ms", "20",
+                "--timeout-ms", "120000",
+                "--coordinator", f"127.0.0.1:{barrier_port}",
+                "--",
+            ] + payload
+        # temp files, not pipes: a chatty child would fill a pipe buffer
+        # and deadlock against the polling executor
+        out_f = tempfile.NamedTemporaryFile(
+            "w+", dir=self._workdir, suffix=".out", delete=False
+        )
+        err_f = tempfile.NamedTemporaryFile(
+            "w+", dir=self._workdir, suffix=".err", delete=False
+        )
+        proc = subprocess.Popen(
+            payload, env=child_env, stdout=out_f, stderr=err_f, text=True
+        )
+        return {
+            "proc": proc,
+            "stdout": out_f,
+            "stderr": err_f,
+            "name": m["name"],
+            "namespace": m["namespace"],
+        }
+
+    @staticmethod
+    def _result_from(meta) -> Dict[str, str]:
+        import json
+
+        meta["stdout"].flush()
+        with open(meta["stdout"].name) as f:
+            for line in reversed(f.read().strip().splitlines()):
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                info = {}
+                if "items_per_sec" in r:
+                    info["items_per_sec"] = f"{r['items_per_sec']:.2f}"
+                if "final_step" in r:
+                    info["final_step"] = str(r["final_step"])
+                if r.get("loss") is not None:
+                    info["final_loss"] = f"{r['loss']:.4f}"
+                return info
+        return {}
+
+    # -- PodRunner --------------------------------------------------------
+
+    def run(self, pod: Dict[str, Any]) -> Tuple[Optional[str], Dict[str, str]]:
+        env = pod_env(pod)
+        if "KFT_TRAINING_SPEC" not in env:
+            return None, {}  # not a training pod
+        with self._lock:
+            self._reap_orphans()
+            uid = pod["metadata"].get("uid", "")
+            meta = self._procs.get(uid)
+            if meta is None:
+                meta = self._spawn(pod, env)
+                self._procs[uid] = meta
+                return None, {}  # spawned; poll on later ticks
+            rc = meta["proc"].poll()
+            if rc is None:
+                return None, {}
+            if rc == 0:
+                return SUCCEEDED, self._result_from(meta)
+            meta["stderr"].flush()
+            with open(meta["stderr"].name) as f:
+                tail = f.read()[-2000:]
+            return FAILED, {"reason": "NonzeroExit", "message": tail}
+
+    def stop_all(self) -> None:
+        """Kill every child (test teardown)."""
+        with self._lock:
+            for meta in self._procs.values():
+                if meta["proc"].poll() is None:
+                    meta["proc"].kill()
+                    meta["proc"].wait(timeout=10)
+                for f in (meta["stdout"], meta["stderr"]):
+                    f.close()
+            self._procs.clear()
+
+    def kill_member(self, pod_name: str) -> bool:
+        """Fault injection: kill the child of a named pod (crash a real
+        gang member; the controller should observe NonzeroExit and gang-
+        restart)."""
+        with self._lock:
+            for meta in self._procs.values():
+                if meta["name"] == pod_name and meta["proc"].poll() is None:
+                    meta["proc"].kill()
+                    return True
+        return False
+
+
 class PodExecutor:
     """Drives every Pod in the store through Pending→Running→terminal.
 

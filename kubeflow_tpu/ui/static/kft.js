@@ -50,7 +50,8 @@ const KFT = {
 
   get(path) { return this.api("GET", path); },
   post(path, body) { return this.api("POST", path, body || {}); },
-  del(path) { return this.api("DELETE", path); },
+  // KFAM's binding delete takes the binding in the body (api/kfam.py)
+  del(path, body) { return this.api("DELETE", path, body); },
 
   // topbar helpers ----------------------------------------------------
 

@@ -287,3 +287,171 @@ class TestDeployRouterBehindGateway:
             "POST", "/kfctl/apps/v1beta1/create", body={}
         )
         assert status == 404
+
+
+class TestContributorManagement:
+    """Workgroup sharing through the dashboard members panel — the
+    manage-users-view.js / add-contributor flow equivalent (reference:
+    api_workgroup.ts:377). The page drives EXACTLY these requests (the
+    jscheck tier pins its JS references); here the same calls run through
+    the live gateway."""
+
+    def login(self, gw):
+        status, body, headers = gw.handle_full(
+            "POST", "/apikflogin", body={"username": "alice", "password": "pw"}
+        )
+        assert status == 200
+        return {"cookie": dict(headers)["Set-Cookie"].split(";")[0]}
+
+    def test_add_list_remove_contributor_through_gateway(self, platform):
+        gw = platform.gateway
+        cookie = self.login(gw)
+        status, _ = gw.handle(
+            "POST", "/api/workgroup/create", body={"namespace": "team"},
+            headers=cookie,
+        )
+        assert status == 201
+        platform.settle()
+
+        # the members panel lists the owner's admin binding
+        status, body = gw.handle(
+            "GET", "/kfam/v1/bindings", headers=cookie,
+            query={"namespace": "team"},
+        )
+        assert status == 200
+        assert {(b["user"]["name"], b["role"]) for b in body["bindings"]} == {
+            ("alice", "admin")
+        }
+
+        # add a contributor (the addContributor(event) form submit)
+        status, body = gw.handle(
+            "POST", "/kfam/v1/bindings",
+            body={"user": "bob@example.com", "referredNamespace": "team",
+                  "role": "edit"},
+            headers=cookie,
+        )
+        assert status in (200, 201), body
+        platform.settle()
+        status, body = gw.handle(
+            "GET", "/kfam/v1/bindings", headers=cookie,
+            query={"namespace": "team"},
+        )
+        users = {(b["user"]["name"], b["role"]) for b in body["bindings"]}
+        assert ("bob@example.com", "edit") in users
+
+        # the contributor can now read the namespace's resources
+        bob = {"cookie": cookie["cookie"]}  # same session transport...
+        status, body = platform.dashboard.handle(
+            "GET", "/api/resources/team",
+            headers={"x-auth-user-email": "bob@example.com"},
+        )
+        assert status == 200
+
+        # remove (the removeContributor button)
+        status, body = gw.handle(
+            "DELETE", "/kfam/v1/bindings",
+            body={"user": "bob@example.com", "referredNamespace": "team",
+                  "role": "edit"},
+            headers=cookie,
+        )
+        assert status == 200, body
+        status, body = gw.handle(
+            "GET", "/kfam/v1/bindings", headers=cookie,
+            query={"namespace": "team"},
+        )
+        assert {(b["user"]["name"], b["role"]) for b in body["bindings"]} == {
+            ("alice", "admin")
+        }
+
+    def test_non_owner_cannot_add_contributors(self, platform):
+        gw = platform.gateway
+        cookie = self.login(gw)
+        status, _ = gw.handle(
+            "POST", "/api/workgroup/create", body={"namespace": "mine"},
+            headers=cookie,
+        )
+        assert status == 201
+        platform.settle()
+        # mallory (no session, direct BFF with her own header) is refused
+        status, body = platform.kfam.handle(
+            "POST", "/kfam/v1/bindings",
+            body={"user": "mallory@example.com",
+                  "referredNamespace": "mine", "role": "admin"},
+            headers={"x-auth-user-email": "mallory@example.com"},
+        )
+        assert status == 403
+
+
+class TestJsCheck:
+    """The executable-less JS tier (ui/jscheck.py): shipped pages are
+    reference-closed; seeded typos fail. (No JS engine exists in this
+    environment — see the module docstring — so reference closure is the
+    strongest automated check available.)"""
+
+    def test_shipped_pages_clean(self):
+        import os
+
+        from kubeflow_tpu.ui.jscheck import check_static_dir
+
+        static = os.path.join(
+            os.path.dirname(__file__), "..", "kubeflow_tpu", "ui", "static"
+        )
+        assert check_static_dir(static) == {}
+
+    def test_typoed_kft_method_caught(self):
+        from kubeflow_tpu.ui.jscheck import check_page
+
+        kft = "const KFT = {\n  get(path) { return 1; },\n};\n"
+        html = '<script>KFT.gte("/api/x");</script>'
+        errs = check_page("p.html", html, kft)
+        assert any("KFT.gte" in e for e in errs)
+
+    def test_phantom_element_id_caught(self):
+        from kubeflow_tpu.ui.jscheck import check_page
+
+        kft = "const KFT = {\n  get(path) { return 1; },\n};\n"
+        html = (
+            '<div id="real"></div>'
+            '<script>document.getElementById("reall").innerHTML = "";</script>'
+        )
+        errs = check_page("p.html", html, kft)
+        assert any('getElementById("reall")' in e for e in errs)
+
+    def test_unbalanced_brace_caught_with_line(self):
+        from kubeflow_tpu.ui.jscheck import lex_errors
+
+        errs = lex_errors("function f() {\n  if (x) {\n}\n", "p.js")
+        assert errs and "never closed" in errs[0]
+
+    def test_unterminated_string_caught(self):
+        from kubeflow_tpu.ui.jscheck import lex_errors
+
+        errs = lex_errors('const s = "abc;\n', "p.js")
+        assert errs and "unterminated" in errs[0]
+
+    def test_undefined_inline_handler_caught(self):
+        from kubeflow_tpu.ui.jscheck import check_page
+
+        kft = "const KFT = {\n  get(path) { return 1; },\n};\n"
+        html = (
+            '<form onsubmit="return createWorkgrp(event)"></form>'
+            "<script>async function createWorkgroup(ev) { return false; }"
+            "</script>"
+        )
+        errs = check_page("p.html", html, kft)
+        assert any("createWorkgrp" in e for e in errs)
+
+    def test_members_parsed_from_kft(self):
+        import os
+
+        from kubeflow_tpu.ui.jscheck import kft_members
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "kubeflow_tpu", "ui", "static",
+            "kft.js",
+        )
+        with open(path) as f:
+            members = kft_members(f.read())
+        for expect in ("get", "post", "del", "renderChart", "initTopbar",
+                       "logout", "namespace", "setNamespace", "msg"):
+            assert expect in members, members
