@@ -1,0 +1,135 @@
+"""Real AWS client implementation behind the profile plugin's IAM seam.
+
+The `AwsIamForServiceAccount` plugin (controllers/profile.py) edits an IAM
+role's trust policy so the namespace's service account can
+AssumeRoleWithWebIdentity — the reference does this with aws-sdk-go
+(reference: profile-controller/controllers/plugin_iam.go:21-48,66). This is
+the boto3-backed production implementation of the `AwsIamClient` protocol.
+
+The boto3 client is injectable: production builds one (import-guarded —
+boto3 is absent in air-gapped CI); tests inject a stub with get_role /
+update_assume_role_policy semantics and run the same contract suite as the
+fake (tests/test_cloud_clients.py).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def have_boto3() -> bool:
+    try:
+        import boto3  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _build_client():
+    try:
+        import boto3
+    except ImportError as e:  # pragma: no cover - exercised via message test
+        raise ImportError(
+            "boto3 is not installed; BotoAwsIamClient needs it in "
+            "production. In air-gapped runs inject a `client` or use the "
+            "fake implementation."
+        ) from e
+    return boto3.client("iam")
+
+
+class BotoAwsIamClient:
+    """`AwsIamClient` over IAM get-role / update-assume-role-policy.
+
+    oidc_provider is the cluster's OIDC issuer (the IRSA federated
+    principal); the trust entry's StringEquals subject is
+    `system:serviceaccount:<namespace>:<ksa>` — the same condition the
+    reference writes.
+    """
+
+    def __init__(self, oidc_provider: str, client=None):
+        self.oidc_provider = oidc_provider.rstrip("/")
+        self.client = client if client is not None else _build_client()
+
+    @staticmethod
+    def _role_name(role_arn: str) -> str:
+        return role_arn.rsplit("/", 1)[-1]
+
+    def _subject(self, namespace: str, ksa: str) -> str:
+        return f"system:serviceaccount:{namespace}:{ksa}"
+
+    def _condition_key(self) -> str:
+        host = self.oidc_provider.split("://", 1)[-1]
+        return f"{host}:sub"
+
+    def _entry(self, namespace: str, ksa: str) -> dict:
+        return {
+            "Effect": "Allow",
+            "Principal": {"Federated": self.oidc_provider},
+            "Action": "sts:AssumeRoleWithWebIdentity",
+            "Condition": {
+                "StringEquals": {
+                    self._condition_key(): self._subject(namespace, ksa)
+                }
+            },
+        }
+
+    def _load_policy(self, role_name: str) -> dict:
+        role = self.client.get_role(RoleName=role_name)["Role"]
+        doc = role.get("AssumeRolePolicyDocument") or {}
+        if isinstance(doc, str):  # the API may return URL-encoded JSON
+            from urllib.parse import unquote
+
+            doc = json.loads(unquote(doc))
+        doc.setdefault("Version", "2012-10-17")
+        doc.setdefault("Statement", [])
+        return doc
+
+    def _matches(self, stmt: dict, namespace: str, ksa: str) -> bool:
+        cond = stmt.get("Condition", {}).get("StringEquals", {})
+        return (
+            stmt.get("Action") == "sts:AssumeRoleWithWebIdentity"
+            and cond.get(self._condition_key())
+            == self._subject(namespace, ksa)
+        )
+
+    def add_trust_entry(
+        self, role_arn: str, namespace: str, ksa: str
+    ) -> None:
+        role_name = self._role_name(role_arn)
+        doc = self._load_policy(role_name)
+        if any(
+            self._matches(s, namespace, ksa) for s in doc["Statement"]
+        ):
+            return  # idempotent, like the fake
+        doc["Statement"].append(self._entry(namespace, ksa))
+        self.client.update_assume_role_policy(
+            RoleName=role_name, PolicyDocument=json.dumps(doc)
+        )
+        log.info(
+            "added IRSA trust for %s/%s to %s", namespace, ksa, role_arn
+        )
+
+    def remove_trust_entry(
+        self, role_arn: str, namespace: str, ksa: str
+    ) -> None:
+        role_name = self._role_name(role_arn)
+        doc = self._load_policy(role_name)
+        kept = [
+            s for s in doc["Statement"]
+            if not self._matches(s, namespace, ksa)
+        ]
+        if len(kept) == len(doc["Statement"]):
+            return
+        doc["Statement"] = kept
+        self.client.update_assume_role_policy(
+            RoleName=role_name, PolicyDocument=json.dumps(doc)
+        )
+        log.info(
+            "removed IRSA trust for %s/%s from %s", namespace, ksa, role_arn
+        )

@@ -1,0 +1,206 @@
+"""Cluster-config handoff: the BuildClusterConfig analog.
+
+The reference's deployment engine provisions a GKE cluster, then builds a
+rest.Config from the Container API and injects it into the kustomize
+phase so Apply(K8S) targets the cluster it just created (reference:
+bootstrap/cmd/bootstrap/app/kfctlServer.go:595 BuildClusterConfig, :289
+SetK8sRestConfig). Round 2's coordinator always self-applied to the
+in-process store — "deploy to the cluster you just created" was not
+expressible (VERDICT r2 weak #5). This module closes that:
+
+- `build_cluster_config` — Container-API cluster → a standard kubeconfig
+  dict (endpoint + cluster CA + the gke-gcloud-auth-plugin exec entry).
+- `K8sTarget` — where Apply(K8S) lands. `StoreTarget` is the in-process
+  default (hermetic CI); `KubeconfigTarget` (import-guarded on the
+  kubernetes client) applies to the real API server named by a
+  kubeconfig; `gke_target_builder` wires a GkeProvider apply result into
+  one — the SetK8sRestConfig moment.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Protocol
+
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def build_cluster_config(
+    cluster: Dict[str, Any],
+    project: str = "",
+    zone: str = "",
+    allow_insecure: bool = False,
+) -> Dict[str, Any]:
+    """Container-API cluster dict → kubeconfig dict (BuildClusterConfig).
+
+    Works on both the real API response and FakeContainerApi's shape; the
+    endpoint must be present (a cluster still provisioning has none), and
+    so must the cluster CA — silently skipping TLS verification would let
+    the K8S phase hand exec-plugin credentials to a MITM. `allow_insecure`
+    is the explicit dev-only opt-out.
+    """
+    endpoint = cluster.get("endpoint", "")
+    if not endpoint:
+        raise ValueError(
+            f"cluster {cluster.get('name', '?')!r} has no endpoint yet "
+            f"(status: {cluster.get('status', '?')})"
+        )
+    name = cluster.get("name", "cluster")
+    context = f"gke_{project or 'project'}_{zone or 'zone'}_{name}"
+    ca = cluster.get("masterAuth", {}).get("clusterCaCertificate", "")
+    cluster_entry: Dict[str, Any] = {"server": f"https://{endpoint}"}
+    if ca:
+        cluster_entry["certificate-authority-data"] = ca
+    elif allow_insecure:
+        cluster_entry["insecure-skip-tls-verify"] = True
+    else:
+        raise ValueError(
+            f"cluster {name!r} reports no CA certificate; refusing to "
+            "render an unverified kubeconfig (allow_insecure=True to "
+            "override in dev)"
+        )
+    return {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "current-context": context,
+        "clusters": [{"name": context, "cluster": cluster_entry}],
+        "contexts": [
+            {
+                "name": context,
+                "context": {"cluster": context, "user": context},
+            }
+        ],
+        "users": [
+            {
+                "name": context,
+                "user": {
+                    "exec": {
+                        "apiVersion": "client.authentication.k8s.io/v1beta1",
+                        "command": "gke-gcloud-auth-plugin",
+                        "provideClusterInfo": True,
+                    }
+                },
+            }
+        ],
+    }
+
+
+class K8sTarget(Protocol):
+    """Where the K8S phase's rendered objects land."""
+
+    def apply(self, obj: Dict[str, Any]) -> None: ...
+
+
+class StoreTarget:
+    """Apply into the in-process StateStore (hermetic default)."""
+
+    def __init__(self, store) -> None:
+        self.store = store
+
+    def apply(self, obj: Dict[str, Any]) -> None:
+        self.store.apply(obj)
+
+
+def have_kubernetes_sdk() -> bool:
+    try:
+        import kubernetes  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+class KubeconfigTarget:
+    """Apply to the real API server a kubeconfig names.
+
+    Import-guarded: the kubernetes client is absent in air-gapped CI —
+    constructing without it raises with guidance; an injected `client`
+    (tests) bypasses the SDK entirely.
+    """
+
+    def __init__(
+        self, kubeconfig: Dict[str, Any], client: Optional[Any] = None
+    ) -> None:
+        self.kubeconfig = kubeconfig
+        if client is not None:
+            self.client = client
+            return
+        try:
+            import kubernetes.config
+        except ImportError as e:
+            raise ImportError(
+                "the kubernetes client is not installed; KubeconfigTarget "
+                "needs it in production. Inject `client` for tests or use "
+                "StoreTarget for in-process applies."
+            ) from e
+        self.client = _SdkClient(
+            kubernetes.config.new_client_from_config_dict(kubeconfig)
+        )
+
+    def apply(self, obj: Dict[str, Any]) -> None:
+        # whatever client was wired; the injectable seam keeps this
+        # testable without a cluster
+        self.client.apply(obj)
+
+
+class _SdkClient:
+    """kubernetes-SDK adapter: create, merge-patch on AlreadyExists.
+
+    Create-or-UPDATE, matching StoreTarget's semantics — swallowing the
+    409 would leave stale objects on the real cluster after a changed
+    re-render (the second-apply contract means converge, not no-op)."""
+
+    def __init__(self, api_client) -> None:
+        self.api_client = api_client
+
+    def apply(self, obj: Dict[str, Any]) -> None:
+        import kubernetes.dynamic
+        import kubernetes.utils
+        from kubernetes.client.rest import ApiException
+
+        try:
+            kubernetes.utils.create_from_dict(self.api_client, obj)
+        except ApiException as e:
+            if e.status != 409:
+                raise
+            dyn = kubernetes.dynamic.DynamicClient(self.api_client)
+            resource = dyn.resources.get(
+                api_version=obj.get("apiVersion", "v1"), kind=obj["kind"]
+            )
+            resource.patch(
+                body=obj,
+                name=obj["metadata"]["name"],
+                namespace=obj["metadata"].get("namespace"),
+                content_type="application/merge-patch+json",
+            )
+
+
+def gke_target_builder(container_api, kubeconfig_client_factory=None):
+    """Coordinator `target_builder`: platform_info → KubeconfigTarget.
+
+    The returned callable is the SetK8sRestConfig step — it looks the
+    just-provisioned cluster up through the SAME Container API the
+    provider used, renders its kubeconfig, and hands back the remote
+    apply target for the K8S phase."""
+
+    def build(platform, platform_info: Dict[str, Any]):
+        cluster = container_api.get_cluster(
+            platform.project, platform.zone, platform_info["cluster"]
+        )
+        if cluster is None:
+            raise RuntimeError(
+                f"cluster {platform_info['cluster']} vanished between the "
+                "PLATFORM and K8S phases"
+            )
+        kubeconfig = build_cluster_config(
+            cluster, platform.project, platform.zone
+        )
+        client = (
+            kubeconfig_client_factory(kubeconfig)
+            if kubeconfig_client_factory is not None
+            else None
+        )
+        return KubeconfigTarget(kubeconfig, client=client)
+
+    return build

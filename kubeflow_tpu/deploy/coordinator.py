@@ -58,9 +58,15 @@ class Coordinator:
         self,
         store: StateStore,
         provider: Optional[PlatformProvider] = None,
+        target_builder=None,
     ) -> None:
+        """target_builder(platform, platform_info) -> K8sTarget: the
+        BuildClusterConfig → SetK8sRestConfig handoff (reference:
+        kfctlServer.go:595,289; deploy/cluster_config.py
+        gke_target_builder). None = apply to the in-process store."""
         self.store = store
         self.provider = provider or LocalProvider()
+        self.target_builder = target_builder
         reg = default_registry()
         # the reference's metric battery (server.go:68-132)
         self._deploy_seconds = reg.histogram(
@@ -76,8 +82,13 @@ class Coordinator:
         try:
             with self._deploy_seconds.time(phase="platform"):
                 platform_info = self.provider.apply_platform(platform)
+            target = None
+            if self.target_builder is not None:
+                # the K8S phase targets the cluster the PLATFORM phase
+                # just provisioned, not the local store
+                target = self.target_builder(platform, platform_info)
             with self._deploy_seconds.time(phase="k8s"):
-                applied = self._apply_k8s_with_retry(platform)
+                applied = self._apply_k8s_with_retry(platform, target)
         except Exception:
             self._deploy_total.inc(outcome="failed")
             raise
@@ -89,13 +100,17 @@ class Coordinator:
             "elapsed_s": round(time.monotonic() - t0, 3),
         }
 
-    def _apply_k8s_with_retry(self, platform: PlatformDef) -> int:
+    def _apply_k8s_with_retry(self, platform: PlatformDef, target=None) -> int:
         objs = manifests.render(platform)
+        if target is None:
+            from kubeflow_tpu.deploy.cluster_config import StoreTarget
+
+            target = StoreTarget(self.store)
         last_exc: Optional[Exception] = None
         for attempt in range(1, APPLY_K8S_RETRIES + 1):
             try:
                 for obj in objs:
-                    self.store.apply(obj)  # create-or-update: idempotent
+                    target.apply(obj)  # create-or-update: idempotent
                 return len(objs)
             except Exception as e:  # flaky-boundary retry
                 last_exc = e

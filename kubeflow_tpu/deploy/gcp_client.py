@@ -1,0 +1,212 @@
+"""Real GCP client implementations behind the platform's injection seams.
+
+Round 2 defined the seams (`ContainerApi` in deploy/gke.py, `IamClient` in
+controllers/profile.py) but shipped only in-memory fakes — the reference
+ships working SDK integrations (reference:
+bootstrap/cmd/bootstrap/app/kfctlServer.go:595 BuildClusterConfig via the
+Container API; profile-controller/controllers/plugin_workload_identity.go:
+86-120 real IAM policy edits). These are the production implementations:
+
+- `GoogleContainerApi` — GKE clusters/node pools via the Container REST
+  API (googleapiclient discovery), with operation polling and 404→None
+  normalization so it honors exactly the contract `FakeContainerApi`
+  models.
+- `GoogleIamClient` — workloadIdentityUser bindings on a GCP service
+  account via the IAM policy read-modify-write cycle.
+
+Both take an injectable `service` transport: production builds one from
+googleapiclient (import-guarded — the SDK is absent in air-gapped CI);
+tests inject a stub with the same REST semantics and run the SAME
+contract suite as the fakes (tests/test_cloud_clients.py), so the
+translation logic is exercised without the SDK or network.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def have_google_sdk() -> bool:
+    try:
+        import googleapiclient.discovery  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _build_service(api: str, version: str):
+    try:
+        from googleapiclient.discovery import build
+    except ImportError as e:  # pragma: no cover - exercised via message test
+        raise ImportError(
+            "googleapiclient is not installed; the GCP clients need it in "
+            "production. In air-gapped runs inject a `service` transport "
+            "or use the Fake* implementations."
+        ) from e
+    return build(api, version, cache_discovery=False)
+
+
+def _is_http_404(exc: Exception) -> bool:
+    status = getattr(getattr(exc, "resp", None), "status", None)
+    if status is None:
+        status = getattr(exc, "status", None)  # stub transports
+    return status == 404
+
+
+class GoogleContainerApi:
+    """`ContainerApi` over the real Container v1 REST surface.
+
+    Create calls return long-running operations; `wait` polls them to DONE
+    (the reference's BuildClusterConfig assumes a RUNNING cluster).
+    """
+
+    def __init__(self, service=None, poll_s: float = 5.0, timeout_s: float = 900.0):
+        self.service = service if service is not None else _build_service(
+            "container", "v1"
+        )
+        self.poll_s = poll_s
+        self.timeout_s = timeout_s
+
+    @staticmethod
+    def _parent(project: str, zone: str) -> str:
+        return f"projects/{project}/locations/{zone}"
+
+    def _wait_op(self, project: str, zone: str, op: Dict[str, Any]) -> None:
+        name = op.get("name")
+        if not name or op.get("status") == "DONE":
+            if op.get("error"):  # synchronous failure reported as DONE
+                raise RuntimeError(f"operation failed: {op['error']}")
+            return
+        deadline = time.monotonic() + self.timeout_s
+        ops = self.service.projects().locations().operations()
+        while time.monotonic() < deadline:
+            cur = ops.get(
+                name=f"{self._parent(project, zone)}/operations/{name}"
+            ).execute()
+            if cur.get("status") == "DONE":
+                if cur.get("error"):
+                    raise RuntimeError(f"operation {name} failed: {cur['error']}")
+                return
+            time.sleep(self.poll_s)
+        raise TimeoutError(f"operation {name} did not finish in {self.timeout_s}s")
+
+    def get_cluster(
+        self, project: str, zone: str, name: str
+    ) -> Optional[Dict[str, Any]]:
+        clusters = self.service.projects().locations().clusters()
+        try:
+            return clusters.get(
+                name=f"{self._parent(project, zone)}/clusters/{name}"
+            ).execute()
+        except Exception as e:  # noqa: BLE001 - HttpError shape varies
+            if _is_http_404(e):
+                return None
+            raise
+
+    def create_cluster(
+        self, project: str, zone: str, spec: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        clusters = self.service.projects().locations().clusters()
+        op = clusters.create(
+            parent=self._parent(project, zone), body={"cluster": spec}
+        ).execute()
+        self._wait_op(project, zone, op)
+        cluster = self.get_cluster(project, zone, spec["name"])
+        if cluster is None:  # pragma: no cover - API contract violation
+            raise RuntimeError(f"cluster {spec['name']} missing after create")
+        return cluster
+
+    def create_node_pool(
+        self, project: str, zone: str, cluster: str, spec: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        pools = (
+            self.service.projects().locations().clusters().nodePools()
+        )
+        op = pools.create(
+            parent=f"{self._parent(project, zone)}/clusters/{cluster}",
+            body={"nodePool": spec},
+        ).execute()
+        self._wait_op(project, zone, op)
+        return spec
+
+    def delete_cluster(self, project: str, zone: str, name: str) -> None:
+        clusters = self.service.projects().locations().clusters()
+        try:
+            op = clusters.delete(
+                name=f"{self._parent(project, zone)}/clusters/{name}"
+            ).execute()
+        except Exception as e:  # noqa: BLE001
+            if _is_http_404(e):
+                return  # idempotent delete, like the fake
+            raise
+        self._wait_op(project, zone, op)
+
+
+class GoogleIamClient:
+    """`IamClient` over the real IAM policy read-modify-write cycle
+    (reference: plugin_workload_identity.go:86-120)."""
+
+    ROLE = "roles/iam.workloadIdentityUser"
+
+    def __init__(self, service=None, project: Optional[str] = None):
+        self.service = service if service is not None else _build_service(
+            "iam", "v1"
+        )
+        self.project = project
+
+    def _resource(self, gcp_sa: str) -> str:
+        project = self.project or gcp_sa.split("@", 1)[-1].split(".", 1)[0]
+        return f"projects/{project}/serviceAccounts/{gcp_sa}"
+
+    def _member(self, gcp_sa: str, namespace: str, ksa: str) -> str:
+        # derive the workload-identity pool project from the SA email when
+        # not given explicitly, exactly as _resource does
+        project = self.project or gcp_sa.split("@", 1)[-1].split(".", 1)[0]
+        return f"serviceAccount:{project}.svc.id.goog[{namespace}/{ksa}]"
+
+    def _edit_policy(self, gcp_sa: str, mutate) -> None:
+        accounts = self.service.projects().serviceAccounts()
+        resource = self._resource(gcp_sa)
+        policy = accounts.getIamPolicy(resource=resource).execute() or {}
+        bindings = policy.setdefault("bindings", [])
+        entry = next(
+            (b for b in bindings if b.get("role") == self.ROLE), None
+        )
+        if entry is None:
+            entry = {"role": self.ROLE, "members": []}
+            bindings.append(entry)
+        mutate(entry["members"])
+        bindings[:] = [b for b in bindings if b.get("members")]
+        accounts.setIamPolicy(
+            resource=resource, body={"policy": policy}
+        ).execute()
+
+    def bind_workload_identity(
+        self, gcp_sa: str, namespace: str, ksa: str
+    ) -> None:
+        member = self._member(gcp_sa, namespace, ksa)
+
+        def add(members):
+            if member not in members:
+                members.append(member)
+
+        self._edit_policy(gcp_sa, add)
+        log.info("bound %s to %s", member, gcp_sa)
+
+    def unbind_workload_identity(
+        self, gcp_sa: str, namespace: str, ksa: str
+    ) -> None:
+        member = self._member(gcp_sa, namespace, ksa)
+
+        def drop(members):
+            if member in members:
+                members.remove(member)
+
+        self._edit_policy(gcp_sa, drop)
+        log.info("unbound %s from %s", member, gcp_sa)

@@ -59,6 +59,9 @@ class FakeContainerApi:
             **spec,
             "status": "RUNNING",
             "endpoint": f"10.0.0.{len(self.clusters) + 1}",
+            # base64("fake-ca") — present so BuildClusterConfig renders a
+            # CA-pinned kubeconfig exactly as it would from the real API
+            "masterAuth": {"clusterCaCertificate": "ZmFrZS1jYQ=="},
             "nodePools": list(spec.get("nodePools", [])),
         }
         self.clusters[self._key(project, zone, spec["name"])] = cluster
