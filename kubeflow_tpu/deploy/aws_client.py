@@ -46,14 +46,28 @@ def _build_client():
 class BotoAwsIamClient:
     """`AwsIamClient` over IAM get-role / update-assume-role-policy.
 
-    oidc_provider is the cluster's OIDC issuer (the IRSA federated
-    principal); the trust entry's StringEquals subject is
+    `oidc_provider_arn` is the cluster's IAM OIDC provider ARN
+    (arn:aws:iam::<acct>:oidc-provider/<issuer-host/path>). Real IAM
+    requires the ARN as the federated Principal while the StringEquals
+    condition is keyed on the issuer HOST path — both derive from the one
+    ARN here, so they can never disagree. The subject is
     `system:serviceaccount:<namespace>:<ksa>` — the same condition the
     reference writes.
     """
 
-    def __init__(self, oidc_provider: str, client=None):
-        self.oidc_provider = oidc_provider.rstrip("/")
+    ARN_MARKER = ":oidc-provider/"
+
+    def __init__(self, oidc_provider_arn: str, client=None):
+        arn = oidc_provider_arn.rstrip("/")
+        if self.ARN_MARKER not in arn:
+            raise ValueError(
+                "expected an IAM OIDC provider ARN "
+                "(arn:aws:iam::<acct>:oidc-provider/<issuer>), got "
+                f"{oidc_provider_arn!r} — a bare issuer URL is not a valid "
+                "federated principal"
+            )
+        self.provider_arn = arn
+        self.issuer_host = arn.split(self.ARN_MARKER, 1)[1]
         self.client = client if client is not None else _build_client()
 
     @staticmethod
@@ -64,13 +78,12 @@ class BotoAwsIamClient:
         return f"system:serviceaccount:{namespace}:{ksa}"
 
     def _condition_key(self) -> str:
-        host = self.oidc_provider.split("://", 1)[-1]
-        return f"{host}:sub"
+        return f"{self.issuer_host}:sub"
 
     def _entry(self, namespace: str, ksa: str) -> dict:
         return {
             "Effect": "Allow",
-            "Principal": {"Federated": self.oidc_provider},
+            "Principal": {"Federated": self.provider_arn},
             "Action": "sts:AssumeRoleWithWebIdentity",
             "Condition": {
                 "StringEquals": {

@@ -161,19 +161,28 @@ class _SdkClient:
 
         try:
             kubernetes.utils.create_from_dict(self.api_client, obj)
-        except ApiException as e:
+            return
+        except kubernetes.utils.FailToCreateError as e:
+            # create_from_dict wraps per-object ApiExceptions; anything
+            # beyond AlreadyExists is a real failure
+            if any(
+                getattr(ae, "status", None) != 409
+                for ae in e.api_exceptions
+            ):
+                raise
+        except ApiException as e:  # defensive: some paths raise it bare
             if e.status != 409:
                 raise
-            dyn = kubernetes.dynamic.DynamicClient(self.api_client)
-            resource = dyn.resources.get(
-                api_version=obj.get("apiVersion", "v1"), kind=obj["kind"]
-            )
-            resource.patch(
-                body=obj,
-                name=obj["metadata"]["name"],
-                namespace=obj["metadata"].get("namespace"),
-                content_type="application/merge-patch+json",
-            )
+        dyn = kubernetes.dynamic.DynamicClient(self.api_client)
+        resource = dyn.resources.get(
+            api_version=obj.get("apiVersion", "v1"), kind=obj["kind"]
+        )
+        resource.patch(
+            body=obj,
+            name=obj["metadata"]["name"],
+            namespace=obj["metadata"].get("namespace"),
+            content_type="application/merge-patch+json",
+        )
 
 
 def gke_target_builder(container_api, kubeconfig_client_factory=None):

@@ -160,14 +160,16 @@ class GoogleIamClient:
         )
         self.project = project
 
+    def _project_of(self, gcp_sa: str) -> str:
+        """Workload-identity pool project: explicit, else from the SA
+        email (sa@PROJECT.iam.gserviceaccount.com)."""
+        return self.project or gcp_sa.split("@", 1)[-1].split(".", 1)[0]
+
     def _resource(self, gcp_sa: str) -> str:
-        project = self.project or gcp_sa.split("@", 1)[-1].split(".", 1)[0]
-        return f"projects/{project}/serviceAccounts/{gcp_sa}"
+        return f"projects/{self._project_of(gcp_sa)}/serviceAccounts/{gcp_sa}"
 
     def _member(self, gcp_sa: str, namespace: str, ksa: str) -> str:
-        # derive the workload-identity pool project from the SA email when
-        # not given explicitly, exactly as _resource does
-        project = self.project or gcp_sa.split("@", 1)[-1].split(".", 1)[0]
+        project = self._project_of(gcp_sa)
         return f"serviceAccount:{project}.svc.id.goog[{namespace}/{ksa}]"
 
     def _edit_policy(self, gcp_sa: str, mutate) -> None:

@@ -272,11 +272,18 @@ class Bert(nn.Module):
                     x, attention_mask, deterministic
                 )
 
-        # MLM head: transform + tied-style output projection to vocab.
+        # MLM head: transform + tied-style output projection to vocab. The
+        # vocab matmul runs in the compute dtype — in f32 this single
+        # [tokens, d] x [d, 30k] projection (fwd + 2 bwd passes) ran at the
+        # MXU's f32 rate and ate ~15% of the step (the round-2 28.9% MFU
+        # gap, VERDICT r2 item 8); params stay f32, logits cast to f32 for
+        # the softmax.
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
         h = nn.gelu(h, approximate=True)
         h = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(h)
-        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="mlm_out")(h)
+        logits = nn.Dense(
+            cfg.vocab_size, dtype=cfg.dtype, name="mlm_out"
+        )(h.astype(cfg.dtype)).astype(jnp.float32)
 
         pooled = nn.tanh(
             nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(x[:, 0])

@@ -173,7 +173,7 @@ class CausalSelfAttention(nn.Module):
             from kubeflow_tpu.ops.attention import auto_attention_impl
 
             impl = auto_attention_impl(
-                x.shape[0], x.shape[1], cfg.num_heads, cfg.dtype
+                x.shape[0], x.shape[1], cfg.num_heads, cfg.dtype, causal=True
             )
 
         if impl == "flash":
@@ -431,9 +431,12 @@ class Gpt(nn.Module):
                 )
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        # vocab projection in the compute dtype (f32 matmuls run at a
+        # fraction of bf16 MXU peak — see models/bert.py mlm_out); logits
+        # cast to f32 for the softmax/sampling path
         logits = nn.Dense(
-            cfg.vocab_size, dtype=jnp.float32, use_bias=False, name="head"
-        )(x)
+            cfg.vocab_size, dtype=cfg.dtype, use_bias=False, name="head"
+        )(x.astype(cfg.dtype)).astype(jnp.float32)
         return {"logits": logits}
 
 

@@ -70,12 +70,18 @@ def _resolve_blocks(s_pad: int, block_q: int, block_k: int):
 # ---------------------------------------------------------------------------
 
 
+def _last_visible_k(iq, block_q: int, block_k: int):
+    """Highest k-block index a causal q block attends to (its diagonal)."""
+    return (iq * block_q + block_q - 1) // block_k
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int
 ):
     ik = pl.program_id(2)
     n_k = pl.num_programs(2)
+    iq = pl.program_id(1)
 
     @pl.when(ik == 0)
     def _init():
@@ -83,53 +89,64 @@ def _fwd_kernel(
         m_ref[:] = jnp.full_like(m_ref, BIG_NEG)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0]  # (BQ, D)
-    k = k_ref[0]  # (BK, D)
-    v = v_ref[0]  # (BK, D)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    s = s * scale  # (BQ, BK)
+    # causal sparsity: blocks strictly above the diagonal contribute
+    # nothing — skip their MXU work entirely (their K/V fetches are also
+    # elided: the clamped index maps repeat the diagonal block, and the
+    # pipeline only issues a DMA when the block index changes)
+    last_k = _last_visible_k(iq, block_q, block_k) if causal else n_k - 1
+    work = (ik <= last_k) if causal else (ik >= 0)
 
-    kmask = mask_ref[0, 0] != 0  # (BK,) key padding
-    s = jnp.where(kmask[None, :], s, BIG_NEG)
-    if causal:
-        iq = pl.program_id(1)
-        q_pos = iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
+    @pl.when(work)
+    def _body():
+        q = q_ref[0]  # (BQ, D)
+        k = k_ref[0]  # (BK, D)
+        v = v_ref[0]  # (BK, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        k_pos = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
+        s = s * scale  # (BQ, BK)
+
+        kmask = mask_ref[0, 0] != 0  # (BK,) key padding
+        s = jnp.where(kmask[None, :], s, BIG_NEG)
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, BIG_NEG)
+
+        m_prev = m_ref[:, 0]  # (BQ,)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        # keep fully-masked columns exactly zero (BIG_NEG rows would
+        # otherwise renormalize to uniform when everything is masked)
+        p = jnp.where(kmask[None, :], p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        s = jnp.where(q_pos >= k_pos, s, BIG_NEG)
+        m_ref[:, 0] = m_new
+        l_ref[:, 0] = l_new
 
-    m_prev = m_ref[:, 0]  # (BQ,)
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-    p = jnp.exp(s - m_new[:, None])
-    # keep fully-masked columns exactly zero (BIG_NEG rows would otherwise
-    # renormalize to uniform when everything is masked)
-    p = jnp.where(kmask[None, :], p, 0.0)
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
-    acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_ref[:, 0] = m_new
-    l_ref[:, 0] = l_new
-
-    @pl.when(ik == n_k - 1)
+    @pl.when(ik == last_k)
     def _finish():
         l = jnp.maximum(l_ref[:, 0], 1e-30)
         o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
         lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l)
 
 
-def _fwd(q, k, v, mask, scale, causal, block_q, block_k):
-    """q,k,v: (BH, S, D); mask: (BH, S) int32. Returns (o, lse).
+def _fwd(q, k, v, mask, scale, causal, block_q, block_k, num_heads):
+    """q,k,v: (BH, S, D); mask: (B, 1, S) int32 (shared across the head
+    dim by the index map — never replicated in HBM). Returns (o, lse).
 
     block_q/block_k must already be resolved divisors of S (see
-    `_resolve_blocks`); every block is processed — no truncation.
+    `_resolve_blocks`); every block is processed — no truncation. Causal
+    grids clamp K/V fetches at the diagonal so skipped blocks cost
+    neither MXU work nor DMA bytes.
     """
     bh, s_len, d = q.shape
     bq, bk = block_q, block_k
@@ -138,14 +155,23 @@ def _fwd(q, k, v, mask, scale, causal, block_q, block_k):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
     )
+
+    def kv_idx(b, iq, ik):
+        ikc = jnp.minimum(ik, _last_visible_k(iq, bq, bk)) if causal else ik
+        return (b, ikc, 0)
+
+    def mask_idx(b, iq, ik):
+        ikc = jnp.minimum(ik, _last_visible_k(iq, bq, bk)) if causal else ik
+        return (b // num_heads, 0, ikc)
+
     return pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
-            pl.BlockSpec((1, 1, bk), lambda b, iq, ik: (b, 0, ik)),
+            pl.BlockSpec((1, bk, d), kv_idx),
+            pl.BlockSpec((1, bk, d), kv_idx),
+            pl.BlockSpec((1, 1, bk), mask_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
@@ -175,45 +201,55 @@ def _bwd_dq_kernel(
 ):
     ik = pl.program_id(2)
     n_k = pl.num_programs(2)
+    iq = pl.program_id(1)
 
     @pl.when(ik == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    kmask = mask_ref[0, 0] != 0
-    s = jnp.where(kmask[None, :], s, BIG_NEG)
-    if causal:
-        iq = pl.program_id(1)
-        q_pos = iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, BIG_NEG)
-    p = jnp.exp(s - lse[:, None])
-    p = jnp.where(kmask[None, :], p, 0.0)
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    ds = p * (dp - delta[:, None])
-    acc_ref[:] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
+    last_k = _last_visible_k(iq, block_q, block_k) if causal else n_k - 1
+    work = (ik <= last_k) if causal else (ik >= 0)
 
-    @pl.when(ik == n_k - 1)
+    @pl.when(work)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        kmask = mask_ref[0, 0] != 0
+        s = jnp.where(kmask[None, :], s, BIG_NEG)
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, BIG_NEG)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(kmask[None, :], p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(ik == last_k)
     def _finish():
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _first_visible_q(ik, block_q: int, block_k: int):
+    """Lowest q-block index that attends to causal k block ik."""
+    return (ik * block_k) // block_q
 
 
 def _bwd_dkv_kernel(
@@ -223,46 +259,53 @@ def _bwd_dkv_kernel(
 ):
     iq = pl.program_id(2)
     n_q = pl.num_programs(2)
+    ikb = pl.program_id(1)
 
     @pl.when(iq == 0)
     def _init():
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    kmask = mask_ref[0, 0] != 0
-    s = jnp.where(kmask[None, :], s, BIG_NEG)
-    if causal:
-        ikb = pl.program_id(1)
-        q_pos = iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
+    # causal: q blocks strictly above this k block's diagonal see none of
+    # it — skip them (their fetches are clamped away in the index maps)
+    first_q = _first_visible_q(ikb, block_q, block_k) if causal else 0
+    work = (iq >= first_q) if causal else (iq >= 0)
+
+    @pl.when(work)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        kmask = mask_ref[0, 0] != 0
+        s = jnp.where(kmask[None, :], s, BIG_NEG)
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ikb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, BIG_NEG)
+        p = jnp.exp(s - lse[:, None])  # (BQ, BK)
+        p = jnp.where(kmask[None, :], p, 0.0)
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        k_pos = ikb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        s = jnp.where(q_pos >= k_pos, s, BIG_NEG)
-    p = jnp.exp(s - lse[:, None])  # (BQ, BK)
-    p = jnp.where(kmask[None, :], p, 0.0)
-    dv_acc_ref[:] += jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    ds = p * (dp - delta[:, None])
-    dk_acc_ref[:] += jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
+        ds = p * (dp - delta[:, None])
+        dk_acc_ref[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
 
     @pl.when(iq == n_q - 1)
     def _finish():
@@ -270,7 +313,7 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, residuals, g):
+def _bwd(scale, causal, block_q, block_k, num_heads, residuals, g):
     q, k, v, mask, o, lse = residuals
     do, _ = g
     bh, s_len, d = q.shape
@@ -279,6 +322,14 @@ def _bwd(scale, causal, block_q, block_k, residuals, g):
     n_q, n_k = s_len // bq, s_len // bk
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, None, :]
 
+    def kv_idx(b, iq, ik):
+        ikc = jnp.minimum(ik, _last_visible_k(iq, bq, bk)) if causal else ik
+        return (b, ikc, 0)
+
+    def mask_idx_q(b, iq, ik):
+        ikc = jnp.minimum(ik, _last_visible_k(iq, bq, bk)) if causal else ik
+        return (b // num_heads, 0, ikc)
+
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
@@ -286,9 +337,9 @@ def _bwd(scale, causal, block_q, block_k, residuals, g):
         grid=(bh, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),
-            pl.BlockSpec((1, 1, bk), lambda b, iq, ik: (b, 0, ik)),
+            pl.BlockSpec((1, bk, d), kv_idx),
+            pl.BlockSpec((1, bk, d), kv_idx),
+            pl.BlockSpec((1, 1, bk), mask_idx_q),
             pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
             pl.BlockSpec((1, 1, bq), lambda b, iq, ik: (b, 0, iq)),
             pl.BlockSpec((1, 1, bq), lambda b, iq, ik: (b, 0, iq)),
@@ -299,19 +350,27 @@ def _bwd(scale, causal, block_q, block_k, residuals, g):
         interpret=_use_interpret(),
     )(q, k, v, mask, do, lse, delta)
 
+    def q_idx(b, ik, iq):
+        iqc = jnp.maximum(iq, _first_visible_q(ik, bq, bk)) if causal else iq
+        return (b, iqc, 0)
+
+    def lse_idx(b, ik, iq):
+        iqc = jnp.maximum(iq, _first_visible_q(ik, bq, bk)) if causal else iq
+        return (b, 0, iqc)
+
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
         ),
         grid=(bh, n_k, n_q),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, ik, iq: (b, iq, 0)),
+            pl.BlockSpec((1, bq, d), q_idx),
             pl.BlockSpec((1, bk, d), lambda b, ik, iq: (b, ik, 0)),
             pl.BlockSpec((1, bk, d), lambda b, ik, iq: (b, ik, 0)),
-            pl.BlockSpec((1, 1, bk), lambda b, ik, iq: (b, 0, ik)),
-            pl.BlockSpec((1, bq, d), lambda b, ik, iq: (b, iq, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, ik, iq: (b, 0, iq)),
-            pl.BlockSpec((1, 1, bq), lambda b, ik, iq: (b, 0, iq)),
+            pl.BlockSpec((1, 1, bk), lambda b, ik, iq: (b // num_heads, 0, ik)),
+            pl.BlockSpec((1, bq, d), q_idx),
+            pl.BlockSpec((1, 1, bq), lse_idx),
+            pl.BlockSpec((1, 1, bq), lse_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, ik, iq: (b, ik, 0)),
@@ -330,19 +389,21 @@ def _bwd(scale, causal, block_q, block_k, residuals, g):
     return dq, dk, dv, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, mask, scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, mask, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask, scale, causal, block_q, block_k, num_heads):
+    o, _ = _fwd(q, k, v, mask, scale, causal, block_q, block_k, num_heads)
     return o
 
 
-def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, mask, scale, causal, block_q, block_k)
+def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k, num_heads):
+    o, lse = _fwd(q, k, v, mask, scale, causal, block_q, block_k, num_heads)
     return o, (q, k, v, mask, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, residuals, g):
-    dq, dk, dv, _ = _bwd(scale, causal, block_q, block_k, residuals, (g, None))
+def _flash_bwd(scale, causal, block_q, block_k, num_heads, residuals, g):
+    dq, dk, dv, _ = _bwd(
+        scale, causal, block_q, block_k, num_heads, residuals, (g, None)
+    )
     return dq, dk, dv, None
 
 
@@ -385,13 +446,15 @@ def flash_attention(
         mask = jnp.pad(mask, ((0, 0), (0, pad)))
     s_pad = s_len + pad
 
-    # [B, S, H, D] -> (B*H, S, D)
+    # [B, S, H, D] -> (B*H, S, D); the mask stays (B, 1, S) — the kernels'
+    # index maps share one copy across heads instead of replicating it
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
 
     qbh, kbh, vbh = to_bh(q), to_bh(k), to_bh(v)
-    mask_bh = jnp.repeat(mask[:, None, :], h, axis=1).reshape(b * h, 1, s_pad)
-    out = _flash(qbh, kbh, vbh, mask_bh, float(scale), causal, bq, bk)
+    out = _flash(
+        qbh, kbh, vbh, mask[:, None, :], float(scale), causal, bq, bk, h
+    )
     out = out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
     if pad:
         out = out[:, :s_len]

@@ -299,18 +299,24 @@ class TestGoogleIamClient:
         assert svc.policies  # the cloud call actually happened
 
 
+PROVIDER_ARN = (
+    "arn:aws:iam::123:oidc-provider/oidc.eks.us-west-2.amazonaws.com/id/ABC"
+)
+
+
 class TestBotoAwsIamClient:
     def test_add_remove_trust_entry(self):
         stub = StubBotoIam()
-        iam = BotoAwsIamClient(
-            "https://oidc.eks.us-west-2.amazonaws.com/id/ABC", client=stub
-        )
+        iam = BotoAwsIamClient(PROVIDER_ARN, client=stub)
         arn = "arn:aws:iam::123:role/kf-role"
         iam.add_trust_entry(arn, "team", "default-editor")
         doc = stub.docs["kf-role"]
         assert len(doc["Statement"]) == 1
         stmt = doc["Statement"][0]
         assert stmt["Action"] == "sts:AssumeRoleWithWebIdentity"
+        # principal = the provider ARN; condition key = the issuer host —
+        # both from one input (real IAM rejects a URL principal)
+        assert stmt["Principal"]["Federated"] == PROVIDER_ARN
         assert stmt["Condition"]["StringEquals"] == {
             "oidc.eks.us-west-2.amazonaws.com/id/ABC:sub":
                 "system:serviceaccount:team:default-editor"
@@ -335,9 +341,16 @@ class TestBotoAwsIamClient:
         stub = StubBotoIam()
         doc = {"Version": "2012-10-17", "Statement": []}
         stub.docs["kf-role"] = quote(json.dumps(doc))
-        iam = BotoAwsIamClient("https://oidc/x", client=stub)
+        iam = BotoAwsIamClient(PROVIDER_ARN, client=stub)
         iam.add_trust_entry("arn:aws:iam::1:role/kf-role", "a", "b")
         assert len(stub.docs["kf-role"]["Statement"]) == 1
+
+    def test_bare_issuer_url_rejected(self):
+        with pytest.raises(ValueError, match="oidc-provider"):
+            BotoAwsIamClient(
+                "https://oidc.eks.us-west-2.amazonaws.com/id/ABC",
+                client=StubBotoIam(),
+            )
 
 
 class TestClusterConfigHandoff:
@@ -419,7 +432,7 @@ class TestImportGuards:
     @pytest.mark.skipif(have_boto3(), reason="boto3 present")
     def test_boto_client_without_sdk_raises_with_guidance(self):
         with pytest.raises(ImportError, match="boto3"):
-            BotoAwsIamClient("https://oidc/x")
+            BotoAwsIamClient(PROVIDER_ARN)
 
     @pytest.mark.skipif(have_kubernetes_sdk(), reason="kubernetes present")
     def test_kubeconfig_target_without_sdk_raises_with_guidance(self):
