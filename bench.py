@@ -214,11 +214,19 @@ def bench_bert(steps: int) -> dict:
         if peak_flops and cost["flops"]
         else None,
     }
-    if on_tpu and impl != "flash":
-        # keep the kernel measured even where the policy picks dense
-        dt_flash, _ = run("flash")
-        out["flash_step_time_ms"] = round(dt_flash * 1e3, 3)
-        out["flash_speedup_vs_dense"] = round(dt / dt_flash, 3)
+    if on_tpu:
+        # always measure the impl the policy did NOT pick, so the
+        # crossover stays visible in every report (dense may genuinely be
+        # infeasible at long seq — that null is the datapoint)
+        other = "dense" if impl == "flash" else "flash"
+        try:
+            dt_other, _ = run(other)
+            out[f"{other}_step_time_ms"] = round(dt_other * 1e3, 3)
+            ratio = (dt_other / dt) if other == "dense" else (dt / dt_other)
+            out["flash_speedup_vs_dense"] = round(ratio, 3)
+        except Exception as e:  # noqa: BLE001 - OOM expected at long seq
+            out[f"{other}_step_time_ms"] = None
+            out[f"{other}_error"] = type(e).__name__
     return out
 
 

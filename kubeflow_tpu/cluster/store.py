@@ -98,6 +98,11 @@ class StateStore:
         # the interception point the reference implements as a webhook server
         # (reference: components/admission-webhook/main.go:389 mutatePods).
         self._admission_hooks: Dict[str, List[Callable[[Dict[str, Any]], None]]] = {}
+        # Normalizers by kind, run on EVERY write path (create, update,
+        # apply) — the conversion-webhook interception for multi-version
+        # CRDs (cluster/versions.py): a spoke-version payload converts to
+        # the storage version no matter which verb carried it.
+        self._normalizers: Dict[str, List[Callable[[Dict[str, Any]], None]]] = {}
         reg = default_registry()
         self._writes = reg.counter(
             "statestore_writes_total", "writes", ["kind", "op"]
@@ -112,6 +117,19 @@ class StateStore:
         the create (the webhook allowed/denied contract)."""
         with self._lock:
             self._admission_hooks.setdefault(kind, []).append(hook)
+
+    def add_normalizer(
+        self, kind: str, fn: Callable[[Dict[str, Any]], None]
+    ) -> None:
+        """Register a write normalizer for `kind`, run on create, update,
+        AND apply — unlike admission hooks (create-only). Raising rejects
+        the write."""
+        with self._lock:
+            self._normalizers.setdefault(kind, []).append(fn)
+
+    def _normalize(self, obj: Dict[str, Any]) -> None:
+        for fn in self._normalizers.get(obj.get("kind", ""), []):
+            fn(obj)
 
     # -- internals -------------------------------------------------------
 
@@ -132,6 +150,7 @@ class StateStore:
 
     def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
         obj = copy.deepcopy(obj)
+        self._normalize(obj)
         m = obj.setdefault("metadata", {})
         kind = obj["kind"]
         namespace = m.setdefault("namespace", "default")
@@ -174,6 +193,7 @@ class StateStore:
         util.go:18-101).
         """
         obj = copy.deepcopy(obj)
+        self._normalize(obj)
         m = obj["metadata"]
         kind = obj["kind"]
         namespace = m.get("namespace", "default")
@@ -298,6 +318,11 @@ class StateStore:
         """Create-or-update (server-side-apply-lite): the universal reconcile
         primitive (reference: reconcilehelper/util.go:18-46 Deployment/Service
         create-or-copy-fields)."""
+        # normalize BEFORE the merge: an apply carrying a spoke-version
+        # payload must convert to the storage schema, or its spec would
+        # silently overwrite the hub-shaped stored spec
+        obj = copy.deepcopy(obj)
+        self._normalize(obj)
         m = obj.get("metadata", {})
         existing = self.try_get(
             obj["kind"], m.get("name", ""), m.get("namespace", "default")

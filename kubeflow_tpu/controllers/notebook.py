@@ -41,6 +41,74 @@ DEFAULT_NOTEBOOK_PORT = 8888
 DEFAULT_FS_GROUP = 100  # jovyan gid (reference notebook_controller.go:334)
 
 
+def notebook_versions():
+    """Multi-version Notebook CRD (reference: notebook_types.go:27-45 —
+    v1alpha1/v1beta1/v1 with conversion). v1beta1 is the storage (hub)
+    version; v1alpha1 is the legacy flat shape (image/cpu/memory at the
+    spec top level, pre-template) converted on write; v1 is the GA copy
+    of the v1beta1 schema."""
+    from kubeflow_tpu.cluster.objects import GROUP
+    from kubeflow_tpu.cluster.versions import VersionedKind
+
+    def alpha_to_hub(obj):
+        out = dict(obj)
+        spec = obj.get("spec", {}) or {}
+        name = obj.get("metadata", {}).get("name", "notebook")
+        container = {
+            "name": name,
+            "image": spec.get("image", ""),
+            "resources": {
+                "requests": {
+                    k: v
+                    for k, v in (
+                        ("cpu", spec.get("cpu")),
+                        ("memory", spec.get("memory")),
+                    )
+                    if v
+                }
+            },
+        }
+        hub_spec = {"template": {"spec": {"containers": [container]}}}
+        if spec.get("tpuTopology"):
+            hub_spec["tpu"] = {"topology": spec["tpuTopology"]}
+        out["spec"] = hub_spec
+        return out
+
+    def hub_to_alpha(obj):
+        out = dict(obj)
+        spec = obj.get("spec", {}) or {}
+        containers = (
+            spec.get("template", {}).get("spec", {}).get("containers", [])
+        )
+        c = containers[0] if containers else {}
+        requests = c.get("resources", {}).get("requests", {})
+        flat = {
+            "image": c.get("image", ""),
+            "cpu": requests.get("cpu", ""),
+            "memory": requests.get("memory", ""),
+        }
+        if spec.get("tpu", {}).get("topology"):
+            flat["tpuTopology"] = spec["tpu"]["topology"]
+        out["spec"] = flat
+        return out
+
+    identity = dict  # v1 shares the v1beta1 schema (GA rename only)
+    return (
+        VersionedKind(KIND, GROUP, "v1beta1")
+        .spoke("v1alpha1", alpha_to_hub, hub_to_alpha)
+        .spoke("v1", identity, identity)
+    )
+
+
+def install_notebook_conversion(store) -> None:
+    """Normalize every Notebook create to the storage version."""
+    from kubeflow_tpu.cluster.versions import ConversionRegistry
+
+    reg = ConversionRegistry()
+    reg.register(notebook_versions())
+    reg.install(store)
+
+
 def new_notebook(
     name: str,
     namespace: str = "default",

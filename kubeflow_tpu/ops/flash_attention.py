@@ -75,6 +75,43 @@ def _last_visible_k(iq, block_q: int, block_k: int):
     return (iq * block_q + block_q - 1) // block_k
 
 
+def _q_major_maps(causal: bool, bq: int, bk: int, num_heads: int):
+    """(kv, mask) index maps for (b, iq, ik) grids.
+
+    Causal grids clamp the k index at the q block's diagonal: steps past
+    it re-map to the diagonal block, and the pipeline only issues a DMA
+    when the mapped index changes — so skipped blocks cost no traffic.
+    The mask map also folds the head dim away (one [B, 1, S] copy serves
+    every head)."""
+
+    def clamp(iq, ik):
+        return jnp.minimum(ik, _last_visible_k(iq, bq, bk)) if causal else ik
+
+    def kv(b, iq, ik):
+        return (b, clamp(iq, ik), 0)
+
+    def mask(b, iq, ik):
+        return (b // num_heads, 0, clamp(iq, ik))
+
+    return kv, mask
+
+
+def _k_major_maps(causal: bool, bq: int, bk: int):
+    """(q, lse) index maps for (b, ik, iq) grids (the dk/dv kernel):
+    the q index clamps at the first block that sees this k block."""
+
+    def clamp(ik, iq):
+        return jnp.maximum(iq, _first_visible_q(ik, bq, bk)) if causal else iq
+
+    def q(b, ik, iq):
+        return (b, clamp(ik, iq), 0)
+
+    def lse(b, ik, iq):
+        return (b, 0, clamp(ik, iq))
+
+    return q, lse
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int
@@ -155,15 +192,7 @@ def _fwd(q, k, v, mask, scale, causal, block_q, block_k, num_heads):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
     )
-
-    def kv_idx(b, iq, ik):
-        ikc = jnp.minimum(ik, _last_visible_k(iq, bq, bk)) if causal else ik
-        return (b, ikc, 0)
-
-    def mask_idx(b, iq, ik):
-        ikc = jnp.minimum(ik, _last_visible_k(iq, bq, bk)) if causal else ik
-        return (b // num_heads, 0, ikc)
-
+    kv_idx, mask_idx = _q_major_maps(causal, bq, bk, num_heads)
     return pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
@@ -322,14 +351,7 @@ def _bwd(scale, causal, block_q, block_k, num_heads, residuals, g):
     n_q, n_k = s_len // bq, s_len // bk
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, None, :]
 
-    def kv_idx(b, iq, ik):
-        ikc = jnp.minimum(ik, _last_visible_k(iq, bq, bk)) if causal else ik
-        return (b, ikc, 0)
-
-    def mask_idx_q(b, iq, ik):
-        ikc = jnp.minimum(ik, _last_visible_k(iq, bq, bk)) if causal else ik
-        return (b // num_heads, 0, ikc)
-
+    kv_idx, mask_idx_q = _q_major_maps(causal, bq, bk, num_heads)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
@@ -350,14 +372,7 @@ def _bwd(scale, causal, block_q, block_k, num_heads, residuals, g):
         interpret=_use_interpret(),
     )(q, k, v, mask, do, lse, delta)
 
-    def q_idx(b, ik, iq):
-        iqc = jnp.maximum(iq, _first_visible_q(ik, bq, bk)) if causal else iq
-        return (b, iqc, 0)
-
-    def lse_idx(b, ik, iq):
-        iqc = jnp.maximum(iq, _first_visible_q(ik, bq, bk)) if causal else iq
-        return (b, 0, iqc)
-
+    q_idx, lse_idx = _k_major_maps(causal, bq, bk)
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk

@@ -58,6 +58,13 @@ class Platform:
         self.platform_def = platform_def or PlatformDef()
         self.store = StateStore()
         poddefaults.register(self.store)
+        # multi-version Notebook CRD: spoke-version writes (v1alpha1/v1)
+        # convert to the storage version before persist
+        from kubeflow_tpu.controllers.notebook import (
+            install_notebook_conversion,
+        )
+
+        install_notebook_conversion(self.store)
 
         self.manager = ControllerManager(self.store)
         use_istio = self.platform_def.use_istio

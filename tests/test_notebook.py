@@ -346,3 +346,177 @@ class TestCascadeGc:
         assert store.try_get("Service", "wb", "team-a") is None
         assert store.try_get("VirtualService", "notebook-team-a-wb", "team-a") is None
         assert store.try_get("Pod", "wb-0", "team-a") is None  # recursive
+
+
+class TestNotebookVersions:
+    """Multi-version CRD discipline (reference notebook_types.go:27-45):
+    spoke writes convert to the storage version; reads serve any version."""
+
+    def test_v1alpha1_create_normalizes_to_storage(self):
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.notebook import (
+            install_notebook_conversion,
+        )
+
+        store = StateStore()
+        install_notebook_conversion(store)
+        store.create(
+            {
+                "apiVersion": "kubeflow-tpu.dev/v1alpha1",
+                "kind": "Notebook",
+                "metadata": {"name": "legacy", "namespace": "default"},
+                "spec": {
+                    "image": "jax-notebook:1",
+                    "cpu": "2",
+                    "memory": "4Gi",
+                    "tpuTopology": "v5e-4",
+                },
+                "status": {},
+            }
+        )
+        nb = store.get("Notebook", "legacy", "default")
+        assert nb["apiVersion"] == "kubeflow-tpu.dev/v1beta1"
+        c = nb["spec"]["template"]["spec"]["containers"][0]
+        assert c["image"] == "jax-notebook:1"
+        assert c["resources"]["requests"] == {"cpu": "2", "memory": "4Gi"}
+        assert nb["spec"]["tpu"]["topology"] == "v5e-4"
+
+    def test_v1alpha1_round_trip(self):
+        from kubeflow_tpu.controllers.notebook import (
+            new_notebook,
+            notebook_versions,
+        )
+
+        vk = notebook_versions()
+        nb = new_notebook(
+            "rt", image="img:2", cpu="1", memory="2Gi", tpu_topology="v5e-8"
+        )
+        alpha = vk.convert_to(nb, "v1alpha1")
+        assert alpha["apiVersion"].endswith("/v1alpha1")
+        assert alpha["spec"] == {
+            "image": "img:2",
+            "cpu": "1",
+            "memory": "2Gi",
+            "tpuTopology": "v5e-8",
+        }
+        back = vk.to_storage(alpha)
+        assert (
+            back["spec"]["template"]["spec"]["containers"][0]["image"]
+            == "img:2"
+        )
+
+    def test_v1_is_schema_identical(self):
+        from kubeflow_tpu.controllers.notebook import (
+            new_notebook,
+            notebook_versions,
+        )
+
+        vk = notebook_versions()
+        nb = new_notebook("ga", image="img:3")
+        v1 = vk.convert_to(nb, "v1")
+        assert v1["apiVersion"].endswith("/v1")
+        assert v1["spec"] == nb["spec"]
+
+    def test_spoke_write_back_via_apply_and_update_normalizes(self):
+        """Reading at a spoke version and writing back (apply OR update)
+        must re-convert — otherwise the flat alpha spec would overwrite
+        the hub-shaped stored spec and reconcile would see no containers."""
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.notebook import (
+            install_notebook_conversion,
+            new_notebook,
+            notebook_versions,
+        )
+
+        store = StateStore()
+        install_notebook_conversion(store)
+        vk = notebook_versions()
+        store.create(new_notebook("wb", image="img:1", cpu="1", memory="1Gi"))
+        # client reads at v1alpha1, edits, applies back
+        alpha = vk.convert_to(store.get("Notebook", "wb", "default"), "v1alpha1")
+        alpha["spec"]["image"] = "img:2"
+        store.apply(alpha)
+        nb = store.get("Notebook", "wb", "default")
+        assert nb["apiVersion"].endswith("/v1beta1")
+        assert (
+            nb["spec"]["template"]["spec"]["containers"][0]["image"]
+            == "img:2"
+        )
+        # and via update (carrying the fresh resourceVersion)
+        alpha = vk.convert_to(nb, "v1alpha1")
+        alpha["spec"]["image"] = "img:3"
+        store.update(alpha)
+        nb = store.get("Notebook", "wb", "default")
+        assert (
+            nb["spec"]["template"]["spec"]["containers"][0]["image"]
+            == "img:3"
+        )
+
+    def test_unknown_version_rejected_on_update_too(self):
+        import pytest as _pytest
+
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.cluster.versions import UnknownVersion
+        from kubeflow_tpu.controllers.notebook import (
+            install_notebook_conversion,
+            new_notebook,
+        )
+
+        store = StateStore()
+        install_notebook_conversion(store)
+        store.create(new_notebook("uv"))
+        bad = store.get("Notebook", "uv", "default")
+        bad["apiVersion"] = "kubeflow-tpu.dev/v2"
+        with _pytest.raises(UnknownVersion, match="v2"):
+            store.update(bad)
+
+    def test_unknown_version_rejected(self):
+        import pytest as _pytest
+
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.cluster.versions import UnknownVersion
+        from kubeflow_tpu.controllers.notebook import (
+            install_notebook_conversion,
+        )
+
+        store = StateStore()
+        install_notebook_conversion(store)
+        with _pytest.raises(UnknownVersion, match="v2"):
+            store.create(
+                {
+                    "apiVersion": "kubeflow-tpu.dev/v2",
+                    "kind": "Notebook",
+                    "metadata": {"name": "x", "namespace": "default"},
+                    "spec": {},
+                    "status": {},
+                }
+            )
+
+    def test_legacy_write_reconciles_like_native(self, devices8):
+        """A v1alpha1-created notebook drives the SAME reconcile results
+        as a native v1beta1 one — controllers only see the hub version."""
+        from kubeflow_tpu.cluster.reconciler import ControllerManager
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.notebook import (
+            NotebookController,
+            install_notebook_conversion,
+        )
+
+        store = StateStore()
+        install_notebook_conversion(store)
+        cm = ControllerManager(store)
+        cm.register(NotebookController())
+        store.create(
+            {
+                "apiVersion": "kubeflow-tpu.dev/v1alpha1",
+                "kind": "Notebook",
+                "metadata": {"name": "leg", "namespace": "default"},
+                "spec": {"image": "jax-notebook:1", "cpu": "1",
+                         "memory": "1Gi"},
+                "status": {},
+            }
+        )
+        cm.run_until_idle(max_seconds=10)
+        ss = store.get("StatefulSet", "leg", "default")
+        tpl = ss["spec"]["template"]["spec"]["containers"][0]
+        assert tpl["image"] == "jax-notebook:1"
