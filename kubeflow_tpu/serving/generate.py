@@ -189,6 +189,40 @@ class ServedLm:
         # thread-safe; any threaded WSGI container would race without this
         self._lock = threading.Lock()
 
+    @classmethod
+    def from_registry(
+        cls,
+        model_name: str,
+        checkpoint_dir: Optional[str] = None,
+        params=None,
+        served_name: Optional[str] = None,
+        scan_layers: bool = True,
+        **model_kwargs,
+    ) -> "ServedLm":
+        """Build from the platform model registry; params from an orbax
+        checkpoint's TrainState if a directory is given.
+
+        Serving defaults to scan_layers=True (depth-independent decode
+        lowering); the params convert between the named-layer and
+        scanned layouts automatically in BOTH directions, so any
+        checkpoint loads into either serving configuration."""
+        from kubeflow_tpu.models.gpt import (
+            stack_layer_params,
+            unstack_layer_params,
+        )
+        from kubeflow_tpu.models.registry import get_model
+        from kubeflow_tpu.serving.server import restore_checkpoint_params
+
+        model = get_model(model_name, scan_layers=scan_layers, **model_kwargs)
+        if params is None:
+            params = restore_checkpoint_params(checkpoint_dir)
+        has_named = any(str(k).startswith("layer_") for k in params)
+        if scan_layers and "layers" not in params and has_named:
+            params = stack_layer_params(params, model.cfg.num_layers)
+        elif not scan_layers and "layers" in params and not has_named:
+            params = unstack_layer_params(params, model.cfg.num_layers)
+        return cls(served_name or model_name, model, params)
+
     @staticmethod
     def _bucket_tokens(n: int, headroom: int) -> int:
         b = 1

@@ -11,22 +11,75 @@ import argparse
 import sys
 
 
+def is_causal_family(model_name: str) -> bool:
+    """Does this registry model serve :generate (decoder-only LM)?
+
+    Decided by the model's TYPE, not a name prefix — a new causal family
+    registered later routes correctly without editing this file."""
+    from kubeflow_tpu.models.gpt import Gpt
+    from kubeflow_tpu.models.registry import get_model
+
+    return isinstance(get_model(model_name), Gpt)
+
+
+def build_server(
+    model: str,
+    checkpoint_dir: str = "",
+    batch_window_ms: float = 2.0,
+    params=None,
+):
+    """Assemble the ModelServer for one registry model (testable core of
+    the entrypoint): causal families serve :generate via ServedLm
+    (scanned-layer decode); everything else serves :predict via
+    ServedModel with cross-request micro-batching."""
+    from kubeflow_tpu.serving.server import ModelServer, ServedModel
+
+    server = ModelServer()
+    if is_causal_family(model):
+        from kubeflow_tpu.serving.generate import ServedLm
+
+        if batch_window_ms:
+            # ServedLm has no cross-request batcher (decode requests
+            # carry per-request lengths); say so instead of silently
+            # accepting the flag
+            print(
+                "note: --batch-window-ms does not apply to the "
+                ":generate path; serving unbatched",
+                flush=True,
+            )
+        server.add_lm(
+            ServedLm.from_registry(
+                model, checkpoint_dir=checkpoint_dir or None, params=params
+            )
+        )
+    else:
+        server.add(
+            ServedModel.from_registry(
+                model,
+                checkpoint_dir=checkpoint_dir or None,
+                params=params,
+                batch_window_ms=batch_window_ms,
+            )
+        )
+    return server
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="kubeflow-tpu model server")
     ap.add_argument("--model", required=True, help="registry model name")
     ap.add_argument("--checkpoint-dir", default="", help="orbax checkpoint dir")
     ap.add_argument("--port", type=int, default=8500)
     ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument(
+        "--batch-window-ms", type=float, default=2.0,
+        help="cross-request micro-batch window for :predict (0 disables)",
+    )
     args = ap.parse_args(argv)
 
     from kubeflow_tpu.api.wsgi import Server
-    from kubeflow_tpu.serving.server import ModelServer, ServedModel
 
-    server = ModelServer()
-    server.add(
-        ServedModel.from_registry(
-            args.model, checkpoint_dir=args.checkpoint_dir or None
-        )
+    server = build_server(
+        args.model, args.checkpoint_dir, args.batch_window_ms
     )
     httpd = Server(server.app, host=args.host, port=args.port)
     print(f"serving {args.model} on :{httpd.port}", flush=True)

@@ -40,6 +40,21 @@ def bucket_for(n: int) -> int:
     return BATCH_BUCKETS[-1]
 
 
+def restore_checkpoint_params(checkpoint_dir: Optional[str]):
+    """Params from an orbax checkpoint's TrainState (latest step) — the
+    one restore used by every serving loader (ServedModel + ServedLm)."""
+    if checkpoint_dir is None:
+        raise ValueError("need checkpoint_dir or params")
+    import orbax.checkpoint as ocp
+
+    with ocp.CheckpointManager(checkpoint_dir) as mgr:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {checkpoint_dir}")
+        restored = mgr.restore(step)
+    return restored["params"]
+
+
 class ServedModel:
     """One named, versioned model: jitted apply over padded batches."""
 
@@ -90,6 +105,7 @@ class ServedModel:
         checkpoint_dir: Optional[str] = None,
         params: Any = None,
         served_name: Optional[str] = None,
+        batch_window_ms: float = 0.0,
         **model_kwargs,
     ) -> "ServedModel":
         """Build from the platform model registry; params from an orbax
@@ -98,21 +114,17 @@ class ServedModel:
 
         model = get_model(model_name, **model_kwargs)
         if params is None:
-            if checkpoint_dir is None:
-                raise ValueError("need checkpoint_dir or params")
-            import orbax.checkpoint as ocp
-
-            with ocp.CheckpointManager(checkpoint_dir) as mgr:
-                step = mgr.latest_step()
-                if step is None:
-                    raise FileNotFoundError(f"no checkpoint in {checkpoint_dir}")
-                restored = mgr.restore(step)
-            params = restored["params"]
+            params = restore_checkpoint_params(checkpoint_dir)
 
         def apply_fn(p, x):
             return model.apply({"params": p}, x, train=False)
 
-        return cls(served_name or model_name, apply_fn, params)
+        return cls(
+            served_name or model_name,
+            apply_fn,
+            params,
+            batch_window_ms=batch_window_ms,
+        )
 
     def predict_array(self, x: np.ndarray) -> np.ndarray:
         """Array-in/array-out predict: bucket pad, jitted apply, unpad.

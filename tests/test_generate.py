@@ -345,3 +345,90 @@ class TestSampling:
             lm.generate([[1, 2]], 2, prompt_mask=[[1, 1, 1]])
         with pytest.raises(ValueError, match="real token"):
             lm.generate([[1, 2]], 2, prompt_mask=[[0, 0]])
+
+
+class TestServedLmFromRegistry:
+    def test_checkpoint_restore_with_layer_restack(self, tmp_path):
+        """A TRAINING checkpoint (named layer_i params) loads into the
+        scanned serving layout and generates identically to serving the
+        raw params with named layers."""
+        from kubeflow_tpu.serving.generate import ServedLm
+        from kubeflow_tpu.training.checkpoint import CheckpointManager
+        from kubeflow_tpu.training.trainer import TrainState
+
+        model = get_model("gpt_tiny", dtype=jnp.float32)
+        prompt = jnp.arange(5)[None, :].astype(jnp.int32) % 512
+        params = model.init(
+            jax.random.PRNGKey(3), prompt, deterministic=True
+        )["params"]
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            extra_vars={}, opt_state={},
+        )
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+        mgr.save(1, state)
+        mgr.close()
+
+        lm = ServedLm.from_registry(
+            "gpt_tiny",
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            dtype=jnp.float32,
+        )
+        assert "layers" in lm.params  # restacked for the scan layout
+        want = ServedLm("ref", model, params).generate([[5, 6, 7]], 4)
+        got = lm.generate([[5, 6, 7]], 4)
+        np.testing.assert_array_equal(got, want)
+
+    def test_server_entrypoint_serves_generative_family(self, gpt_and_params):
+        """The REAL entrypoint dispatch (serving/main.py build_server):
+        a causal-family model routes to ServedLm (generative, :generate
+        responds); a vision model routes to ServedModel (:predict)."""
+        from kubeflow_tpu.models.gpt import stack_layer_params
+        from kubeflow_tpu.serving.main import build_server, is_causal_family
+
+        model, params = gpt_and_params
+        assert is_causal_family("gpt_tiny")
+        assert not is_causal_family("mlp")
+        server = build_server(
+            "gpt_tiny",
+            params=stack_layer_params(params, model.cfg.num_layers),
+        )
+        status, body = server.app.handle("GET", "/v1/models")
+        assert status == 200
+        assert body["models"][0]["generative"] is True
+        status, body = server.app.handle(
+            "POST", "/v1/models/gpt_tiny:generate",
+            body={"prompt_ids": [[1, 2, 3]], "max_new_tokens": 3},
+        )
+        assert status == 200 and len(body["sequences"][0]) == 6
+
+    def test_scan_layers_false_unstacks_scanned_checkpoint(self, tmp_path):
+        """The inverse conversion: a scanned-layout checkpoint loads into
+        a named-layer serving config."""
+        from kubeflow_tpu.models.gpt import stack_layer_params
+        from kubeflow_tpu.serving.generate import ServedLm
+        from kubeflow_tpu.training.checkpoint import CheckpointManager
+        from kubeflow_tpu.training.trainer import TrainState
+
+        model = get_model("gpt_tiny", dtype=jnp.float32)
+        prompt = jnp.arange(5)[None, :].astype(jnp.int32) % 512
+        params = model.init(
+            jax.random.PRNGKey(4), prompt, deterministic=True
+        )["params"]
+        stacked = stack_layer_params(params, model.cfg.num_layers)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=stacked,
+            extra_vars={}, opt_state={},
+        )
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+        mgr.save(1, state)
+        mgr.close()
+        lm = ServedLm.from_registry(
+            "gpt_tiny",
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            scan_layers=False,
+            dtype=jnp.float32,
+        )
+        assert "layer_0" in lm.params
+        want = ServedLm("ref", model, params).generate([[5, 6, 7]], 4)
+        np.testing.assert_array_equal(lm.generate([[5, 6, 7]], 4), want)
