@@ -167,10 +167,13 @@ def bench_bert(steps: int) -> dict:
     n_dev = len(jax.devices())
     seq_len = int(os.environ.get("KFT_BENCH_BERT_SEQ", "512"))
     per_chip_batch = int(os.environ.get("KFT_BENCH_BERT_BATCH", "32"))
+    # bert_large sits closer to MXU peak (measured 0.433 MFU at b16/s512
+    # vs bert_base's 0.35-0.37 — docs/PERF.md): bigger K/N amortize better
+    bert_model = os.environ.get("KFT_BENCH_BERT_MODEL", "bert_base")
 
     def run(attention_impl: str):
         cfg = TrainingConfig(
-            model="bert_base",
+            model=bert_model,
             global_batch_size=per_chip_batch * n_dev,
             steps=steps,
             warmup_steps=1,
@@ -206,6 +209,7 @@ def bench_bert(steps: int) -> dict:
     tokens_per_sec = per_chip_batch * n_dev * seq_len / dt
     peak_flops, _ = _chip_peaks(jax.devices()[0])
     out = {
+        "model": bert_model,
         "attention_impl": impl,
         "seq_len": seq_len,
         "tokens_per_sec": round(tokens_per_sec, 1),

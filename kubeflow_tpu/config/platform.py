@@ -244,10 +244,28 @@ class TrainingConfig(ConfigNode):
         help="non-empty: serve the jax.profiler capture endpoint "
         "(runtime/profiler.py) writing TB-readable traces here",
     )
+    accum_steps: int = config_field(
+        default=1,
+        help="gradient accumulation: split each global batch into this "
+        "many sequential microbatches (lax.scan), average the grads, "
+        "apply ONE optimizer update — large effective batches on few "
+        "chips. Exactly equals the full-batch grad when microbatch "
+        "losses weight tokens equally (causal LM); ragged-valid-count "
+        "losses (MLM) get standard mean-of-means semantics. Models with "
+        "batch statistics (BatchNorm) are rejected: per-microbatch "
+        "stats would not equal full-batch stats.",
+    )
 
     def validate(self) -> None:
         if self.global_batch_size < 1:
             raise ConfigError("global_batch_size must be >= 1")
+        if self.accum_steps < 1:
+            raise ConfigError("accum_steps must be >= 1")
+        if self.accum_steps > 1 and self.global_batch_size % self.accum_steps:
+            raise ConfigError(
+                f"global_batch_size {self.global_batch_size} not divisible "
+                f"by accum_steps {self.accum_steps}"
+            )
         if self.dtype not in ("float32", "bfloat16"):
             raise ConfigError(f"dtype must be float32|bfloat16, got {self.dtype}")
         if not 0.0 <= self.label_smoothing < 1.0:

@@ -183,6 +183,14 @@ class Trainer:
         model = self.model
         tx = self.tx
         cfg = self.cfg
+        if cfg.accum_steps > 1 and "batch_stats" in state.extra_vars:
+            # keyed on the MODEL's variables, not the task class: a
+            # BN-free model under the image task accumulates exactly
+            raise ValueError(
+                "accum_steps > 1 is unsupported for models with batch "
+                "statistics (BatchNorm): per-microbatch stats != "
+                "full-batch stats"
+            )
         batch_sh = NamedSharding(mesh, P(("data", "fsdp")))
         shardings = self._state_shardings
 
@@ -196,14 +204,66 @@ class Trainer:
                 "augment": jax.random.fold_in(step_rng, 1),
             }
 
-            def loss_fn(params):
+            def loss_fn(params, sub_batch, sub_rngs):
                 loss, out = task.loss(
-                    model, params, state.extra_vars, batch, True, rngs
+                    model, params, state.extra_vars, sub_batch, True, sub_rngs
                 )
                 return loss, out
 
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-            (loss, out), grads = grad_fn(state.params)
+            if cfg.accum_steps > 1:
+                # gradient accumulation: microbatches stream through ONE
+                # scanned body (compile cost independent of accum_steps);
+                # grads average, the optimizer applies once. Mean-reduced
+                # losses with equal microbatch sizes make the averaged
+                # grad identical to the full-batch grad.
+                a = cfg.accum_steps
+                micro = jax.tree.map(
+                    lambda x: x.reshape((a, x.shape[0] // a) + x.shape[1:]),
+                    batch,
+                )
+                # one reshard up front: keep every scan iteration's rows
+                # spread across the data devices (the contiguous reshape
+                # would otherwise cluster a microbatch on few devices)
+                micro = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(
+                        x, P(None, ("data", "fsdp"))
+                    ),
+                    micro,
+                )
+
+                def accum(carry, xs):
+                    sub_batch, i = xs
+                    sub_rngs = {
+                        k: jax.random.fold_in(r, i) for k, r in rngs.items()
+                    }
+                    (loss_i, out_i), g_i = grad_fn(
+                        state.params, sub_batch, sub_rngs
+                    )
+                    g_acc, loss_acc = carry
+                    return (
+                        jax.tree.map(jnp.add, g_acc, g_i),
+                        loss_acc + loss_i,
+                    ), out_i["aux"]
+
+                g0 = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+                )
+                (g_sum, loss_sum), aux_stack = jax.lax.scan(
+                    accum, (g0, jnp.zeros((), jnp.float32)),
+                    (micro, jnp.arange(a)),
+                )
+                grads = jax.tree.map(lambda g: g / a, g_sum)
+                loss = loss_sum / a
+                # aux averaged over ALL microbatches — consistent with the
+                # averaged loss (last-microbatch-only would be 1/a of the
+                # data and noisier)
+                out = {
+                    "aux": jax.tree.map(lambda x: x.mean(0), aux_stack),
+                    "var_updates": {},
+                }
+            else:
+                (loss, out), grads = grad_fn(state.params, batch, rngs)
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = jax.tree.map(
                 lambda p, u: (p + u.astype(p.dtype)), state.params, updates

@@ -203,3 +203,107 @@ class TestCheckpoint:
         state2, m = tr.train_step(restored, gb, rng)
         assert int(jax.device_get(state2.step)) == 2
         mgr.close()
+
+
+class TestGradientAccumulation:
+    """accum_steps: scanned microbatch grads == full-batch grads (mean
+    losses, equal microbatch sizes), one optimizer update either way."""
+
+    def _run(self, accum, devices):
+        """Causal-LM vehicle: every row has the same number of valid
+        next-token pairs, so microbatch means weight tokens identically
+        and the averaged grad is EXACTLY the full-batch grad. (MLM's
+        ragged valid counts give mean-of-means semantics instead — the
+        standard accumulation behavior, documented on accum_steps.)"""
+        from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.data import make_global_batch
+        from kubeflow_tpu.training.tasks import CausalLmTask
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = TrainingConfig(
+            model="gpt_tiny",
+            global_batch_size=8,
+            steps=1,
+            warmup_steps=1,
+            learning_rate=1e-3,
+            dtype="float32",
+            seed=5,
+            mesh=MeshConfig(data=2),
+            accum_steps=accum,
+            checkpoint={"enabled": False},
+        )
+        mesh = mesh_from_config(cfg.mesh, devices=devices[:2])
+        task = CausalLmTask(cfg, seq_len=16, vocab_size=128)
+        tr = Trainer(cfg, mesh=mesh, task=task)
+        state = tr.init_state()
+        batch = make_global_batch(task.synthetic_data().batch_at(0), mesh)
+        state, m = tr.train_step(state, batch, jax.random.PRNGKey(0))
+        loss = float(jax.device_get(m["loss"]))
+        leaf = np.asarray(
+            jax.device_get(state.params["layer_0"]["attention"]["query"]["kernel"])
+        )
+        return loss, leaf
+
+    def test_accum_matches_full_batch(self, devices8):
+        loss1, leaf1 = self._run(1, devices8)
+        loss4, leaf4 = self._run(4, devices8)
+        assert loss1 == pytest.approx(loss4, rel=1e-5)
+        np.testing.assert_allclose(leaf4, leaf1, rtol=1e-5, atol=1e-6)
+
+    def test_bn_free_image_model_accumulates(self, devices8):
+        """The guard keys on the MODEL's variables: mlp (no BatchNorm)
+        under the image task accumulates fine."""
+        from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.data import make_global_batch
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = TrainingConfig(
+            model="mlp",
+            global_batch_size=8,
+            steps=1,
+            warmup_steps=1,
+            dtype="float32",
+            mesh=MeshConfig(data=2),
+            accum_steps=2,
+            checkpoint={"enabled": False},
+        )
+        mesh = mesh_from_config(cfg.mesh, devices=devices8[:2])
+        tr = Trainer(cfg, mesh=mesh)
+        state = tr.init_state()
+        batch = make_global_batch(tr.task.synthetic_data().batch_at(0), mesh)
+        state, m = tr.train_step(state, batch, jax.random.PRNGKey(0))
+        assert np.isfinite(float(jax.device_get(m["loss"])))
+
+    def test_batch_stats_models_rejected(self, devices8):
+        from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.data import make_global_batch
+        from kubeflow_tpu.training.tasks import ImageClassificationTask
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = TrainingConfig(
+            model="resnet18",
+            global_batch_size=8,
+            steps=1,
+            warmup_steps=1,
+            mesh=MeshConfig(data=2),
+            accum_steps=2,
+            checkpoint={"enabled": False},
+        )
+        mesh = mesh_from_config(cfg.mesh, devices=devices8[:2])
+        task = ImageClassificationTask(cfg, image_size=8, num_classes=4)
+        tr = Trainer(cfg, mesh=mesh, task=task)
+        state = tr.init_state()
+        batch = make_global_batch(task.synthetic_data().batch_at(0), mesh)
+        with pytest.raises(ValueError, match="batch statistics"):
+            tr.train_step(state, batch, jax.random.PRNGKey(0))
+
+    def test_config_divisibility_validated(self):
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import TrainingConfig
+
+        cfg = TrainingConfig(model="bert_tiny", global_batch_size=6, accum_steps=4)
+        with pytest.raises(ConfigError, match="divisible"):
+            cfg.validate()
