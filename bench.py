@@ -197,13 +197,16 @@ def bench_bert(steps: int) -> dict:
             cost = _cost_analysis(trainer._train_step, state, batch_dev, rng)
         return dt, cost
 
+    from kubeflow_tpu.models.registry import get_model
     from kubeflow_tpu.ops.attention import auto_attention_impl
 
-    # per-chip batch: this call runs outside the trainer's mesh context,
-    # so the policy's per-device divide would otherwise see dp=1 and
-    # misjudge multi-chip hosts
+    # head count from the ACTUAL model (bert_large has 16, not 12) so
+    # the policy's score-memory estimate matches the measured geometry;
+    # per-chip batch because this call runs outside the trainer's mesh
+    # context (the per-device divide would otherwise see dp=1)
+    num_heads = get_model(bert_model).cfg.num_heads
     impl = auto_attention_impl(
-        per_chip_batch, seq_len, 12, "bfloat16"
+        per_chip_batch, seq_len, num_heads, "bfloat16"
     ) if on_tpu else "dense"
     dt, cost = run(impl)
     tokens_per_sec = per_chip_batch * n_dev * seq_len / dt

@@ -244,6 +244,13 @@ class TrainingConfig(ConfigNode):
         help="non-empty: serve the jax.profiler capture endpoint "
         "(runtime/profiler.py) writing TB-readable traces here",
     )
+    seq_len: int = config_field(
+        default=0,
+        help="sequence length for LM jobs (BERT/GPT): sets the task's "
+        "training length AND the model's max_len/context window. 0 = "
+        "the model family's default. The long-context configs set this "
+        "(e.g. 32768 with a sequence mesh axis).",
+    )
     accum_steps: int = config_field(
         default=1,
         help="gradient accumulation: split each global batch into this "
@@ -261,6 +268,14 @@ class TrainingConfig(ConfigNode):
             raise ConfigError("global_batch_size must be >= 1")
         if self.accum_steps < 1:
             raise ConfigError("accum_steps must be >= 1")
+        if self.seq_len < 0:
+            raise ConfigError("seq_len must be >= 0")
+        if self.seq_len and not self.model.startswith(("bert", "gpt")):
+            # would silently no-op for image models (their input size is
+            # the task's image_size, not a sequence)
+            raise ConfigError(
+                f"seq_len applies to LM models only (model={self.model!r})"
+            )
         if self.accum_steps > 1 and self.global_batch_size % self.accum_steps:
             raise ConfigError(
                 f"global_batch_size {self.global_batch_size} not divisible "

@@ -81,6 +81,17 @@ class Trainer:
             # a pipeline mesh axis requires a stage-partitionable model;
             # factories without pipeline support raise TypeError loudly
             kwargs.setdefault("pipeline_stages", cfg.mesh.pipeline)
+        if cfg.seq_len > 0 and model is None:
+            # cfg.seq_len sizes the model's context window; the task's
+            # training length follows below (validate() restricts the
+            # knob to the LM families, whose factories accept max_len)
+            kwargs.setdefault("max_len", cfg.seq_len)
+        if cfg.mesh.sequence > 1 and model is None:
+            # a sequence mesh axis means sequence parallelism: default
+            # the attention to the ring implementation (KV rotation over
+            # ICI neighbors) exactly as a pipeline axis defaults
+            # pipeline_stages — mesh axes ARE the strategy selection
+            kwargs.setdefault("attention_impl", "ring")
         self.model = model if model is not None else get_model(
             cfg.model, dtype=dtype, **kwargs
         )
@@ -92,6 +103,17 @@ class Trainer:
         if task is None and mcfg is not None:
             if hasattr(self.task, "vocab_size") and hasattr(mcfg, "vocab_size"):
                 self.task.vocab_size = min(self.task.vocab_size, mcfg.vocab_size)
+            if cfg.seq_len > 0 and hasattr(self.task, "seq_len"):
+                if hasattr(mcfg, "max_len") and cfg.seq_len > mcfg.max_len:
+                    # an EXPLICIT request must never be clamped silently —
+                    # that trains at a fraction of the configured context
+                    # while reporting success
+                    raise ValueError(
+                        f"cfg.seq_len {cfg.seq_len} exceeds the model's "
+                        f"max_len {mcfg.max_len}; build the model with a "
+                        f"matching context window"
+                    )
+                self.task.seq_len = cfg.seq_len
             if hasattr(self.task, "seq_len") and hasattr(mcfg, "max_len"):
                 self.task.seq_len = min(self.task.seq_len, mcfg.max_len)
         self.tx, self.schedule = make_optimizer(cfg, cfg.model)

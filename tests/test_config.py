@@ -180,3 +180,99 @@ training:
         assert slice_cfg.total_chips == training.mesh.num_devices == 16
         assert training.data.name == "npz"
         assert training.data.target_accuracy == 0.76
+
+    def test_gpt_longcontext_config_is_valid(self):
+        """configs/gpt_longcontext_v5e16.yaml parses into a schedulable
+        job: 32k context via a real sequence axis, mesh == slice chips,
+        accumulation divides the batch."""
+        import os
+
+        import yaml
+
+        from kubeflow_tpu.controllers.tpujob import (
+            new_tpu_train_job,
+            parse_job_spec,
+        )
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "configs",
+            "gpt_longcontext_v5e16.yaml",
+        )
+        with open(path) as f:
+            spec = yaml.safe_load(f)
+        job = new_tpu_train_job("longcontext", **spec)
+        slice_cfg, training = parse_job_spec(job["spec"])[:2]
+        assert slice_cfg.total_chips == training.mesh.num_devices == 16
+        assert training.mesh.sequence == 8
+        assert training.accum_steps == 4
+        assert training.remat is True
+        assert training.seq_len == 32768  # the headline feature
+        training.validate()
+
+    def test_seq_len_reaches_model_and_task(self, devices8):
+        """cfg.seq_len sizes BOTH the model's context window and the
+        task's training length — a long-context config cannot silently
+        train at the family default (the gap a review caught: the 32k
+        yaml used to run 1024-token sequences)."""
+        from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = TrainingConfig(
+            model="gpt_tiny",
+            global_batch_size=4,
+            steps=1,
+            seq_len=64,
+            mesh=MeshConfig(data=1),
+            checkpoint={"enabled": False},
+        )
+        cfg.validate()
+        mesh = mesh_from_config(cfg.mesh, devices=devices8[:1])
+        tr = Trainer(cfg, mesh=mesh)
+        assert tr.model.cfg.max_len == 64
+        assert tr.task.seq_len == 64
+
+    def test_seq_len_rejected_for_image_models(self):
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import TrainingConfig
+
+        cfg = TrainingConfig(model="resnet50", seq_len=2048)
+        with pytest.raises(ConfigError, match="LM models"):
+            cfg.validate()
+
+    def test_seq_len_conflict_with_model_max_len_raises(self, devices8):
+        """An explicit seq_len larger than the model's context window is
+        an error, never a silent clamp."""
+        from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = TrainingConfig(
+            model="gpt_tiny",
+            global_batch_size=4,
+            steps=1,
+            seq_len=4096,
+            mesh=MeshConfig(data=1),
+            checkpoint={"enabled": False},
+        )
+        mesh = mesh_from_config(cfg.mesh, devices=devices8[:1])
+        with pytest.raises(ValueError, match="max_len"):
+            Trainer(cfg, mesh=mesh, model_kwargs={"max_len": 128})
+
+    def test_sequence_axis_defaults_ring_attention(self, devices8):
+        """mesh.sequence > 1 selects ring attention by default — mesh
+        axes ARE the strategy selection (pipeline_stages precedent)."""
+        from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = TrainingConfig(
+            model="gpt_tiny",
+            global_batch_size=4,
+            steps=1,
+            mesh=MeshConfig(data=1, sequence=2),
+            checkpoint={"enabled": False},
+        )
+        mesh = mesh_from_config(cfg.mesh, devices=devices8[:2])
+        tr = Trainer(cfg, mesh=mesh)
+        assert tr.model.cfg.attention_impl == "ring"

@@ -286,12 +286,14 @@ class TestTcpBarrier:
 
         port = free_port()
         coord = ["--coordinator", f"127.0.0.1:{port}"]
+        # generous margins: under a saturated CI host, process spawn +
+        # agent startup can take seconds — short barriers flake
         c = run_agent(
             agent, tmp_path / "c", 0, 3, payload=["true"],
-            timeout_ms=8000, extra=coord,
+            timeout_ms=60000, extra=coord,
         )
         time.sleep(0.3)
-        ghost = socket.create_connection(("127.0.0.1", port), timeout=5)
+        ghost = socket.create_connection(("127.0.0.1", port), timeout=30)
         ghost.sendall(b"ready 1\n")
         time.sleep(0.3)
         ghost.close()
@@ -299,13 +301,13 @@ class TestTcpBarrier:
         workers = [
             run_agent(
                 agent, tmp_path / f"w{i}", i, 3, payload=["true"],
-                timeout_ms=8000, extra=coord,
+                timeout_ms=60000, extra=coord,
             )
             for i in (1, 2)
         ]
-        assert c.wait(timeout=15) == 0
+        assert c.wait(timeout=90) == 0
         for i, w in zip((1, 2), workers):
-            assert w.wait(timeout=15) == 0
+            assert w.wait(timeout=90) == 0
             assert (tmp_path / f"w{i}" / f"phase.{i}").read_text() == "Succeeded"
 
 
