@@ -744,17 +744,70 @@ def bench_studyjob_trials(n_trials: int = 4) -> dict:
     }
 
 
-def main() -> int:
-    import jax
+def _bench_in_subprocess(fn_name: str, timeout_s: int) -> dict:
+    """Run one bench function in a fresh python with a hard wall-clock cap.
 
+    Blocked device/compile calls cannot be interrupted in-process; a
+    subprocess can always be killed. The child prints one JSON line."""
+    import subprocess
+
+    code = (
+        "import json, bench; "
+        f"print(json.dumps(bench.{fn_name}()))"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"{fn_name} exceeded {timeout_s}s (killed)"}
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            result = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(result, dict):  # stray scalar lines are not results
+            return result
+    return {
+        "error": f"{fn_name} exited {out.returncode} with no result",
+        "stderr_tail": out.stderr[-500:],
+    }
+
+
+def main() -> int:
     batch = int(os.environ.get("KFT_BENCH_BATCH", "256"))
     steps = int(os.environ.get("KFT_BENCH_STEPS", "20"))
     suite = os.environ.get("KFT_BENCH_SUITE", "all")
+
+    # generate runs FIRST, in a bounded subprocess, BEFORE this process
+    # initializes any jax backend: on hosts where libtpu is exclusive
+    # per process, a child spawned after the parent holds the TPU could
+    # never attach. Bounded because the tunneled remote-compile endpoint
+    # can hang ~30 min on scan-heavy programs and a blocked in-process
+    # compile cannot be interrupted. Fallback chain: fused scan →
+    # host-loop stepwise → recorded error.
+    generate = None
+    if suite == "all" and os.environ.get("KFT_BENCH_GENERATE") != "0":
+        budget_s = int(os.environ.get("KFT_BENCH_GENERATE_TIMEOUT", "600"))
+        generate = _bench_in_subprocess("bench_generate", budget_s)
+        if "error" in generate:
+            fused_err = generate["error"]
+            generate = _bench_in_subprocess(
+                "bench_generate_stepwise", budget_s
+            )
+            generate["fused_error"] = fused_err
+
+    import jax
+
     n_dev = len(jax.devices())
 
     resnet = bench_resnet(batch, steps)
 
-    bert = trials = long_ctx = serving = generate = attn_sweep = None
+    bert = trials = long_ctx = serving = attn_sweep = None
     if suite == "all":
         try:
             bert = bench_bert(max(5, steps // 2))
@@ -768,25 +821,6 @@ def main() -> int:
             serving = bench_serving()
         except Exception as e:  # noqa: BLE001
             serving = {"error": f"{type(e).__name__}: {e}"}
-        if os.environ.get("KFT_BENCH_GENERATE") != "0":
-            # default since round 3: scan_layers makes the decode program
-            # cheap to lower (one traced layer body). One retry: the
-            # tunneled remote-compile endpoint drops connections under
-            # long-running batteries (observed "Broken pipe" flakes).
-            try:
-                generate = bench_generate()
-            except Exception as e:  # noqa: BLE001
-                # the fused prefill+scan program can exceed what the
-                # tunneled remote-compile endpoint tolerates; fall back to
-                # the host-loop decode (mode recorded — not comparable)
-                try:
-                    generate = bench_generate_stepwise()
-                    generate["fused_error"] = f"{type(e).__name__}: {e}"
-                except Exception as e2:  # noqa: BLE001
-                    generate = {
-                        "error": f"{type(e).__name__}: {e}",
-                        "stepwise_error": f"{type(e2).__name__}: {e2}",
-                    }
         if jax.default_backend() == "tpu":
             # last: the compiled-kernel path only exists on TPU
             try:
