@@ -4,6 +4,12 @@ The hermetic twin of the reference's cluster e2e tier (SURVEY.md §4 T4:
 kf_is_ready_test.py roster assertions + workload e2e) driven through the
 assembled Platform object — every controller, webhook, API, and the real
 XLA training path in one flow.
+
+The REAL-PROCESS tier of this journey — TPUTrainJob CR → gang pods run as
+actual OS processes (jax.distributed over localhost) → conditions →
+kill-a-member → whole-gang restart with KFT_RESTORE_DIR — lives in
+tests/test_subprocess_gang.py (SubprocessPodRunner), kept separate because
+its ~40 s real-process runs would dominate this file's fast loop.
 """
 
 import pytest
