@@ -81,18 +81,47 @@ def apply_remote(
     )
 
 
-def apply_local(platform: PlatformDef) -> Dict[str, Any]:
+def apply_local(
+    platform: PlatformDef,
+    container_api=None,
+    kubeconfig_client_factory=None,
+) -> Dict[str, Any]:
     """Two-phase apply in process (platform then k8s, with retries).
 
-    The provider comes from the PlatformDef: project+zone selects GKE —
-    which raises here, since the laptop path carries no cloud client; the
-    operator points --server at a deploy router instead (the reference's
-    click-to-deploy split)."""
+    The provider comes from the PlatformDef: project+zone selects GKE.
+    With the googleapiclient SDK present, the real Container API client
+    provisions the cluster AND the K8S phase applies to it through the
+    rendered kubeconfig (the BuildClusterConfig → SetK8sRestConfig
+    handoff, deploy/cluster_config.py). Without the SDK, provider_for
+    raises with guidance — the operator points --server at a deploy
+    router instead (the reference's click-to-deploy split). The two
+    keyword seams exist for tests (inject fakes)."""
     from kubeflow_tpu.cluster.store import StateStore
     from kubeflow_tpu.deploy.coordinator import Coordinator
-    from kubeflow_tpu.deploy.gke import provider_for
+    from kubeflow_tpu.deploy.gke import (
+        autodetect_container_api,
+        provider_for,
+        selects_gke,
+    )
 
-    coordinator = Coordinator(StateStore(), provider=provider_for(platform))
+    target_builder = None
+    if selects_gke(platform):
+        if container_api is None:
+            # engages only when BOTH SDKs exist (provision + kubeconfig
+            # target) — see autodetect_container_api
+            container_api = autodetect_container_api()
+        if container_api is not None:
+            from kubeflow_tpu.deploy.cluster_config import gke_target_builder
+
+            target_builder = gke_target_builder(
+                container_api,
+                kubeconfig_client_factory=kubeconfig_client_factory,
+            )
+    coordinator = Coordinator(
+        StateStore(),
+        provider=provider_for(platform, container_api),
+        target_builder=target_builder,
+    )
     return coordinator.apply(platform)
 
 

@@ -180,15 +180,39 @@ class GkeProvider:
         self.api.delete_cluster(platform.project, platform.zone, platform.name)
 
 
+def selects_gke(platform: PlatformDef) -> bool:
+    """THE provider-selection predicate (kfctl plugin-detect analog,
+    reference kf_is_ready_test.py:26-44) — one definition so callers
+    building targets and callers building providers can't drift."""
+    return bool(platform.project and platform.zone)
+
+
+def autodetect_container_api():
+    """The real Container API client, when the FULL production GKE path
+    is available — BOTH googleapiclient (provision) and the kubernetes
+    client (the K8S phase's kubeconfig target) must be installed:
+    provisioning a real cluster and then failing the handoff on a
+    missing import would leave billed infrastructure behind with no
+    deployment on it. Returns None when either SDK is absent."""
+    from kubeflow_tpu.deploy.cluster_config import have_kubernetes_sdk
+    from kubeflow_tpu.deploy.gcp_client import (
+        GoogleContainerApi,
+        have_google_sdk,
+    )
+
+    if have_google_sdk() and have_kubernetes_sdk():
+        return GoogleContainerApi()
+    return None
+
+
 def provider_for(platform: PlatformDef, container_api=None):
-    """Pick the provider from the PlatformDef (the kfctl plugin-detect
-    analog, reference kf_is_ready_test.py:26-44): a project+zone selects
-    GKE; otherwise local. A GKE selection REQUIRES a real container_api —
+    """Pick the provider from the PlatformDef: `selects_gke` → GKE;
+    otherwise local. A GKE selection REQUIRES a real container_api —
     defaulting to the in-memory fake would report clusters created while
     provisioning nothing."""
     from kubeflow_tpu.deploy.coordinator import LocalProvider
 
-    if platform.project and platform.zone:
+    if selects_gke(platform):
         if container_api is None:
             raise ValueError(
                 f"PlatformDef {platform.name!r} selects the gke provider "

@@ -103,8 +103,14 @@ class TestPollLoop:
 
 class TestLocalApplyProviderSelection:
     def test_gke_platformdef_refuses_local_apply(self, tmp_path, capsys):
-        """--local with a GKE PlatformDef must fail loudly, not fake-deploy
-        (the laptop path has no cloud client; use --server)."""
+        """--local with a GKE PlatformDef and no cloud SDKs must fail
+        loudly, not fake-deploy (use --server, or install the SDKs for
+        the real path). Skipped where the real client would auto-engage —
+        running it there would issue LIVE cloud calls."""
+        from kubeflow_tpu.deploy.gke import autodetect_container_api
+
+        if autodetect_container_api() is not None:
+            pytest.skip("cloud SDKs present: the real client engages")
         p = tmp_path / "gke.yaml"
         p.write_text(
             "name: kf\nkind: PlatformDef\nproject: proj\nzone: us-central2-b\n"
@@ -113,3 +119,56 @@ class TestLocalApplyProviderSelection:
         assert rc == 1
         out = json.loads(capsys.readouterr().out.strip())
         assert out["success"] is False and "container API" in out["log"]
+
+
+class TestLocalGkeApply:
+    """`kft-deploy apply --local` with a GKE PlatformDef: provision via
+    the Container API, then apply the K8S phase to the PROVISIONED
+    cluster through the rendered kubeconfig (the full production path,
+    driven over injected fakes)."""
+
+    def test_local_apply_provisions_and_targets_cluster(self):
+        from kubeflow_tpu.config.platform import PlatformDef, SliceConfig
+        from kubeflow_tpu.deploy.cli import apply_local
+        from kubeflow_tpu.deploy.gke import FakeContainerApi
+
+        applied = []
+
+        class RecordingClient:
+            def __init__(self, kubeconfig):
+                self.kubeconfig = kubeconfig
+
+            def apply(self, obj):
+                applied.append(obj)
+
+        api = FakeContainerApi()
+        out = apply_local(
+            PlatformDef(
+                name="kf-cli",
+                project="proj",
+                zone="us-central2-b",
+                slice=SliceConfig(topology="v5e-16"),
+            ),
+            container_api=api,
+            kubeconfig_client_factory=RecordingClient,
+        )
+        assert out["platform"]["provider"] == "gke"
+        assert out["objects_applied"] == len(applied) > 0
+        assert api.get_cluster("proj", "us-central2-b", "kf-cli") is not None
+
+    def test_local_gke_without_sdk_or_fake_raises_with_guidance(self):
+        from kubeflow_tpu.config.platform import PlatformDef, SliceConfig
+        from kubeflow_tpu.deploy.cli import apply_local
+        from kubeflow_tpu.deploy.gke import autodetect_container_api
+
+        if autodetect_container_api() is not None:
+            pytest.skip("cloud SDKs present: the real client engages")
+        with pytest.raises(ValueError, match="container API client"):
+            apply_local(
+                PlatformDef(
+                    name="kf-cli",
+                    project="proj",
+                    zone="us-central2-b",
+                    slice=SliceConfig(topology="v5e-16"),
+                )
+            )
