@@ -234,6 +234,13 @@ class TrainingConfig(ConfigNode):
     data: DataConfig = config_field(default_factory=DataConfig)
     checkpoint: CheckpointConfig = config_field(default_factory=CheckpointConfig)
     remat: bool = config_field(default=False, help="jax.checkpoint rematerialisation")
+    loss_chunk: int = config_field(
+        default=0,
+        help="causal-LM only: stream the LM head + cross-entropy over "
+        "sequence chunks of this many positions so the [B,S,vocab] "
+        "logits never materialize (long-context HBM enabler; see "
+        "training/tasks.py::CausalLmTask). 0 = full logits.",
+    )
     label_smoothing: float = config_field(
         default=0.0,
         help="label-smoothing epsilon for classification losses "

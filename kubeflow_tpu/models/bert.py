@@ -49,6 +49,9 @@ class BertConfig:
     # (parallel/pipeline.py). num_layers must divide evenly into stages.
     pipeline_stages: int = 1
     num_microbatches: int = 0  # 0 = pipeline_stages
+    # "gpipe" (plain scan) | "1f1b" (segmented remat scan: the 1F1B
+    # activation bound — at most S outstanding microbatches per stage)
+    pipeline_schedule: str = "gpipe"
     # expert parallelism: >0 replaces every MLP with a routed MoE of that
     # many experts, stacked on the `expert` mesh axis (parallel/moe.py).
     # moe_top_k=1 is Switch routing, 2 is GShard top-2; dropped-token
@@ -219,6 +222,7 @@ class PipelinedEncoder(nn.Module):
                 ("stage", "batch", "seq", "act_embed")
             ),
             travel_specs=[logical_to_spec(("stage", "batch", "seq"))],
+            schedule=cfg.pipeline_schedule,
         )
         return unmicrobatch(out)
 
@@ -246,15 +250,30 @@ class Bert(nn.Module):
         if token_type_ids is None:
             token_type_ids = jnp.zeros((b, s), dtype=jnp.int32)
 
+        # ids carry the (batch, seq) layout BEFORE the table gathers: with a
+        # sequence mesh axis, unconstrained ids make GSPMD pick an output
+        # sharding for the vocab-sharded gather that it can only reconcile
+        # with the activation layout by involuntary full rematerialization
+        # (replicate-then-reshard; the MULTICHIP_r03 warning, VERDICT r4
+        # item 2). Index-sharded gathers partition cleanly.
+        input_ids = shard_constraint(input_ids, ("batch", "seq"))
+        token_type_ids = shard_constraint(token_type_ids, ("batch", "seq"))
         tok = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok_emb"
         )(input_ids)
+        # the gather OUTPUTS are pinned to the activation layout as well:
+        # with an fsdp axis the table's embed dim is fsdp-sharded, and
+        # operand-passthrough propagation would emit gathers whose output
+        # carries fsdp on hidden — unreachable from the (batch, seq, none)
+        # consumer layout except by full rematerialization
+        tok = shard_constraint(tok, ("batch", "seq", "act_embed"))
         pos = nn.Embed(
             cfg.max_len, cfg.hidden_size, dtype=cfg.dtype, name="pos_emb"
         )(jnp.arange(s)[None, :])
         seg = nn.Embed(
             cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="seg_emb"
         )(token_type_ids)
+        seg = shard_constraint(seg, ("batch", "seq", "act_embed"))
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(tok + pos + seg)
         x = x.astype(cfg.dtype)
         x = shard_constraint(x, ("batch", "seq", "act_embed"))

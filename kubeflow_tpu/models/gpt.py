@@ -53,6 +53,8 @@ class GptConfig:
     # (models/layers.py pipeline_scan). num_layers % stages == 0.
     pipeline_stages: int = 1
     num_microbatches: int = 0  # 0 = pipeline_stages
+    # "gpipe" | "1f1b" — see models/layers.py pipeline_scan
+    pipeline_schedule: str = "gpipe"
     # expert parallelism: >0 replaces every MLP with a routed MoE stacked
     # on the `expert` mesh axis (models/layers.py MoeMlp).
     num_experts: int = 0
@@ -351,6 +353,7 @@ class PipelinedDecoder(nn.Module):
                 ("stage", "batch", "seq", "act_embed")
             ),
             travel_specs=[logical_to_spec(("stage", "batch", "seq"))],
+            schedule=cfg.pipeline_schedule,
         )
         return unmicrobatch(out)
 
@@ -369,6 +372,7 @@ class Gpt(nn.Module):
         deterministic: bool = True,
         decode: bool = False,
         prefill: bool = False,
+        return_hidden: bool = False,
     ):
         cfg = self.cfg
         b, s = input_ids.shape
@@ -377,9 +381,15 @@ class Gpt(nn.Module):
             if attention_mask is not None
             else jnp.ones((b, s), dtype=bool)
         )
+        # ids carry the (batch, seq) layout BEFORE the table gather — see
+        # models/bert.py: unconstrained ids + a sequence mesh axis push
+        # GSPMD into involuntary full rematerialization on the vocab-
+        # sharded embedding gather (VERDICT r4 item 2)
+        input_ids = shard_constraint(input_ids, ("batch", "seq"))
         tok = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok_emb"
         )(input_ids)
+        tok = shard_constraint(tok, ("batch", "seq", "act_embed"))
         if decode or prefill:
             # the decode cursor lives IN the cache (one source of truth —
             # a restored cache cannot disagree with a caller-passed
@@ -434,9 +444,21 @@ class Gpt(nn.Module):
         # vocab projection in the compute dtype (f32 matmuls run at a
         # fraction of bf16 MXU peak — see models/bert.py mlm_out); logits
         # cast to f32 for the softmax/sampling path
-        logits = nn.Dense(
+        head = nn.Dense(
             cfg.vocab_size, dtype=cfg.dtype, use_bias=False, name="head"
-        )(x.astype(cfg.dtype)).astype(jnp.float32)
+        )
+        if return_hidden:
+            # Long-context path: the full [B,S,V] logits tensor is the HBM
+            # wall at 32k+ context (f32 logits alone are ~6.6 GB for
+            # gpt_small at 32k) — return post-LN hidden states and let the
+            # task stream the head matmul + loss over sequence chunks
+            # (training/tasks.py::CausalLmTask, loss_chunk). The 1-position
+            # apply exists so the head's params are created in BOTH
+            # branches (init-time tree equality); XLA dead-code-eliminates
+            # it at runtime.
+            _ = head(x[:, :1].astype(cfg.dtype))
+            return {"hidden": x}
+        logits = head(x.astype(cfg.dtype)).astype(jnp.float32)
         return {"logits": logits}
 
 

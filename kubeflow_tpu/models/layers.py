@@ -134,29 +134,47 @@ def pipeline_scan(
     state_spec: Optional[P] = None,
     travel_specs: Optional[Sequence[Optional[P]]] = None,
     name: str = "stages",
+    schedule: str = "gpipe",
 ) -> jax.Array:
-    """GPipe microbatch schedule as one scanned tick (call from @nn.compact).
+    """Pipeline microbatch schedule as one scanned tick (call from
+    @nn.compact).
 
     stage_cls(*stage_args) is one pipeline stage taking (x, mask..., det);
     it is stacked [S] by nn.vmap (stage i's params apply to buffer slot i)
     and the tick — inject at slot 0, apply all stages, emit slot S-1, roll
     one stage down (CollectivePermute over the `pipeline` mesh axis) — is
     an `nn.scan` of length M + S - 1. Params are broadcast across ticks;
-    the "losses" collection (MoE aux) is stacked [T, S] and summed by the
+    the "losses" collection (MoE aux) is stacked per tick and summed by the
     task, so experts compose with pipelining.
+
+    schedule:
+    - "gpipe": plain scan — autodiff saves every tick's carry, so live
+      activations grow with M (all microbatches in flight).
+    - "1f1b": the 1F1B activation bound in SPMD form — a segmented scan
+      (outer scan over ceil(T/S) segments, inner remat'd scan over S
+      ticks). Autodiff saves carries only at segment boundaries and
+      recomputes within a segment, so at any point of the backward at most
+      S microbatches' activations are live per stage — the 1F1B invariant
+      — at the cost of one extra forward per segment (what MPMD 1F1B
+      implementations also pay when they checkpoint). The bubble fraction
+      (S-1)/T is identical to GPipe's, exactly as for non-interleaved
+      1F1B; raise num_microbatches to shrink it.
 
     Exactness: identical math to the unrolled loop in parallel/pipeline.py
     (tests/test_pipeline.py proves both against sequential application).
-    Bubble-tick caveat: during fill/drain, stage slots hold zeros/drained
-    garbage; their *outputs* never reach the collected result (exact), but
-    MoE aux losses sown on bubble slots do contribute a small routing
-    regularizer bias — acceptable for a load-balance term, documented here
-    so nobody mistakes it for a numerics bug.
+    Bubble ticks: slots holding no real microbatch (fill/drain/segment
+    padding) are ZEROED before the stage applies — their outputs never
+    reach the collected result, and zero inputs give MoE routers zero
+    gradient, so sown bubble aux losses carry no load-balance bias (the
+    round-3 advisor finding; a zero-input router's aux is a constant with
+    zero gradient).
 
     x_mb: [M, mb, ...] microbatched activations. travel: per-microbatch
     side inputs (e.g. the attention mask) riding along with their
     microbatch. Returns [M, mb, ...] last-stage outputs in order.
     """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     m = x_mb.shape[0]
     s = num_stages
     ticks = m + s - 1
@@ -173,21 +191,43 @@ def pipeline_scan(
         methods=["__call__"],
     )(*stage_args, name=name)
 
+    # segment length: 1f1b checkpoints the carry every S ticks; gpipe is
+    # one segment of the full schedule (plain scan)
+    seg = s if schedule == "1f1b" else ticks
+    nseg = -(-ticks // seg)
+    total = nseg * seg
+
     # per-tick injection streams, padded past M with the last microbatch
     # (harmless: a microbatch injected at tick t ≥ M would exit at
-    # t + S - 1 ≥ M + S - 1 = T, beyond the last collected tick)
+    # t + S - 1 ≥ M + S - 1 = T, beyond the last collected tick; the
+    # validity mask below also zeroes it in-flight)
     def pad(a):
-        reps = jnp.broadcast_to(a[-1:], (s - 1,) + a.shape[1:]) if s > 1 else a[:0]
+        extra = total - m
+        reps = (
+            jnp.broadcast_to(a[-1:], (extra,) + a.shape[1:])
+            if extra > 0
+            else a[:0]
+        )
         return jnp.concatenate([a, reps], axis=0)
 
     inj_x = pad(x_mb)
     inj_travel = [pad(a) for a in travel]
+    tick_idx = jnp.arange(total, dtype=jnp.int32)
 
     def tick(stack, carry, xs):
         state, tstate = carry
-        ix, itravel = xs
+        ix, itravel, t = xs
         state = state.at[0].set(ix)
         tstate = [ts.at[0].set(a) for ts, a in zip(tstate, itravel)]
+        # slot i at tick t holds microbatch t - i; anything else is a
+        # fill/drain/padding bubble — zero it so bubble compute cannot
+        # leak into gradients (MoE aux sown on zero inputs has zero
+        # gradient: router logits are x @ W with x = 0)
+        mb_idx = t - jnp.arange(s, dtype=jnp.int32)
+        valid = (mb_idx >= 0) & (mb_idx < m)
+        state = state * valid.reshape((s,) + (1,) * (state.ndim - 1)).astype(
+            state.dtype
+        )
         state = _constrain(state, state_spec)
         tstate = [_constrain(ts, sp) for ts, sp in zip(tstate, travel_specs)]
         y = stack(state, *tstate, deterministic)
@@ -199,15 +239,46 @@ def pipeline_scan(
         tstate = [jnp.roll(ts, 1, axis=0) for ts in tstate]
         return (state, tstate), out
 
-    scan = nn.scan(
-        tick,
-        variable_broadcast="params",
-        variable_axes={"losses": 0},
-        split_rngs={"params": False, "dropout": True},
-        length=ticks,
-    )
     state0 = jnp.zeros((s,) + x_mb.shape[1:], x_mb.dtype)
     tstate0 = [jnp.zeros((s,) + a.shape[1:], a.dtype) for a in travel]
-    _, outs = scan(stack, (state0, tstate0), (inj_x, inj_travel))
+
+    if schedule == "gpipe":
+        scan = nn.scan(
+            tick,
+            variable_broadcast="params",
+            variable_axes={"losses": 0},
+            split_rngs={"params": False, "dropout": True},
+            length=ticks,
+        )
+        _, outs = scan(
+            stack, (state0, tstate0), (inj_x, inj_travel, tick_idx)
+        )
+    else:
+        def segment(stack, carry, xs):
+            inner = nn.scan(
+                tick,
+                variable_broadcast="params",
+                variable_axes={"losses": 0},
+                split_rngs={"params": False, "dropout": True},
+                length=seg,
+            )
+            return inner(stack, carry, xs)
+
+        def reseg(a):
+            return a.reshape((nseg, seg) + a.shape[1:])
+
+        outer = nn.scan(
+            nn.remat(segment, prevent_cse=False),
+            variable_broadcast="params",
+            variable_axes={"losses": 0},
+            split_rngs={"params": False, "dropout": True},
+            length=nseg,
+        )
+        _, outs = outer(
+            stack,
+            (state0, tstate0),
+            (reseg(inj_x), [reseg(a) for a in inj_travel], reseg(tick_idx)),
+        )
+        outs = outs.reshape((total,) + outs.shape[2:])
     # microbatch j exits the last stage at tick j + s - 1
-    return outs[s - 1:]
+    return outs[s - 1:ticks]

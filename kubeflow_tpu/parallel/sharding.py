@@ -34,6 +34,10 @@ LOGICAL_RULES: Dict[str, Union[None, str, Tuple[str, ...]]] = {
     "heads": "tensor",
     "kv": None,
     "vocab": "tensor",
+    # lookup-table vocab dim: tensor-parallel AND fsdp-sharded (hidden dim
+    # whole) — vocab-sharded gathers partition cleanly; hidden-sharded
+    # tables force replicate-then-reshard (training/annotations.py)
+    "vocab_table": ("tensor", "fsdp"),
     "stage": "pipeline",
     "expert": "expert",
     # conv/vision

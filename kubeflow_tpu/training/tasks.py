@@ -223,10 +223,26 @@ class CausalLmTask:
     name = "lm"
     has_batch_stats = False
 
-    def __init__(self, cfg: TrainingConfig, seq_len: int = 1024, vocab_size: int = 50257):
+    def __init__(
+        self,
+        cfg: TrainingConfig,
+        seq_len: int = 1024,
+        vocab_size: int = 50257,
+        loss_chunk: Optional[int] = None,
+    ):
         self.cfg = cfg
         self.seq_len = seq_len
         self.vocab_size = vocab_size
+        # loss_chunk > 0 streams the LM head + cross-entropy over sequence
+        # chunks of that many positions (lax.scan + jax.checkpoint), so the
+        # [B,S,V] logits tensor never materializes — the enabler for 32k+
+        # context where f32 logits alone exceed HBM (configs/
+        # gpt_longcontext_v5e16.yaml). 0 = the plain full-logits path.
+        self.loss_chunk = (
+            loss_chunk
+            if loss_chunk is not None
+            else getattr(cfg, "loss_chunk", 0)
+        )
 
     def synthetic_data(self) -> SyntheticData:
         return SyntheticData(
@@ -238,8 +254,17 @@ class CausalLmTask:
         )
 
     def init_variables(self, model, rng, batch) -> Dict[str, Any]:
+        # under loss_chunk the init pass must also skip the full [1,S,V]
+        # logits — at 32k context they alone exceed HBM (the head's params
+        # are created either way; models/gpt.py return_hidden)
+        kwargs = (
+            {"return_hidden": True}
+            if self.loss_chunk and self.loss_chunk > 0
+            else {}
+        )
         return model.init(
-            rng, jnp.asarray(batch["input_ids"][:1]), deterministic=True
+            rng, jnp.asarray(batch["input_ids"][:1]), deterministic=True,
+            **kwargs,
         )
 
     @staticmethod
@@ -254,9 +279,64 @@ class CausalLmTask:
         valid = (attention_mask[:, 1:] != 0) & (attention_mask[:, :-1] != 0)
         return logits[:, :-1], jnp.where(valid, targets, -100)
 
+    @staticmethod
+    def _shift_full(input_ids, attention_mask):
+        """Full-length next-token targets: position i predicts ids[i+1],
+        the final position is always ignored (-100). Same validity rule as
+        `_shift` but keeps [B, S] so the sequence axis stays chunkable."""
+        b = input_ids.shape[0]
+        targets = jnp.concatenate(
+            [input_ids[:, 1:], jnp.full((b, 1), -100, input_ids.dtype)],
+            axis=1,
+        )
+        valid = jnp.concatenate(
+            [
+                (attention_mask[:, 1:] != 0) & (attention_mask[:, :-1] != 0),
+                jnp.zeros((b, 1), bool),
+            ],
+            axis=1,
+        )
+        return jnp.where(valid, targets, -100)
+
+    @staticmethod
+    def _chunked_lm_loss(head_kernel, hidden, targets, chunk, compute_dtype):
+        """Streamed LM head + CE: scan over sequence chunks, each chunk's
+        [B, chunk, V] logits live only inside its (rematerialized) scan
+        tick. Numerically identical to the full-logits path modulo f32
+        summation order."""
+        b, s, h = hidden.shape
+        pad = (-s) % chunk
+        if pad:
+            hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+            targets = jnp.pad(
+                targets, ((0, 0), (0, pad)), constant_values=-100
+            )
+        n = (s + pad) // chunk
+        hs = hidden.reshape(b, n, chunk, h).swapaxes(0, 1)
+        ts = targets.reshape(b, n, chunk).swapaxes(0, 1)
+        kernel = head_kernel.astype(compute_dtype)
+
+        def body(carry, ht):
+            h_c, t_c = ht
+            logits = (h_c.astype(compute_dtype) @ kernel).astype(jnp.float32)
+            valid = t_c != -100
+            safe = jnp.where(valid, t_c, 0)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+            ll = jnp.where(valid, ll, 0.0)
+            return (carry[0] - ll.sum(), carry[1] + valid.sum()), None
+
+        (total, count), _ = jax.lax.scan(
+            jax.checkpoint(body),
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            (hs, ts),
+        )
+        return total / jnp.maximum(count, 1)
+
     def loss(self, model, params, extra_vars, batch, train: bool, rngs):
         # "losses" is mutable so MoE decoder blocks can sow their
         # load-balance auxiliary loss (models/gpt.py); empty for dense.
+        chunked = self.loss_chunk and self.loss_chunk > 0
         out, sown = model.apply(
             {"params": params, **extra_vars},
             batch["input_ids"],
@@ -264,11 +344,24 @@ class CausalLmTask:
             deterministic=not train,
             rngs=rngs if train else None,
             mutable=["losses"],
+            return_hidden=bool(chunked),
         )
-        logits, targets = self._shift(
-            out["logits"], batch["input_ids"], batch["attention_mask"]
-        )
-        loss = cross_entropy(logits, targets, ignore=-100)
+        if chunked:
+            targets = self._shift_full(
+                batch["input_ids"], batch["attention_mask"]
+            )
+            loss = self._chunked_lm_loss(
+                params["head"]["kernel"],
+                out["hidden"],
+                targets,
+                int(self.loss_chunk),
+                getattr(model.cfg, "dtype", jnp.float32),
+            )
+        else:
+            logits, targets = self._shift(
+                out["logits"], batch["input_ids"], batch["attention_mask"]
+            )
+            loss = cross_entropy(logits, targets, ignore=-100)
         aux = {}
         moe_aux = _sown_loss_sum(sown)
         if moe_aux is not None:

@@ -86,6 +86,11 @@ class Trainer:
             # training length follows below (validate() restricts the
             # knob to the LM families, whose factories accept max_len)
             kwargs.setdefault("max_len", cfg.seq_len)
+        if cfg.remat and model is None:
+            # cfg.remat reaches the model factory (the LM families wrap
+            # their blocks in nn.remat); factories without a remat knob
+            # raise TypeError loudly rather than silently not remat-ing
+            kwargs.setdefault("remat", True)
         if cfg.mesh.sequence > 1 and model is None:
             # a sequence mesh axis means sequence parallelism: default
             # the attention to the ring implementation (KV rotation over
@@ -157,7 +162,11 @@ class Trainer:
         """Derive NamedShardings for every leaf of the state."""
         mesh = self.mesh
         fsdp = mesh.shape.get("fsdp", 1)
-        param_axes = logical_axes_for(state_shapes.params, fsdp_size=fsdp)
+        param_axes = logical_axes_for(
+            state_shapes.params,
+            fsdp_size=fsdp,
+            mesh_axis_sizes=dict(mesh.shape),
+        )
 
         param_specs = jax.tree.map(
             lambda ax: logical_to_spec(ax, mesh=mesh),

@@ -90,6 +90,65 @@ class TestCausalLmTask:
         assert b["input_ids"].max() < 512
         assert b["attention_mask"].all()
 
+    def test_shift_full_matches_shift(self):
+        """_shift_full ([B,S] with -100s) encodes the same (target, valid)
+        pairs as _shift ([B,S-1]) — the chunked path's shifted targets are
+        the dense path's plus an always-ignored final position."""
+        ids = jnp.array([[5, 6, 7, 3], [9, 2, 0, 0]])
+        mask = jnp.array([[1, 1, 1, 0], [1, 1, 0, 0]])
+        logits = jnp.zeros((2, 4, 8))
+        _, t_dense = CausalLmTask._shift(logits, ids, mask)
+        t_full = CausalLmTask._shift_full(ids, mask)
+        np.testing.assert_array_equal(
+            np.asarray(t_full[:, :-1]), np.asarray(t_dense)
+        )
+        assert (np.asarray(t_full[:, -1]) == -100).all()
+
+    @pytest.mark.parametrize("chunk", [5, 16])  # 5 does not divide S=16
+    def test_chunked_loss_matches_full_logits(self, chunk):
+        """loss_chunk streams the LM head + CE over sequence chunks
+        without materializing [B,S,V] logits (the 32k-context HBM
+        enabler); it must be numerically equal to the full-logits path,
+        including ragged attention masks and non-dividing chunk sizes."""
+        cfg = TrainingConfig(
+            model="gpt_tiny", global_batch_size=2, dtype="float32"
+        )
+        model = get_model("gpt_tiny", dtype=jnp.float32)
+        task_full = CausalLmTask(cfg, seq_len=16, vocab_size=512)
+        task_chunk = CausalLmTask(
+            cfg, seq_len=16, vocab_size=512, loss_chunk=chunk
+        )
+        ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 512)
+        mask = jnp.array([[1] * 16, [1] * 11 + [0] * 5])  # ragged row
+        batch = {"input_ids": ids, "attention_mask": mask}
+        params = model.init(jax.random.PRNGKey(1), ids[:1])["params"]
+        loss_f, _ = task_full.loss(model, params, {}, batch, False, None)
+        loss_c, _ = task_chunk.loss(model, params, {}, batch, False, None)
+        np.testing.assert_allclose(
+            float(loss_f), float(loss_c), rtol=1e-5
+        )
+
+    def test_cfg_remat_and_loss_chunk_reach_model_and_task(self):
+        """TrainingConfig.remat/loss_chunk must actually wire through the
+        Trainer (remat was a silent no-op before round 4: the yaml knob
+        existed but never reached the model factory)."""
+        cfg = TrainingConfig(
+            model="gpt_tiny",
+            global_batch_size=2,
+            seq_len=32,
+            remat=True,
+            loss_chunk=8,
+            mesh=MeshConfig(data=1),
+        )
+        from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        mesh = build_mesh(
+            MeshSpec.from_config(cfg.mesh), devices=jax.devices()[:1]
+        )
+        tr = Trainer(cfg, mesh=mesh)
+        assert tr.model.cfg.remat is True
+        assert tr.task.loss_chunk == 8
+
 
 class TestGptTrainer:
     def test_loss_decreases(self, devices8):

@@ -217,6 +217,119 @@ class TestPipelinedBert:
         dt = time.monotonic() - t0
         assert dt < 60.0, f"deep pipeline schedule took {dt:.1f}s to compile"
 
+    def _pipelined_encoder(self, schedule: str, microbatches: int = 16):
+        from kubeflow_tpu.models.bert import BertConfig, PipelinedEncoder
+
+        cfg = BertConfig(
+            vocab_size=128,
+            hidden_size=32,
+            num_layers=8,
+            num_heads=2,
+            mlp_dim=64,
+            max_len=32,
+            dropout_rate=0.0,
+            dtype=jnp.float32,
+            pipeline_stages=8,
+            num_microbatches=microbatches,
+            pipeline_schedule=schedule,
+        )
+        return PipelinedEncoder(cfg)
+
+    def test_1f1b_matches_gpipe(self, devices8):
+        """The segmented-remat (1F1B-bound) schedule is pure scheduling:
+        outputs and gradients must equal GPipe's bit-for-bit math."""
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 4, 32))
+        mask = jnp.ones((16, 4), bool)
+        outs, grads = {}, {}
+        params0 = None
+        for schedule in ("gpipe", "1f1b"):
+            enc = self._pipelined_encoder(schedule)
+            params = enc.init(jax.random.PRNGKey(1), x, mask, True)["params"]
+            if params0 is None:
+                params0 = params
+            else:
+                jax.tree.map(
+                    np.testing.assert_array_equal, params0, params
+                )  # same init: schedules share param structure
+
+            def loss(p, enc=enc):
+                y = enc.apply({"params": p}, x, mask, True)
+                return jnp.sum(y.astype(jnp.float32) ** 2)
+
+            outs[schedule], grads[schedule] = jax.jit(
+                jax.value_and_grad(loss)
+            )(params)
+        np.testing.assert_allclose(
+            float(outs["gpipe"]), float(outs["1f1b"]), rtol=1e-5
+        )
+        # gradients agree up to f32 reduction-order noise (the remat'd
+        # backward fuses differently): compare against the GLOBAL gradient
+        # scale — near-zero elements carry absolute noise from the same
+        # ±O(max) summands, so per-element rtol is the wrong yardstick
+        # (forward outputs above are bit-exact; measured grad skew is
+        # ~4e-7 of max|grad| in f64, i.e. the f32 LayerNorm islands)
+        gmax = max(
+            float(jnp.max(jnp.abs(g))) for g in jax.tree.leaves(grads["gpipe"])
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-4, atol=1e-5 * gmax
+            ),
+            grads["gpipe"],
+            grads["1f1b"],
+        )
+
+    def test_1f1b_bounds_live_activations(self, devices8):
+        """The point of 1F1B: backward-pass live activations stay bounded
+        by the stage count instead of growing with the microbatch count.
+        Asserted via XLA's own accounting (compiled memory analysis):
+        with M=32 microbatches over S=8 stages, the 1f1b program's temp
+        allocation must be well under GPipe's (which holds all M ticks'
+        carries for the backward)."""
+        x = jax.random.normal(jax.random.PRNGKey(0), (32, 4, 32))
+        mask = jnp.ones((32, 4), bool)
+
+        def temp_bytes(schedule):
+            enc = self._pipelined_encoder(schedule, microbatches=32)
+            params = enc.init(jax.random.PRNGKey(1), x, mask, True)["params"]
+
+            def loss(p):
+                y = enc.apply({"params": p}, x, mask, True)
+                return jnp.sum(y.astype(jnp.float32) ** 2)
+
+            compiled = jax.jit(jax.grad(loss)).lower(params).compile()
+            mem = compiled.memory_analysis()
+            assert mem is not None, "memory analysis unsupported on backend"
+            return mem.temp_size_in_bytes
+
+        gpipe, f1b = temp_bytes("gpipe"), temp_bytes("1f1b")
+        # S/M = 8/32: the carry-checkpoint set shrinks ~4x; leave slack
+        # for XLA scheduling noise but require a decisive reduction
+        assert f1b < 0.6 * gpipe, (f1b, gpipe)
+
+    def test_1f1b_compiles_fast(self, devices8):
+        """Segmenting must not reintroduce schedule-length compile cost:
+        the inner tick is traced once, the outer scan once."""
+        import time
+
+        enc = self._pipelined_encoder("1f1b")
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 8, 32))
+        mask = jnp.ones((16, 8), bool)
+        params = enc.init(jax.random.PRNGKey(1), x, mask, True)["params"]
+        t0 = time.monotonic()
+        jax.jit(
+            lambda p, x: enc.apply({"params": p}, x, mask, True)
+        ).lower(params, x).compile()
+        dt = time.monotonic() - t0
+        assert dt < 60.0, f"1f1b schedule took {dt:.1f}s to compile"
+
+    def test_unknown_schedule_rejected(self, devices8):
+        enc = self._pipelined_encoder("rolling")
+        x = jnp.zeros((4, 2, 32))
+        mask = jnp.ones((4, 2), bool)
+        with pytest.raises(ValueError, match="schedule"):
+            enc.init(jax.random.PRNGKey(0), x, mask, True)
+
     def test_unsupported_model_raises(self, devices8):
         from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
         from kubeflow_tpu.parallel.mesh import mesh_from_config

@@ -1,0 +1,71 @@
+"""SPMD efficiency enforcement: multi-chip compiles must be free of
+GSPMD "Involuntary full rematerialization" (replicate-then-reshard)
+warnings — the dryrun's compiler-diagnostic capture turned into a test.
+
+Round 3 shipped a {data, tensor, sequence} mesh whose embedding gather
+fell back to full rematerialization every step (MULTICHIP_r03 tail;
+VERDICT r3 weak #2/#7): the warning scrolled by and nobody acted on it.
+These tests pin the fixed layouts (vocab_table-sharded lookup tables,
+(batch, seq)-constrained ids) and fail if a layout change regresses.
+"""
+
+import jax
+import numpy as np
+
+from __graft_entry__ import _REMAT_WARNING, capture_compiler_diagnostics
+from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+from kubeflow_tpu.parallel.mesh import mesh_from_config
+from kubeflow_tpu.training.data import make_global_batch
+from kubeflow_tpu.training.tasks import CausalLmTask, MlmTask
+from kubeflow_tpu.training.trainer import Trainer
+
+
+def _compile_and_check(model, axes, task_cls, model_kwargs=None):
+    cfg = TrainingConfig(
+        model=model,
+        global_batch_size=16,
+        steps=1,
+        warmup_steps=1,
+        learning_rate=1e-3,
+        mesh=MeshConfig(**axes),
+    )
+    mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:8])
+    task = task_cls(cfg, seq_len=16, vocab_size=512)
+    trainer = Trainer(
+        cfg, mesh=mesh, task=task, model_kwargs=model_kwargs or {}
+    )
+    with capture_compiler_diagnostics() as diag:
+        state = trainer.init_state()
+        batch = make_global_batch(task.synthetic_data().batch_at(0), mesh)
+        _, metrics = trainer.train_step(state, batch, jax.random.PRNGKey(0))
+        loss = float(jax.device_get(metrics["loss"]))
+        text = diag.text()
+    assert np.isfinite(loss)
+    offending = [ln for ln in text.splitlines() if _REMAT_WARNING in ln]
+    assert not offending, offending[0]
+
+
+class TestNoInvoluntaryRemat:
+    def test_sp_tp_dp_mesh_bert(self, devices8):
+        """The round-3 offender: {data, tensor, sequence} on the encoder."""
+        _compile_and_check(
+            "bert_tiny",
+            {"data": 2, "tensor": 2, "sequence": 2},
+            MlmTask,
+            {"attention_impl": "ring"},
+        )
+
+    def test_fsdp_pp_mesh_bert(self, devices8):
+        """The second (previously unnoticed) offender: fsdp-sharded
+        embedding tables under {data, fsdp, pipeline}."""
+        _compile_and_check(
+            "bert_tiny", {"data": 2, "fsdp": 2, "pipeline": 2}, MlmTask
+        )
+
+    def test_sp_mesh_gpt(self, devices8):
+        _compile_and_check(
+            "gpt_tiny",
+            {"data": 4, "sequence": 2},
+            CausalLmTask,
+            {"attention_impl": "ring"},
+        )
