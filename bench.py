@@ -649,6 +649,71 @@ def bench_generate_stepwise(
     }
 
 
+def bench_generate_micro(batch: int = 4, prompt_len: int = 32) -> dict:
+    """Last-resort decode datapoint: one jitted prefill + 4 single-token
+    decode steps on a tiny cache. Exists because the tunneled
+    remote-compile endpoint kills BOTH the fused scan program and the
+    600-token stepwise loop when degraded (round-3/4 observations) — this
+    compiles two small scan-free programs and still lands a real
+    ms/token number (mode recorded; not comparable to fused numbers)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.registry import get_model
+
+    max_len = prompt_len + 16
+    model = get_model(
+        "gpt_small", dtype=jnp.bfloat16, scan_layers=True, max_len=max_len
+    )
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, prompt_len), 0, 50257
+    ).astype(jnp.int32)
+    params = jax.jit(
+        lambda rng: model.init(
+            rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
+        )
+    )(jax.random.PRNGKey(0))["params"]
+    prefill = jax.jit(
+        lambda p: model.apply(
+            {"params": params}, p, prefill=True, mutable=["cache"]
+        )
+    )
+
+    def _step(cache, tok):
+        out, mutated = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            decode=True,
+            mutable=["cache"],
+        )
+        nxt = jnp.argmax(out["logits"][:, 0], axis=-1).astype(jnp.int32)
+        return mutated["cache"], nxt
+
+    step = jax.jit(_step)
+    out, mutated = prefill(prompt)
+    cache = mutated["cache"]
+    tok = jnp.argmax(out["logits"][:, -1], axis=-1).astype(jnp.int32)
+    cache, tok = step(cache, tok)  # compile decode
+    _ = int(jax.device_get(tok[0]))
+    iters = 8
+    t0 = time.monotonic()
+    for _ in range(iters):
+        cache, tok = step(cache, tok)
+    _ = int(jax.device_get(tok[0]))
+    dt = (time.monotonic() - t0) / iters
+    return {
+        "model": "gpt_small",
+        "mode": "micro",  # 1-token decode step time only; see docstring
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "max_len": max_len,
+        "ms_per_decode_step": round(dt * 1e3, 3),
+        "generate_tokens_per_sec": round(batch / dt, 1),
+    }
+
+
 def bench_studyjob_trials(n_trials: int = 4) -> dict:
     """Trials/hr through the real control plane (Katib-equivalent metric).
 
@@ -744,17 +809,119 @@ def bench_studyjob_trials(n_trials: int = 4) -> dict:
     }
 
 
-def _bench_in_subprocess(fn_name: str, timeout_s: int) -> dict:
-    """Run one bench function in a fresh python with a hard wall-clock cap.
+def bench_probe() -> dict:
+    """Cheapest possible device touch: backend + device kind + one tiny
+    matmul round trip. Warms the (tunneled) compile path and tells the
+    orchestrator what hardware the battery is running on."""
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.monotonic()
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    y = float(jax.device_get((x @ x).sum()))
+    assert y == 128.0 * 128 * 128
+    return {
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+        "device_kind": getattr(jax.devices()[0], "device_kind", "cpu"),
+        "probe_ms": round((time.monotonic() - t0) * 1e3, 1),
+    }
+
+
+def bench_long_context_train(seq_len: int = 32768) -> dict:
+    """The long-context north star, END TO END: a full GPT-small train
+    step at 32k context on ONE chip (the single-chip half of
+    configs/gpt_longcontext_v5e16.yaml — the v5e-16 job shards this same
+    step over {data:2, sequence:8}).
+
+    What makes 32k fit in 16 GB HBM: causal flash attention (no [S,S]
+    scores), nn.remat on every block (cfg.remat), and the chunked LM loss
+    (loss_chunk=4096 — the [B,S,50257] logits tensor, 6.6 GB in f32,
+    never materializes; training/tasks.py::_chunked_lm_loss). Reports
+    MFU from XLA's own cost model, not just attention ms
+    (VERDICT r3 item 3)."""
+    import jax
+
+    from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+    from kubeflow_tpu.parallel.mesh import build_mesh, MeshSpec
+    from kubeflow_tpu.training.data import make_global_batch
+    from kubeflow_tpu.training.trainer import Trainer
+
+    n_dev = len(jax.devices())
+    steps = int(os.environ.get("KFT_BENCH_LONGCTX_STEPS", "4"))
+    cfg = TrainingConfig(
+        model="gpt_small",
+        seq_len=seq_len,
+        global_batch_size=1 * n_dev,
+        steps=steps,
+        warmup_steps=1,
+        learning_rate=3e-4,
+        remat=True,
+        loss_chunk=4096,
+        mesh=MeshConfig(data=n_dev),
+    )
+    mesh = build_mesh(MeshSpec.from_config(cfg.mesh), devices=jax.devices())
+    trainer = Trainer(
+        cfg, mesh=mesh, model_kwargs={"attention_impl": "flash"}
+    )
+    state = trainer.init_state()
+    batch_dev = make_global_batch(
+        trainer.task.synthetic_data().batch_at(0), mesh
+    )
+    rng = jax.random.PRNGKey(0)
+    dt, state = _timed_steps(trainer, state, batch_dev, rng, steps)
+    with jax.set_mesh(mesh):
+        cost = _cost_analysis(trainer._train_step, state, batch_dev, rng)
+    peak_flops, peak_bw = _chip_peaks(jax.devices()[0])
+    tokens_per_step = cfg.global_batch_size * seq_len / n_dev
+    return {
+        "model": "gpt_small",
+        "seq_len": seq_len,
+        "attention_impl": "flash_causal",
+        "remat": True,
+        "loss_chunk": 4096,
+        "tokens_per_sec_per_chip": round(tokens_per_step / dt, 1),
+        "step_time_ms": round(dt * 1e3, 1),
+        "mfu": round(cost["flops"] / dt / peak_flops, 4)
+        if peak_flops and cost["flops"]
+        else None,
+        "hbm_util": round(cost["bytes"] / dt / peak_bw, 4)
+        if peak_bw and cost["bytes"]
+        else None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Orchestration: every entry in a bounded subprocess, results streamed
+# incrementally, a global budget that sheds gracefully (VERDICT r3 item 1 —
+# round 3 lost its entire battery to one stalled tunnel compile because the
+# JSON printed only at the end; the reference's CI has the same contract in
+# its always-emit-junit exit handler, unit_tests.jsonnet:162-186).
+#
+# The parent process NEVER imports jax: on hosts where libtpu is exclusive
+# per process, children serially own the chip. After every completed entry
+# the parent prints the FULL cumulative summary as one JSON line (flushed) —
+# whenever the driver's own timeout kills us, the last line on stdout is
+# always a complete, parseable summary holding every finished entry.
+# ---------------------------------------------------------------------------
+
+_RESULT_MARK = "KFT_BENCH_RESULT "
+
+
+def _bench_in_subprocess(expr: str, timeout_s: float, extra_env=None) -> dict:
+    """Run one bench expression in a fresh python with a hard wall-clock cap.
 
     Blocked device/compile calls cannot be interrupted in-process; a
-    subprocess can always be killed. The child prints one JSON line."""
+    subprocess can always be killed. The child prints one marked JSON line."""
     import subprocess
 
     code = (
         "import json, bench; "
-        f"print(json.dumps(bench.{fn_name}()))"
+        f"r = bench.{expr}; "
+        f"print({_RESULT_MARK!r} + json.dumps(r))"
     )
+    env = dict(os.environ)
+    env.update(extra_env or {})
     try:
         out = subprocess.run(
             [sys.executable, "-c", code],
@@ -762,19 +929,75 @@ def _bench_in_subprocess(fn_name: str, timeout_s: int) -> dict:
             text=True,
             timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
         )
     except subprocess.TimeoutExpired:
-        return {"error": f"{fn_name} exceeded {timeout_s}s (killed)"}
+        return {"error": f"{expr} exceeded {int(timeout_s)}s (killed)"}
     for line in reversed(out.stdout.strip().splitlines()):
+        if not line.startswith(_RESULT_MARK):
+            continue
         try:
-            result = json.loads(line)
+            result = json.loads(line[len(_RESULT_MARK):])
         except json.JSONDecodeError:
             continue
-        if isinstance(result, dict):  # stray scalar lines are not results
+        if isinstance(result, dict):
             return result
     return {
-        "error": f"{fn_name} exited {out.returncode} with no result",
+        "error": f"{expr} exited {out.returncode} with no result",
         "stderr_tail": out.stderr[-500:],
+    }
+
+
+def _entry_specs(batch: int, steps: int):
+    """(key, expression, per-entry timeout s, extra env, tpu_only).
+
+    Ordered by headline importance: whatever the budget sheds, it sheds
+    from the tail. Per-entry timeouts assume tunnel-grade compiles
+    (60-300 s per program); the global budget is the real cap."""
+    bert_steps = max(5, steps // 2)
+    return [
+        ("resnet50", f"bench_resnet({batch}, {steps})", 900, None, False),
+        ("bert_base_pretrain", f"bench_bert({bert_steps})", 720, None, False),
+        (
+            "bert_large_pretrain",
+            f"bench_bert({bert_steps})",
+            720,
+            {"KFT_BENCH_BERT_MODEL": "bert_large", "KFT_BENCH_BERT_BATCH": "16"},
+            False,
+        ),
+        ("long_context_train", "bench_long_context_train()", 900, None, True),
+        ("generate", "bench_generate()", 600, None, False),
+        ("studyjob", "bench_studyjob_trials()", 720, None, False),
+        ("serving", "bench_serving()", 480, None, False),
+        ("long_context_attention", "bench_long_context()", 480, None, True),
+        ("attention_sweep", "bench_attention_sweep()", 900, None, True),
+    ]
+
+
+def _summary(results: dict, batch: int, complete: bool, t0: float) -> dict:
+    resnet = results.get("resnet50") or {}
+    per_chip = resnet.get("images_per_sec_per_chip")
+    probe = results.get("probe") or {}
+    return {
+        "metric": "images/sec/chip (ResNet-50 train step, bf16, batch "
+        f"{batch}/chip, {probe.get('n_devices', 1)} chip(s))",
+        "value": per_chip,
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_V100_IMAGES_PER_SEC, 3)
+        if per_chip
+        else None,
+        "resnet50": results.get("resnet50"),
+        "bert_base_pretrain": results.get("bert_base_pretrain"),
+        "bert_large_pretrain": results.get("bert_large_pretrain"),
+        "long_context_train": results.get("long_context_train"),
+        "studyjob": results.get("studyjob"),
+        "serving": results.get("serving"),
+        "generate": results.get("generate"),
+        "long_context_attention": results.get("long_context_attention"),
+        "attention_sweep": results.get("attention_sweep"),
+        "device_kind": probe.get("device_kind"),
+        "complete": complete,
+        "elapsed_s": round(time.monotonic() - t0, 1),
     }
 
 
@@ -782,76 +1005,75 @@ def main() -> int:
     batch = int(os.environ.get("KFT_BENCH_BATCH", "256"))
     steps = int(os.environ.get("KFT_BENCH_STEPS", "20"))
     suite = os.environ.get("KFT_BENCH_SUITE", "all")
+    # Global wall-clock budget: sheds remaining entries gracefully so the
+    # final summary ALWAYS prints. Sized for tunnel-grade first compiles
+    # (each entry re-pays its own compile in its own subprocess); the
+    # incremental cumulative lines make even a driver-side hard kill
+    # lossless, so erring large here costs nothing.
+    budget_s = float(os.environ.get("KFT_BENCH_BUDGET", "2400"))
+    t0 = time.monotonic()
+    results = {}
 
-    # generate runs FIRST, in a bounded subprocess, BEFORE this process
-    # initializes any jax backend: on hosts where libtpu is exclusive
-    # per process, a child spawned after the parent holds the TPU could
-    # never attach. Bounded because the tunneled remote-compile endpoint
-    # can hang ~30 min on scan-heavy programs and a blocked in-process
-    # compile cannot be interrupted. Fallback chain: fused scan →
-    # host-loop stepwise → recorded error.
-    generate = None
-    if suite == "all" and os.environ.get("KFT_BENCH_GENERATE") != "0":
-        budget_s = int(os.environ.get("KFT_BENCH_GENERATE_TIMEOUT", "600"))
-        generate = _bench_in_subprocess("bench_generate", budget_s)
-        if "error" in generate:
-            fused_err = generate["error"]
-            generate = _bench_in_subprocess(
-                "bench_generate_stepwise", budget_s
-            )
-            generate["fused_error"] = fused_err
+    def emit(complete: bool):
+        print(json.dumps(_summary(results, batch, complete, t0)), flush=True)
 
-    import jax
-
-    n_dev = len(jax.devices())
-
-    resnet = bench_resnet(batch, steps)
-
-    bert = trials = long_ctx = serving = attn_sweep = None
-    if suite == "all":
-        try:
-            bert = bench_bert(max(5, steps // 2))
-        except Exception as e:  # noqa: BLE001
-            bert = {"error": f"{type(e).__name__}: {e}"}
-        try:
-            trials = bench_studyjob_trials()
-        except Exception as e:  # noqa: BLE001
-            trials = {"error": f"{type(e).__name__}: {e}"}
-        try:
-            serving = bench_serving()
-        except Exception as e:  # noqa: BLE001
-            serving = {"error": f"{type(e).__name__}: {e}"}
-        if jax.default_backend() == "tpu":
-            # last: the compiled-kernel path only exists on TPU
-            try:
-                long_ctx = bench_long_context()
-            except Exception as e:  # noqa: BLE001
-                long_ctx = {"error": f"{type(e).__name__}: {e}"}
-            try:
-                attn_sweep = bench_attention_sweep()
-            except Exception as e:  # noqa: BLE001
-                attn_sweep = {"error": f"{type(e).__name__}: {e}"}
-
-    per_chip = resnet["images_per_sec_per_chip"]
-    print(
-        json.dumps(
-            {
-                "metric": "images/sec/chip (ResNet-50 train step, bf16, batch "
-                f"{batch}/chip, {n_dev} chip(s))",
-                "value": per_chip,
-                "unit": "images/sec/chip",
-                "vs_baseline": round(per_chip / REFERENCE_V100_IMAGES_PER_SEC, 3),
-                "resnet50": resnet,
-                "bert_base_pretrain": bert,
-                "studyjob": trials,
-                "serving": serving,
-                "generate": generate,
-                "long_context_attention": long_ctx,
-                "attention_sweep": attn_sweep,
-                "device_kind": getattr(jax.devices()[0], "device_kind", "cpu"),
-            }
-        )
+    results["probe"] = _bench_in_subprocess(
+        "bench_probe()", min(300.0, budget_s)
     )
+    # tpu_only entries skip only on a POSITIVE non-tpu answer: a probe
+    # error (tunnel stall — the exact mode this harness defends against)
+    # must not reclassify a real TPU host as CPU and silently drop the
+    # long-context entries; attempt them and let their own bounds decide
+    on_tpu = results["probe"].get("backend", "unknown") != "cpu"
+    emit(False)
+
+    specs = _entry_specs(batch, steps)
+    if suite != "all":
+        specs = [s for s in specs if s[0] == "resnet50"]
+    if os.environ.get("KFT_BENCH_GENERATE") == "0":
+        specs = [s for s in specs if s[0] != "generate"]
+
+    for key, expr, cap_s, extra_env, tpu_only in specs:
+        if tpu_only and not on_tpu:
+            results[key] = {"skipped": "tpu-only entry on non-tpu backend"}
+            continue
+        remaining = budget_s - (time.monotonic() - t0)
+        if remaining < 90:
+            results[key] = {
+                "skipped": f"budget exhausted ({int(budget_s)}s)"
+            }
+            emit(False)
+            continue
+        timeout_s = min(float(cap_s), remaining)
+        result = _bench_in_subprocess(expr, timeout_s, extra_env)
+        if key == "generate" and "error" in result:
+            # fallback chain: fused scan → host-loop stepwise → micro
+            # (prefill + single decode step) → recorded errors. The
+            # tunneled remote-compile endpoint drops scan-heavy programs
+            # when degraded; each tier compiles less than the last, and
+            # `mode` marks the numbers as non-comparable across tiers.
+            tier_errors = [f"fused: {result['error']}"]
+            for fb, tier in (
+                ("bench_generate_stepwise()", "stepwise"),
+                ("bench_generate_micro()", "micro"),
+            ):
+                remaining = budget_s - (time.monotonic() - t0)
+                if remaining <= 90:
+                    break
+                result = _bench_in_subprocess(
+                    fb, min(float(cap_s), remaining)
+                )
+                if "error" in result:
+                    tier_errors.append(f"{tier}: {result['error']}")
+                else:
+                    break
+            # every failed tier's error survives (the fused failure is the
+            # most diagnostic signal for tunnel-degradation triage)
+            result["tier_errors"] = tier_errors
+        results[key] = result
+        emit(False)
+
+    emit(True)
     return 0
 
 
