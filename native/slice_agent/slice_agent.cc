@@ -40,7 +40,14 @@
 //     weak-item 5).
 //
 // Exit codes: payload's exit code; 3 = device gate timeout, 4 = barrier
-// timeout, 5 = terminated by gang signal, 2 = usage error.
+// timeout, 5 = terminated by gang signal, 6 = data staging failure,
+// 2 = usage error.
+//
+// Data staging (reference controller.py:104-116 s3_copy lifecycle):
+// --stage-in SRC=DST pairs copy (recursively, FNV-1a64-verified) before
+// the gang barrier — no worker starts until data is local; --stage-out
+// pairs push artifacts after a successful payload. --stage-cmd CMD
+// delegates each pair to `CMD SRC DST` (gsutil/s5cmd-class tools).
 
 #include <arpa/inet.h>
 #include <dirent.h>
@@ -61,12 +68,18 @@
 #include <cstdarg>
 #include <cstdio>
 #include <cstdlib>
+#include <cstdint>
 #include <cstring>
 #include <map>
 #include <string>
 #include <vector>
 
 namespace {
+
+struct StagePair {
+  std::string src;
+  std::string dst;
+};
 
 struct Options {
   std::string shared_dir;
@@ -77,6 +90,16 @@ struct Options {
   int min_devices = 0;
   int poll_ms = 100;
   long timeout_ms = 0;  // 0 = no timeout
+  // data staging (the openmpi sidecar's s3_copy lifecycle, reference
+  // controller.py:104-116): --stage-in runs BEFORE the gang barrier, so
+  // no worker starts until every agent's data is local and verified;
+  // --stage-out pushes artifacts after the payload finishes. SRC=DST
+  // pairs; copies are recursive with FNV-1a64 read-back verification.
+  // --stage-cmd delegates each pair to `CMD SRC DST` instead (the
+  // production hook for gsutil/s5cmd-class tools).
+  std::vector<StagePair> stage_in;
+  std::vector<StagePair> stage_out;
+  std::string stage_cmd;
   std::vector<char*> payload;
 };
 
@@ -169,6 +192,15 @@ bool parse_args(int argc, char** argv, Options* o) {
     else if (a == "--min-devices" && next(&v)) o->min_devices = (int)v;
     else if (a == "--poll-ms" && next(&v)) o->poll_ms = (int)v;
     else if (a == "--timeout-ms" && next(&v)) o->timeout_ms = v;
+    else if ((a == "--stage-in" || a == "--stage-out") && i + 1 < argc) {
+      std::string pair = argv[++i];
+      auto eq = pair.find('=');
+      if (eq == std::string::npos || eq == 0 || eq + 1 == pair.size())
+        return false;
+      StagePair sp{pair.substr(0, eq), pair.substr(eq + 1)};
+      (a == "--stage-in" ? o->stage_in : o->stage_out).push_back(sp);
+    }
+    else if (a == "--stage-cmd" && i + 1 < argc) o->stage_cmd = argv[++i];
     else if (a == "--") { i++; break; }
     else return false;
   }
@@ -454,6 +486,149 @@ void mkdirs(const std::string& path) {
   }
 }
 
+// ---- data staging ----------------------------------------------------
+// The openmpi sidecar downloads training data before releasing workers and
+// uploads results afterwards (reference controller.py:104-116 s3_copy).
+// Here: recursive local copies (the mounted-bucket / NFS / test-fake case)
+// with FNV-1a64 read-back verification, or delegation to --stage-cmd.
+
+uint64_t fnv1a64(const void* data, size_t n, uint64_t h) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  for (size_t i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+// Hash a whole file; returns false on read error.
+bool hash_file(const std::string& path, uint64_t* out) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  uint64_t h = 1469598103934665603ULL;
+  char buf[1 << 16];
+  ssize_t n;
+  while ((n = ::read(fd, buf, sizeof(buf))) > 0) h = fnv1a64(buf, n, h);
+  ::close(fd);
+  if (n < 0) return false;
+  *out = h;
+  return true;
+}
+
+bool copy_file_verified(const std::string& src, const std::string& dst,
+                        long* bytes) {
+  int in = ::open(src.c_str(), O_RDONLY);
+  if (in < 0) {
+    logmsg("stage: cannot open %s (%s)", src.c_str(), strerror(errno));
+    return false;
+  }
+  std::string tmp = dst + ".staging";
+  int out = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (out < 0) {
+    logmsg("stage: cannot create %s (%s)", tmp.c_str(), strerror(errno));
+    ::close(in);
+    return false;
+  }
+  uint64_t want = 1469598103934665603ULL;
+  char buf[1 << 16];
+  ssize_t n;
+  bool ok = true;
+  while ((n = ::read(in, buf, sizeof(buf))) > 0) {
+    want = fnv1a64(buf, n, want);
+    ssize_t off = 0;
+    while (off < n) {
+      ssize_t w = ::write(out, buf + off, n - off);
+      if (w <= 0) { ok = false; break; }
+      off += w;
+      *bytes += w;
+    }
+    if (!ok) break;
+  }
+  if (n < 0) ok = false;
+  ::close(in);
+  if (::close(out) != 0) ok = false;
+  uint64_t got = 0;
+  // read-back verification: the copy on disk must hash identically to
+  // what was read from the source (catches torn/short writes)
+  if (ok) ok = hash_file(tmp, &got) && got == want;
+  if (ok) ok = ::rename(tmp.c_str(), dst.c_str()) == 0;  // atomic publish
+  if (!ok) {
+    logmsg("stage: copy %s -> %s failed verification", src.c_str(),
+           dst.c_str());
+    ::unlink(tmp.c_str());
+  }
+  return ok;
+}
+
+bool is_dir(const std::string& path) {
+  struct stat st;
+  return ::stat(path.c_str(), &st) == 0 && S_ISDIR(st.st_mode);
+}
+
+bool copy_tree(const std::string& src, const std::string& dst, long* files,
+               long* bytes) {
+  if (!is_dir(src)) {
+    auto slash = dst.find_last_of('/');
+    if (slash != std::string::npos && slash > 0)
+      mkdirs(dst.substr(0, slash));  // bare filenames have no parent to make
+    if (!copy_file_verified(src, dst, bytes)) return false;
+    (*files)++;
+    return true;
+  }
+  mkdirs(dst);
+  DIR* d = ::opendir(src.c_str());
+  if (!d) return false;
+  bool ok = true;
+  while (struct dirent* e = ::readdir(d)) {
+    std::string name = e->d_name;
+    if (name == "." || name == "..") continue;
+    if (!copy_tree(src + "/" + name, dst + "/" + name, files, bytes)) {
+      ok = false;
+      break;
+    }
+  }
+  ::closedir(d);
+  return ok;
+}
+
+bool run_stage_cmd(const std::string& cmd, const StagePair& p) {
+  pid_t child = ::fork();
+  if (child < 0) return false;
+  if (child == 0) {
+    ::execlp(cmd.c_str(), cmd.c_str(), p.src.c_str(), p.dst.c_str(),
+             (char*)nullptr);
+    std::perror("execlp stage-cmd");
+    _exit(127);
+  }
+  int status = 0;
+  ::waitpid(child, &status, 0);
+  return WIFEXITED(status) && WEXITSTATUS(status) == 0;
+}
+
+// Run one staging direction; on success writes `signal_name` (e.g.
+// staged.<id>) with a "files=N bytes=M" summary so tests/operators can
+// assert the gate ordering.
+bool run_stage(const Options& o, const std::vector<StagePair>& pairs,
+               const char* what, const std::string& signal_name) {
+  long files = 0, bytes = 0;
+  for (const auto& p : pairs) {
+    bool ok = o.stage_cmd.empty() ? copy_tree(p.src, p.dst, &files, &bytes)
+                                  : run_stage_cmd(o.stage_cmd, p);
+    if (!ok) {
+      logmsg("%s failed: %s -> %s", what, p.src.c_str(), p.dst.c_str());
+      return false;
+    }
+  }
+  if (!pairs.empty()) {
+    char summary[96];
+    std::snprintf(summary, sizeof(summary), "files=%ld bytes=%ld", files,
+                  bytes);
+    write_file(sig_path(o, signal_name), summary);
+    logmsg("%s done: %s", what, summary);
+  }
+  return true;
+}
+
 int main(int argc, char** argv) {
   Options o;
   if (!parse_args(argc, argv, &o)) return usage();
@@ -476,6 +651,16 @@ int main(int argc, char** argv) {
     }
     logmsg("device gate passed (%d nodes at %s*)",
            count_device_nodes(o.device_glob), o.device_glob.c_str());
+  }
+
+  // 1.5 Stage-in BEFORE the barrier: the barrier release then guarantees
+  //     every gang member's data is local and verified (the reference
+  //     sidecar's download-before-SIGCONT contract, controller.py:104-116).
+  if (!run_stage(o, o.stage_in, "stage-in",
+                 "staged." + std::to_string(o.process_id))) {
+    write_file(sig_path(o, "phase." + std::to_string(o.process_id)),
+               "Failed");
+    return 6;
   }
 
   // 2. Gang barrier: TCP (cross-host default) or signal files (shared dir).
@@ -594,6 +779,14 @@ int main(int argc, char** argv) {
   }
 
   int code = WIFEXITED(status) ? WEXITSTATUS(status) : 128 + WTERMSIG(status);
+  // 4. Stage-out artifacts (the sidecar's post-run upload). Runs only on
+  //    payload success; a failed stage-out fails the member — artifacts
+  //    that never reached the store mean the work is not durable.
+  if (code == 0 &&
+      !run_stage(o, o.stage_out, "stage-out",
+                 "staged_out." + std::to_string(o.process_id))) {
+    code = 6;
+  }
   write_file(sig_path(o, "phase." + std::to_string(o.process_id)),
              code == 0 ? "Succeeded" : "Failed");
   if (o.process_id == 0)

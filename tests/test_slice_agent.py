@@ -385,3 +385,90 @@ class TestSliceAgentTsan:
             assert p.returncode == 0, (
                 f"exit {p.returncode} (66=TSan race):\n{err}"
             )
+
+
+class TestDataStaging:
+    """Stage-in/out lifecycle (reference controller.py:104-116 s3_copy):
+    data lands locally (verified) BEFORE the barrier releases any worker;
+    artifacts are pushed to the store after a successful payload."""
+
+    def _make_remote(self, tmp_path):
+        remote = tmp_path / "remote" / "dataset"
+        (remote / "sub").mkdir(parents=True)
+        (remote / "a.bin").write_bytes(os.urandom(70000))  # > one copy buf
+        (remote / "sub" / "b.txt").write_text("shard")
+        return remote
+
+    def test_stage_in_before_barrier_gates_the_gang(self, agent, tmp_path):
+        """A 2-gang where member 1 stages a dataset: member 0 must block at
+        the barrier until member 1's stage-in completes, so every payload
+        starts with data local."""
+        remote = self._make_remote(tmp_path)
+        local = tmp_path / "scratch"
+        shared = tmp_path / "shared"
+        procs = [
+            run_agent(agent, shared, 0, 2, payload=["true"], timeout_ms=8000),
+            run_agent(
+                agent, shared, 1, 2, payload=["true"], timeout_ms=8000,
+                extra=["--stage-in", f"{remote}={local}"],
+            ),
+        ]
+        for p in procs:
+            assert p.wait(timeout=10) == 0, p.stderr.read()
+        assert (local / "a.bin").read_bytes() == (remote / "a.bin").read_bytes()
+        assert (local / "sub" / "b.txt").read_text() == "shard"
+        staged = (shared / "staged.1").read_text()
+        assert staged.startswith("files=2 bytes=")
+        # the barrier start signal can only exist if staging finished first
+        assert (shared / "start").exists()
+
+    def test_stage_in_failure_fails_member_before_barrier(self, agent, tmp_path):
+        p = run_agent(
+            agent, tmp_path, 0, 1, payload=["true"], timeout_ms=4000,
+            extra=["--stage-in", f"{tmp_path}/missing={tmp_path}/out"],
+        )
+        assert p.wait(timeout=10) == 6  # staging failure exit code
+        assert (tmp_path / "phase.0").read_text() == "Failed"
+        assert not (tmp_path / "start").exists()
+
+    def test_stage_out_after_success(self, agent, tmp_path):
+        work = tmp_path / "work"
+        work.mkdir()
+        store = tmp_path / "store"
+        p = run_agent(
+            agent, tmp_path / "shared", 0, 1,
+            payload=["cp", "/etc/hostname", str(work / "result.txt")],
+            timeout_ms=8000,
+            extra=["--stage-out", f"{work}={store}"],
+        )
+        assert p.wait(timeout=10) == 0, p.stderr.read()
+        assert (store / "result.txt").exists()
+        assert (tmp_path / "shared" / "staged_out.0").read_text().startswith(
+            "files=1"
+        )
+
+    def test_stage_out_skipped_on_payload_failure(self, agent, tmp_path):
+        work = tmp_path / "work"
+        work.mkdir()
+        (work / "partial.txt").write_text("junk")
+        store = tmp_path / "store"
+        p = run_agent(
+            agent, tmp_path / "shared", 0, 1, payload=["false"],
+            timeout_ms=8000, extra=["--stage-out", f"{work}={store}"],
+        )
+        assert p.wait(timeout=10) == 1
+        assert not store.exists()  # no partial-result uploads
+
+    def test_stage_cmd_delegation(self, agent, tmp_path):
+        """--stage-cmd hands each SRC DST pair to an external tool (the
+        gsutil/s5cmd hook); the agent trusts its exit code."""
+        src = tmp_path / "src.txt"
+        src.write_text("payload data")
+        dst = tmp_path / "dst.txt"
+        p = run_agent(
+            agent, tmp_path / "shared", 0, 1, payload=["true"],
+            timeout_ms=8000,
+            extra=["--stage-in", f"{src}={dst}", "--stage-cmd", "cp"],
+        )
+        assert p.wait(timeout=10) == 0, p.stderr.read()
+        assert dst.read_text() == "payload data"
