@@ -56,11 +56,17 @@ class Gatekeeper:
         password_hash: str,
         user_header: str = "x-auth-user-email",
         session_ttl_s: float = 24 * 3600,
+        jwt_validator=None,
     ):
         self.username = username
         self.password_hash = password_hash
         self.user_header = user_header
         self.session_ttl_s = session_ttl_s
+        # bearer-token identity source (api/jwt_auth.py JwtValidator): the
+        # IAP/OIDC posture — a valid signed JWT is as good as a session
+        # (reference echo-server/main.py:27-40 trusts the ESP assertion;
+        # here the signature/aud/iss/exp are actually verified)
+        self.jwt_validator = jwt_validator
         self._sessions: Dict[str, Tuple[str, float]] = {}  # token -> (user, exp)
         self.app = self._build()
 
@@ -106,7 +112,27 @@ class Gatekeeper:
             user = self._session_user(jar[COOKIE_NAME].value)
             if user is not None:
                 return user
-        return self._basic_auth_user(headers.get("authorization", ""))
+        authorization = headers.get("authorization", "")
+        bearer_user = self._bearer_user(authorization)
+        if bearer_user is not None:
+            return bearer_user
+        return self._basic_auth_user(authorization)
+
+    def _bearer_user(self, authorization: str) -> Optional[str]:
+        """Authorization: Bearer — verified JWT claims become identity.
+        Returns None (fall through to other schemes / 401) on any
+        validation failure; the failure reason is never leaked."""
+        if self.jwt_validator is None:
+            return None
+        if not authorization.lower().startswith("bearer "):
+            return None
+        from kubeflow_tpu.api.jwt_auth import InvalidToken
+
+        try:
+            claims = self.jwt_validator.validate(authorization[7:].strip())
+        except InvalidToken:
+            return None
+        return self.jwt_validator.identity(claims) or None
 
     def _session_user(self, token: str) -> Optional[str]:
         entry = self._sessions.get(token)
@@ -147,13 +173,10 @@ class Gatekeeper:
             # request through (with identity attached), 302 sends to login
             # (302 not 301: browsers cache permanent redirects, which would
             # lock a logged-in user out of pages visited while logged out).
-            # Cookie (browser) or Basic header (programmatic) both pass.
-            token = req.cookies().get(COOKIE_NAME, "")
-            user = self._session_user(token) if token else None
-            if user is None:
-                user = self._basic_auth_user(
-                    req.headers.get("authorization", "")
-                )
+            # Cookie (browser), Bearer JWT (IAP/OIDC posture), or Basic
+            # header (programmatic) all pass — one resolution path
+            # (authenticate) serves the endpoint and the gateway filter.
+            user = self.authenticate(req.headers)
             if user is None:
                 req.response_headers.append(("Location", LOGIN_PATH))
                 return {"success": False, "log": "login required"}, 302

@@ -1,0 +1,201 @@
+"""Bearer-token (JWT) validation — the IAP/OIDC identity path.
+
+The reference's production posture is IAP: ESP forwards a signed JWT whose
+claims downstream components trust (reference: components/echo-server/
+main.py:27-40 decodes the assertion; metric-collector/service-readiness/
+kubeflow-readiness.py:144-176 runs the OIDC flow; static-config-server
+serves the JWK). The rebuild's gateway previously accepted only gatekeeper
+sessions/Basic; this module adds the token path: signature verification
+against a configured JWK set plus aud/iss/exp checks, stdlib-only.
+
+Algorithms:
+- RS256 (the IAP/OIDC standard): RSASSA-PKCS1-v1_5 verification implemented
+  directly — s^e mod n via pow(), then an exact EMSA-PKCS1-v1_5 encoding
+  match of the SHA-256 DigestInfo. Verification needs no secret and no
+  bignum library beyond Python ints.
+- HS256: shared-secret HMAC (service-to-service and tests).
+
+ES256 is not implemented (no P-256 point math in stdlib); IAP assertions at
+the gateway arrive RS256-signed from Google's JWK endpoint.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from typing import Any, Dict, List, Optional, Union
+
+# DigestInfo prefix for SHA-256 (RFC 8017 §9.2 notes): the DER encoding of
+# AlgorithmIdentifier(id-sha256) + OCTET STRING header, followed by the
+# 32-byte digest.
+_SHA256_DIGEST_INFO = bytes.fromhex(
+    "3031300d060960864801650304020105000420"
+)
+
+
+class InvalidToken(Exception):
+    """Token failed validation; the message says why (never echoed to the
+    client beyond a 401 — callers log it)."""
+
+
+def b64url_decode(segment: str) -> bytes:
+    pad = "=" * (-len(segment) % 4)
+    return base64.urlsafe_b64decode(segment + pad)
+
+
+def b64url_encode(raw: bytes) -> str:
+    return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+
+def split_token(token: str):
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise InvalidToken("token must have three segments")
+    try:
+        header = json.loads(b64url_decode(parts[0]))
+        payload = json.loads(b64url_decode(parts[1]))
+        signature = b64url_decode(parts[2])
+    except Exception as e:  # noqa: BLE001 - any malformed segment
+        raise InvalidToken(f"malformed token: {type(e).__name__}") from e
+    if not isinstance(header, dict) or not isinstance(payload, dict):
+        # valid JSON but not objects ("[1]".get would raise later and
+        # escape the except-InvalidToken guard at the gateway → 500)
+        raise InvalidToken("token segments must be JSON objects")
+    signing_input = f"{parts[0]}.{parts[1]}".encode()
+    return header, payload, signature, signing_input
+
+
+def _rsa_verify_pkcs1_sha256(
+    signing_input: bytes, signature: bytes, n: int, e: int
+) -> bool:
+    """RSASSA-PKCS1-v1_5 with SHA-256: recover EM = sig^e mod n and compare
+    against the expected 0x00 0x01 FF..FF 0x00 DigestInfo digest encoding
+    byte-for-byte (constant structure, so a simple compare_digest works)."""
+    k = (n.bit_length() + 7) // 8
+    if len(signature) != k:
+        return False
+    m = pow(int.from_bytes(signature, "big"), e, n)
+    em = m.to_bytes(k, "big")
+    digest = hashlib.sha256(signing_input).digest()
+    ps_len = k - 3 - len(_SHA256_DIGEST_INFO) - len(digest)
+    if ps_len < 8:
+        return False
+    expected = (
+        b"\x00\x01" + b"\xff" * ps_len + b"\x00" + _SHA256_DIGEST_INFO + digest
+    )
+    return hmac.compare_digest(em, expected)
+
+
+def _jwk_rsa_numbers(jwk: Dict[str, Any]):
+    try:
+        n = int.from_bytes(b64url_decode(jwk["n"]), "big")
+        e = int.from_bytes(b64url_decode(jwk["e"]), "big")
+    except Exception as ex:  # noqa: BLE001
+        raise InvalidToken("JWK missing RSA parameters") from ex
+    return n, e
+
+
+class JwtValidator:
+    """Validate bearer JWTs against a JWK set (plus optional HS256 secret).
+
+    jwks: a JWK-set dict ({"keys": [...]}) or a bare list of JWKs — the
+    format static-config-server publishes (api/auxservers.py) and the
+    reference's IAP JWK endpoint serves. Key selection is by `kid` when the
+    token names one, else every RSA key is tried.
+    """
+
+    def __init__(
+        self,
+        jwks: Optional[Union[Dict[str, Any], List[Dict[str, Any]]]] = None,
+        audience: Optional[str] = None,
+        issuer: Optional[str] = None,
+        hs256_secret: Optional[bytes] = None,
+        leeway_s: float = 60.0,
+    ):
+        if isinstance(jwks, dict):
+            jwks = jwks.get("keys", [])
+        self.keys: List[Dict[str, Any]] = list(jwks or [])
+        self.audience = audience
+        self.issuer = issuer
+        self.hs256_secret = hs256_secret
+        self.leeway_s = leeway_s
+
+    def _candidate_keys(self, kid: Optional[str]) -> List[Dict[str, Any]]:
+        rsa = [k for k in self.keys if k.get("kty", "RSA") == "RSA"]
+        if kid is not None:
+            named = [k for k in rsa if k.get("kid") == kid]
+            if named:
+                return named
+        return rsa
+
+    def _verify_signature(self, header, signature, signing_input) -> None:
+        alg = header.get("alg")
+        if alg == "RS256":
+            for jwk in self._candidate_keys(header.get("kid")):
+                n, e = _jwk_rsa_numbers(jwk)
+                if _rsa_verify_pkcs1_sha256(signing_input, signature, n, e):
+                    return
+            raise InvalidToken("RS256 signature verification failed")
+        if alg == "HS256":
+            if not self.hs256_secret:
+                raise InvalidToken("HS256 token but no shared secret configured")
+            want = hmac.new(
+                self.hs256_secret, signing_input, hashlib.sha256
+            ).digest()
+            if not hmac.compare_digest(want, signature):
+                raise InvalidToken("HS256 signature mismatch")
+            return
+        # "none" and everything else is rejected outright — alg confusion
+        # (downgrade-to-none, RS/HS swap) is the classic JWT attack
+        raise InvalidToken(f"unsupported alg {alg!r}")
+
+    def validate(self, token: str) -> Dict[str, Any]:
+        """Return the verified claims, or raise InvalidToken."""
+        header, payload, signature, signing_input = split_token(token)
+        self._verify_signature(header, signature, signing_input)
+        now = time.time()
+
+        def as_ts(name):
+            value = payload.get(name)
+            if value is None:
+                return None
+            try:
+                return float(value)
+            except (TypeError, ValueError):
+                raise InvalidToken(f"claim {name!r} is not a timestamp")
+
+        exp = as_ts("exp")
+        if exp is not None and now > exp + self.leeway_s:
+            raise InvalidToken("token expired")
+        nbf = as_ts("nbf")
+        if nbf is not None and now < nbf - self.leeway_s:
+            raise InvalidToken("token not yet valid")
+        if self.issuer is not None and payload.get("iss") != self.issuer:
+            raise InvalidToken(f"issuer {payload.get('iss')!r} not accepted")
+        if self.audience is not None:
+            aud = payload.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self.audience not in auds:
+                raise InvalidToken(f"audience {aud!r} not accepted")
+        return payload
+
+    def identity(self, claims: Dict[str, Any]) -> str:
+        """The account a verified token speaks for (IAP puts it in `email`,
+        plain OIDC in `sub` — reference kubeflow-readiness.py claim use)."""
+        return str(claims.get("email") or claims.get("sub") or "")
+
+
+def sign_hs256(
+    claims: Dict[str, Any], secret: bytes, headers: Optional[Dict] = None
+) -> str:
+    """Mint an HS256 token (service-to-service issuance and tests)."""
+    header = {"alg": "HS256", "typ": "JWT", **(headers or {})}
+    signing_input = (
+        f"{b64url_encode(json.dumps(header).encode())}."
+        f"{b64url_encode(json.dumps(claims).encode())}"
+    ).encode()
+    sig = hmac.new(secret, signing_input, hashlib.sha256).digest()
+    return f"{signing_input.decode()}.{b64url_encode(sig)}"

@@ -1,0 +1,198 @@
+"""Bearer-token (JWT) identity path: signature/aud/iss/exp validation at
+the gateway (reference echo-server/main.py:27-40 trusts ESP's assertion;
+kubeflow-readiness.py:144-176 runs the OIDC dance; here the gateway itself
+verifies). RS256 verification is stdlib (pure-int RSASSA-PKCS1-v1_5);
+tokens in these tests are SIGNED with the `cryptography` package, which is
+a test-only dependency (the framework never imports it)."""
+
+import json
+import time
+
+import pytest
+
+from kubeflow_tpu.api.gatekeeper import Gatekeeper, hash_password
+from kubeflow_tpu.api.jwt_auth import (
+    InvalidToken,
+    JwtValidator,
+    b64url_encode,
+    sign_hs256,
+)
+
+SECRET = b"gang-shared-secret"
+
+
+def make_validator(**kw):
+    kw.setdefault("hs256_secret", SECRET)
+    return JwtValidator(**kw)
+
+
+class TestHs256:
+    def test_roundtrip(self):
+        tok = sign_hs256({"sub": "svc-a", "email": "svc@kf.local"}, SECRET)
+        claims = make_validator().validate(tok)
+        assert claims["sub"] == "svc-a"
+        assert make_validator().identity(claims) == "svc@kf.local"
+
+    def test_tampered_payload_rejected(self):
+        tok = sign_hs256({"sub": "svc-a"}, SECRET)
+        h, p, s = tok.split(".")
+        forged = b64url_encode(json.dumps({"sub": "root"}).encode())
+        with pytest.raises(InvalidToken, match="HS256 signature"):
+            make_validator().validate(f"{h}.{forged}.{s}")
+
+    def test_wrong_secret_rejected(self):
+        tok = sign_hs256({"sub": "x"}, b"other-secret")
+        with pytest.raises(InvalidToken):
+            make_validator().validate(tok)
+
+    def test_expired_rejected_and_leeway_honored(self):
+        past = time.time() - 3600
+        with pytest.raises(InvalidToken, match="expired"):
+            make_validator().validate(sign_hs256({"exp": past}, SECRET))
+        near = time.time() - 10  # inside the 60 s leeway
+        make_validator().validate(sign_hs256({"exp": near}, SECRET))
+
+    def test_nbf_rejected(self):
+        future = time.time() + 3600
+        with pytest.raises(InvalidToken, match="not yet valid"):
+            make_validator().validate(sign_hs256({"nbf": future}, SECRET))
+
+    def test_audience_and_issuer_checked(self):
+        v = make_validator(audience="kf-api", issuer="https://iss")
+        ok = sign_hs256({"aud": ["other", "kf-api"], "iss": "https://iss"}, SECRET)
+        v.validate(ok)
+        with pytest.raises(InvalidToken, match="audience"):
+            v.validate(sign_hs256({"aud": "other", "iss": "https://iss"}, SECRET))
+        with pytest.raises(InvalidToken, match="issuer"):
+            v.validate(sign_hs256({"aud": "kf-api", "iss": "evil"}, SECRET))
+
+    def test_alg_none_rejected(self):
+        header = b64url_encode(json.dumps({"alg": "none"}).encode())
+        payload = b64url_encode(json.dumps({"sub": "root"}).encode())
+        with pytest.raises(InvalidToken, match="unsupported alg"):
+            make_validator().validate(f"{header}.{payload}.")
+
+    def test_malformed_rejected(self):
+        for bad in ("", "a.b", "x.y.z.w", "!!!.@@@.###"):
+            with pytest.raises(InvalidToken):
+                make_validator().validate(bad)
+
+
+@pytest.fixture(scope="module")
+def rsa_key():
+    cryptography = pytest.importorskip("cryptography")  # noqa: F841
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def rs256_sign(claims, key, kid=None):
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    header = {"alg": "RS256", "typ": "JWT"}
+    if kid:
+        header["kid"] = kid
+    signing_input = (
+        f"{b64url_encode(json.dumps(header).encode())}."
+        f"{b64url_encode(json.dumps(claims).encode())}"
+    ).encode()
+    sig = key.sign(signing_input, padding.PKCS1v15(), hashes.SHA256())
+    return f"{signing_input.decode()}.{b64url_encode(sig)}"
+
+
+def jwk_of(key, kid="k1"):
+    pub = key.public_key().public_numbers()
+
+    def be(i):
+        return b64url_encode(i.to_bytes((i.bit_length() + 7) // 8, "big"))
+
+    return {"kty": "RSA", "kid": kid, "n": be(pub.n), "e": be(pub.e)}
+
+
+class TestRs256:
+    def test_valid_token_verifies_against_jwk(self, rsa_key):
+        v = JwtValidator(jwks={"keys": [jwk_of(rsa_key)]})
+        claims = v.validate(
+            rs256_sign({"email": "user@corp", "sub": "u1"}, rsa_key, kid="k1")
+        )
+        assert v.identity(claims) == "user@corp"
+
+    def test_tampered_claims_rejected(self, rsa_key):
+        v = JwtValidator(jwks=[jwk_of(rsa_key)])
+        tok = rs256_sign({"email": "user@corp"}, rsa_key)
+        h, p, s = tok.split(".")
+        forged = b64url_encode(json.dumps({"email": "admin@corp"}).encode())
+        with pytest.raises(InvalidToken, match="RS256"):
+            v.validate(f"{h}.{forged}.{s}")
+
+    def test_wrong_key_rejected(self, rsa_key):
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        v = JwtValidator(jwks=[jwk_of(other)])
+        with pytest.raises(InvalidToken, match="RS256"):
+            v.validate(rs256_sign({"sub": "u"}, rsa_key))
+
+    def test_hs256_cannot_spoof_rsa_key(self, rsa_key):
+        """Alg-confusion: an HS256 token 'signed' with the public JWK bytes
+        must not verify when no shared secret is configured."""
+        v = JwtValidator(jwks=[jwk_of(rsa_key)])  # no hs256_secret
+        tok = sign_hs256({"sub": "root"}, json.dumps(jwk_of(rsa_key)).encode())
+        with pytest.raises(InvalidToken, match="no shared secret"):
+            v.validate(tok)
+
+
+class TestGatewayBearer:
+    def _gk(self, **kw):
+        return Gatekeeper(
+            "admin", hash_password("pw"), jwt_validator=make_validator(**kw)
+        )
+
+    def test_valid_bearer_passes_auth_with_identity(self):
+        gk = self._gk()
+        tok = sign_hs256({"email": "svc@kf.local"}, SECRET)
+        status, _, headers = gk.app.handle_full(
+            "GET", "/auth", headers={"authorization": f"Bearer {tok}"}
+        )
+        assert status == 200
+        assert dict(headers)["x-auth-user-email"] == "svc@kf.local"
+
+    def test_tampered_bearer_redirects_to_login(self):
+        gk = self._gk()
+        tok = sign_hs256({"email": "svc@kf.local"}, b"wrong")
+        status, _, headers = gk.app.handle_full(
+            "GET", "/auth", headers={"authorization": f"Bearer {tok}"}
+        )
+        assert status == 302  # anonymous → login redirect, no identity
+
+    def test_sessions_still_work_alongside_bearer(self):
+        gk = self._gk()
+        _, _, headers = gk.app.handle_full(
+            "POST", "/apikflogin", body={"username": "admin", "password": "pw"}
+        )
+        cookie = dict(headers)["Set-Cookie"].split(";")[0]
+        status, _, headers = gk.app.handle_full(
+            "GET", "/auth", headers={"cookie": cookie}
+        )
+        assert status == 200
+        assert dict(headers)["x-auth-user-email"] == "admin"
+
+    def test_no_validator_ignores_bearer(self):
+        gk = Gatekeeper("admin", hash_password("pw"))
+        tok = sign_hs256({"email": "svc@kf.local"}, SECRET)
+        status, _, _ = gk.app.handle_full(
+            "GET", "/auth", headers={"authorization": f"Bearer {tok}"}
+        )
+        assert status == 302
+
+    def test_echo_round_trips_bearer_claims(self):
+        from kubeflow_tpu.api.auxservers import build_echo_app
+
+        app = build_echo_app()
+        tok = sign_hs256({"email": "svc@kf.local", "sub": "u1"}, SECRET)
+        status, body = app.handle(
+            "GET", "/", headers={"authorization": f"Bearer {tok}"}
+        )
+        assert status == 200
+        assert body["jwt_claims"]["email"] == "svc@kf.local"
