@@ -451,3 +451,141 @@ class TestImportGuards:
             }
         )
         assert store.get("ConfigMap", "x", "default")
+
+
+# ---------------------------------------------------------------------------
+# Cloud Monitoring metrics backend (api/cloud_metrics.py) — the dashboard's
+# Stackdriver seam (reference stackdriver_metrics_service.ts:1-197),
+# contract-tested against a stub transport exactly like the clients above.
+# ---------------------------------------------------------------------------
+
+
+class StubMonitoringService:
+    """projects().timeSeries().list(...) surface with canned responses."""
+
+    def __init__(self, response=None, error=None):
+        self.response = response or {}
+        self.error = error
+        self.calls = []
+
+    def projects(self):
+        return self
+
+    def timeSeries(self):  # noqa: N802 - matches the REST surface
+        return self
+
+    def list(self, **kwargs):
+        self.calls.append(kwargs)
+        svc = self
+
+        class _Call:
+            def execute(self):
+                if svc.error:
+                    raise svc.error
+                return svc.response
+
+        return _Call()
+
+
+def _series(label_ns, points):
+    return {
+        "resource": {"labels": {"namespace_name": label_ns, "pod_name": "p0"}},
+        "metric": {"labels": {"instance": "i0"}},
+        "points": [
+            {
+                "interval": {"endTime": t},
+                "value": value,
+            }
+            for t, value in points
+        ],
+    }
+
+
+class TestCloudMonitoringMetricsService:
+    def _svc(self, **kw):
+        from kubeflow_tpu.api.cloud_metrics import CloudMonitoringMetricsService
+
+        return CloudMonitoringMetricsService("proj", **kw)
+
+    def test_points_parsed_merged_and_chronological(self):
+        stub = StubMonitoringService(
+            response={
+                "timeSeries": [
+                    _series(
+                        "team",
+                        [
+                            ("2026-07-30T10:00:30Z", {"doubleValue": 0.5}),
+                            ("2026-07-30T10:00:00Z", {"int64Value": "7"}),
+                        ],
+                    )
+                ]
+            }
+        )
+        points = self._svc(service=stub).query(
+            "team", "container_cpu_utilization", 3600
+        )
+        assert [p["value"] for p in points] == [7.0, 0.5]  # sorted by t
+        assert points[0]["t"] < points[1]["t"]
+        assert points[0]["labels"]["namespace_name"] == "team"
+        assert points[0]["labels"]["instance"] == "i0"
+
+    def test_filter_carries_metric_map_namespace_and_cluster(self):
+        stub = StubMonitoringService()
+        self._svc(service=stub, cluster_name="kf").query(
+            "team", "node_cpu_utilization", 600
+        )
+        (call,) = stub.calls
+        assert call["name"] == "projects/proj"
+        assert (
+            'metric.type="kubernetes.io/node/cpu/allocatable_utilization"'
+            in call["filter"]
+        )
+        assert 'resource.label.namespace_name="team"' in call["filter"]
+        assert 'resource.label.cluster_name="kf"' in call["filter"]
+        assert call["interval_startTime"] < call["interval_endTime"]
+
+    def test_unmapped_metric_passes_through(self):
+        stub = StubMonitoringService()
+        self._svc(service=stub).query("ns", "custom.googleapis.com/x", 60)
+        assert 'metric.type="custom.googleapis.com/x"' in stub.calls[0]["filter"]
+
+    def test_fetch_error_degrades_to_empty_series(self):
+        stub = StubMonitoringService(error=RuntimeError("backend down"))
+        assert self._svc(service=stub).query("ns", "m", 60) == []
+
+    def test_contract_matches_registry_shape(self):
+        """Both backends serve the same point shape, so /api/metrics is
+        backend-agnostic (the seam the dashboard selects by config)."""
+        from kubeflow_tpu.api.dashboard import RegistryMetricsService
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        reg = RegistryMetricsService()
+        default_registry().gauge("kft_stub_metric", "help").set(1.0)
+        reg.sample()
+        reg_points = reg.query("", "kft_stub_metric", 3600)
+        stub = StubMonitoringService(
+            response={
+                "timeSeries": [
+                    _series("ns", [("2026-07-30T10:00:00Z", {"doubleValue": 1.0})])
+                ]
+            }
+        )
+        cloud_points = self._svc(service=stub).query("ns", "m", 3600)
+        assert reg_points and cloud_points
+        assert set(reg_points[0]) == set(cloud_points[0]) == {"t", "value", "labels"}
+
+    def test_backend_selection_by_config(self):
+        from kubeflow_tpu.api.cloud_metrics import make_metrics_service
+        from kubeflow_tpu.api.dashboard import RegistryMetricsService
+
+        assert isinstance(make_metrics_service(), RegistryMetricsService)
+        stub = StubMonitoringService()
+        svc = make_metrics_service(
+            {"backend": "cloud-monitoring", "project": "p", "service": stub}
+        )
+        svc.query("ns", "m", 60)
+        assert stub.calls
+        with pytest.raises(ValueError, match="project"):
+            make_metrics_service({"backend": "cloud-monitoring"})
+        with pytest.raises(ValueError, match="unknown"):
+            make_metrics_service({"backend": "prometheus-push"})
