@@ -19,10 +19,14 @@ Routes:
 
 from __future__ import annotations
 
+import json
+import os
 import queue
+import re
+import shutil
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from kubeflow_tpu.api.wsgi import App, BadRequest, NotFoundError
 from kubeflow_tpu.cluster.store import StateStore
@@ -35,6 +39,78 @@ from kubeflow_tpu.utils.metrics import default_registry
 log = get_logger(__name__)
 
 
+_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9.-]{0,61}[a-z0-9])?$")
+
+
+class DeploymentRecords:
+    """Durable per-deployment app dirs — the Cloud-Source-Repo push.
+
+    The reference's kfctl server pushes every rendered app to a source repo
+    so a deployment is auditable and recoverable after a server restart
+    (reference: bootstrap/cmd/bootstrap/app/sourceRepos.go:51-236
+    CreateLocalRepo/CommitAndPushRepo). Here each deployment gets
+    `{app_dir}/{name}/` holding:
+
+    - spec.yaml     — the submitted PlatformDef (the KfDef equivalent)
+    - app.yaml      — the rendered manifests (ci/release.py's bundle
+                      format: yaml.safe_dump_all of the objects)
+    - status.json   — latest state, updated on every transition
+
+    A restarted Router lists these and serves their status as recovered
+    records; GC removes expired dirs.
+    """
+
+    def __init__(self, app_dir: str):
+        self.app_dir = app_dir
+        os.makedirs(app_dir, exist_ok=True)
+
+    def _dir(self, name: str) -> str:
+        if not _NAME_RE.match(name):
+            raise BadRequest(f"invalid deployment name {name!r}")
+        return os.path.join(self.app_dir, name)
+
+    def write_app(self, name: str, platform: PlatformDef) -> None:
+        import dataclasses
+
+        import yaml
+
+        from kubeflow_tpu.deploy import manifests
+
+        d = self._dir(name)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "spec.yaml"), "w") as f:
+            yaml.safe_dump(dataclasses.asdict(platform), f, sort_keys=False)
+        with open(os.path.join(d, "app.yaml"), "w") as f:
+            yaml.safe_dump_all(manifests.render(platform), f, sort_keys=False)
+
+    def write_status(self, name: str, status: Dict[str, Any]) -> None:
+        d = self._dir(name)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, "status.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump({**status, "updated_at": time.time()}, f)
+        os.replace(tmp, os.path.join(d, "status.json"))  # atomic publish
+
+    def read_status(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(os.path.join(self._dir(name), "status.json")) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def list_names(self) -> List[str]:
+        try:
+            return sorted(
+                n for n in os.listdir(self.app_dir)
+                if os.path.isdir(os.path.join(self.app_dir, n))
+            )
+        except FileNotFoundError:
+            return []
+
+    def remove(self, name: str) -> None:
+        shutil.rmtree(self._dir(name), ignore_errors=True)
+
+
 class DeployServer:
     """Serial deployment processor for ONE deployment target."""
 
@@ -42,12 +118,14 @@ class DeployServer:
         self,
         store: Optional[StateStore] = None,
         provider: Optional[PlatformProvider] = None,
+        on_status: Optional[Callable[[Dict[str, Any]], None]] = None,
     ) -> None:
         self.store = store or StateStore()
         self.coordinator = Coordinator(self.store, provider)
         self._queue: "queue.Queue[PlatformDef]" = queue.Queue()
         self._status_lock = threading.Lock()
         self._status: Dict[str, Any] = {"state": "Pending"}
+        self._on_status = on_status
         self.created_at = time.time()
         self._worker = threading.Thread(
             target=self._process_loop, daemon=True, name="deploy-worker"
@@ -55,9 +133,17 @@ class DeployServer:
         self._stop = threading.Event()
         self._worker.start()
 
-    def submit(self, platform: PlatformDef) -> None:
+    def _set_status(self, status: Dict[str, Any]) -> None:
         with self._status_lock:
-            self._status = {"state": "Queued", "name": platform.name}
+            self._status = status
+        if self._on_status is not None:
+            try:
+                self._on_status(dict(status))
+            except Exception as e:  # noqa: BLE001 - persistence best-effort
+                log.warning("status persistence failed: %s", e)
+
+    def submit(self, platform: PlatformDef) -> None:
+        self._set_status({"state": "Queued", "name": platform.name})
         self._queue.put(platform)
 
     def status(self) -> Dict[str, Any]:
@@ -70,24 +156,17 @@ class DeployServer:
                 platform = self._queue.get(timeout=0.1)
             except queue.Empty:
                 continue
-            with self._status_lock:
-                self._status = {"state": "Deploying", "name": platform.name}
+            self._set_status({"state": "Deploying", "name": platform.name})
             try:
                 result = self.coordinator.apply(platform)
-                with self._status_lock:
-                    self._status = {
-                        "state": "Succeeded",
-                        "name": platform.name,
-                        **result,
-                    }
+                self._set_status(
+                    {"state": "Succeeded", "name": platform.name, **result}
+                )
             except Exception as e:
                 log.error("deployment %s failed: %s", platform.name, e)
-                with self._status_lock:
-                    self._status = {
-                        "state": "Failed",
-                        "name": platform.name,
-                        "error": str(e),
-                    }
+                self._set_status(
+                    {"state": "Failed", "name": platform.name, "error": str(e)}
+                )
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -102,10 +181,15 @@ class Router:
         provider: Optional[PlatformProvider] = None,
         max_lifetime_s: float = 3600.0,
         shared_store: Optional[StateStore] = None,
+        app_dir: Optional[str] = None,
     ) -> None:
         self.provider = provider
         self.max_lifetime_s = max_lifetime_s
         self.shared_store = shared_store
+        # durable per-deployment records (spec + rendered app + status):
+        # a restarted router recovers every deployment's last state from
+        # here (the sourceRepos.go push, see DeploymentRecords)
+        self.records = DeploymentRecords(app_dir) if app_dir else None
         self._servers: Dict[str, DeployServer] = {}
         self._lock = threading.Lock()
         reg = default_registry()
@@ -122,7 +206,16 @@ class Router:
                     raise NotFoundError(f"no deployment {name!r}")
                 # one isolated server per deployment (router.go:275-405);
                 # a shared store models deploying into one cluster
-                srv = DeployServer(store=self.shared_store, provider=self.provider)
+                on_status = (
+                    (lambda st, n=name: self.records.write_status(n, st))
+                    if self.records
+                    else None
+                )
+                srv = DeployServer(
+                    store=self.shared_store,
+                    provider=self.provider,
+                    on_status=on_status,
+                )
                 self._servers[name] = srv
             return srv
 
@@ -141,7 +234,32 @@ class Router:
         for srv in expired:
             srv.shutdown()
             self._gc_total.inc()
-        return len(expired)
+        count = len(expired)
+        # expired durable records (recovered or live) leave the disk too —
+        # the GC contract covers the app dirs (gcServer.go expiry)
+        if self.records is not None:
+            live = set(self._servers)
+            for name in self.records.list_names():
+                if name in live:
+                    continue
+                st = self.records.read_status(name) or {}
+                updated = st.get("updated_at")
+                if updated is None:
+                    # no status.json (crash between write_app and the first
+                    # status write): age by directory mtime — defaulting to
+                    # 0 would delete exactly the crash-mid-deploy audit
+                    # record this store exists to preserve
+                    try:
+                        updated = os.path.getmtime(
+                            os.path.join(self.records.app_dir, name)
+                        )
+                    except OSError:
+                        continue
+                if now - updated > self.max_lifetime_s:
+                    self.records.remove(name)
+                    self._gc_total.inc()
+                    count += 1
+        return count
 
     def shutdown(self) -> None:
         with self._lock:
@@ -162,6 +280,11 @@ class Router:
             except ConfigError as e:
                 raise BadRequest(f"invalid PlatformDef: {e}")
             name = body.get("name") or platform.name
+            if self.records is not None:
+                # persist the KfDef-equivalent + rendered app BEFORE the
+                # apply starts: even a crash mid-deploy leaves an
+                # auditable record (sourceRepos.go push-before-apply)
+                self.records.write_app(name, platform)
             srv = self._server_for(name, create=True)
             srv.submit(platform)
             return {"success": True, "name": name, "state": "Queued"}, 201
@@ -171,8 +294,35 @@ class Router:
             name = req.query.get("name", "")
             if not name:
                 raise BadRequest("name query param required")
-            srv = self._server_for(name)
+            try:
+                srv = self._server_for(name)
+            except NotFoundError:
+                # no live server (e.g. the router restarted): serve the
+                # durable record so deployments survive process death
+                if self.records is not None:
+                    recovered = self.records.read_status(name)
+                    if recovered is not None:
+                        return {
+                            "success": True,
+                            "recovered": True,
+                            **recovered,
+                        }
+                raise
             return {"success": True, **srv.status()}
+
+        @app.get("/kfctl/apps/v1beta1/list")
+        def list_deployments(req):
+            out = {}
+            if self.records is not None:
+                for name in self.records.list_names():
+                    st = self.records.read_status(name)
+                    if st:
+                        out[name] = {"recovered": True, **st}
+            with self._lock:
+                live = dict(self._servers)
+            for name, srv in live.items():
+                out[name] = srv.status()
+            return {"success": True, "deployments": out}
 
         @app.post("/kfctl/apps/v1beta1/gc")
         def run_gc(req):

@@ -130,6 +130,92 @@ class TestDeployServerAndRouter:
         finally:
             router.shutdown()
 
+    @staticmethod
+    def _deploy_and_wait(router, name):
+        status, _ = router.app.handle(
+            "POST",
+            "/kfctl/apps/v1beta1/create",
+            body={"name": name, "spec": {"name": name}},
+        )
+        assert status == 201
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            _, body = router.app.handle(
+                "GET", "/kfctl/apps/v1beta1/status", query={"name": name}
+            )
+            if body["state"] in ("Succeeded", "Failed"):
+                return body
+            time.sleep(0.05)
+        raise AssertionError("deployment did not settle")
+
+    def test_restarted_router_recovers_deployment_records(self, tmp_path):
+        """Durable deployment records (reference sourceRepos.go:51-236):
+        spec + rendered app + status land under the app dir, and a FRESH
+        router over the same dir serves the status and listing — a
+        restarted deploy server no longer forgets every deployment."""
+        app_dir = str(tmp_path / "apps")
+        router = Router(shared_store=StateStore(), app_dir=app_dir)
+        try:
+            body = self._deploy_and_wait(router, "kf-durable")
+            assert body["state"] == "Succeeded"
+        finally:
+            router.shutdown()
+        # the on-disk record is complete and auditable
+        import yaml
+
+        spec = yaml.safe_load((tmp_path / "apps/kf-durable/spec.yaml").read_text())
+        assert spec["name"] == "kf-durable"
+        objs = list(
+            yaml.safe_load_all((tmp_path / "apps/kf-durable/app.yaml").read_text())
+        )
+        assert any(o.get("kind") == "Deployment" for o in objs)
+        # a brand-new router over the same app dir recovers the status
+        restarted = Router(shared_store=StateStore(), app_dir=app_dir)
+        try:
+            status, body = restarted.app.handle(
+                "GET", "/kfctl/apps/v1beta1/status", query={"name": "kf-durable"}
+            )
+            assert status == 200
+            assert body["state"] == "Succeeded"
+            assert body["recovered"] is True
+            _, listing = restarted.app.handle("GET", "/kfctl/apps/v1beta1/list")
+            assert "kf-durable" in listing["deployments"]
+        finally:
+            restarted.shutdown()
+
+    def test_gc_removes_expired_records(self, tmp_path):
+        app_dir = str(tmp_path / "apps")
+        router = Router(shared_store=StateStore(), app_dir=app_dir)
+        try:
+            self._deploy_and_wait(router, "kf-old")
+        finally:
+            router.shutdown()
+        restarted = Router(
+            shared_store=StateStore(), app_dir=app_dir, max_lifetime_s=0.0
+        )
+        try:
+            assert restarted.gc(now=time.time() + 10) >= 1
+            assert not (tmp_path / "apps/kf-old").exists()
+            status, _, _ = restarted.app.handle_full(
+                "GET", "/kfctl/apps/v1beta1/status", query={"name": "kf-old"}
+            )
+            assert status == 404
+        finally:
+            restarted.shutdown()
+
+    def test_traversal_names_rejected(self, tmp_path):
+        router = Router(app_dir=str(tmp_path / "apps"))
+        try:
+            status, _, _ = router.app.handle_full(
+                "POST",
+                "/kfctl/apps/v1beta1/create",
+                body={"name": "../evil", "spec": {"name": "kf"}},
+            )
+            assert status == 400
+            assert not (tmp_path / "evil").exists()
+        finally:
+            router.shutdown()
+
     def test_invalid_spec_rejected(self):
         router = Router()
         try:
