@@ -858,6 +858,7 @@ def bench_long_context_train(seq_len: int = 32768) -> dict:
         learning_rate=3e-4,
         remat=True,
         loss_chunk=4096,
+        assume_full_attention=True,  # packed pretrain: no padding masks
         mesh=MeshConfig(data=n_dev),
     )
     mesh = build_mesh(MeshSpec.from_config(cfg.mesh), devices=jax.devices())

@@ -76,11 +76,19 @@ def _resolve_blocks(s_pad: int, block_q: int, block_k: int):
 
 
 def _auto_head_group(h: int, s_pad: int) -> int:
-    """Largest group of heads whose score tile fits the VMEM budget at
-    128-sized blocks (the floor _resolve_blocks can shrink to)."""
+    """Preferred head group, by measurement (docs/PERF.md sweep): at
+    short-to-mid lengths G=4 keeps 512x512 blocks inside the score
+    budget and won every case (1.55x dense @4k, 1.59x @8k bidirectional
+    on v5e); G=6/12 force asymmetric/small blocks and lose ground. At
+    LONG lengths the tradeoff flips — big per-head blocks beat grouping
+    (32k causal: G=1/1024 at 140 ms vs G=4/512 at 156 ms) because K/V
+    re-fetch traffic scales with n_q and softmax state stays cheaper
+    than grid-step savings. Order tries the measured winner first."""
     if s_pad <= 128:
         return 1
-    for g in (8, 6, 4, 3, 2):
+    if s_pad >= 16384:
+        return 1
+    for g in (4, 8, 6, 3, 2):
         if h % g == 0 and g * 128 * 128 <= _SCORE_BUDGET:
             return g
     return 1
@@ -384,6 +392,17 @@ def _bwd(scale, causal, has_mask, block_q, block_k, num_heads, group,
     do, _ = g
     bh, s_len, d = q.shape
     bq, bk = block_q, block_k
+    # the backward body keeps ~4 concurrent f32 (G,BQ,BK) tiles live
+    # (s, p, dp, ds) where the forward needs ~2 — at the forward's block
+    # sizes the dq/dkv kernels overflow the ~16 MB scoped-VMEM budget
+    # (measured: 20.75M requested at G=4, 512x512, masked). Halve blocks
+    # until the tile set fits half the forward budget; halving a divisor
+    # of s_len keeps it a divisor (blocks >=128 are 128-multiples).
+    while group * bq * bk > _SCORE_BUDGET // 2 and (bq > 128 or bk > 128):
+        if bq >= bk:
+            bq //= 2
+        else:
+            bk //= 2
     assert s_len % bq == 0 and s_len % bk == 0, (s_len, bq, bk)
     n_q, n_k = s_len // bq, s_len // bk
     delta = jnp.sum(
@@ -518,8 +537,12 @@ def flash_attention(
     group = head_group if head_group is not None else _auto_head_group(h, s_pad)
     if h % group != 0:
         raise ValueError(f"head_group {group} must divide num_heads {h}")
-    # shrink blocks until the f32 score tile (G*BQ*BK) fits the budget
-    while group * block_q * block_k > _SCORE_BUDGET and (
+    # shrink blocks until the f32 score tile (G*BQ*BK) fits the budget.
+    # With a mask the forward body holds extra select intermediates —
+    # measured 16.22 MB (228 KB over the scoped-VMEM limit) at the
+    # unmasked budget — so masked kernels get 3/4 of it.
+    budget = _SCORE_BUDGET if not has_mask else (3 * _SCORE_BUDGET) // 4
+    while group * block_q * block_k > budget and (
         block_q > 128 or block_k > 128
     ):
         if block_q >= block_k:

@@ -243,6 +243,12 @@ class CausalLmTask:
             if loss_chunk is not None
             else getattr(cfg, "loss_chunk", 0)
         )
+        # masks known all-ones (packed pretrain): stop passing them so the
+        # flash kernel compiles its masked path out (config/platform.py
+        # assume_full_attention; measured ~2x on 32k steps)
+        self.assume_full_attention = bool(
+            getattr(cfg, "assume_full_attention", False)
+        )
 
     def synthetic_data(self) -> SyntheticData:
         return SyntheticData(
@@ -331,16 +337,19 @@ class CausalLmTask:
             (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
             (hs, ts),
         )
-        return total / jnp.maximum(count, 1)
+        return total / jnp.maximum(count, 1), count
 
     def loss(self, model, params, extra_vars, batch, train: bool, rngs):
         # "losses" is mutable so MoE decoder blocks can sow their
         # load-balance auxiliary loss (models/gpt.py); empty for dense.
         chunked = self.loss_chunk and self.loss_chunk > 0
+        attention_mask = batch["attention_mask"]
+        if self.assume_full_attention:
+            attention_mask = None
         out, sown = model.apply(
             {"params": params, **extra_vars},
             batch["input_ids"],
-            attention_mask=batch["attention_mask"],
+            attention_mask=attention_mask,
             deterministic=not train,
             rngs=rngs if train else None,
             mutable=["losses"],
@@ -350,7 +359,7 @@ class CausalLmTask:
             targets = self._shift_full(
                 batch["input_ids"], batch["attention_mask"]
             )
-            loss = self._chunked_lm_loss(
+            loss, n_items = self._chunked_lm_loss(
                 params["head"]["kernel"],
                 out["hidden"],
                 targets,
@@ -362,12 +371,23 @@ class CausalLmTask:
                 out["logits"], batch["input_ids"], batch["attention_mask"]
             )
             loss = cross_entropy(logits, targets, ignore=-100)
+            n_items = (targets != -100).sum()
         aux = {}
         moe_aux = _sown_loss_sum(sown)
         if moe_aux is not None:
             loss = loss + moe_aux
             aux["moe_aux_loss"] = moe_aux
-        return loss, {"aux": aux, "var_updates": {}}
+        # valid-pair count: gradient accumulation weights microbatches by
+        # this so ragged masks still produce the exact full-batch
+        # token-mean gradient (training/trainer.py accum). MlmTask does
+        # NOT report one: its loss mixes two denominators (masked tokens
+        # for MLM, batch rows for NSP) — one weight cannot make both
+        # exact, so it keeps equal weighting.
+        return loss, {
+            "aux": aux,
+            "var_updates": {},
+            "loss_items": n_items.astype(jnp.float32),
+        }
 
     def count_items(self, batch) -> int:
         return batch["input_ids"].shape[0] * batch["input_ids"].shape[1]

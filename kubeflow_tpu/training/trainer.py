@@ -245,9 +245,15 @@ class Trainer:
             if cfg.accum_steps > 1:
                 # gradient accumulation: microbatches stream through ONE
                 # scanned body (compile cost independent of accum_steps);
-                # grads average, the optimizer applies once. Mean-reduced
-                # losses with equal microbatch sizes make the averaged
-                # grad identical to the full-batch grad.
+                # grads average, the optimizer applies once. Microbatch
+                # contributions are weighted by the task-reported item
+                # count ("loss_items": valid next-token pairs — reported
+                # by the CAUSAL-LM task only; MLM/image report none and
+                # get equal weights, see tasks.py on MLM's two mixed
+                # denominators): Σ w_i·g_i / Σ w_i IS the full-batch mean
+                # gradient even when ragged attention masks give
+                # microbatches unequal valid counts (the round-3
+                # advisor's mean-of-means caveat, now exact for LM).
                 a = cfg.accum_steps
                 micro = jax.tree.map(
                     lambda x: x.reshape((a, x.shape[0] // a) + x.shape[1:]),
@@ -271,21 +277,32 @@ class Trainer:
                     (loss_i, out_i), g_i = grad_fn(
                         state.params, sub_batch, sub_rngs
                     )
-                    g_acc, loss_acc = carry
+                    # out's dict structure is static per task: tasks whose
+                    # loss is a mean over a data-dependent item count
+                    # (valid LM tokens) report it; others weight equally
+                    w_i = out_i.get(
+                        "loss_items", jnp.ones((), jnp.float32)
+                    ).astype(jnp.float32)
+                    g_acc, loss_acc, w_acc = carry
                     return (
-                        jax.tree.map(jnp.add, g_acc, g_i),
-                        loss_acc + loss_i,
+                        jax.tree.map(
+                            lambda acc, g: acc + g * w_i, g_acc, g_i
+                        ),
+                        loss_acc + loss_i * w_i,
+                        w_acc + w_i,
                     ), out_i["aux"]
 
                 g0 = jax.tree.map(
                     lambda p: jnp.zeros(p.shape, jnp.float32), state.params
                 )
-                (g_sum, loss_sum), aux_stack = jax.lax.scan(
-                    accum, (g0, jnp.zeros((), jnp.float32)),
+                (g_sum, loss_sum, w_sum), aux_stack = jax.lax.scan(
+                    accum,
+                    (g0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
                     (micro, jnp.arange(a)),
                 )
-                grads = jax.tree.map(lambda g: g / a, g_sum)
-                loss = loss_sum / a
+                w_sum = jnp.maximum(w_sum, 1e-9)
+                grads = jax.tree.map(lambda g: g / w_sum, g_sum)
+                loss = loss_sum / w_sum
                 # aux averaged over ALL microbatches — consistent with the
                 # averaged loss (last-microbatch-only would be 1/a of the
                 # data and noisier)

@@ -36,10 +36,21 @@ _CLOSE = {v: k for k, v in _OPEN.items()}
 _REGEX_PREFIX = set("(,=:[!&|?{};\n") | {None}
 
 
-def lex_errors(src: str, origin: str = "<script>") -> List[str]:
-    """Unterminated strings/comments + bracket balance, with line numbers."""
+def _scan_literals(src: str, origin: str = "<script>"):
+    """ONE scanner for comments, strings, template literals, and regex
+    literals: returns (stripped, errors) where `stripped` is the source
+    with every literal space-filled (length- and newline-preserving) and
+    `errors` lists unterminated literals with line numbers. Both
+    lex_errors and kft_members consume this, so the two checks can never
+    disagree about where a literal starts or ends."""
+    out = list(src)
     errors: List[str] = []
-    stack: List[Tuple[str, int]] = []
+
+    def blank(a: int, b: int) -> None:
+        for k in range(a, min(b, len(out))):
+            if out[k] != "\n":
+                out[k] = " "
+
     line = 1
     i = 0
     n = len(src)
@@ -52,14 +63,18 @@ def lex_errors(src: str, origin: str = "<script>") -> List[str]:
             continue
         if c == "/" and i + 1 < n and src[i + 1] == "/":
             j = src.find("\n", i)
-            i = n if j < 0 else j
+            j = n if j < 0 else j
+            blank(i, j)
+            i = j
             continue
         if c == "/" and i + 1 < n and src[i + 1] == "*":
             j = src.find("*/", i + 2)
             if j < 0:
                 errors.append(f"{origin}:{line}: unterminated block comment")
-                return errors
+                blank(i, n)
+                return "".join(out), errors
             line += src.count("\n", i, j)
+            blank(i, j + 2)
             i = j + 2
             continue
         if c in "'\"`":
@@ -80,7 +95,9 @@ def lex_errors(src: str, origin: str = "<script>") -> List[str]:
                 errors.append(
                     f"{origin}:{start_line}: unterminated {c} string"
                 )
-                return errors
+                blank(i, n)
+                return "".join(out), errors
+            blank(i, j + 1)
             i = j + 1
             last_significant = c
             continue
@@ -91,38 +108,65 @@ def lex_errors(src: str, origin: str = "<script>") -> List[str]:
                 j += 2 if src[j] == "\\" else 1
             if j >= n or src[j] == "\n":
                 errors.append(f"{origin}:{line}: unterminated regex literal")
-                return errors
+                blank(i, n)
+                return "".join(out), errors
+            blank(i, j + 1)
             i = j + 1
             continue
-        if c in _OPEN:
-            stack.append((c, line))
-        elif c in _CLOSE:
-            if not stack:
-                errors.append(f"{origin}:{line}: unmatched '{c}'")
-                return errors
-            opener, oline = stack.pop()
-            if _OPEN[opener] != c:
-                errors.append(
-                    f"{origin}:{line}: '{c}' closes '{opener}' from line "
-                    f"{oline}"
-                )
-                return errors
         if not c.isspace():
             last_significant = c
         i += 1
-    for opener, oline in stack:
-        errors.append(f"{origin}:{oline}: '{opener}' never closed")
-    return errors
+    return "".join(out), errors
+
+
+def lex_errors(src: str, origin: str = "<script>") -> List[str]:
+    """Unterminated strings/comments + bracket balance, with line numbers.
+
+    Literal scanning is _scan_literals; bracket balance runs over the
+    stripped text, so brackets inside strings/comments never count."""
+    stripped, errors = _scan_literals(src, origin)
+    if errors:
+        return errors
+    stack: List[Tuple[str, int]] = []
+    line = 1
+    for c in stripped:
+        if c == "\n":
+            line += 1
+        elif c in _OPEN:
+            stack.append((c, line))
+        elif c in _CLOSE:
+            if not stack:
+                return [f"{origin}:{line}: unmatched '{c}'"]
+            opener, oline = stack.pop()
+            if _OPEN[opener] != c:
+                return [
+                    f"{origin}:{line}: '{c}' closes '{opener}' from line "
+                    f"{oline}"
+                ]
+    return [
+        f"{origin}:{oline}: '{opener}' never closed" for opener, oline in stack
+    ]
+
+
+def _strip_literals(src: str) -> str:
+    """Literal-stripped source (see _scan_literals)."""
+    return _scan_literals(src)[0]
 
 
 def kft_members(kft_js: str) -> Set[str]:
-    """Property names of the KFT object literal (depth-1 keys)."""
-    m = re.search(r"const KFT = \{", kft_js)
+    """Property names of the KFT object literal (depth-1 keys).
+
+    The walk runs over literal-stripped source: a brace (or member-shaped
+    text) inside a string, template literal, or comment previously
+    corrupted the depth counter and truncated the member set (round-3
+    advisor finding)."""
+    stripped = _strip_literals(kft_js)
+    m = re.search(r"const KFT = \{", stripped)
     if m is None:
         return set()
     depth = 0
     members: Set[str] = set()
-    body = kft_js[m.end() - 1:]
+    body = stripped[m.end() - 1:]
     # walk the object literal; keys appear at depth 1 as `name(`/`name:`
     for match in re.finditer(r"[{}]|^\s*(?:async\s+)?([A-Za-z_]\w*)\s*[(:]",
                              body, re.M):

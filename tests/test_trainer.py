@@ -210,11 +210,9 @@ class TestGradientAccumulation:
     losses, equal microbatch sizes), one optimizer update either way."""
 
     def _run(self, accum, devices):
-        """Causal-LM vehicle: every row has the same number of valid
-        next-token pairs, so microbatch means weight tokens identically
-        and the averaged grad is EXACTLY the full-batch grad. (MLM's
-        ragged valid counts give mean-of-means semantics instead — the
-        standard accumulation behavior, documented on accum_steps.)"""
+        """Causal-LM vehicle with full masks: every row has the same
+        number of valid next-token pairs (the equal-weights base case;
+        see test_accum_exact_with_ragged_masks for the weighted one)."""
         from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
         from kubeflow_tpu.parallel.mesh import mesh_from_config
         from kubeflow_tpu.training.data import make_global_batch
@@ -248,6 +246,56 @@ class TestGradientAccumulation:
     def test_accum_matches_full_batch(self, devices8):
         loss1, leaf1 = self._run(1, devices8)
         loss4, leaf4 = self._run(4, devices8)
+        assert loss1 == pytest.approx(loss4, rel=1e-5)
+        np.testing.assert_allclose(leaf4, leaf1, rtol=1e-5, atol=1e-6)
+
+    def _run_ragged(self, accum, devices):
+        """Rows with very different valid-pair counts, arranged so the
+        accumulation's microbatches are UNEQUALLY weighted."""
+        from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.data import make_global_batch
+        from kubeflow_tpu.training.tasks import CausalLmTask
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = TrainingConfig(
+            model="gpt_tiny",
+            global_batch_size=8,
+            steps=1,
+            warmup_steps=1,
+            learning_rate=1e-3,
+            dtype="float32",
+            seed=5,
+            mesh=MeshConfig(data=2),
+            accum_steps=accum,
+            checkpoint={"enabled": False},
+        )
+        mesh = mesh_from_config(cfg.mesh, devices=devices[:2])
+        task = CausalLmTask(cfg, seq_len=16, vocab_size=128)
+        tr = Trainer(cfg, mesh=mesh, task=task)
+        state = tr.init_state()
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, size=(8, 16)).astype(np.int32)
+        mask = np.ones((8, 16), np.int32)
+        for row in range(8):  # first microbatches see far more tokens
+            mask[row, 2 + row :] = 0
+        batch = make_global_batch(
+            {"input_ids": ids, "attention_mask": mask}, mesh
+        )
+        state, m = tr.train_step(state, batch, jax.random.PRNGKey(0))
+        loss = float(jax.device_get(m["loss"]))
+        leaf = np.asarray(
+            jax.device_get(state.params["layer_0"]["attention"]["query"]["kernel"])
+        )
+        return loss, leaf
+
+    def test_accum_exact_with_ragged_masks(self, devices8):
+        """Valid-token-weighted accumulation (loss_items): the combined
+        grad equals the full-batch token-mean grad even when microbatches
+        hold different numbers of valid pairs — the round-3 advisor's
+        mean-of-means caveat, now closed for causal LM."""
+        loss1, leaf1 = self._run_ragged(1, devices8)
+        loss4, leaf4 = self._run_ragged(4, devices8)
         assert loss1 == pytest.approx(loss4, rel=1e-5)
         np.testing.assert_allclose(leaf4, leaf1, rtol=1e-5, atol=1e-6)
 

@@ -441,6 +441,24 @@ class TestJsCheck:
         errs = check_page("p.html", html, kft)
         assert any("createWorkgrp" in e for e in errs)
 
+    def test_braces_in_strings_do_not_truncate_members(self):
+        """A '{'/'}' inside a string, template literal, or comment must
+        not corrupt the depth walk (round-3 advisor finding: the raw
+        regex counted every brace, so a brace-bearing string truncated
+        the member set and produced false 'KFT.x not defined')."""
+        from kubeflow_tpu.ui.jscheck import kft_members
+
+        kft = (
+            "const KFT = {\n"
+            '  tpl(x) { return `rendered {brace} ${x} }`; },\n'
+            '  note() { return "closing } in a string"; },\n'
+            "  // comment with } and { braces\n"
+            "  after() { return 1; },\n"
+            "};\n"
+        )
+        members = kft_members(kft)
+        assert {"tpl", "note", "after"} <= members
+
     def test_members_parsed_from_kft(self):
         import os
 
