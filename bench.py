@@ -954,7 +954,11 @@ def _entry_specs(batch: int, steps: int):
 
     Ordered by headline importance: whatever the budget sheds, it sheds
     from the tail. Per-entry timeouts assume tunnel-grade compiles
-    (60-300 s per program); the global budget is the real cap."""
+    (60-300 s per program); the global budget is the real cap. generate
+    runs LAST: its scan-heavy programs are the ones a degraded
+    remote-compile transport kills, and its fallback chain can burn
+    multiple tier timeouts — it must never starve the entries before it
+    (exactly what sank round 3's battery)."""
     bert_steps = max(5, steps // 2)
     return [
         ("resnet50", f"bench_resnet({batch}, {steps})", 900, None, False),
@@ -967,11 +971,11 @@ def _entry_specs(batch: int, steps: int):
             False,
         ),
         ("long_context_train", "bench_long_context_train()", 900, None, True),
-        ("generate", "bench_generate()", 600, None, False),
         ("studyjob", "bench_studyjob_trials()", 720, None, False),
         ("serving", "bench_serving()", 480, None, False),
-        ("long_context_attention", "bench_long_context()", 480, None, True),
         ("attention_sweep", "bench_attention_sweep()", 900, None, True),
+        ("long_context_attention", "bench_long_context()", 480, None, True),
+        ("generate", "bench_generate()", 420, None, False),
     ]
 
 

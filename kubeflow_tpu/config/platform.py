@@ -243,11 +243,11 @@ class TrainingConfig(ConfigNode):
     )
     assume_full_attention: bool = config_field(
         default=False,
-        help="causal-LM only: attention masks are known all-ones (packed "
-        "pretrain batches) — the task stops passing them, so the flash "
-        "kernel compiles its masked path out (full block budget, no "
-        "per-block selects; measured ~2x on 32k train steps). Loss "
-        "validity still excludes the final position.",
+        help="LM families (causal + MLM): attention masks are known "
+        "all-ones (packed pretrain batches) — the task stops passing "
+        "them, so the flash kernel compiles its masked path out (full "
+        "block budget, no per-block selects; measured ~2x on 32k train "
+        "steps). Causal loss validity still excludes the final position.",
     )
     label_smoothing: float = config_field(
         default=0.0,

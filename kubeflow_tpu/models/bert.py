@@ -243,10 +243,14 @@ class Bert(nn.Module):
     ):
         cfg = self.cfg
         b, s = input_ids.shape
-        if attention_mask is None:
-            attention_mask = jnp.ones((b, s), dtype=bool)
-        else:
+        # attention_mask=None means "no padding anywhere": the None flows
+        # to the attention impls (all accept it) so the flash kernel
+        # compiles its masked path out — same contract as models/gpt.py.
+        # The pipeline path needs a concrete mask for its travel arrays.
+        if attention_mask is not None:
             attention_mask = attention_mask.astype(bool)
+        elif cfg.pipeline_stages > 1:
+            attention_mask = jnp.ones((b, s), dtype=bool)
         if token_type_ids is None:
             token_type_ids = jnp.zeros((b, s), dtype=jnp.int32)
 

@@ -158,6 +158,11 @@ class MlmTask:
         self.cfg = cfg
         self.seq_len = seq_len
         self.vocab_size = vocab_size
+        # same contract as CausalLmTask: packed batches stop passing the
+        # all-ones mask so flash compiles its masked path out
+        self.assume_full_attention = bool(
+            getattr(cfg, "assume_full_attention", False)
+        )
 
     def synthetic_data(self) -> SyntheticData:
         return SyntheticData(
@@ -181,7 +186,9 @@ class MlmTask:
         out, sown = model.apply(
             {"params": params, **extra_vars},
             batch["input_ids"],
-            attention_mask=batch["attention_mask"],
+            attention_mask=None
+            if self.assume_full_attention
+            else batch["attention_mask"],
             deterministic=not train,
             rngs=rngs if train else None,
             mutable=["losses"],

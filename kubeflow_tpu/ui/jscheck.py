@@ -216,10 +216,21 @@ def check_page(
     ids = page_ids(html)
     funcs = defined_functions(scripts) | members
     all_js = "\n".join(scripts)
-    for m in re.finditer(r"\bKFT\.([A-Za-z_]\w*)", all_js):
+    # reference scans run against literal-stripped source so a KFT.name
+    # inside a comment or string cannot produce a false "not defined"
+    # (stripping is length-preserving, so raw/stripped offsets align).
+    # Known limitation: references inside template-literal ${...}
+    # interpolations are blanked too and go unchecked.
+    stripped_js = _strip_literals(all_js)
+    for m in re.finditer(r"\bKFT\.([A-Za-z_]\w*)", stripped_js):
         if m.group(1) not in members:
             errors.append(f"{name}: KFT.{m.group(1)} is not defined in kft.js")
     for m in re.finditer(r'getElementById\(\s*"([^"]+)"\s*\)', all_js):
+        # the id argument is itself a string literal, so match on the raw
+        # text but require the CALL to survive stripping (i.e. it is real
+        # code, not part of a comment or larger string)
+        if not stripped_js.startswith("getElementById", m.start()):
+            continue
         if m.group(1) not in ids:
             errors.append(
                 f"{name}: getElementById(\"{m.group(1)}\") has no matching "

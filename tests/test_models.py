@@ -4,6 +4,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+import numpy as np
+
 from kubeflow_tpu.models import get_model, list_models
 
 
@@ -67,6 +69,31 @@ class TestBert:
         model = get_model("bert_base")
         assert model.cfg.hidden_size == 768
         assert model.cfg.num_layers == 12
+
+    def test_none_mask_equals_all_ones_mask(self):
+        """attention_mask=None (the packed-pretrain fast path that skips
+        all mask plumbing) must be numerically identical to an explicit
+        all-ones mask, for both families."""
+        for name, kw in (("bert_tiny", {}), ("gpt_tiny", {})):
+            model = get_model(name, dtype=jnp.float32)
+            ids = jnp.arange(32, dtype=jnp.int32).reshape(2, 16) % 512
+            variables = model.init(
+                jax.random.PRNGKey(0), ids, deterministic=True
+            )
+            none_out = model.apply(variables, ids, deterministic=True)
+            ones_out = model.apply(
+                variables,
+                ids,
+                attention_mask=jnp.ones((2, 16), jnp.int32),
+                deterministic=True,
+            )
+            key = "mlm_logits" if name.startswith("bert") else "logits"
+            np.testing.assert_allclose(
+                np.asarray(none_out[key]),
+                np.asarray(ones_out[key]),
+                rtol=1e-5,
+                atol=1e-5,
+            )
 
     def test_attention_mask_changes_output(self):
         model = get_model("bert_tiny")

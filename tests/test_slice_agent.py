@@ -339,26 +339,35 @@ class TestBarrierArgsRendering:
         assert args[args.index("--num-processes") + 1] == "4"
 
 
+@pytest.fixture(scope="module")
+def tsan_agent(tmp_path_factory):
+    """Build the TSan-instrumented agent ONCE for the whole tier."""
+    import subprocess
+
+    build_dir = tmp_path_factory.mktemp("tsan-build")
+    src_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "native", "slice_agent",
+    )
+    build = subprocess.run(
+        ["make", "-s", "tsan", f"BUILD={build_dir}"],
+        cwd=src_dir, capture_output=True, text=True,
+    )
+    if build.returncode != 0 and any(
+        s in (build.stderr or "").lower() for s in ("libtsan", "-ltsan")
+    ):
+        pytest.skip(f"libtsan unavailable: {build.stderr.splitlines()[-1]}")
+    assert build.returncode == 0, build.stderr
+    return str(build_dir / "slice_agent_tsan")
+
+
 class TestSliceAgentTsan:
-    def test_tcp_gang_race_free_under_tsan(self, tmp_path):
+    def test_tcp_gang_race_free_under_tsan(self, tsan_agent, tmp_path):
         """Race-detection tier: a 3-member TCP-barrier gang (threads +
         sockets + fork/exec supervision) runs under ThreadSanitizer."""
         import subprocess
 
-        src_dir = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "native", "slice_agent",
-        )
-        build = subprocess.run(
-            ["make", "-s", "tsan", f"BUILD={tmp_path}"],
-            cwd=src_dir, capture_output=True, text=True,
-        )
-        if build.returncode != 0 and any(
-            s in (build.stderr or "").lower() for s in ("libtsan", "-ltsan")
-        ):
-            pytest.skip(f"libtsan unavailable: {build.stderr.splitlines()[-1]}")
-        assert build.returncode == 0, build.stderr
-        agent = str(tmp_path / "slice_agent_tsan")
+        agent = tsan_agent
         port = free_port()
         env = {**os.environ, "TSAN_OPTIONS": "exitcode=66"}
         procs = [
@@ -385,6 +394,49 @@ class TestSliceAgentTsan:
             assert p.returncode == 0, (
                 f"exit {p.returncode} (66=TSan race):\n{err}"
             )
+
+    def test_staged_gang_race_free_under_tsan(self, tsan_agent, tmp_path):
+        """Data staging inside the gang lifecycle under ThreadSanitizer:
+        member 1 stages a local fake remote before the TCP barrier."""
+        import subprocess
+
+        agent = tsan_agent
+        remote = tmp_path / "remote"
+        remote.mkdir()
+        (remote / "shard.bin").write_bytes(os.urandom(70000))
+        port = free_port()
+        env = {**os.environ, "TSAN_OPTIONS": "exitcode=66"}
+        coord = ["--coordinator", f"127.0.0.1:{port}"]
+        procs = []
+        for i in range(2):
+            extra = coord + (
+                ["--stage-in", f"{remote}={tmp_path}/scratch-1"]
+                if i == 1
+                else []
+            )
+            procs.append(
+                subprocess.Popen(
+                    [agent,
+                     "--shared-dir", str(tmp_path / f"own-{i}"),
+                     "--process-id", str(i), "--num-processes", "2",
+                     "--poll-ms", "10", "--timeout-ms", "10000"]
+                    + extra + ["--", "true"],
+                    stderr=subprocess.PIPE, text=True, env=env,
+                )
+            )
+        try:
+            results = [p.communicate(timeout=30) for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for p, (_, err) in zip(procs, results):
+            assert p.returncode == 0, (
+                f"exit {p.returncode} (66=TSan race):\n{err}"
+            )
+        assert (tmp_path / "scratch-1" / "shard.bin").read_bytes() == (
+            remote / "shard.bin"
+        ).read_bytes()
 
 
 class TestDataStaging:

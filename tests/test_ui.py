@@ -459,6 +459,26 @@ class TestJsCheck:
         members = kft_members(kft)
         assert {"tpl", "note", "after"} <= members
 
+    def test_kft_reference_in_comment_or_string_not_flagged(self):
+        """Reference scans run over literal-stripped source: a KFT.name
+        in a comment or string must not produce a false 'not defined',
+        while real undefined references still fail."""
+        from kubeflow_tpu.ui.jscheck import check_page
+
+        kft = "const KFT = {\n  get(path) { return 1; },\n};\n"
+        html = (
+            "<script>\n"
+            "// note: KFT.futureThing was removed\n"
+            'const s = "see KFT.alsoGone for details";\n'
+            '// getElementById("phantom") only in this comment\n'
+            "KFT.get('/api/x');\n"
+            "</script>"
+        )
+        assert check_page("p.html", html, kft) == []
+        bad = "<script>KFT.reallyMissing();</script>"
+        errs = check_page("p.html", bad, kft)
+        assert any("KFT.reallyMissing" in e for e in errs)
+
     def test_members_parsed_from_kft(self):
         import os
 
