@@ -973,7 +973,29 @@ def _entry_specs(batch: int, steps: int):
         ("long_context_train", "bench_long_context_train()", 900, None, True),
         ("studyjob", "bench_studyjob_trials()", 720, None, False),
         ("serving", "bench_serving()", 480, None, False),
-        ("attention_sweep", "bench_attention_sweep()", 900, None, True),
+        # the sweep is split per length: each is ~4 tunnel compiles in its
+        # own bounded subprocess, so a stall at one length cannot lose the
+        # others (the whole-sweep subprocess regularly exceeded any sane
+        # cap at ~20 compiles)
+        ("attention_sweep_2048", "bench_attention_sweep((2048,))", 420, None, True),
+        ("attention_sweep_4096", "bench_attention_sweep((4096,))", 420, None, True),
+        ("attention_sweep_8192", "bench_attention_sweep((8192,))", 420, None, True),
+        (
+            "attention_sweep_16384",
+            "bench_attention_sweep((16384,))",
+            420,
+            None,
+            True,
+        ),
+        (
+            # the dense columns OOM here — that null IS the datapoint
+            # (flash is the only feasible impl at 32k)
+            "attention_sweep_32768",
+            "bench_attention_sweep((32768,))",
+            420,
+            None,
+            True,
+        ),
         ("long_context_attention", "bench_long_context()", 480, None, True),
         ("generate", "bench_generate()", 420, None, False),
     ]
@@ -983,6 +1005,12 @@ def _summary(results: dict, batch: int, complete: bool, t0: float) -> dict:
     resnet = results.get("resnet50") or {}
     per_chip = resnet.get("images_per_sec_per_chip")
     probe = results.get("probe") or {}
+    # reassemble the per-length sweep entries into the one sweep table
+    sweep = {}
+    for key, value in results.items():
+        if key.startswith("attention_sweep_") and isinstance(value, dict):
+            s = key.rsplit("_", 1)[1]
+            sweep[s] = value.get(s, value)  # unwrap {"4096": row} | error
     return {
         "metric": "images/sec/chip (ResNet-50 train step, bf16, batch "
         f"{batch}/chip, {probe.get('n_devices', 1)} chip(s))",
@@ -999,7 +1027,7 @@ def _summary(results: dict, batch: int, complete: bool, t0: float) -> dict:
         "serving": results.get("serving"),
         "generate": results.get("generate"),
         "long_context_attention": results.get("long_context_attention"),
-        "attention_sweep": results.get("attention_sweep"),
+        "attention_sweep": sweep or None,
         "device_kind": probe.get("device_kind"),
         "complete": complete,
         "elapsed_s": round(time.monotonic() - t0, 1),

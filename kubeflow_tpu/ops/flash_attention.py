@@ -78,12 +78,13 @@ def _resolve_blocks(s_pad: int, block_q: int, block_k: int):
 def _auto_head_group(h: int, s_pad: int) -> int:
     """Preferred head group, by measurement (docs/PERF.md sweep): at
     short-to-mid lengths G=4 keeps 512x512 blocks inside the score
-    budget and won every case (1.55x dense @4k, 1.59x @8k bidirectional
-    on v5e); G=6/12 force asymmetric/small blocks and lose ground. At
-    LONG lengths the tradeoff flips — big per-head blocks beat grouping
-    (32k causal: G=1/1024 at 140 ms vs G=4/512 at 156 ms) because K/V
-    re-fetch traffic scales with n_q and softmax state stays cheaper
-    than grid-step savings. Order tries the measured winner first."""
+    budget and won every case (1.37x dense @4k, 1.53x @8k bidirectional
+    on v5e, min-of-N); G=6/12 force asymmetric/small blocks and lose
+    ground. At LONG lengths the tradeoff flips — big per-head blocks
+    beat grouping (32k causal: G=1/1024 beat G=4/512 by ~10%) because
+    K/V re-fetch traffic scales with n_q and softmax state stays
+    cheaper than grid-step savings. Order tries the measured winner
+    first."""
     if s_pad <= 128:
         return 1
     if s_pad >= 16384:
@@ -392,13 +393,20 @@ def _bwd(scale, causal, has_mask, block_q, block_k, num_heads, group,
     do, _ = g
     bh, s_len, d = q.shape
     bq, bk = block_q, block_k
-    # the backward body keeps ~4 concurrent f32 (G,BQ,BK) tiles live
-    # (s, p, dp, ds) where the forward needs ~2 — at the forward's block
-    # sizes the dq/dkv kernels overflow the ~16 MB scoped-VMEM budget
-    # (measured: 20.75M requested at G=4, 512x512, masked). Halve blocks
-    # until the tile set fits half the forward budget; halving a divisor
-    # of s_len keeps it a divisor (blocks >=128 are 128-multiples).
-    while group * bq * bk > _SCORE_BUDGET // 2 and (bq > 128 or bk > 128):
+    # The backward body keeps ~4 concurrent f32 (G,BQ,BK) tiles live
+    # (s, p, dp, ds) where the forward needs ~2. MASKED backward at the
+    # forward's block sizes overflows the ~16 MB scoped-VMEM budget
+    # (measured: 20.75 MB requested at G=4, 512x512, masked) — masked
+    # kernels halve blocks until the tile set fits half the score
+    # budget. UNMASKED backward at full-size blocks fits empirically
+    # (the pre-fix sweep ran G=4 512x512 and the round-2 kernel ran
+    # 1024x1024 per-head backward at 32k), and keeping the full blocks
+    # is where the 1.55x/1.59x bidirectional numbers come from — the
+    # packed-pretrain fast path (assume_full_attention) rides this.
+    # Halving a divisor of s_len keeps it a divisor (blocks >=128 are
+    # 128-multiples).
+    bwd_budget = _SCORE_BUDGET // 2 if has_mask else _SCORE_BUDGET
+    while group * bq * bk > bwd_budget and (bq > 128 or bk > 128):
         if bq >= bk:
             bq //= 2
         else:

@@ -42,7 +42,15 @@ def _scan_literals(src: str, origin: str = "<script>"):
     with every literal space-filled (length- and newline-preserving) and
     `errors` lists unterminated literals with line numbers. Both
     lex_errors and kft_members consume this, so the two checks can never
-    disagree about where a literal starts or ends."""
+    disagree about where a literal starts or ends.
+
+    Template literals keep their ${...} interpolations UN-blanked
+    (including the `${`/`}` pair, which balances for the bracket check):
+    interpolation contents are real executable JS, so KFT.* references
+    and getElementById calls inside them stay visible to the reference
+    scans, and nested strings/templates/comments within an interpolation
+    are themselves scanned. Only the literal text of the template is
+    blanked."""
     out = list(src)
     errors: List[str] = []
 
@@ -55,12 +63,39 @@ def _scan_literals(src: str, origin: str = "<script>"):
     i = 0
     n = len(src)
     last_significant = None
+    # Mode stack for template literals: ("tmpl", start_line) = inside a
+    # template's literal text; ("interp", brace_depth) = inside a ${...}
+    # interpolation (code context). Empty stack = top-level code.
+    stack: List[list] = []
+
+    def in_tmpl() -> bool:
+        return bool(stack) and stack[-1][0] == "tmpl"
+
     while i < n:
         c = src[i]
         if c == "\n":
             line += 1
             i += 1
             continue
+        if in_tmpl():
+            if c == "\\":
+                blank(i, i + 2)
+                i += 2
+                continue
+            if c == "`":  # closing backtick
+                out[i] = " "
+                stack.pop()
+                last_significant = "`"
+                i += 1
+                continue
+            if c == "$" and i + 1 < n and src[i + 1] == "{":
+                stack.append(["interp", 0])
+                i += 2  # "${" stays visible; its brace balances the "}"
+                continue
+            out[i] = " "
+            i += 1
+            continue
+        # ---- code context (top level or inside an interpolation) ----
         if c == "/" and i + 1 < n and src[i + 1] == "/":
             j = src.find("\n", i)
             j = n if j < 0 else j
@@ -77,21 +112,22 @@ def _scan_literals(src: str, origin: str = "<script>"):
             blank(i, j + 2)
             i = j + 2
             continue
-        if c in "'\"`":
+        if c == "`":
+            stack.append(["tmpl", line])
+            out[i] = " "
+            i += 1
+            continue
+        if c in "'\"":
             start_line = line
             j = i + 1
             while j < n:
                 if src[j] == "\\":
                     j += 2
                     continue
-                if src[j] == c:
-                    break
-                if src[j] == "\n":
-                    if c != "`":
-                        break  # non-template strings don't span lines
-                    line += 1
+                if src[j] == c or src[j] == "\n":
+                    break  # non-template strings don't span lines
                 j += 1
-            if j >= n or (src[j] == "\n" and c != "`"):
+            if j >= n or src[j] == "\n":
                 errors.append(
                     f"{origin}:{start_line}: unterminated {c} string"
                 )
@@ -113,9 +149,22 @@ def _scan_literals(src: str, origin: str = "<script>"):
             blank(i, j + 1)
             i = j + 1
             continue
+        if stack and stack[-1][0] == "interp":
+            if c == "{":
+                stack[-1][1] += 1
+            elif c == "}":
+                if stack[-1][1] == 0:
+                    stack.pop()  # back to template-literal text
+                    i += 1  # "}" stays visible, balancing the "${"
+                    continue
+                stack[-1][1] -= 1
         if not c.isspace():
             last_significant = c
         i += 1
+    for frame in stack:
+        if frame[0] == "tmpl":
+            errors.append(f"{origin}:{frame[1]}: unterminated ` string")
+            break
     return "".join(out), errors
 
 

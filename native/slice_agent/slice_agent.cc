@@ -461,16 +461,24 @@ bool tcp_check_master(TcpGang* g, bool* succeeded) {
   if (g->peers.empty()) return false;
   char tmp[64];
   ssize_t n = ::recv(g->peers[0], tmp, sizeof(tmp), 0);
-  if (n > 0) {
-    g->worker_buf.append(tmp, (size_t)n);
-  } else if (n == 0) {  // EOF without a phase line: coordinator died
+  if (n > 0) g->worker_buf.append(tmp, (size_t)n);
+  // ALWAYS consult the buffer before treating EOF as a dead coordinator:
+  // a fast coordinator coalesces "start\n" with the phase push into one
+  // segment, so the phase line may already sit in worker_buf (stashed by
+  // tcp_barrier_worker) when the first poll here reads the FIN — the
+  // old EOF-first order mis-reported that as gone/failed (observed ~2%
+  // of gangs with instant payloads: worker exit 5 after a Succeeded
+  // coordinator).
+  auto nl = g->worker_buf.find('\n');
+  if (nl != std::string::npos) {
+    *succeeded = (g->worker_buf.substr(0, nl) == "phase Succeeded");
+    return true;
+  }
+  if (n == 0) {  // EOF and no buffered phase line: coordinator died
     *succeeded = false;
     return true;
-  }  // n < 0: no data yet (EAGAIN) — keep waiting
-  auto nl = g->worker_buf.find('\n');
-  if (nl == std::string::npos) return false;
-  *succeeded = (g->worker_buf.substr(0, nl) == "phase Succeeded");
-  return true;
+  }
+  return false;  // n < 0: no data yet (EAGAIN) — keep waiting
 }
 
 }  // namespace

@@ -479,6 +479,32 @@ class TestJsCheck:
         errs = check_page("p.html", bad, kft)
         assert any("KFT.reallyMissing" in e for e in errs)
 
+    def test_template_interpolations_stay_checked(self):
+        """${...} interpolation contents are real executable JS: KFT.*
+        references and getElementById calls inside them must still be
+        reference-checked (only the template's literal TEXT is blanked),
+        and template braces must not corrupt the bracket balance."""
+        from kubeflow_tpu.ui.jscheck import check_page, lex_errors
+
+        kft = "const KFT = {\n  get(path) { return 1; },\n};\n"
+        bad = (
+            "<script>const x = `v: ${KFT.removedHelper(1)} end`;</script>"
+        )
+        errs = check_page("p.html", bad, kft)
+        assert any("KFT.removedHelper" in e for e in errs), errs
+        bad_id = (
+            '<script>const y = `${document.getElementById("phantom").value}`;'
+            "</script>"
+        )
+        errs = check_page("p.html", bad_id, kft)
+        assert any("phantom" in e for e in errs), errs
+        good = (
+            "<script>const z = `a {brace} ${KFT.get('/x')} b`;\n"
+            "const w = `nested ${ `${KFT.get('/y')}` } deep`;</script>"
+        )
+        assert check_page("p.html", good, kft) == []
+        assert lex_errors("const t = `open ${1 + 2");  # unterminated
+
     def test_members_parsed_from_kft(self):
         import os
 
