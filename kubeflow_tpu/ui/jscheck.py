@@ -79,6 +79,8 @@ def _scan_literals(src: str, origin: str = "<script>"):
             continue
         if in_tmpl():
             if c == "\\":
+                if i + 1 < n and src[i + 1] == "\n":
+                    line += 1  # line continuation still advances the count
                 blank(i, i + 2)
                 i += 2
                 continue
@@ -267,9 +269,9 @@ def check_page(
     all_js = "\n".join(scripts)
     # reference scans run against literal-stripped source so a KFT.name
     # inside a comment or string cannot produce a false "not defined"
-    # (stripping is length-preserving, so raw/stripped offsets align).
-    # Known limitation: references inside template-literal ${...}
-    # interpolations are blanked too and go unchecked.
+    # (stripping is length-preserving, so raw/stripped offsets align);
+    # template-literal ${...} interpolations stay UN-blanked, so
+    # references inside them remain checked (_scan_literals).
     stripped_js = _strip_literals(all_js)
     for m in re.finditer(r"\bKFT\.([A-Za-z_]\w*)", stripped_js):
         if m.group(1) not in members:
