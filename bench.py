@@ -543,9 +543,8 @@ def bench_generate(
 
     # max_len bounds the KV cache the decode step attends over — sized to
     # the measured shapes (prompt + new tokens + slack) rather than the
-    # model's full 1024: the tunneled remote-compile endpoint drops
-    # connections on very large decode programs, and short-context decode
-    # is the honest serving shape for this batch anyway
+    # model's full 1024: short-context decode is the honest serving shape
+    # for this batch, and numbers at different max_len are not comparable
     max_len = prompt_len + new_tokens + 64
     model = get_model(
         "gpt_small", dtype=jnp.bfloat16, scan_layers=True, max_len=max_len
@@ -562,13 +561,20 @@ def bench_generate(
             rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
         )
     )(jax.random.PRNGKey(0))["params"]
-    fn = jax.jit(lambda p: greedy_generate(model, params, p, new_tokens))
-    out = fn(prompt)
+    # params ride as an ARGUMENT, never a closure: captured params embed
+    # ~250 MB of weights as constants in the serialized program, which the
+    # tunneled remote-compile endpoint cannot swallow (the root cause of
+    # three rounds of null generate entries — train steps always passed
+    # params as args and compiled fine)
+    fn = jax.jit(
+        lambda params, p: greedy_generate(model, params, p, new_tokens)
+    )
+    out = fn(params, prompt)
     _ = int(jax.device_get(out[0, -1]))  # compile + materialize
     iters = 3
     t0 = time.monotonic()
     for _ in range(iters):
-        out = fn(prompt)
+        out = fn(params, prompt)
     _ = int(jax.device_get(out[0, -1]))
     dt = (time.monotonic() - t0) / iters
     # end-to-end: dt includes the prompt prefill pass + new_tokens-1
@@ -619,12 +625,15 @@ def bench_generate_stepwise(
         )
     )(jax.random.PRNGKey(0))["params"]
 
+    # params as arguments (see bench_generate: closure-captured params
+    # embed the weights as constants and kill the tunneled compile)
     prefill = jax.jit(
-        lambda p: model.apply(
+        lambda params, p: model.apply(
             {"params": params}, p, prefill=True, mutable=["cache"]
         )
     )
-    def _step(cache, tok):
+
+    def _step(params, cache, tok):
         out, mutated = model.apply(
             {"params": params, "cache": cache},
             tok[:, None],
@@ -637,11 +646,11 @@ def bench_generate_stepwise(
     step = jax.jit(_step)
 
     def run():
-        out, mutated = prefill(prompt)
+        out, mutated = prefill(params, prompt)
         cache = mutated["cache"]
         tok = jnp.argmax(out["logits"][:, -1], axis=-1).astype(jnp.int32)
         for _ in range(new_tokens - 1):
-            cache, tok = step(cache, tok)
+            cache, tok = step(params, cache, tok)
         return int(jax.device_get(tok[0]))
 
     run()  # compile prefill + decode step, materialize
@@ -691,13 +700,15 @@ def bench_generate_micro(batch: int = 4, prompt_len: int = 32) -> dict:
             rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
         )
     )(jax.random.PRNGKey(0))["params"]
+    # params as arguments (see bench_generate: closure capture kills the
+    # tunneled compile by embedding the weights as constants)
     prefill = jax.jit(
-        lambda p: model.apply(
+        lambda params, p: model.apply(
             {"params": params}, p, prefill=True, mutable=["cache"]
         )
     )
 
-    def _step(cache, tok):
+    def _step(params, cache, tok):
         out, mutated = model.apply(
             {"params": params, "cache": cache},
             tok[:, None],
@@ -708,15 +719,15 @@ def bench_generate_micro(batch: int = 4, prompt_len: int = 32) -> dict:
         return mutated["cache"], nxt
 
     step = jax.jit(_step)
-    out, mutated = prefill(prompt)
+    out, mutated = prefill(params, prompt)
     cache = mutated["cache"]
     tok = jnp.argmax(out["logits"][:, -1], axis=-1).astype(jnp.int32)
-    cache, tok = step(cache, tok)  # compile decode
+    cache, tok = step(params, cache, tok)  # compile decode
     _ = int(jax.device_get(tok[0]))
     iters = 8
     t0 = time.monotonic()
     for _ in range(iters):
-        cache, tok = step(cache, tok)
+        cache, tok = step(params, cache, tok)
     _ = int(jax.device_get(tok[0]))
     dt = (time.monotonic() - t0) / iters
     return {
@@ -759,18 +770,20 @@ def bench_generate_nocache(batch: int = 8, context_len: int = 128) -> dict:
             rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
         )
     )(jax.random.PRNGKey(0))["params"]
+    # params as arguments (see bench_generate: closure capture kills the
+    # tunneled compile by embedding the weights as constants)
     fwd = jax.jit(
-        lambda ids: jnp.argmax(
+        lambda params, ids: jnp.argmax(
             model.apply({"params": params}, ids, deterministic=True)[
                 "logits"
             ][:, -1],
             axis=-1,
         )
     )
-    out = fwd(ids)
+    out = fwd(params, ids)
     _ = int(jax.device_get(out[0]))  # compile + materialize
     best = _min_of_n(
-        lambda: fwd(ids), lambda out: int(jax.device_get(out[0]))
+        lambda: fwd(params, ids), lambda out: int(jax.device_get(out[0]))
     )
     return {
         "model": "gpt_small",
@@ -1039,6 +1052,11 @@ def _entry_specs(batch: int, steps: int):
             False,
         ),
         ("long_context_train", "bench_long_context_train()", 900, None, True),
+        # the guaranteed decode datapoint, taken EARLY while the transport
+        # is fresh: by the tail of a full battery the tunnel's compile
+        # helper rejects even this plain-forward program (measured twice);
+        # the richer cached tiers still get their chance last
+        ("generate_floor", "bench_generate_nocache()", 300, None, False),
         ("studyjob", "bench_studyjob_trials()", 720, None, False),
         ("serving", "bench_serving()", 480, None, False),
         # the sweep is split per length: each is ~4 tunnel compiles in its
@@ -1094,6 +1112,7 @@ def _summary(results: dict, batch: int, complete: bool, t0: float) -> dict:
         "studyjob": results.get("studyjob"),
         "serving": results.get("serving"),
         "generate": results.get("generate"),
+        "generate_floor": results.get("generate_floor"),
         "long_context_attention": results.get("long_context_attention"),
         "attention_sweep": sweep or None,
         "device_kind": probe.get("device_kind"),
@@ -1132,7 +1151,9 @@ def main() -> int:
     if suite != "all":
         specs = [s for s in specs if s[0] == "resnet50"]
     if os.environ.get("KFT_BENCH_GENERATE") == "0":
-        specs = [s for s in specs if s[0] != "generate"]
+        specs = [
+            s for s in specs if s[0] not in ("generate", "generate_floor")
+        ]
 
     for key, expr, cap_s, extra_env, tpu_only in specs:
         if tpu_only and not on_tpu:
@@ -1159,6 +1180,14 @@ def main() -> int:
                 ("bench_generate_micro()", "micro"),
                 ("bench_generate_nocache()", "nocache"),
             ):
+                if tier == "nocache":
+                    # the identical measurement already ran EARLY as
+                    # generate_floor (fresh transport); don't burn budget
+                    # re-compiling it at the fatigued tail
+                    floor = results.get("generate_floor")
+                    if isinstance(floor, dict) and "error" not in floor:
+                        result = dict(floor)
+                        break
                 remaining = budget_s - (time.monotonic() - t0)
                 if remaining <= 90:
                     break

@@ -306,10 +306,17 @@ class ServedLm:
             if fn is None:
                 want_mask = mask is not None
 
-                def run(p, m, rng):
+                # params enter as an ARGUMENT, never via closure: captured
+                # params embed every weight as a constant in the lowered
+                # program — hundreds of MB that a remote-compile transport
+                # must swallow (measured: the embedded-constant form hung
+                # the tunneled compile endpoint for three rounds while the
+                # params-as-args form compiles in seconds), and any param
+                # hot-swap would silently keep serving the stale constants
+                def run(params, p, m, rng):
                     return generate(
                         self.model,
-                        self.params,
+                        params,
                         p,
                         n_bucket,
                         prompt_mask=m if want_mask else None,
@@ -332,5 +339,7 @@ class ServedLm:
             if mask is not None
             else jnp.ones_like(jnp.asarray(x), dtype=bool)
         )
-        out = np.asarray(jax.device_get(fn(jnp.asarray(x), m_arg, rng)))
+        out = np.asarray(
+            jax.device_get(fn(self.params, jnp.asarray(x), m_arg, rng))
+        )
         return out[:, : x.shape[1] + n]
