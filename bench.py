@@ -1190,7 +1190,7 @@ def main() -> int:
                         break
                 remaining = budget_s - (time.monotonic() - t0)
                 if remaining <= 90:
-                    break
+                    continue  # cost-free tiers (the floor reuse) still run
                 result = _bench_in_subprocess(
                     fb, min(float(cap_s), remaining)
                 )

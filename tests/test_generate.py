@@ -432,3 +432,29 @@ class TestServedLmFromRegistry:
         assert "layer_0" in lm.params
         want = ServedLm("ref", model, params).generate([[5, 6, 7]], 4)
         np.testing.assert_array_equal(lm.generate([[5, 6, 7]], 4), want)
+
+
+class TestNoEmbeddedWeights:
+    def test_decode_programs_take_params_as_arguments(self, gpt_and_params):
+        """Params must enter jitted decode fns as ARGUMENTS, never via
+        closure: captured params embed every weight as a constant in the
+        lowered program (measured ~250 MB for gpt_small), which a
+        remote-compile transport cannot swallow — the root cause of
+        three rounds of unmeasurable decode. Guard: the lowered text of
+        the params-as-args form stays small; the closure form balloons
+        by at least the params' serialized size."""
+        model, params = gpt_and_params
+        prompt = jnp.ones((2, 4), jnp.int32)
+
+        good = jax.jit(
+            lambda p, ids: greedy_generate(model, p, ids, 3)
+        ).lower(params, prompt).as_text()
+        bad = jax.jit(
+            lambda ids: greedy_generate(model, params, ids, 3)
+        ).lower(prompt).as_text()
+        n_weights = sum(x.size for x in jax.tree.leaves(params))
+        # the closure form must be visibly fatter than the args form by
+        # an amount on the order of the weights; the args form must not
+        # carry them at all
+        assert len(bad) - len(good) > n_weights, (len(good), len(bad))
+        assert len(good) < n_weights, len(good)
