@@ -899,7 +899,10 @@ def bench_probe() -> dict:
 
     t0 = time.monotonic()
     x = jnp.ones((128, 128), jnp.bfloat16)
-    y = float(jax.device_get((x @ x).sum()))
+    # f32 accumulation for the check: a bf16 sum's partials round above
+    # 2^15 on sequential-reduce backends, which would fail the assert on a
+    # perfectly healthy device (the probe must only fail on real problems)
+    y = float(jax.device_get((x @ x).astype(jnp.float32).sum()))
     assert y == 128.0 * 128 * 128
     return {
         "backend": jax.default_backend(),

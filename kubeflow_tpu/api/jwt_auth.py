@@ -114,6 +114,7 @@ class JwtValidator:
         issuer: Optional[str] = None,
         hs256_secret: Optional[bytes] = None,
         leeway_s: float = 60.0,
+        require_exp: bool = True,
     ):
         if isinstance(jwks, dict):
             jwks = jwks.get("keys", [])
@@ -122,6 +123,11 @@ class JwtValidator:
         self.issuer = issuer
         self.hs256_secret = hs256_secret
         self.leeway_s = leeway_s
+        # IAP assertions always carry exp; a signed token with NO exp would
+        # otherwise validate forever, so a leak becomes permanent access.
+        # Default-on matches the posture this module is modeled on; opt out
+        # only for non-gateway service meshes with their own rotation.
+        self.require_exp = require_exp
 
     def _candidate_keys(self, kid: Optional[str]) -> List[Dict[str, Any]]:
         rsa = [k for k in self.keys if k.get("kty", "RSA") == "RSA"]
@@ -168,6 +174,8 @@ class JwtValidator:
                 raise InvalidToken(f"claim {name!r} is not a timestamp")
 
         exp = as_ts("exp")
+        if exp is None and self.require_exp:
+            raise InvalidToken("token has no exp claim")
         if exp is not None and now > exp + self.leeway_s:
             raise InvalidToken("token expired")
         nbf = as_ts("nbf")

@@ -545,6 +545,20 @@ def flash_attention(
     group = head_group if head_group is not None else _auto_head_group(h, s_pad)
     if h % group != 0:
         raise ValueError(f"head_group {group} must divide num_heads {h}")
+    if head_group is not None and s_len + pad > 128:
+        # the block-shrink loops bottom out at 128x128; past that an
+        # explicit group's f32 score tile cannot be made to fit the
+        # tightest (masked-backward) budget and the kernel would fail at
+        # compile with a scoped-VMEM error — reject it with a clear
+        # message. (<=128: the single-block fast path forces group=1, so
+        # any requested group is unused and must not be rejected.)
+        floor_budget = _SCORE_BUDGET // 2 if has_mask else _SCORE_BUDGET
+        if group * 128 * 128 > floor_budget:
+            raise ValueError(
+                f"head_group {group} cannot fit VMEM even at 128x128 "
+                f"blocks (max {floor_budget // (128 * 128)} for "
+                f"{'masked' if has_mask else 'unmasked'} kernels)"
+            )
     # shrink blocks until the f32 score tile (G*BQ*BK) fits the budget.
     # With a mask the forward body holds extra select intermediates —
     # measured 16.22 MB (228 KB over the scoped-VMEM limit) at the

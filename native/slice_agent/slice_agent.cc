@@ -261,14 +261,29 @@ bool send_line(int fd, const std::string& msg) {
   return true;
 }
 
-// Coordinator side: listen, collect `ready` lines from N-1 workers, send
-// `start` to all. Keeps the connections in g->peers for the phase push.
-bool tcp_barrier_coordinator(const Options& o, TcpGang* g, long start) {
-  std::string host;
-  int port = 0;
-  if (!split_host_port(o.coordinator, &host, &port)) return false;
+// One TCP dial attempt to host:port; returns the connected fd or -1.
+int dial_once(const std::string& host, int port) {
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  char portbuf[16];
+  std::snprintf(portbuf, sizeof(portbuf), "%d", port);
+  int fd = -1;
+  if (::getaddrinfo(host.c_str(), portbuf, &hints, &res) == 0 && res) {
+    int s = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (s >= 0 && ::connect(s, res->ai_addr, res->ai_addrlen) == 0) fd = s;
+    else if (s >= 0) ::close(s);
+  }
+  if (res) ::freeaddrinfo(res);
+  return fd;
+}
+
+// Bind+listen on :port (SO_REUSEADDR, non-blocking); -1 on failure.
+int listen_on(int port, int backlog) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return false;
+  if (fd < 0) return -1;
   int one = 1;
   ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   struct sockaddr_in addr;
@@ -277,12 +292,47 @@ bool tcp_barrier_coordinator(const Options& o, TcpGang* g, long start) {
   addr.sin_addr.s_addr = INADDR_ANY;  // workers dial our DNS name
   addr.sin_port = htons((uint16_t)port);
   if (::bind(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
-      ::listen(fd, o.num_processes) != 0) {
-    logmsg("tcp barrier: cannot listen on :%d (%s)", port, strerror(errno));
+      ::listen(fd, backlog) != 0) {
     ::close(fd);
-    return false;
+    return -1;
   }
   set_nonblocking(fd);
+  return fd;
+}
+
+// Accept on `listen_fd` for up to `window_ms`, pushing `abort` to each (at
+// most `expect`) dialer: covers workers that had NOT yet connected when the
+// gang aborted — without this they retry a dead port until the barrier
+// deadline (the slow path fail-fast exists to eliminate).
+void abort_accept_window(int listen_fd, int expect, int poll_ms,
+                         long window_ms) {
+  long t0 = now_ms();
+  int told = 0;
+  while (now_ms() - t0 < window_ms && told < expect) {
+    int c = ::accept(listen_fd, nullptr, nullptr);
+    if (c >= 0) {
+      send_line(c, "abort\n");
+      ::close(c);
+      told++;
+    } else {
+      ::usleep(poll_ms * 1000);
+    }
+  }
+  if (told) logmsg("abort pushed to %d worker(s)", told);
+}
+
+// Coordinator side: listen, collect `ready` lines from N-1 workers, send
+// `start` to all. Keeps the connections in g->peers for the phase push.
+bool tcp_barrier_coordinator(const Options& o, TcpGang* g, long start) {
+  std::string host;
+  int port = 0;
+  if (!split_host_port(o.coordinator, &host, &port)) return false;
+  int fd = listen_on(port, o.num_processes);
+  if (fd < 0) {
+    logmsg("tcp barrier: cannot listen on :%d (%s)", port, strerror(errno));
+    return false;
+  }
+  int one = 1;
   g->listen_fd = fd;
   // Readiness is tracked per worker *id*, not per connection: a worker that
   // restarts and reconnects replaces its old socket instead of double-
@@ -331,6 +381,23 @@ bool tcp_barrier_coordinator(const Options& o, TcpGang* g, long start) {
           std::string line = conns[i].buf.substr(0, nl);
           conns[i].buf.erase(0, nl + 1);
           int id = -1;
+          if (std::sscanf(line.c_str(), "fail %d", &id) == 1 && id >= 1 &&
+              id < o.num_processes) {
+            // a peer's stage-in failed pre-barrier: abort the whole gang
+            // NOW instead of letting everyone wait out the barrier timeout
+            logmsg("worker %d reported pre-barrier failure; aborting gang",
+                   id);
+            for (auto& kv : ready_fd) send_line(kv.second, "abort\n");
+            // connected-but-unready workers see our FIN and fail fast
+            for (auto& c2 : conns) ::close(c2.fd);
+            // workers that never connected would retry a dead port until
+            // the deadline — keep accepting briefly to hand them `abort`
+            int expect = o.num_processes - 1 - (int)ready_fd.size();
+            if (expect > 0) abort_accept_window(fd, expect, o.poll_ms, 5000);
+            ::close(fd);
+            g->listen_fd = -1;
+            return false;
+          }
           if (std::sscanf(line.c_str(), "ready %d", &id) == 1 && id >= 1 &&
               id < o.num_processes) {
             // one id per connection: a socket re-identifying under a new id
@@ -390,22 +457,7 @@ bool tcp_barrier_worker(const Options& o, TcpGang* g, long start) {
       logmsg("tcp barrier timeout: cannot reach %s", o.coordinator.c_str());
       return false;
     }
-    struct addrinfo hints;
-    std::memset(&hints, 0, sizeof(hints));
-    hints.ai_family = AF_INET;
-    hints.ai_socktype = SOCK_STREAM;
-    struct addrinfo* res = nullptr;
-    char portbuf[16];
-    std::snprintf(portbuf, sizeof(portbuf), "%d", port);
-    if (::getaddrinfo(host.c_str(), portbuf, &hints, &res) == 0 && res) {
-      int s = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
-      if (s >= 0 && ::connect(s, res->ai_addr, res->ai_addrlen) == 0) {
-        fd = s;
-      } else if (s >= 0) {
-        ::close(s);
-      }
-    }
-    if (res) ::freeaddrinfo(res);
+    fd = dial_once(host, port);
     if (fd < 0) ::usleep(o.poll_ms * 1000);
   }
   char msg[32];
@@ -433,6 +485,11 @@ bool tcp_barrier_worker(const Options& o, TcpGang* g, long start) {
     else ::usleep(o.poll_ms * 1000);
   }
   auto nl = buf.find('\n');
+  if (buf.compare(0, 5, "abort") == 0) {
+    logmsg("gang aborted by coordinator (peer failed pre-barrier)");
+    ::close(fd);
+    return false;
+  }
   if (buf.compare(0, 5, "start") != 0) {
     logmsg("unexpected barrier message: %s", buf.c_str());
     return false;
@@ -453,6 +510,43 @@ void tcp_push_phase(TcpGang* g, const char* phase) {
   g->peers.clear();
   if (g->listen_fd >= 0) ::close(g->listen_fd);
   g->listen_fd = -1;
+}
+
+// Worker whose stage-in failed, TCP mode: best-effort `fail <id>` report so
+// the coordinator aborts the gang instead of waiting out the barrier
+// timeout (the shared-dir mode equivalent is the phase.<id> Failed file).
+// Bounded to ~5 s of connect retries — fail-fast must not itself block.
+void tcp_report_failure(const Options& o) {
+  std::string host;
+  int port = 0;
+  if (!split_host_port(o.coordinator, &host, &port)) return;
+  long t0 = now_ms();
+  while (now_ms() - t0 < 5000) {
+    int s = dial_once(host, port);
+    if (s >= 0) {
+      char msg[32];
+      std::snprintf(msg, sizeof(msg), "fail %d\n", o.process_id);
+      send_line(s, msg);
+      ::close(s);
+      logmsg("stage-in failure reported to coordinator");
+      return;
+    }
+    ::usleep(o.poll_ms * 1000);
+  }
+  logmsg("could not reach coordinator to report stage-in failure");
+}
+
+// Coordinator whose stage-in failed, TCP mode: listen briefly and push
+// `abort` to every worker that dials in, so they fail fast instead of
+// retrying the dead coordinator until the barrier deadline.
+void tcp_abort_gang(const Options& o) {
+  std::string host;
+  int port = 0;
+  if (!split_host_port(o.coordinator, &host, &port)) return;
+  int fd = listen_on(port, o.num_processes);
+  if (fd < 0) return;
+  abort_accept_window(fd, o.num_processes - 1, o.poll_ms, 5000);
+  ::close(fd);
 }
 
 // Worker supervision poll: has the coordinator finished (or died)?
@@ -668,6 +762,12 @@ int main(int argc, char** argv) {
                  "staged." + std::to_string(o.process_id))) {
     write_file(sig_path(o, "phase." + std::to_string(o.process_id)),
                "Failed");
+    // TCP mode: the phase file alone is invisible cross-host — tell the
+    // gang so peers abort now instead of waiting out the barrier timeout
+    if (!o.coordinator.empty() && o.num_processes > 1) {
+      if (o.process_id == 0) tcp_abort_gang(o);
+      else tcp_report_failure(o);
+    }
     return 6;
   }
 

@@ -21,6 +21,11 @@ from kubeflow_tpu.api.jwt_auth import (
 SECRET = b"gang-shared-secret"
 
 
+def fresh(claims):
+    """Claims with a valid exp (validators now require one by default)."""
+    return {"exp": time.time() + 3600, **claims}
+
+
 def make_validator(**kw):
     kw.setdefault("hs256_secret", SECRET)
     return JwtValidator(**kw)
@@ -28,7 +33,7 @@ def make_validator(**kw):
 
 class TestHs256:
     def test_roundtrip(self):
-        tok = sign_hs256({"sub": "svc-a", "email": "svc@kf.local"}, SECRET)
+        tok = sign_hs256(fresh({"sub": "svc-a", "email": "svc@kf.local"}), SECRET)
         claims = make_validator().validate(tok)
         assert claims["sub"] == "svc-a"
         assert make_validator().identity(claims) == "svc@kf.local"
@@ -55,22 +60,31 @@ class TestHs256:
     def test_nbf_rejected(self):
         future = time.time() + 3600
         with pytest.raises(InvalidToken, match="not yet valid"):
-            make_validator().validate(sign_hs256({"nbf": future}, SECRET))
+            make_validator().validate(sign_hs256(fresh({"nbf": future}), SECRET))
 
     def test_audience_and_issuer_checked(self):
         v = make_validator(audience="kf-api", issuer="https://iss")
-        ok = sign_hs256({"aud": ["other", "kf-api"], "iss": "https://iss"}, SECRET)
+        ok = sign_hs256(fresh({"aud": ["other", "kf-api"], "iss": "https://iss"}), SECRET)
         v.validate(ok)
         with pytest.raises(InvalidToken, match="audience"):
-            v.validate(sign_hs256({"aud": "other", "iss": "https://iss"}, SECRET))
+            v.validate(sign_hs256(fresh({"aud": "other", "iss": "https://iss"}), SECRET))
         with pytest.raises(InvalidToken, match="issuer"):
-            v.validate(sign_hs256({"aud": "kf-api", "iss": "evil"}, SECRET))
+            v.validate(sign_hs256(fresh({"aud": "kf-api", "iss": "evil"}), SECRET))
 
     def test_alg_none_rejected(self):
         header = b64url_encode(json.dumps({"alg": "none"}).encode())
         payload = b64url_encode(json.dumps({"sub": "root"}).encode())
         with pytest.raises(InvalidToken, match="unsupported alg"):
             make_validator().validate(f"{header}.{payload}.")
+
+    def test_missing_exp_rejected_by_default(self):
+        """A signed token with NO exp claim must not validate forever: the
+        default posture requires exp (a leaked token would otherwise grant
+        permanent access); require_exp=False opts out explicitly."""
+        tok = sign_hs256({"sub": "svc-a"}, SECRET)
+        with pytest.raises(InvalidToken, match="no exp"):
+            make_validator().validate(tok)
+        make_validator(require_exp=False).validate(tok)
 
     def test_malformed_rejected(self):
         for bad in ("", "a.b", "x.y.z.w", "!!!.@@@.###"):
@@ -114,7 +128,7 @@ class TestRs256:
     def test_valid_token_verifies_against_jwk(self, rsa_key):
         v = JwtValidator(jwks={"keys": [jwk_of(rsa_key)]})
         claims = v.validate(
-            rs256_sign({"email": "user@corp", "sub": "u1"}, rsa_key, kid="k1")
+            rs256_sign(fresh({"email": "user@corp", "sub": "u1"}), rsa_key, kid="k1")
         )
         assert v.identity(claims) == "user@corp"
 
@@ -151,7 +165,7 @@ class TestGatewayBearer:
 
     def test_valid_bearer_passes_auth_with_identity(self):
         gk = self._gk()
-        tok = sign_hs256({"email": "svc@kf.local"}, SECRET)
+        tok = sign_hs256(fresh({"email": "svc@kf.local"}), SECRET)
         status, _, headers = gk.app.handle_full(
             "GET", "/auth", headers={"authorization": f"Bearer {tok}"}
         )

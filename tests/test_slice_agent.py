@@ -483,6 +483,75 @@ class TestDataStaging:
         assert (tmp_path / "phase.0").read_text() == "Failed"
         assert not (tmp_path / "start").exists()
 
+    def test_tcp_worker_stage_in_failure_aborts_gang_fast(
+        self, agent, tmp_path
+    ):
+        """TCP mode: a worker's stage-in failure must reach the coordinator
+        (`fail <id>` report) so the whole gang aborts NOW — before this fix
+        peers only saw a phase file on a volume they don't share and blocked
+        until the barrier timeout."""
+        port = free_port()
+        coord = ["--coordinator", f"127.0.0.1:{port}"]
+        t0 = time.monotonic()
+        c = run_agent(
+            agent, tmp_path / "c", 0, 2, payload=["true"],
+            timeout_ms=30000, extra=coord,
+        )
+        w = run_agent(
+            agent, tmp_path / "w", 1, 2, payload=["true"], timeout_ms=30000,
+            extra=coord + ["--stage-in", f"{tmp_path}/missing={tmp_path}/out"],
+        )
+        assert w.wait(timeout=10) == 6  # staging failure exit code
+        assert c.wait(timeout=10) == 4  # gang aborted, NOT payload-ran
+        # fail-fast: both exited long before the 30 s barrier deadline
+        assert time.monotonic() - t0 < 20
+        assert (tmp_path / "w" / "phase.1").read_text() == "Failed"
+
+    def test_tcp_abort_reaches_worker_that_connects_late(
+        self, agent, tmp_path
+    ):
+        """A worker still starting up when the gang aborts must not retry a
+        dead port until the barrier deadline: the coordinator keeps a brief
+        abort-accept window open for stragglers."""
+        port = free_port()
+        coord = ["--coordinator", f"127.0.0.1:{port}"]
+        t0 = time.monotonic()
+        c = run_agent(
+            agent, tmp_path / "c", 0, 3, payload=["true"],
+            timeout_ms=30000, extra=coord,
+        )
+        bad = run_agent(
+            agent, tmp_path / "w1", 1, 3, payload=["true"], timeout_ms=30000,
+            extra=coord + ["--stage-in", f"{tmp_path}/missing={tmp_path}/out"],
+        )
+        assert bad.wait(timeout=10) == 6
+        time.sleep(1.0)  # gang already aborted; now the straggler dials in
+        late = run_agent(
+            agent, tmp_path / "w2", 2, 3, payload=["true"],
+            timeout_ms=30000, extra=coord,
+        )
+        assert late.wait(timeout=10) == 4  # got `abort`, failed fast
+        assert c.wait(timeout=10) == 4
+        assert time.monotonic() - t0 < 20
+
+    def test_tcp_coordinator_stage_in_failure_aborts_workers_fast(
+        self, agent, tmp_path
+    ):
+        port = free_port()
+        coord = ["--coordinator", f"127.0.0.1:{port}"]
+        t0 = time.monotonic()
+        c = run_agent(
+            agent, tmp_path / "c", 0, 2, payload=["true"], timeout_ms=30000,
+            extra=coord + ["--stage-in", f"{tmp_path}/missing={tmp_path}/out"],
+        )
+        w = run_agent(
+            agent, tmp_path / "w", 1, 2, payload=["true"],
+            timeout_ms=30000, extra=coord,
+        )
+        assert c.wait(timeout=10) == 6
+        assert w.wait(timeout=10) == 4  # abort received, fail fast
+        assert time.monotonic() - t0 < 20
+
     def test_stage_out_after_success(self, agent, tmp_path):
         work = tmp_path / "work"
         work.mkdir()
