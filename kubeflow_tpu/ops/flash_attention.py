@@ -390,7 +390,7 @@ def _bwd_dkv_kernel(
 def _bwd(scale, causal, has_mask, block_q, block_k, num_heads, group,
          residuals, g):
     q, k, v, mask, o, lse = residuals
-    do, _ = g
+    do, dlse = g
     bh, s_len, d = q.shape
     bq, bk = block_q, block_k
     # The backward body keeps ~4 concurrent f32 (G,BQ,BK) tiles live
@@ -416,6 +416,11 @@ def _bwd(scale, causal, has_mask, block_q, block_k, num_heads, group,
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )[:, None, :]
+    if dlse is not None:
+        # lse cotangent folds into delta: ∂lse_i/∂s_ij = p_ij, so
+        # ds_ij = p_ij·(dp_ij − (delta_i − dlse_i)) — the kernels stay
+        # unchanged, only the per-row correction shifts
+        delta = delta - dlse.astype(jnp.float32)
 
     kv_idx, mask_idx_q = _q_major_maps(causal, bq, bk, num_heads, group)
     dq = pl.pallas_call(
@@ -506,6 +511,39 @@ def _flash_bwd(scale, causal, has_mask, block_q, block_k, num_heads, group,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_lse(q, k, v, mask, scale, causal, has_mask, block_q, block_k,
+               num_heads, group):
+    """(out, lse) variant: the normalized block output plus its row
+    log-sum-exp — the pair ring attention merges across KV rotations."""
+    return _fwd(
+        q, k, v, mask, scale, causal, has_mask, block_q, block_k,
+        num_heads, group,
+    )
+
+
+def _flash_lse_fwd(q, k, v, mask, scale, causal, has_mask, block_q, block_k,
+                   num_heads, group):
+    o, lse = _fwd(
+        q, k, v, mask, scale, causal, has_mask, block_q, block_k,
+        num_heads, group,
+    )
+    return (o, lse), (q, k, v, mask, o, lse)
+
+
+def _flash_lse_bwd(scale, causal, has_mask, block_q, block_k, num_heads,
+                   group, residuals, g):
+    do, dlse = g
+    dq, dk, dv, _ = _bwd(
+        scale, causal, has_mask, block_q, block_k, num_heads, group,
+        residuals, (do, dlse),
+    )
+    return dq, dk, dv, None
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -516,7 +554,8 @@ def flash_attention(
     block_k: int = 1024,
     scale: Optional[float] = None,
     head_group: Optional[int] = None,
-) -> jax.Array:
+    return_lse: bool = False,
+):
     """Blockwise attention over [batch, seq, heads, head_dim] inputs.
 
     `mask` is a [batch, seq] key-padding mask (1 = attend); when omitted,
@@ -529,6 +568,13 @@ def flash_attention(
     `head_group` batches that many heads through each kernel block (must
     divide the head count); None picks the largest group whose f32 score
     tile fits the VMEM budget, shrinking block_q/block_k to match.
+
+    `return_lse=True` returns (out, lse[batch, heads, seq] float32) — the
+    normalized output plus its row log-sum-exp, which is exactly what an
+    online combine across KV blocks needs (ring attention merges per-step
+    flash results with logaddexp); the backward folds the lse cotangent
+    into the per-row delta correction, so gradients through the merge are
+    exact.
     """
     b, s_len, h, d = q.shape
     if scale is None:
@@ -588,6 +634,17 @@ def flash_attention(
         return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
 
     qbh, kbh, vbh = to_bh(q), to_bh(k), to_bh(v)
+    if return_lse:
+        out, lse = _flash_lse(
+            qbh, kbh, vbh, mask[:, None, :], float(scale), causal, has_mask,
+            bq, bk, h, group,
+        )
+        out = out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
+        lse = lse.reshape(b, h, s_pad)
+        if pad:
+            out = out[:, :s_len]
+            lse = lse[..., :s_len]
+        return out, lse
     out = _flash(
         qbh, kbh, vbh, mask[:, None, :], float(scale), causal, has_mask,
         bq, bk, h, group,
