@@ -188,13 +188,16 @@ class StudyJobController(Controller):
 
         # collect finished trials
         results: List[Tuple[int, Optional[float], str]] = []
+        trial_metrics: Dict[int, Dict[str, Any]] = {}
         for idx, t in trials.items():
             conds = {
                 c["type"]: c["status"]
                 for c in t.get("status", {}).get("conditions", [])
             }
             if conds.get(JOB_SUCCEEDED) == "True":
-                val = t.get("status", {}).get("trainingMetrics", {}).get(metric_key)
+                tm = t.get("status", {}).get("trainingMetrics", {})
+                trial_metrics[idx] = tm
+                val = tm.get(metric_key)
                 results.append((idx, val, "succeeded"))
             elif conds.get(JOB_FAILED) == "True":
                 results.append((idx, None, "failed"))
@@ -244,6 +247,9 @@ class StudyJobController(Controller):
                 "index": best_idx,
                 "parameters": suggestions[best_idx],
                 "metric": {metric_key: best_val},
+                # every metric the trial surfaced (items_per_sec is
+                # steady-state; compile_s is the separated one-time cost)
+                "allMetrics": trial_metrics.get(best_idx, {}),
             }
             set_condition(study, COND_RUNNING, "False", "TrialsDone", "")
             set_condition(

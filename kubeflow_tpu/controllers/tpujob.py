@@ -277,7 +277,8 @@ class TPUTrainJobController(Controller):
                 ps = coord.get("status", {})
                 metrics = {}
                 for key in (
-                    "items_per_sec", "final_loss", "final_step", "eval_top1"
+                    "items_per_sec", "final_loss", "final_step", "eval_top1",
+                    "compile_s",
                 ):
                     if key in ps:
                         try:

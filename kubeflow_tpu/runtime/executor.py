@@ -145,6 +145,8 @@ class InProcessTrainerRunner(PodRunner):
         }
         if result["loss"] is not None:
             info["final_loss"] = f"{result['loss']:.4f}"
+        if "compile_s" in result:
+            info["compile_s"] = f"{result['compile_s']:.2f}"
         if "eval_top1" in result:
             self.last_metrics["eval_top1"] = result["eval_top1"]
             info["eval_top1"] = f"{result['eval_top1']:.4f}"

@@ -83,6 +83,78 @@ class SyntheticData:
             yield self.batch_at(step)
             step += 1
 
+    def device_batch_fn(self):
+        """Traceable per-step batch generator — synthetic data made ON the
+        device (the reference's own harness does the same:
+        tf_cnn_benchmarks --data_name=synthetic renders inputs device-side).
+        A host-generated 256-image batch is ~77 MB of host→device traffic
+        EVERY step; over a remote-device transport that serializes ahead of
+        compute and throttles short trials to the wire, not the chip.
+        Deterministic per (seed, step) like batch_at — resume-safe — though
+        the stream differs from the host path's numpy RNG."""
+        import jax
+        import jax.numpy as jnp
+
+        b = self.global_batch_size
+        base = jax.random.PRNGKey(self.seed)
+        if self.task == "image":
+
+            def fn(step):
+                k1, k2 = jax.random.split(jax.random.fold_in(base, step))
+                return {
+                    "image": jax.random.normal(
+                        k1, (b, self.image_size, self.image_size, 3),
+                        jnp.float32,
+                    ),
+                    "label": jax.random.randint(
+                        k2, (b,), 0, self.num_classes, jnp.int32
+                    ),
+                }
+
+            return fn
+        if self.task == "lm":
+
+            def fn(step):
+                (k1,) = jax.random.split(
+                    jax.random.fold_in(base, step), 1
+                )
+                ids = jax.random.randint(
+                    k1, (b, self.seq_len), 0, self.vocab_size, jnp.int32
+                )
+                return {
+                    "input_ids": ids,
+                    "attention_mask": jnp.ones(
+                        (b, self.seq_len), jnp.int32
+                    ),
+                }
+
+            return fn
+        if self.task == "mlm":
+
+            def fn(step):
+                k1, k2, k3 = jax.random.split(
+                    jax.random.fold_in(base, step), 3
+                )
+                ids = jax.random.randint(
+                    k1, (b, self.seq_len), 0, self.vocab_size, jnp.int32
+                )
+                mask = jax.random.uniform(k2, (b, self.seq_len)) < 0.15
+                labels = jnp.where(mask, ids, -100)
+                ids = jnp.where(mask, 1, ids)  # [MASK]-like id
+                return {
+                    "input_ids": ids,
+                    "attention_mask": jnp.ones(
+                        (b, self.seq_len), jnp.int32
+                    ),
+                    "labels": labels,
+                    "nsp_labels": jax.random.randint(
+                        k3, (b,), 0, 2, jnp.int32
+                    ),
+                }
+
+            return fn
+        return None
+
 
 def batch_spec(batch: Dict[str, np.ndarray]) -> Dict[str, P]:
     """Batch arrays shard along (data, fsdp) on their leading dim."""
