@@ -66,13 +66,23 @@ class ServedModel:
         version: str = "1",
         postprocess: Optional[Callable[[np.ndarray], Any]] = None,
         batch_window_ms: float = 0.0,
+        transfer_dtype: Any = None,
     ):
         self.name = name
         self.version = version
         self.params = params
         self.postprocess = postprocess
+        # host→device bytes are the serving bottleneck on remote-device
+        # transports: casting instances to the model's compute dtype on the
+        # HOST (e.g. bf16) halves the wire bytes before they ever hit the
+        # device link. Opt-in: the model must accept the narrower input.
+        self.transfer_dtype = transfer_dtype
         self._jitted = jax.jit(apply_fn)
         self._lock = threading.Lock()
+        # last device call's transfer/compute split (attribution for the
+        # X-*-Ms response headers; under the batcher this is the most
+        # recent fused batch, which is what a concurrent client rode)
+        self.last_device_decomp: Dict[str, float] = {}
         reg = default_registry()
         self._latency = reg.histogram(
             "serving_predict_seconds", "predict latency", ["model"]
@@ -172,11 +182,64 @@ class ServedModel:
         if padded_n != n:
             pad = np.repeat(x[:1], padded_n - n, axis=0)
             x = np.concatenate([x, pad], axis=0)
+        if self.transfer_dtype is not None:
+            x = x.astype(self.transfer_dtype)
+        import time as _time
+
         with self._lock:
-            y = np.asarray(
-                jax.device_get(self._jitted(self.params, jnp.asarray(x)))
-            )
-        return y[:n]
+            t0 = _time.monotonic()
+            xd = jnp.asarray(x)
+            jax.block_until_ready(xd)
+            t1 = _time.monotonic()
+            y = self._jitted(self.params, xd)
+            jax.block_until_ready(y)
+            t2 = _time.monotonic()
+            out = np.asarray(jax.device_get(y))
+            t3 = _time.monotonic()
+            self.last_device_decomp = {
+                "rows": float(padded_n),
+                "transfer_in_ms": (t1 - t0) * 1e3,
+                "device_ms": (t2 - t1) * 1e3,
+                "transfer_out_ms": (t3 - t2) * 1e3,
+            }
+        return out[:n]
+
+    def warmup(
+        self,
+        element_shape: Sequence[int],
+        dtype: Any = np.float32,
+        max_rows: Optional[int] = None,
+    ) -> None:
+        """Compile every padded-batch program up to `max_rows` (all buckets
+        by default). Under concurrency the micro-batcher fuses requests
+        into bucket sizes no single request hits, so an unwarmed bucket
+        pays its XLA compile inside some client's request — on a tunneled
+        compile path that showed up as p99 ≈ 7× p50 in the 4-client bench.
+        Serve-ready means every reachable program is already compiled."""
+        # warm through the bucket max_rows-row batches actually RUN on
+        # (a 20-row fused batch pads to bucket 32 — stopping at 16 would
+        # leave exactly the compile this method exists to prevent)
+        limit = bucket_for(
+            max_rows if max_rows is not None else BATCH_BUCKETS[-1]
+        )
+        for b in BATCH_BUCKETS:
+            if b > limit:
+                break
+            self._device_predict(np.zeros((b,) + tuple(element_shape), dtype))
+
+    def batch_stats(self) -> Dict[str, float]:
+        """Micro-batcher evidence: how many device batches ran and the mean
+        rows per batch (proof that concurrent requests actually fused)."""
+        if self._batcher is None:
+            return {}
+        hist = self._batcher._fused
+        count = hist.count(model=self.name)
+        return {
+            "fused_batches": float(count),
+            "fused_rows_mean": (
+                hist.sum(model=self.name) / count if count else 0.0
+            ),
+        }
 
     def predict(self, instances: Sequence) -> List:
         if len(instances) == 0:
@@ -289,14 +352,27 @@ class ModelServer:
             # server-side latency decomposition: lets clients separate
             # transport (wall - sum of these) from parse/compute/serialize
             # without guessing (VERDICT r2 weak #8)
+            headers = [
+                ("X-Parse-Ms", f"{(t1 - t0) * 1e3:.2f}"),
+                ("X-Compute-Ms", f"{(t2 - t1) * 1e3:.2f}"),
+                ("X-Serialize-Ms", f"{(t3 - t2) * 1e3:.2f}"),
+            ]
+            # compute further split into host→device transfer / XLA run /
+            # device→host (the most recent device call — under the batcher,
+            # the fused batch this request rode): on remote-device
+            # transports the transfer legs dominate, and without this split
+            # they masquerade as model compute
+            decomp = model.last_device_decomp
+            for key, hdr in (
+                ("transfer_in_ms", "X-Transfer-In-Ms"),
+                ("device_ms", "X-Device-Ms"),
+                ("transfer_out_ms", "X-Transfer-Out-Ms"),
+                ("rows", "X-Device-Batch-Rows"),
+            ):
+                if key in decomp:
+                    headers.append((hdr, f"{decomp[key]:.2f}"))
             return Response(
-                buf.getvalue(),
-                "application/octet-stream",
-                headers=[
-                    ("X-Parse-Ms", f"{(t1 - t0) * 1e3:.2f}"),
-                    ("X-Compute-Ms", f"{(t2 - t1) * 1e3:.2f}"),
-                    ("X-Serialize-Ms", f"{(t3 - t2) * 1e3:.2f}"),
-                ],
+                buf.getvalue(), "application/octet-stream", headers=headers
             )
 
         @app.post("/v1/models/<name>:generate")

@@ -453,7 +453,59 @@ class TestThreadedWire:
             )
             with urllib.request.urlopen(req, timeout=30) as resp:
                 assert resp.status == 200
-                for h in ("X-Parse-Ms", "X-Compute-Ms", "X-Serialize-Ms"):
+                for h in (
+                    "X-Parse-Ms", "X-Compute-Ms", "X-Serialize-Ms",
+                    # device-call split: transfer legs vs XLA run (on
+                    # remote-device transports transfer masquerades as
+                    # compute without it)
+                    "X-Transfer-In-Ms", "X-Device-Ms", "X-Transfer-Out-Ms",
+                ):
                     assert float(resp.headers[h]) >= 0.0
+                assert float(resp.headers["X-Device-Batch-Rows"]) == 2.0
         finally:
             srv.stop()
+
+    def test_warmup_compiles_every_bucket(self, mlp_served):
+        """warmup() pre-runs each padded-batch program so no client request
+        pays a compile — the fused bucket sizes only concurrency reaches
+        must be ready before traffic (the 4-client inversion root cause)."""
+        mlp_served.warmup((8,), np.float32, max_rows=16)
+        # every bucket's program is compiled: the jit cache holds 1,2,4,8,16
+        sizes = {1, 2, 4, 8, 16}
+        assert mlp_served._jitted._cache_size() >= len(sizes)
+        decomp = mlp_served.last_device_decomp
+        assert decomp["rows"] == 16.0 and decomp["device_ms"] >= 0.0
+
+    def test_batch_stats_prove_fusion(self):
+        import threading
+
+        from kubeflow_tpu.models.registry import get_model
+
+        model = get_model("mlp", hidden=(16,), num_classes=4)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+        served = ServedModel(
+            "mlp-fuse",
+            lambda v, x: model.apply(v, x),
+            variables,
+            batch_window_ms=30.0,
+        )
+        try:
+            threads = [
+                threading.Thread(
+                    target=lambda: served.predict_array(
+                        np.zeros((2, 8), np.float32)
+                    )
+                )
+                for _ in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            stats = served.batch_stats()
+            # 8 rows over at most a few windows — strictly fewer device
+            # batches than requests, mean rows > a single request's 2
+            assert stats["fused_batches"] < 4
+            assert stats["fused_rows_mean"] > 2.0
+        finally:
+            served.close()
