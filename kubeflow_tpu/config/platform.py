@@ -266,6 +266,15 @@ class TrainingConfig(ConfigNode):
         "the model family's default. The long-context configs set this "
         "(e.g. 32768 with a sequence mesh axis).",
     )
+    pipeline_schedule: str = config_field(
+        default="gpipe",
+        help="microbatch schedule when mesh.pipeline > 1: gpipe (all "
+        "microbatches forward, then all backward) or 1f1b (one-forward-"
+        "one-backward segmented-remat scan — bounds live activations to "
+        "the stage count instead of the microbatch count, "
+        "models/layers.py::pipeline_scan). Ignored without a pipeline "
+        "axis.",
+    )
     accum_steps: int = config_field(
         default=1,
         help="gradient accumulation: split each global batch into this "
@@ -301,6 +310,11 @@ class TrainingConfig(ConfigNode):
             )
         if self.dtype not in ("float32", "bfloat16"):
             raise ConfigError(f"dtype must be float32|bfloat16, got {self.dtype}")
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ConfigError(
+                f"pipeline_schedule must be gpipe|1f1b, "
+                f"got {self.pipeline_schedule!r}"
+            )
         if not 0.0 <= self.label_smoothing < 1.0:
             raise ConfigError("label_smoothing must be in [0, 1)")
         # these knobs are read only by the image-classification task; a

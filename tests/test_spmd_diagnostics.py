@@ -20,7 +20,7 @@ from kubeflow_tpu.training.tasks import CausalLmTask, MlmTask
 from kubeflow_tpu.training.trainer import Trainer
 
 
-def _compile_and_check(model, axes, task_cls, model_kwargs=None):
+def _compile_and_check(model, axes, task_cls, model_kwargs=None, **cfg_kwargs):
     cfg = TrainingConfig(
         model=model,
         global_batch_size=16,
@@ -28,6 +28,7 @@ def _compile_and_check(model, axes, task_cls, model_kwargs=None):
         warmup_steps=1,
         learning_rate=1e-3,
         mesh=MeshConfig(**axes),
+        **cfg_kwargs,
     )
     mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:8])
     task = task_cls(cfg, seq_len=16, vocab_size=512)
@@ -68,4 +69,16 @@ class TestNoInvoluntaryRemat:
             {"data": 4, "sequence": 2},
             CausalLmTask,
             {"attention_impl": "ring"},
+        )
+
+    def test_pp_1f1b_mesh_gpt(self, devices8):
+        """1f1b selected through the CONFIG tree, not a model kwarg
+        (TrainingConfig.pipeline_schedule → Trainer → pipeline_scan):
+        the schedule must compile remat-free like every other plan."""
+        _compile_and_check(
+            "gpt_tiny",
+            {"data": 4, "pipeline": 2},
+            CausalLmTask,
+            {"num_layers": 4},
+            pipeline_schedule="1f1b",
         )
