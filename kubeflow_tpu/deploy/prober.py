@@ -4,13 +4,21 @@ Re-implements the reference's external black-box probe (reference:
 metric-collector/service-readiness/kubeflow-readiness.py): hit the platform
 endpoint on a period, export the `kubeflow_availability` gauge (:20-37), and
 emit a k8s Event on the dashboard service when the state flips (:102-141).
-The OIDC dance is replaced by a pluggable check callable (in-cluster the
-endpoint sits behind the gatekeeper, which takes Basic auth).
+
+Auth: the reference's prober SIGNS a Google OIDC token and probes through
+IAP every loop (kubeflow-readiness.py:144-176). The equivalent here is
+`authenticated_http_check` — mint a fresh bearer JWT per probe and require
+the gateway to accept it; a redirect to the login page (what the gateway
+does with a missing/invalid token) counts as DOWN, because the platform is
+not available to an authenticated user. The plain `http_check` remains for
+unauthenticated endpoints.
 """
 
 from __future__ import annotations
 
 import threading
+import time
+import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, Optional
 
@@ -28,6 +36,67 @@ def http_check(url: str, timeout_s: float = 5.0) -> Check:
         try:
             with urllib.request.urlopen(url, timeout=timeout_s) as resp:
                 return 200 <= resp.status < 400
+        except Exception:
+            return False
+
+    return check
+
+
+def hs256_token_source(
+    secret: bytes,
+    identity: str = "prober@kubeflow-tpu.dev",
+    audience: Optional[str] = None,
+    issuer: Optional[str] = None,
+    ttl_s: float = 300.0,
+) -> Callable[[], str]:
+    """Mint a fresh short-lived HS256 bearer token per probe — the
+    service-to-service half of the reference's sign-an-OIDC-assertion
+    loop (kubeflow-readiness.py:144-176). Always carries exp (the
+    gateway's validator requires one)."""
+    from kubeflow_tpu.api.jwt_auth import sign_hs256
+
+    def mint() -> str:
+        now = time.time()
+        claims: Dict[str, Any] = {
+            "email": identity,
+            "sub": identity,
+            "iat": now,
+            "exp": now + ttl_s,
+        }
+        if audience is not None:
+            claims["aud"] = audience
+        if issuer is not None:
+            claims["iss"] = issuer
+        return sign_hs256(claims, secret)
+
+    return mint
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    # the gateway answers an unauthenticated probe with 302 → /kflogin;
+    # following it would land a 200 login page and report a DOWN-for-users
+    # platform as up — redirects must surface as the failure they are
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        return None
+
+
+def authenticated_http_check(
+    url: str, token_source: Callable[[], str], timeout_s: float = 5.0
+) -> Check:
+    """Probe through the gateway's bearer path: up means the endpoint
+    answered 2xx to a VALID token. 3xx/401 (login redirect, rejected
+    token) and transport errors are down."""
+    opener = urllib.request.build_opener(_NoRedirect)
+
+    def check() -> bool:
+        try:
+            req = urllib.request.Request(
+                url, headers={"Authorization": f"Bearer {token_source()}"}
+            )
+            with opener.open(req, timeout=timeout_s) as resp:
+                return 200 <= resp.status < 300
+        except urllib.error.HTTPError:
+            return False  # 302-to-login / 401 / 5xx: not available
         except Exception:
             return False
 

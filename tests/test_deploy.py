@@ -291,6 +291,69 @@ class TestProber:
         }
 
 
+class TestAuthenticatedProber:
+    """The reference prober's OIDC dance, e2e against the real gateway
+    (reference kubeflow-readiness.py:144-176: sign a token, probe through
+    IAP): a prober with a valid minted token sees up; a tampered secret
+    sees down — the login redirect must NOT read as availability."""
+
+    def _gateway(self):
+        from kubeflow_tpu.api.gatekeeper import Gatekeeper, hash_password
+        from kubeflow_tpu.api.jwt_auth import JwtValidator
+        from kubeflow_tpu.api.wsgi import Server
+
+        gk = Gatekeeper(
+            "admin",
+            hash_password("pw"),
+            jwt_validator=JwtValidator(hs256_secret=b"probe-secret"),
+        )
+        srv = Server(gk.app)
+        srv.start()
+        return srv
+
+    def test_valid_token_up_tampered_token_down(self):
+        from kubeflow_tpu.deploy.prober import (
+            authenticated_http_check,
+            hs256_token_source,
+        )
+
+        srv = self._gateway()
+        try:
+            url = f"http://127.0.0.1:{srv.port}/auth"
+            good = AvailabilityProber(
+                check=authenticated_http_check(
+                    url, hs256_token_source(b"probe-secret")
+                )
+            )
+            assert good.probe_once() is True
+            bad = AvailabilityProber(
+                check=authenticated_http_check(
+                    url, hs256_token_source(b"wrong-secret")
+                )
+            )
+            assert bad.probe_once() is False
+        finally:
+            srv.stop()
+
+    def test_expired_token_down(self):
+        from kubeflow_tpu.deploy.prober import (
+            authenticated_http_check,
+            hs256_token_source,
+        )
+
+        srv = self._gateway()
+        try:
+            url = f"http://127.0.0.1:{srv.port}/auth"
+            stale = AvailabilityProber(
+                check=authenticated_http_check(
+                    url, hs256_token_source(b"probe-secret", ttl_s=-7200)
+                )
+            )
+            assert stale.probe_once() is False
+        finally:
+            srv.stop()
+
+
 class TestGkeProvider:
     """Second PlatformProvider proving the interface (reference: the GCP
     plugin behind Apply(PLATFORM), kfctlServer.go:221; fake client tier
