@@ -391,8 +391,11 @@ bool tcp_barrier_coordinator(const Options& o, TcpGang* g, long start) {
             // connected-but-unready workers see our FIN and fail fast
             for (auto& c2 : conns) ::close(c2.fd);
             // workers that never connected would retry a dead port until
-            // the deadline — keep accepting briefly to hand them `abort`
-            int expect = o.num_processes - 1 - (int)ready_fd.size();
+            // the deadline — keep accepting briefly to hand them `abort`.
+            // conns counts every live socket (ready, unready, the failing
+            // reporter): those all learned of the abort via send/FIN, so
+            // only the never-connected remainder is worth waiting for.
+            int expect = o.num_processes - 1 - (int)conns.size();
             if (expect > 0) abort_accept_window(fd, expect, o.poll_ms, 5000);
             ::close(fd);
             g->listen_fd = -1;
