@@ -391,7 +391,7 @@ def bench_attention_sweep(lens=(2048, 4096, 8192, 16384, 32768)) -> dict:
     b, h, d = 1, 12, 64
     key = jax.random.PRNGKey(0)
 
-    def timed(fn, *args):
+    def timed(fn, s, *args):
         g = jax.jit(
             jax.grad(
                 lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
@@ -400,9 +400,16 @@ def bench_attention_sweep(lens=(2048, 4096, 8192, 16384, 32768)) -> dict:
         )
         out = g(*args)
         _ = float(jax.device_get(out[0][0, 0, 0, 0]))
+        # short lengths are ms-scale calls where one lucky/unlucky pass
+        # flips the crossover conclusion (observed 8.3–14.8 ms for the
+        # same dense-causal@2k program across runs) — buy stability with
+        # more samples exactly where they are cheap
+        passes, iters = (4, 16) if s <= 4096 else (3, 8)
         return _min_of_n(
             lambda: g(*args),
             lambda out: float(jax.device_get(out[0][0, 0, 0, 0])),
+            passes=passes,
+            iters=iters,
         )
 
     variants = {
@@ -424,7 +431,7 @@ def bench_attention_sweep(lens=(2048, 4096, 8192, 16384, 32768)) -> dict:
         row = {}
         for name, fn in variants.items():
             try:
-                row[f"{name}_ms"] = round(timed(fn, q, k, v) * 1e3, 2)
+                row[f"{name}_ms"] = round(timed(fn, s, q, k, v) * 1e3, 2)
             except Exception as e:  # noqa: BLE001 - OOM expected at long S
                 row[f"{name}_ms"] = None
                 row[f"{name}_error"] = type(e).__name__
@@ -923,7 +930,6 @@ def bench_ring_microbench(local_len: int = 8192) -> dict:
     directions; a v5e-16 {data:2, sequence:8} 64k-context job runs this
     exact body per ring step."""
     import functools
-    import time
 
     import jax
     import jax.numpy as jnp

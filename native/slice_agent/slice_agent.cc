@@ -392,10 +392,13 @@ bool tcp_barrier_coordinator(const Options& o, TcpGang* g, long start) {
             for (auto& c2 : conns) ::close(c2.fd);
             // workers that never connected would retry a dead port until
             // the deadline — keep accepting briefly to hand them `abort`.
-            // conns counts every live socket (ready, unready, the failing
-            // reporter): those all learned of the abort via send/FIN, so
-            // only the never-connected remainder is worth waiting for.
-            int expect = o.num_processes - 1 - (int)conns.size();
+            // Count by READY workers, not live sockets: conns can hold
+            // stray clients (health probes) and unpruned dead sockets,
+            // and an inflated count would SKIP the window and strand a
+            // straggler. Ready-based counting only over-waits, and that
+            // is bounded by the window (connected-but-unready workers we
+            // re-count here fail fast on our FIN anyway).
+            int expect = o.num_processes - 1 - (int)ready_fd.size();
             if (expect > 0) abort_accept_window(fd, expect, o.poll_ms, 5000);
             ::close(fd);
             g->listen_fd = -1;
