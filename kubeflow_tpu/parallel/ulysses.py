@@ -14,11 +14,23 @@ attention (parallel/ring_attention.py):
   head count divides the mesh axis and the full-sequence scores fit
   per-device memory (intra-host / moderate lengths).
 
-Pure GSPMD: the all_to_alls are *implied* by moving the `sequence` mesh
-axis from the seq dim to the heads dim with sharding constraints — XLA
-partitions head-sharded dense attention with no communication inside the
-attention itself. No manual collectives, so the same code runs unsharded
-(constraints no-op) and composes with DP/FSDP on the batch dim.
+Two execution paths, same numerics:
+
+- impl="flash" (default on a real sequence mesh): shard_map over the
+  sequence axis with EXPLICIT `lax.all_to_all`s (seq-sharding → head-
+  sharding and back), each device running the pallas flash kernel over
+  the full sequence for its head subset — the single-chip kernel wins
+  (blockwise VMEM streaming, causal block skipping) apply inside this SP
+  path exactly as they do inside ring attention. Kernel choice per
+  device follows the measured auto policy (dense still wins short
+  sequences bidirectionally).
+- impl="dense": the original pure-GSPMD formulation — the all_to_alls
+  are *implied* by moving the `sequence` mesh axis from the seq dim to
+  the heads dim with sharding constraints; XLA partitions head-sharded
+  dense attention with no communication inside the attention itself.
+
+Both compose with DP/FSDP on the batch dim and no-op without a sequence
+mesh axis.
 """
 
 from __future__ import annotations
@@ -62,6 +74,29 @@ def _constrain(x, template: Tuple[Union[None, str, Tuple[str, ...]], ...]):
     return jax.lax.with_sharding_constraint(x, P(*out))
 
 
+def _flash_or_dense_local(q, k, v, mask, dtype, causal: bool, force=None):
+    """Per-device attention over the full sequence for a head subset:
+    the measured auto policy picks the kernel (flash wins causal ≥4k and
+    bidirectional ≥8k on v5e; XLA's fused dense wins below — the
+    crossover table in docs/PERF.md). `force` overrides the policy
+    ("flash"|"dense" — tests exercise the kernel path hermetically off
+    TPU, where the policy always answers dense)."""
+    from kubeflow_tpu.ops.attention import auto_attention_impl
+    from kubeflow_tpu.ops.flash_attention import flash_attention
+
+    b, s, h, d = q.shape
+    impl = force or auto_attention_impl(
+        b, s, h, str(jnp.dtype(dtype)), causal=causal
+    )
+    if impl == "flash":
+        return flash_attention(
+            q, k, v,
+            mask=None if mask is None else mask.astype(jnp.int32),
+            causal=causal,
+        ).astype(dtype)
+    return dense_attention(q, k, v, mask=mask, dtype=dtype, causal=causal)
+
+
 def ulysses_attention(
     q: jax.Array,
     k: jax.Array,
@@ -69,14 +104,86 @@ def ulysses_attention(
     mask: Optional[jax.Array] = None,
     dtype=jnp.bfloat16,
     causal: bool = False,
+    impl: str = "flash",
+    local_impl: Optional[str] = None,
 ) -> jax.Array:
     """Attention over [B, S, H, D] inputs sharded on the sequence axis.
 
-    heads must be divisible by the `sequence` mesh axis size (checked by
-    the partitioner at compile time — e.g. 12 heads on sequence=4).
-    causal=True works unchanged: each device holds its heads' FULL
-    sequence after the all_to_all, so the autoregressive mask is local.
+    heads must be divisible by the `sequence` mesh axis size (e.g. 12
+    heads on sequence=4). causal=True works unchanged: each device holds
+    its heads' FULL sequence after the all_to_all, so the autoregressive
+    mask is local.
+
+    impl="flash" runs explicit all_to_alls in shard_map with the pallas
+    kernel per device (auto-policied); impl="dense" keeps the pure-GSPMD
+    constraint formulation.
     """
+    mesh = get_abstract_mesh()
+    seq_real = (
+        mesh is not None
+        and "sequence" in mesh.axis_names
+        and mesh.shape["sequence"] > 1
+    )
+    if seq_real:
+        n = mesh.shape["sequence"]
+        if q.shape[1] % n != 0 or q.shape[2] % n != 0:
+            # BOTH formulations need even shards (shard_map rejects the
+            # specs; GSPMD's with_sharding_constraint rejects the layout)
+            # — fail early with the actual requirement instead of a
+            # cryptic partitioner error deep in either path
+            raise ValueError(
+                f"ulysses attention needs seq_len {q.shape[1]} and heads "
+                f"{q.shape[2]} divisible by the sequence mesh axis {n}"
+            )
+    if impl == "flash" and seq_real:
+
+        def inner(q_, k_, v_, m_):
+            # seq-shard -> head-shard: split the heads dim across the
+            # axis, concatenate the sequence shards (explicit all_to_all
+            # over ICI — the same wire traffic GSPMD infers, but the
+            # local compute becomes a pallas call, which GSPMD cannot
+            # auto-partition)
+            def scatter(x):
+                return jax.lax.all_to_all(
+                    x, "sequence", split_axis=2, concat_axis=1, tiled=True
+                )
+
+            qh, kh, vh = scatter(q_), scatter(k_), scatter(v_)
+            full_mask = (
+                None
+                if m_ is None
+                else jax.lax.all_gather(
+                    m_, "sequence", axis=1, tiled=True
+                )
+            )
+            o = _flash_or_dense_local(
+                qh, kh, vh, full_mask, dtype, causal, force=local_impl
+            )
+            # head-shard -> seq-shard (the inverse all_to_all)
+            return jax.lax.all_to_all(
+                o, "sequence", split_axis=1, concat_axis=2, tiled=True
+            )
+
+        qkv_spec = P(None, "sequence", None, None)
+        if mask is None:
+            mapped = jax.shard_map(
+                lambda q_, k_, v_: inner(q_, k_, v_, None),
+                in_specs=(qkv_spec,) * 3,
+                out_specs=qkv_spec,
+                axis_names={"sequence"},
+                check_vma=False,
+            )
+            return mapped(q, k, v)
+        mapped = jax.shard_map(
+            inner,
+            in_specs=(qkv_spec,) * 3 + (P(None, "sequence"),),
+            out_specs=qkv_spec,
+            axis_names={"sequence"},
+            check_vma=False,
+        )
+        return mapped(q, k, v, mask)
+
+    # pure-GSPMD dense path (also the no-sequence-mesh fallback)
     # scatter: seq-sharded -> head-sharded (XLA inserts the all_to_all)
     q = _constrain(q, HEAD_SHARDED)
     k = _constrain(k, HEAD_SHARDED)
