@@ -126,15 +126,19 @@ def ulysses_attention(
     )
     if seq_real:
         n = mesh.shape["sequence"]
-        if q.shape[1] % n != 0 or q.shape[2] % n != 0:
-            # BOTH formulations need even shards (shard_map rejects the
-            # specs; GSPMD's with_sharding_constraint rejects the layout)
-            # — fail early with the actual requirement instead of a
-            # cryptic partitioner error deep in either path
+        if q.shape[1] % n != 0:
+            # an indivisible SEQUENCE dim fails both formulations (the
+            # outputs must re-shard to P(..., "sequence") either way) —
+            # fail early with the actual requirement instead of a cryptic
+            # partitioner error deep in either path
             raise ValueError(
-                f"ulysses attention needs seq_len {q.shape[1]} and heads "
-                f"{q.shape[2]} divisible by the sequence mesh axis {n}"
+                f"ulysses attention needs seq_len {q.shape[1]} divisible "
+                f"by the sequence mesh axis {n}"
             )
+        if q.shape[2] % n != 0:
+            # indivisible HEADS only block the shard_map/flash path; the
+            # GSPMD formulation pads uneven head shards and stays correct
+            impl = "dense"
     if impl == "flash" and seq_real:
 
         def inner(q_, k_, v_, m_):

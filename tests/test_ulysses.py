@@ -139,9 +139,9 @@ class TestUlyssesNumerics:
             np.asarray(got[1]), np.asarray(want[1]), rtol=2e-3, atol=2e-3
         )
 
-    def test_indivisible_length_fails_with_clear_error(self, devices8):
-        """S (or H) not divisible by the sequence axis was never
-        supported — both formulations reject the layout — but the error
+    def test_indivisible_seq_len_fails_with_clear_error(self, devices8):
+        """S not divisible by the sequence axis was never supported —
+        both formulations must re-shard outputs along it — but the error
         should state the requirement, not a partitioner internal."""
         b, s, h, d = 2, 30, 4, 16  # 30 % 4 != 0
         key = jax.random.PRNGKey(3)
@@ -157,6 +157,28 @@ class TestUlyssesNumerics:
                         q, k, v, dtype=jnp.float32
                     )
                 )(q, k, v)
+
+    def test_indivisible_heads_fall_through_to_gspmd(self, devices8):
+        """Heads not divisible by the sequence axis only block the
+        shard_map/flash path: the GSPMD formulation pads uneven head
+        shards, so 6 heads on a 4-wide sequence axis keeps working."""
+        b, s, h, d = 2, 32, 6, 16  # 6 % 4 != 0
+        key = jax.random.PRNGKey(4)
+        q, k, v = (
+            jax.random.normal(jax.random.fold_in(key, i), (b, s, h, d))
+            for i in range(3)
+        )
+        mesh = seq_mesh(devices8)
+        want = dense_reference(q, k, v, None)
+        with jax.set_mesh(mesh):
+            got = jax.jit(
+                lambda q, k, v: ulysses_attention(
+                    q, k, v, dtype=jnp.float32, impl="flash"
+                )
+            )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
 
     def test_unsharded_context_is_noop(self):
         b, s, h, d = 2, 16, 4, 8
