@@ -8,26 +8,32 @@ launcher.py:68-88). The reference publishes no numbers (BASELINE.md), so
 tf_cnn_benchmarks figure for the reference's target hardware: ResNet-50,
 batch 32/GPU, fp32, single V100 ≈ 341 images/sec.
 
-Three measurements (BASELINE.md's config list):
+The battery (BASELINE.md's config list, ordered by headline importance —
+the budget sheds from the tail):
 
-1. **ResNet-50 train step** (the headline): images/sec/chip, plus honest
-   accounting from XLA's own cost model — MFU (model-flops utilization
-   against the chip's bf16 peak) and HBM roofline utilization
-   (bytes-accessed/step over peak HBM bandwidth), both via
-   `jit(...).lower().compile().cost_analysis()` on the measured program.
-2. **BERT-base pretrain step** (the Horovod-BERT config): tokens/sec with
-   the pallas flash-attention kernel on TPU (ops/flash_attention.py), and
-   the dense-attention step time for comparison — the kernel is
-   load-bearing here, not shelf-ware.
-3. **StudyJob trials/hr** (the Katib-equivalent north-star metric): wall
-   clock for a real HP-search study — grid suggestions → gang trial jobs →
-   real XLA training per trial → best-trial selection — through the actual
-   control plane (controllers/studyjob.py + tpujob.py + the in-process
-   trainer runner).
+1.  **ResNet-50 train step** (the headline): images/sec/chip, with MFU and
+    HBM-roofline utilization from XLA's cost model AND the analytic
+    formula (the cost model cannot see pallas custom-call FLOPs).
+2.  **GPT decode** (KV cache, fused prefill+scan): tokens/sec at batch 8
+    plus a batch sweep (decode is HBM-bound; batch amortizes weight reads).
+3.  **BERT base/large pretrain steps**: tokens/sec, both kernels.
+4.  **32k long-context train step**, per-chip batch swept {1,2,4} — the
+    long-context north star, end to end.
+5.  **StudyJob trials/hr** (the Katib-equivalent north-star metric)
+    through the actual control plane, with steady-state per-trial
+    throughput (compile fenced out).
+6.  **Serving latency** incl. 4-client concurrency, on-server
+    parse/transfer/device decomposition, and fused-batch evidence.
+7.  **Attention sweep** (flash vs dense, both directions, 2k-32k), the
+    **ring-attention step body** microbench, and the cache-less decode
+    floor.
 
 All secondary numbers ride as extra keys on the single JSON line; the
 primary metric/value/unit/vs_baseline contract is unchanged. Sub-benches
-degrade to null on failure rather than sinking the headline number.
+degrade to null on failure rather than sinking the headline number. Every
+entry runs in its own bounded subprocess against a shared persistent
+compile cache; the cumulative summary re-prints after every entry so a
+hard kill never loses finished work.
 
 Measurement discipline: warmups round-trip a scalar to the host —
 `block_until_ready` alone does not guarantee prior async work through a
@@ -488,14 +494,19 @@ def bench_serving(batch: int = 8, requests: int = 30) -> dict:
     # p99 8.6 s vs p50 1.3 s — the compile, not the serving path)
     served.warmup((224, 224, 3), np.float32, max_rows=4 * batch)
     def timed_requests(url, payload, content_type, check):
-        """Warm up once, then time `requests` POSTs; returns latency stats."""
+        """Warm up once, then time `requests` POSTs; returns latency stats
+        (plus the server's device-call split from the final response's
+        headers, when the endpoint emits them)."""
+        last_headers = [None]  # the HTTPMessage (case-insensitive lookup)
 
         def call():
             req = urllib.request.Request(
                 url, data=payload, headers={"Content-Type": content_type}
             )
             with urllib.request.urlopen(req, timeout=120) as resp:
-                return resp.read()
+                body = resp.read()
+                last_headers[0] = resp.headers
+                return body
 
         check(call())  # warmup: compile + materialize
         lat = []
@@ -504,13 +515,21 @@ def bench_serving(batch: int = 8, requests: int = 30) -> dict:
             call()
             lat.append(time.monotonic() - t0)
         lat.sort()
-        return {
+        stats = {
             "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
             "p99_ms": round(
                 lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 2
             ),
             "qps": round(requests / sum(lat), 1),
         }
+        for key, hdr in (
+            ("server_transfer_in_ms", "X-Transfer-In-Ms"),
+            ("server_device_ms", "X-Device-Ms"),
+            ("server_transfer_out_ms", "X-Transfer-Out-Ms"),
+        ):
+            if last_headers[0] is not None and last_headers[0].get(hdr):
+                stats[key] = float(last_headers[0][hdr])
+        return stats
 
     def concurrent_npy(url, payload, clients: int, per_client: int):
         """4× concurrent clients on the binary path (threaded server +
