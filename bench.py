@@ -670,6 +670,100 @@ def bench_serving(batch: int = 8, requests: int = 30) -> dict:
     }
 
 
+def _gpt_small_with_params(max_len: int, scan_layers: bool = True):
+    """gpt_small + jit-initialized params — the decode benches' shared
+    setup. The init is jitted because eager init dispatches thousands of
+    tiny ops one round trip at a time over a remote-device transport, and
+    params are returned SEPARATELY so callers pass them as jit arguments
+    (closure-captured params embed ~250 MB of weights as program
+    constants, which the tunneled remote-compile endpoint cannot swallow
+    — the root cause of three rounds of null decode entries)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.registry import get_model
+
+    model = get_model(
+        "gpt_small", dtype=jnp.bfloat16, scan_layers=scan_layers,
+        max_len=max_len,
+    )
+    params = jax.jit(
+        lambda rng: model.init(
+            rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
+        )
+    )(jax.random.PRNGKey(0))["params"]
+    return model, params
+
+
+def bench_serving_generate(
+    batch: int = 4, prompt_len: int = 32, new_tokens: int = 32,
+    requests: int = 8,
+) -> dict:
+    """LM decode THROUGH the REST surface (`:generate` on the model
+    server): JSON prompt_ids in, sequences out — the serving half of the
+    decode story (bench_generate measures the raw program; this measures
+    what a client of the platform sees, wire + LRU-compiled programs +
+    KV-cache decode)."""
+    import json as _json
+    import time
+    import urllib.request
+
+    from kubeflow_tpu.api.wsgi import Server
+    from kubeflow_tpu.serving.generate import ServedLm
+    from kubeflow_tpu.serving.server import ModelServer
+
+    max_len = prompt_len + new_tokens + 64
+    model, params = _gpt_small_with_params(max_len)
+    lm = ServedLm("gpt", model, params, max_batch=batch)
+    server = ModelServer()
+    server.add_lm(lm)
+    srv = Server(server.app, port=0)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/v1/models/gpt:generate"
+        import numpy as np
+
+        prompts = np.random.default_rng(0).integers(
+            0, 50257, (batch, prompt_len)
+        ).tolist()
+        body = _json.dumps(
+            {"prompt_ids": prompts, "max_new_tokens": new_tokens}
+        ).encode()
+
+        def call():
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"}
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                return _json.loads(resp.read())
+
+        out = call()  # compile + materialize
+        assert len(out["sequences"][0]) == prompt_len + new_tokens
+        lat = []
+        for _ in range(requests):
+            t0 = time.monotonic()
+            call()
+            lat.append(time.monotonic() - t0)
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        return {
+            "model": "gpt_small",
+            "batch": batch,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            # the decode step attends over the WHOLE cache buffer —
+            # numbers at different max_len are not comparable
+            "max_len": max_len,
+            "p50_ms": round(p50 * 1e3, 2),
+            "p99_ms": round(lat[-1] * 1e3, 2),
+            "rest_generate_tokens_per_sec": round(
+                batch * new_tokens / p50, 1
+            ),
+        }
+    finally:
+        srv.stop()
+
+
 def bench_generate(
     batch: int = 8,
     prompt_len: int = 64,
@@ -691,7 +785,6 @@ def bench_generate(
     import jax
     import jax.numpy as jnp
 
-    from kubeflow_tpu.models.registry import get_model
     from kubeflow_tpu.serving.generate import greedy_generate
 
     # max_len bounds the KV cache the decode step attends over — sized to
@@ -699,21 +792,7 @@ def bench_generate(
     # model's full 1024: short-context decode is the honest serving shape
     # for this batch, and numbers at different max_len are not comparable
     max_len = prompt_len + new_tokens + 64
-    model = get_model(
-        "gpt_small", dtype=jnp.bfloat16, scan_layers=True, max_len=max_len
-    )
-    # jit the init: eager init dispatches thousands of tiny ops one round
-    # trip at a time over a remote-device transport
-    params = jax.jit(
-        lambda rng: model.init(
-            rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
-        )
-    )(jax.random.PRNGKey(0))["params"]
-    # params ride as an ARGUMENT, never a closure: captured params embed
-    # ~250 MB of weights as constants in the serialized program, which the
-    # tunneled remote-compile endpoint cannot swallow (the root cause of
-    # three rounds of null generate entries — train steps always passed
-    # params as args and compiled fine)
+    model, params = _gpt_small_with_params(max_len)
     fn = jax.jit(
         lambda params, p: greedy_generate(model, params, p, new_tokens)
     )
@@ -779,23 +858,12 @@ def bench_generate_stepwise(
     import jax
     import jax.numpy as jnp
 
-    from kubeflow_tpu.models.registry import get_model
-
     max_len = prompt_len + new_tokens + 64
-    model = get_model(
-        "gpt_small", dtype=jnp.bfloat16, scan_layers=True, max_len=max_len
-    )
+    model, params = _gpt_small_with_params(max_len)
     prompt = jax.random.randint(
         jax.random.PRNGKey(0), (batch, prompt_len), 0, 50257
     ).astype(jnp.int32)
-    params = jax.jit(
-        lambda rng: model.init(
-            rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
-        )
-    )(jax.random.PRNGKey(0))["params"]
 
-    # params as arguments (see bench_generate: closure-captured params
-    # embed the weights as constants and kill the tunneled compile)
     prefill = jax.jit(
         lambda params, p: model.apply(
             {"params": params}, p, prefill=True, mutable=["cache"]
@@ -855,22 +923,11 @@ def bench_generate_micro(batch: int = 4, prompt_len: int = 32) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from kubeflow_tpu.models.registry import get_model
-
     max_len = prompt_len + 16
-    model = get_model(
-        "gpt_small", dtype=jnp.bfloat16, scan_layers=False, max_len=max_len
-    )
+    model, params = _gpt_small_with_params(max_len, scan_layers=False)
     prompt = jax.random.randint(
         jax.random.PRNGKey(0), (batch, prompt_len), 0, 50257
     ).astype(jnp.int32)
-    params = jax.jit(
-        lambda rng: model.init(
-            rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
-        )
-    )(jax.random.PRNGKey(0))["params"]
-    # params as arguments (see bench_generate: closure capture kills the
-    # tunneled compile by embedding the weights as constants)
     prefill = jax.jit(
         lambda params, p: model.apply(
             {"params": params}, p, prefill=True, mutable=["cache"]
@@ -920,27 +977,13 @@ def bench_generate_nocache(batch: int = 8, context_len: int = 128) -> dict:
     this tier measures the cache-less decode cost, which is also the
     honest baseline the KV cache is supposed to beat. mode marks the
     number as non-comparable to cached tiers."""
-    import time
-
     import jax
     import jax.numpy as jnp
 
-    from kubeflow_tpu.models.registry import get_model
-
-    model = get_model(
-        "gpt_small", dtype=jnp.bfloat16, scan_layers=False,
-        max_len=context_len,
-    )
+    model, params = _gpt_small_with_params(context_len, scan_layers=False)
     ids = jax.random.randint(
         jax.random.PRNGKey(0), (batch, context_len), 0, 50257
     ).astype(jnp.int32)
-    params = jax.jit(
-        lambda rng: model.init(
-            rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
-        )
-    )(jax.random.PRNGKey(0))["params"]
-    # params as arguments (see bench_generate: closure capture kills the
-    # tunneled compile by embedding the weights as constants)
     fwd = jax.jit(
         lambda params, ids: jnp.argmax(
             model.apply({"params": params}, ids, deterministic=True)[
@@ -1375,6 +1418,8 @@ def _entry_specs(batch: int, steps: int):
         ),
         # the ring step body, flash vs dense blocks (the SP path's kernel)
         ("ring_attention", "bench_ring_microbench()", 300, None, True),
+        # decode through the REST surface (what a platform client sees)
+        ("serving_generate", "bench_serving_generate()", 300, None, False),
         # the cache-less decode baseline the KV cache is supposed to beat;
         # one plain-forward compile, cheap at the tail
         ("generate_floor", "bench_generate_nocache()", 240, None, False),
@@ -1408,6 +1453,7 @@ def _summary(results: dict, batch: int, complete: bool, t0: float) -> dict:
         "generate": results.get("generate"),
         "generate_floor": results.get("generate_floor"),
         "ring_attention": results.get("ring_attention"),
+        "serving_generate": results.get("serving_generate"),
         "long_context_attention": results.get("long_context_attention"),
         "attention_sweep": sweep or None,
         "device_kind": probe.get("device_kind"),
