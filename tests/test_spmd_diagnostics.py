@@ -71,6 +71,17 @@ class TestNoInvoluntaryRemat:
             {"attention_impl": "ring"},
         )
 
+    def test_sp_ulysses_mesh_bert(self, devices8):
+        """Ulysses' round-5 shard_map formulation (explicit all_to_alls +
+        per-device kernel) must compile remat-free on a real sequence
+        mesh, like the ring plans."""
+        _compile_and_check(
+            "bert_tiny",
+            {"data": 4, "sequence": 2},
+            MlmTask,
+            {"attention_impl": "ulysses"},
+        )
+
     def test_pp_1f1b_mesh_gpt(self, devices8):
         """1f1b selected through the CONFIG tree, not a model kwarg
         (TrainingConfig.pipeline_schedule → Trainer → pipeline_scan):
