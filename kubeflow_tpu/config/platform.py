@@ -183,8 +183,19 @@ class DataConfig(ConfigNode):
         help="training augmentation: none | crop_flip (device-side "
         "random-resized-crop + horizontal flip, training/augment.py)",
     )
+    prefetch_depth: int = config_field(
+        default=2,
+        help="host-fed input pipeline read-ahead: a background thread "
+        "synthesizes + device-transfers up to this many future batches "
+        "while the current step runs (training/prefetch.py) — overlap "
+        "instead of serial host time per step. 0 = synchronous path. "
+        "Batches stay keyed by step index, so any depth trains on the "
+        "bitwise-identical sequence (resume/restart safe).",
+    )
 
     def validate(self) -> None:
+        if self.prefetch_depth < 0:
+            raise ConfigError("data.prefetch_depth must be >= 0")
         if self.name not in ("synthetic", "blobs", "npz"):
             raise ConfigError(
                 f"data.name must be synthetic|blobs|npz, got {self.name!r}"
@@ -258,6 +269,15 @@ class TrainingConfig(ConfigNode):
         default="",
         help="non-empty: serve the jax.profiler capture endpoint "
         "(runtime/profiler.py) writing TB-readable traces here",
+    )
+    compile_cache_dir: str = config_field(
+        default="",
+        help="non-empty: persistent XLA compilation cache directory "
+        "(jax_compilation_cache_dir). The TPUJob controller renders it "
+        "as KFT_COMPILE_CACHE_DIR into every gang pod, so gang restarts "
+        "and StudyJob trials 2..N restore compiled programs from disk "
+        "instead of re-paying the full XLA compile. Point it at storage "
+        "shared across the pods that should share programs (PVC/NFS).",
     )
     seq_len: int = config_field(
         default=0,

@@ -370,6 +370,12 @@ class TPUTrainJobController(Controller):
             # (runtime/profiler.py); a Tensorboard CR fronts the logdir
             env["KFT_PROFILER_LOGDIR"] = profiler_logdir
             env.setdefault("KFT_PROFILER_PORT", "9431")
+        compile_cache = (spec.get("training") or {}).get("compile_cache_dir")
+        if compile_cache:
+            # persistent XLA compile cache (runtime/train_run.py): every
+            # gang member caches its own compiled programs there, so gang
+            # restarts and StudyJob trials 2..N skip the full XLA compile
+            env["KFT_COMPILE_CACHE_DIR"] = compile_cache
         pod = new_object(
             "Pod",
             pod_name,

@@ -97,10 +97,17 @@ class ShardPrefetcher:
                 raise RuntimeError("sl_open failed")
         return self
 
+    def close(self) -> None:
+        """Release the native pool. Idempotent: the handle is detached
+        BEFORE sl_close runs, so a second close (explicit close + context
+        exit, or an error-path close racing __exit__) can never double-free
+        the pool."""
+        handle, self._handle = self._handle, None
+        if handle and self._lib is not None:
+            self._lib.sl_close(handle)
+
     def __exit__(self, *exc) -> None:
-        if self._handle:
-            self._lib.sl_close(self._handle)
-            self._handle = None
+        self.close()
 
     # -- iteration --------------------------------------------------------
 
@@ -129,6 +136,11 @@ class ShardPrefetcher:
             path = (path_p.value or b"").decode()
             if rc < 0:
                 self._lib.sl_release(self._handle, index.value)
+                # tear down NOW and reset _handle: the raise unwinds into
+                # the with block whose __exit__ would otherwise close a
+                # pool the caller may have already torn down while
+                # handling the error (double-free on the native side)
+                self.close()
                 raise OSError(f"shard read failed: {path}")
             blob = ctypes.string_at(data_p, size.value)
             self._lib.sl_release(self._handle, index.value)

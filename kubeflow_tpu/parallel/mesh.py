@@ -161,6 +161,22 @@ def mesh_from_config(
     return build_mesh(MeshSpec.from_config(cfg), devices=devices, num_slices=num_slices)
 
 
+def set_mesh(mesh: Mesh):
+    """Version-portable ambient-mesh context: `with set_mesh(mesh): ...`.
+
+    `jax.set_mesh` only exists on recent jax; older runtimes (the CPU CI
+    image) spell the same thing `jax.sharding.use_mesh`, and before that
+    the Mesh itself was the context manager (the legacy pjit global mesh).
+    All three make bare-PartitionSpec `with_sharding_constraint`s resolve
+    against the mesh, which is all the training path needs.
+    """
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    if hasattr(jax.sharding, "use_mesh"):
+        return jax.sharding.use_mesh(mesh)
+    return mesh
+
+
 def single_device_mesh() -> Mesh:
     """A 1-device mesh with the full axis vocabulary (all sizes 1 except data).
 

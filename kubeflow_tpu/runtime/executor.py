@@ -224,6 +224,23 @@ class SubprocessPodRunner(PodRunner):
         coord, barrier = self._gang_ports[key]
         return coord, barrier, restarts
 
+    @staticmethod
+    def _cleanup_meta(meta: Dict[str, Any]) -> None:
+        """Close AND unlink a child's log files (they are delete=False temp
+        files — close alone leaked one .out/.err pair per pod per gang
+        generation onto disk for the life of the process)."""
+        import os
+
+        for f in (meta["stdout"], meta["stderr"]):
+            try:
+                f.close()
+            except Exception:  # noqa: BLE001 - already closed is fine
+                pass
+            try:
+                os.unlink(f.name)
+            except OSError:
+                pass
+
     def _reap_orphans(self) -> None:
         """Kill children whose pods were deleted (gang teardown/restart)."""
         for uid, meta in list(self._procs.items()):
@@ -240,8 +257,7 @@ class SubprocessPodRunner(PodRunner):
                 if proc.poll() is None:
                     proc.kill()
                     proc.wait(timeout=10)
-                for f in (meta["stdout"], meta["stderr"]):
-                    f.close()
+                self._cleanup_meta(meta)
                 del self._procs[uid]
 
     def _spawn(self, pod: Dict[str, Any], env_block: Dict[str, str]):
@@ -296,7 +312,9 @@ class SubprocessPodRunner(PodRunner):
                 "--",
             ] + payload
         # temp files, not pipes: a chatty child would fill a pipe buffer
-        # and deadlock against the polling executor
+        # and deadlock against the polling executor. stop_all() removes the
+        # workdir tree, so a reused runner must re-create it first.
+        os.makedirs(self._workdir, exist_ok=True)
         out_f = tempfile.NamedTemporaryFile(
             "w+", dir=self._workdir, suffix=".out", delete=False
         )
@@ -360,15 +378,19 @@ class SubprocessPodRunner(PodRunner):
             return FAILED, {"reason": "NonzeroExit", "message": tail}
 
     def stop_all(self) -> None:
-        """Kill every child (test teardown)."""
+        """Kill every child and reclaim all disk (test teardown)."""
+        import shutil
+
         with self._lock:
             for meta in self._procs.values():
                 if meta["proc"].poll() is None:
                     meta["proc"].kill()
                     meta["proc"].wait(timeout=10)
-                for f in (meta["stdout"], meta["stderr"]):
-                    f.close()
+                self._cleanup_meta(meta)
             self._procs.clear()
+            # the workdir also holds slice_agent shared dirs; the whole
+            # tree is this runner's scratch space and dies with it
+            shutil.rmtree(self._workdir, ignore_errors=True)
 
     def kill_member(self, pod_name: str) -> bool:
         """Fault injection: kill the child of a named pod (crash a real

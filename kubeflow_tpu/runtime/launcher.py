@@ -61,7 +61,10 @@ def run(config_path: Optional[str] = None, steps: Optional[int] = None) -> int:
     from kubeflow_tpu.config.core import from_dict
     from kubeflow_tpu.config.platform import TrainingConfig
     from kubeflow_tpu.parallel.distributed import initialize_from_env
-    from kubeflow_tpu.runtime.train_run import run_training
+    from kubeflow_tpu.runtime.train_run import (
+        configure_compile_cache,
+        run_training,
+    )
 
     if config_path:
         import yaml  # YAML is a JSON superset; one loader covers both
@@ -72,6 +75,13 @@ def run(config_path: Optional[str] = None, steps: Optional[int] = None) -> int:
         spec = json.loads(os.environ.get(ENV_TRAINING_SPEC, "{}"))
     cfg = from_dict(TrainingConfig, spec)
     cfg.validate()
+
+    # before ANY compile (distributed init compiles collectives): restarts
+    # of this gang and sibling StudyJob trials restore programs from the
+    # controller-rendered KFT_COMPILE_CACHE_DIR instead of recompiling
+    cache_dir = configure_compile_cache(cfg)
+    if cache_dir:
+        log.info("persistent XLA compile cache: %s", cache_dir)
 
     gang = initialize_from_env()
     import jax

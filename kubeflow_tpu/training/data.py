@@ -15,6 +15,7 @@ TPU-first concerns handled here:
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Iterator, Optional
 
 import jax
@@ -161,6 +162,16 @@ def batch_spec(batch: Dict[str, np.ndarray]) -> Dict[str, P]:
     return {k: P(("data", "fsdp")) for k in batch}
 
 
+@functools.lru_cache(maxsize=8)
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """The one batch NamedSharding per mesh, memoized out of the hot loop:
+    make_global_batch runs every step (and, with the prefetcher, from a
+    background thread concurrently with the step) — rebuilding the
+    sharding per key per call was pure per-step overhead. Meshes are
+    hashable and few per process; the small LRU holds them all."""
+    return NamedSharding(mesh, P(("data", "fsdp")))
+
+
 def make_global_batch(
     batch: Dict[str, np.ndarray],
     mesh: Mesh,
@@ -175,9 +186,9 @@ def make_global_batch(
     correct for any device→process layout. `local_slice` alternatively
     feeds pre-sliced host-local rows via make_array_from_process_local_data.
     """
+    sharding = batch_sharding(mesh)
     out = {}
     for k, v in batch.items():
-        sharding = NamedSharding(mesh, P(("data", "fsdp")))
         if jax.process_count() == 1:
             out[k] = jax.device_put(np.asarray(v), sharding)
         elif local_slice is not None:

@@ -1,0 +1,169 @@
+"""Double-buffered host→device input prefetch for the train loop.
+
+`Trainer.fit` was fully serial: host batch synthesis, the blocking
+host→device transfer, and the XLA step dispatch ran one after another, so
+the device idled for the entire host-side data time of every step. The
+standard accelerator-feeding discipline (the tf_cnn_benchmarks staged input
+pipeline the reference harness descends from) overlaps the two:
+`DevicePrefetcher` pulls `get_batch(i)` for future steps on a background
+thread and eagerly assembles the sharded global `jax.Array` for step i+1
+while step i runs on device. The train step donates only the state, so
+queued device batches are never aliased by a running program.
+
+Design points:
+- **bounded**: at most `depth` assembled batches are resident (numpy +
+  device memory per slot), so a fast producer cannot outrun HBM,
+- **index-keyed determinism**: the worker walks absolute step indices
+  [start_step, end_step) in order and the consumer asserts it receives
+  exactly the step it asked for — a resumed/restarted run replays the
+  identical batch sequence because `get_batch(i)` is a pure function of i,
+- **exception propagation**: a worker failure (bad shard, OOM during
+  device_put) surfaces in the consumer's `get()` as the original exception,
+  at the step it would have fed — never a silent hang,
+- **clean shutdown**: `close()` wakes a blocked worker, joins the (non-
+  daemon) thread, and is idempotent; `Trainer.fit` closes in a finally so
+  early-stop and FloatingPointError exits cannot leak the thread.
+
+A thread (not asyncio) because the host work is numpy/`jax.device_put`
+bound, both of which release the GIL — the overlap is real parallelism.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Tuple
+
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import prefetch_queue_depth_gauge
+
+log = get_logger(__name__)
+
+# queue sentinel: the worker failed; the consumer raises self._error
+_ERROR = object()
+
+
+class DevicePrefetcher:
+    """Background producer of (batch_np, device_batch) keyed by step index.
+
+    with DevicePrefetcher(get_batch, assemble, s0, s1, depth=2) as pf:
+        for i in range(s0, s1):
+            batch_np, batch = pf.get(i)
+    """
+
+    def __init__(
+        self,
+        get_batch: Callable[[int], Dict[str, Any]],
+        assemble: Callable[[Dict[str, Any]], Dict[str, Any]],
+        start_step: int,
+        end_step: int,
+        depth: int = 2,
+        model_label: str = "",
+    ) -> None:
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._get_batch = get_batch
+        self._assemble = assemble
+        self._start = start_step
+        self._end = end_step
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self._model = model_label
+        self._gauge = prefetch_queue_depth_gauge()
+        # non-daemon on purpose: a leak must be loud (the conftest thread
+        # guard fails any test that drops one), not silently reaped at exit
+        self._thread = threading.Thread(
+            target=self._run, name="device-prefetcher", daemon=False
+        )
+        self._started = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "DevicePrefetcher":
+        self._started = True
+        self._thread.start()
+        return self
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        """Stop the worker and join it. Idempotent; safe mid-stream."""
+        self._stop.set()
+        # drain so a worker blocked on a full queue wakes and sees the stop
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        if self._started:
+            self._thread.join(timeout=30)
+            if self._thread.is_alive():  # pragma: no cover - defensive
+                log.error("device-prefetcher failed to join within 30s")
+        self._gauge.set(0, model=self._model)
+
+    # -- producer ---------------------------------------------------------
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+            except queue.Full:
+                continue
+            self._gauge.set(self._queue.qsize(), model=self._model)
+            return True
+        return False
+
+    def _run(self) -> None:
+        try:
+            for i in range(self._start, self._end):
+                if self._stop.is_set():
+                    return
+                batch_np = self._get_batch(i)
+                batch_dev = self._assemble(batch_np)
+                if not self._put((i, batch_np, batch_dev)):
+                    return
+        except BaseException as e:  # noqa: BLE001 - re-raised in consumer
+            self._error = e
+            self._put(_ERROR)
+
+    # -- consumer ---------------------------------------------------------
+
+    def get(self, step: int) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Block until step's batch is ready; returns (batch_np, device).
+
+        Raises the worker's exception if production failed, or RuntimeError
+        if the worker died without producing this step.
+        """
+        while True:
+            try:
+                item = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._error is not None:
+                    raise self._error
+                if not self._thread.is_alive():
+                    # the worker may have enqueued its final batch and
+                    # exited between our timeout and this check — drain
+                    # once more before declaring it dead-without-producing
+                    try:
+                        item = self._queue.get_nowait()
+                    except queue.Empty:
+                        raise RuntimeError(
+                            f"prefetch worker exited before producing "
+                            f"step {step}"
+                        ) from None
+                else:
+                    continue
+            if item is _ERROR:
+                raise self._error
+            self._gauge.set(self._queue.qsize(), model=self._model)
+            i, batch_np, batch_dev = item
+            if i != step:  # pragma: no cover - ordering invariant
+                raise RuntimeError(
+                    f"prefetch out of order: wanted step {step}, got {i}"
+                )
+            return batch_np, batch_dev

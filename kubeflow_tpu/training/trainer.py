@@ -32,13 +32,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.config.platform import TrainingConfig
 from kubeflow_tpu.models.registry import get_model
-from kubeflow_tpu.parallel.mesh import mesh_from_config
+from kubeflow_tpu.parallel.mesh import mesh_from_config, set_mesh
 from kubeflow_tpu.parallel.sharding import logical_to_spec
 from kubeflow_tpu.training.annotations import logical_axes_for
 from kubeflow_tpu.training.data import make_global_batch
+from kubeflow_tpu.training.prefetch import DevicePrefetcher
 from kubeflow_tpu.training.tasks import make_optimizer, task_for_model
 from kubeflow_tpu.utils.logging import get_logger
-from kubeflow_tpu.utils.metrics import default_registry
+from kubeflow_tpu.utils.metrics import default_registry, host_wait_histogram
 
 log = get_logger(__name__)
 
@@ -154,7 +155,7 @@ class Trainer:
                 opt_state=opt_state,
             )
 
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             shapes = jax.eval_shape(init_fn, rng)
             shardings = self.state_shardings(shapes)
             state = jax.jit(init_fn, out_shardings=shardings)(rng)
@@ -342,11 +343,11 @@ class Trainer:
     def train_step(self, state: TrainState, batch, rng) -> Tuple[TrainState, Dict]:
         if self._train_step is None:
             if self._state_shardings is None:
-                with jax.set_mesh(self.mesh):
+                with set_mesh(self.mesh):
                     shapes = jax.eval_shape(lambda s: s, state)
                 self._state_shardings = self.state_shardings(shapes)
             self._train_step = self._build_train_step(state)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             return self._train_step(state, batch, rng)
 
     # ---- eval ----------------------------------------------------------
@@ -376,13 +377,13 @@ class Trainer:
         """
         if self._eval_step is None:
             if self._state_shardings is None:
-                with jax.set_mesh(self.mesh):
+                with set_mesh(self.mesh):
                     shapes = jax.eval_shape(lambda s: s, state)
                 self._state_shardings = self.state_shardings(shapes)
             self._eval_step = self._build_eval_step()
         dp = self.mesh.shape.get("data", 1) * self.mesh.shape.get("fsdp", 1)
         correct = count = loss_sum = 0.0
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             # batches padded to a multiple of data*fsdp: a ragged batch
             # cannot be laid out on the mesh (padding masked via eval_mask)
             for batch_np in eval_data.eval_batches(pad_to_multiple=dp):
@@ -429,20 +430,7 @@ class Trainer:
         if state is None:
             state = self.init_state()
         rng = jax.random.PRNGKey(cfg.seed + 1)
-        registry = default_registry()
-        step_hist = registry.histogram(
-            "training_step_seconds", "train step latency", ["model"]
-        )
-        thpt = registry.gauge(
-            "training_items_per_sec", "items (images/tokens) per second", ["model"]
-        )
-        acc_gauge = registry.gauge(
-            "training_eval_top1", "held-out top-1 accuracy", ["model"]
-        )
         start_step = int(jax.device_get(state.step))
-        eval_every = cfg.data.eval_every_steps if eval_data is not None else 0
-        target = cfg.data.target_accuracy if eval_data is not None else 0.0
-        eval_metrics: Dict[str, float] = {}
 
         # multi-host: lazy columns let each host read/decode only its rows
         get_batch = data.batch_at
@@ -472,19 +460,88 @@ class Trainer:
 
                 device_gen = jax.jit(_gen)
 
+        end_step = start_step + steps
+        # host-fed path: overlap batch synthesis + host→device transfer
+        # with the device step. The prefetcher walks the same step indices
+        # get_batch would see, so any depth (including 0, the synchronous
+        # path) trains on the bitwise-identical batch sequence.
+        prefetcher: Optional[DevicePrefetcher] = None
+        if device_gen is None and cfg.data.prefetch_depth > 0 and steps > 0:
+            prefetcher = DevicePrefetcher(
+                get_batch,
+                lambda b: make_global_batch(b, self.mesh),
+                start_step=start_step,
+                end_step=end_step,
+                depth=cfg.data.prefetch_depth,
+                model_label=cfg.model,
+            ).start()
+        try:
+            last = self._fit_loop(
+                state,
+                rng,
+                start_step,
+                end_step,
+                get_batch,
+                device_gen,
+                prefetcher,
+                eval_data,
+                checkpoint_manager,
+                log_every,
+            )
+        finally:
+            # every exit — normal, early-stop, FloatingPointError, eval
+            # crash — must reap the worker thread (no thread survives fit)
+            if prefetcher is not None:
+                prefetcher.close()
+        return last
+
+    def _fit_loop(
+        self,
+        state: TrainState,
+        rng: jax.Array,
+        start_step: int,
+        end_step: int,
+        get_batch,
+        device_gen,
+        prefetcher: Optional[DevicePrefetcher],
+        eval_data,
+        checkpoint_manager,
+        log_every: int,
+    ) -> Optional[StepMetrics]:
+        cfg = self.cfg
+        steps = end_step - start_step
+        registry = default_registry()
+        step_hist = registry.histogram(
+            "training_step_seconds", "train step latency", ["model"]
+        )
+        thpt = registry.gauge(
+            "training_items_per_sec", "items (images/tokens) per second", ["model"]
+        )
+        acc_gauge = registry.gauge(
+            "training_eval_top1", "held-out top-1 accuracy", ["model"]
+        )
+        host_wait = host_wait_histogram()
+        eval_every = cfg.data.eval_every_steps if eval_data is not None else 0
+        target = cfg.data.target_accuracy if eval_data is not None else 0.0
+        eval_metrics: Dict[str, float] = {}
         last: Optional[StepMetrics] = None
         t_last = time.monotonic()
         steps_since_log = 0
         stop_reason = ""
         compile_s = 0.0
-        end_step = start_step + steps
         for i in range(start_step, end_step):
+            t_wait = time.monotonic()
             if device_gen is not None:
                 batch = device_gen(i)
                 batch_np = batch  # count_items reads shapes/small masks
+            elif prefetcher is not None:
+                batch_np, batch = prefetcher.get(i)
             else:
                 batch_np = get_batch(i)
                 batch = make_global_batch(batch_np, self.mesh)
+            # the input-bound signal: ~0 when the prefetcher kept up, the
+            # full host data time when the loop starved waiting on input
+            host_wait.observe(time.monotonic() - t_wait, model=cfg.model)
             state, metrics = self.train_step(state, batch, rng)
             steps_since_log += 1
             if i == start_step and steps > 1:

@@ -285,6 +285,50 @@ def default_registry() -> MetricsRegistry:
     return _default_registry
 
 
+# ---------------------------------------------------------------------------
+# Training input-pipeline / compile-cache metrics (one definition point so
+# the trainer, the prefetcher, and the run driver all hit the same series).
+# ---------------------------------------------------------------------------
+
+# Host-wait spans µs-scale (prefetched hits) to seconds (input-bound steps);
+# the default deployment-latency buckets start at 5 ms and would flatten the
+# entire healthy range into one bucket.
+HOST_WAIT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1, 2.5, 5, 10,
+)
+
+
+def host_wait_histogram() -> Histogram:
+    """Time `Trainer.fit` blocks waiting on host input each step — the
+    "input-bound" signal: near-zero when the prefetcher keeps the device
+    fed, approaching the full host data time when it cannot."""
+    return default_registry().histogram(
+        "training_host_wait_seconds",
+        "seconds the train loop blocked waiting on host input per step",
+        ["model"],
+        buckets=HOST_WAIT_BUCKETS,
+    )
+
+
+def prefetch_queue_depth_gauge() -> Gauge:
+    """Sharded batches sitting ready in the device prefetch queue."""
+    return default_registry().gauge(
+        "training_prefetch_queue_depth",
+        "device-ready batches buffered ahead of the train step",
+        ["model"],
+    )
+
+
+def compile_cache_hits_counter() -> Counter:
+    """Training runs whose XLA programs restored entirely from the
+    persistent compile cache (no new cache entries written)."""
+    return default_registry().counter(
+        "training_compile_cache_hits_total",
+        "training runs served from the persistent XLA compile cache",
+    )
+
+
 def start_heartbeat(
     gauge: Gauge, period_s: float = 10.0, stop_event: Optional[threading.Event] = None
 ) -> threading.Thread:

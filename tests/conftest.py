@@ -22,6 +22,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# NOTE: do NOT point the whole suite at a persistent compile cache here.
+# Tried and reverted: this image's jaxlib (0.4.36) hard-aborts (Fatal
+# Python error) serializing some programs (test_augment's) into the
+# cache, which would take the entire tier down with it. The platform
+# knob stays opt-in per run (KFT_COMPILE_CACHE_DIR / compile_cache_dir;
+# covered by test_compile_cache.py against tmp dirs).
+
 import pytest  # noqa: E402
 
 
@@ -32,3 +39,41 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs[:8]
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_nondaemon_threads():
+    """Fail any test that leaves a live non-daemon thread behind.
+
+    The lifecycle-bearing components (DevicePrefetcher, SubprocessPodRunner
+    children, wsgi servers) must shut their workers down on every exit
+    path; a leaked non-daemon thread hangs interpreter exit in production
+    pods. Autouse fixtures set up first and tear down last, so fixtures
+    that stop servers run before this check. A short grace window lets
+    threads already mid-shutdown finish joining.
+    """
+    import threading
+    import time
+
+    before = set(threading.enumerate())
+    yield
+
+    def leaked():
+        return [
+            t
+            for t in threading.enumerate()
+            if t.is_alive()
+            and not t.daemon
+            and t not in before
+            and t is not threading.current_thread()
+        ]
+
+    deadline = time.monotonic() + 5.0
+    remaining = leaked()
+    while remaining and time.monotonic() < deadline:
+        time.sleep(0.05)
+        remaining = leaked()
+    assert not remaining, (
+        f"test leaked live non-daemon threads: "
+        f"{[t.name for t in remaining]}"
+    )

@@ -64,6 +64,23 @@ class TestPrefetcher:
             with pytest.raises(OSError, match="missing.npz"):
                 next(it)
 
+    def test_read_error_resets_handle_and_double_close_safe(self, tmp_path):
+        """A failed shard read inside the with block must tear the pool
+        down exactly once: the iterator closes + resets _handle before
+        raising, so the context __exit__ (and any explicit close a caller
+        adds while handling the error) is a no-op, never a double-free."""
+        paths = write_shards(tmp_path, n=4)
+        paths.insert(1, str(tmp_path / "missing.npz"))
+        pf = ShardPrefetcher(paths)
+        with pf as shards:
+            it = iter(shards)
+            next(it)
+            with pytest.raises(OSError, match="missing.npz"):
+                next(it)
+            assert pf._handle is None  # error path already tore down
+            pf.close()  # caller cleanup during handling: safe
+        pf.close()  # and again after __exit__: still safe
+
     def test_empty_list(self):
         with ShardPrefetcher([]) as shards:
             assert list(shards) == []
