@@ -1161,6 +1161,121 @@ def bench_input_pipeline(steps: int = 24) -> dict:
     return out
 
 
+def bench_checkpoint(steps: int = 8) -> dict:
+    """Async checkpoint overlap: the SAME train run saving EVERY step,
+    async vs sync, plus the async contract number — seconds the train loop
+    blocked in save() over the total save wall seconds (snapshot →
+    committed manifest). The subsystem's claim (docs/CHECKPOINTING.md) is
+    blocked < 10% of wall: the loop pays only the host snapshot while the
+    shard writes, the commit rename and the retention sweep ride the
+    background writer.
+
+    Vehicle: ResNet (real multi-MB sharded state — params + two Adam
+    moments — so the shard writes are honest IO, not toy metadata);
+    resnet18 at 64px on the CPU mesh keeps the entry in CI time."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from kubeflow_tpu.config.platform import (
+        CheckpointConfig, MeshConfig, TrainingConfig,
+    )
+    from kubeflow_tpu.parallel.mesh import build_mesh, MeshSpec
+    from kubeflow_tpu.training.checkpoint import CheckpointManager
+    from kubeflow_tpu.training.trainer import Trainer
+    from kubeflow_tpu.utils.metrics import (
+        checkpoint_blocked_histogram,
+        checkpoint_bytes_counter,
+        checkpoint_save_histogram,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_dev = len(jax.devices())
+    model = "resnet50" if on_tpu else "resnet18"
+    image_size = 224 if on_tpu else 64
+    per_chip = 32 if on_tpu else 8
+    blocked = checkpoint_blocked_histogram()
+    save_wall = checkpoint_save_histogram()
+    nbytes = checkpoint_bytes_counter()
+
+    def run(async_save: bool) -> dict:
+        ckpt_dir = tempfile.mkdtemp(prefix="kft-bench-ckpt-")
+        try:
+            cfg = TrainingConfig(
+                model=model,
+                global_batch_size=per_chip * n_dev,
+                steps=steps,
+                warmup_steps=1,
+                learning_rate=0.1,
+                mesh=MeshConfig(data=n_dev),
+                checkpoint=CheckpointConfig(
+                    enabled=True,
+                    directory=ckpt_dir,
+                    interval_steps=1,  # save EVERY step: worst case
+                    keep=2,
+                    async_save=async_save,
+                ),
+            )
+            mesh = build_mesh(
+                MeshSpec.from_config(cfg.mesh), devices=jax.devices()
+            )
+            kwargs = {"num_classes": 100} if not on_tpu else None
+            trainer = Trainer(cfg, mesh=mesh, model_kwargs=kwargs)
+            trainer.task.image_size = image_size
+            if not on_tpu:
+                trainer.task.num_classes = 100
+            mgr = CheckpointManager(
+                ckpt_dir, keep=2, async_save=async_save
+            )
+            b0, w0, n0, c0 = (
+                blocked.sum(), save_wall.sum(), nbytes.value(),
+                save_wall.count(),
+            )
+            try:
+                m = trainer.fit(
+                    steps=steps, checkpoint_manager=mgr, log_every=steps
+                )
+                mgr.wait()
+            finally:
+                mgr.close()
+            return {
+                "steps_per_sec": round(1.0 / m.step_time_s, 3),
+                "blocked_s": blocked.sum() - b0,
+                "save_wall_s": save_wall.sum() - w0,
+                "bytes": nbytes.value() - n0,
+                "saves": save_wall.count() - c0,
+                "final_loss": m.loss,
+            }
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    a = run(True)
+    s = run(False)
+    return {
+        "model": model,
+        "image_size": image_size,
+        "steps": steps,
+        "saves_per_run": a["saves"],
+        "checkpoint_mb": round(a["bytes"] / max(a["saves"], 1) / 1e6, 2),
+        "async_blocked_s": round(a["blocked_s"], 4),
+        "async_save_wall_s": round(a["save_wall_s"], 4),
+        # THE contract number: < 0.10 means the loop pays under 10% of the
+        # checkpoint cost; the rest overlaps training
+        "blocked_over_wall": round(
+            a["blocked_s"] / max(a["save_wall_s"], 1e-9), 4
+        ),
+        "sync_blocked_s": round(s["blocked_s"], 4),
+        "async_steps_per_sec": a["steps_per_sec"],
+        "sync_steps_per_sec": s["steps_per_sec"],
+        "async_speedup": round(
+            a["steps_per_sec"] / max(s["steps_per_sec"], 1e-9), 3
+        ),
+        # saving must never change what gets trained
+        "loss_bitwise_identical": a["final_loss"] == s["final_loss"],
+    }
+
+
 def bench_studyjob_trials(n_trials: int = 4) -> dict:
     """Trials/hr through the real control plane (Katib-equivalent metric).
 
@@ -1478,6 +1593,9 @@ def _entry_specs(batch: int, steps: int):
         ("studyjob", "bench_studyjob_trials()", 600, None, False),
         # host-fed overlap: prefetch_depth 2 vs 0, same batches bitwise
         ("input_pipeline", "bench_input_pipeline()", 600, None, False),
+        # async checkpoint overlap: blocked seconds vs save wall seconds
+        # (measured CPU-mesh r6: blocked_over_wall 0.0096, async 1.44x)
+        ("checkpoint", "bench_checkpoint()", 600, None, False),
         ("serving", "bench_serving()", 480, None, False),
         # the sweep is split per length: each is ~4 tunnel compiles in its
         # own bounded subprocess, so a stall at one length cannot lose the
@@ -1536,6 +1654,7 @@ def _summary(results: dict, batch: int, complete: bool, t0: float) -> dict:
         "long_context_train": results.get("long_context_train"),
         "studyjob": results.get("studyjob"),
         "input_pipeline": results.get("input_pipeline"),
+        "checkpoint": results.get("checkpoint"),
         "serving": results.get("serving"),
         "generate": results.get("generate"),
         "generate_floor": results.get("generate_floor"),

@@ -175,7 +175,12 @@ class JwtValidator:
 
         exp = as_ts("exp")
         if exp is None and self.require_exp:
-            raise InvalidToken("token has no exp claim")
+            raise InvalidToken(
+                "token has no exp claim; non-expiring tokens are rejected "
+                "by default (a leaked one would validate forever) — "
+                "construct JwtValidator(..., require_exp=False) to opt out "
+                "explicitly"
+            )
         if exp is not None and now > exp + self.leeway_s:
             raise InvalidToken("token expired")
         nbf = as_ts("nbf")

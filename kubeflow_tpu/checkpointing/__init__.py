@@ -1,0 +1,25 @@
+"""Async sharded checkpointing subsystem (preemption-safe, mesh-portable).
+
+The platform's one checkpoint implementation: the trainer saves through it
+without stalling the device (manager.py), the TPUJob controller's gang
+restarts resume from it (KFT_CHECKPOINT_DIR / KFT_RESTORE_DIR,
+controllers/tpujob.py), StudyJob trials warm-start from a parent run's
+params (restore_subtree), and the serving loaders read weights from the
+same manifests (restore_params). Layout + commit protocol: layout.py;
+operational guide: docs/CHECKPOINTING.md.
+"""
+
+from kubeflow_tpu.checkpointing.layout import (  # noqa: F401
+    MANIFEST,
+    committed_steps,
+    step_dir,
+    step_dir_name,
+)
+from kubeflow_tpu.checkpointing.manager import (  # noqa: F401
+    CheckpointManager,
+    latest_committed_step,
+    restore_latest,
+    restore_params,
+    restore_pytree,
+    restore_subtree,
+)

@@ -140,17 +140,48 @@ class SliceConfig(ConfigNode):
 
 @dataclasses.dataclass
 class CheckpointConfig(ConfigNode):
+    """Knobs for the async sharded checkpoint subsystem
+    (kubeflow_tpu/checkpointing/; docs/CHECKPOINTING.md). The TPUJob
+    controller renders `directory` as KFT_CHECKPOINT_DIR into every gang
+    pod, so operators can repoint a job without editing the training spec."""
+
     enabled: bool = config_field(default=True)
     directory: str = config_field(default="/tmp/kubeflow_tpu/checkpoints")
     interval_steps: int = config_field(default=1000)
-    keep: int = config_field(default=3, help="checkpoints retained")
-    async_save: bool = config_field(default=True)
+    keep: int = config_field(default=3, help="last-N checkpoints retained")
+    keep_every: int = config_field(
+        default=0,
+        help="additionally retain every k-th step forever (milestone "
+        "checkpoints that survive the keep-last-N sweep); 0 = off",
+    )
+    async_save: bool = config_field(
+        default=True,
+        help="save on a background writer: the train loop blocks only for "
+        "the host snapshot, never the shard writes or the commit",
+    )
+    max_in_flight: int = config_field(
+        default=2,
+        help="bounded in-flight window: at most this many saves may be "
+        "snapshot-resident/writing at once; save() blocks when full "
+        "(bounds host memory at ~window x state size)",
+    )
+    warm_start_dir: str = config_field(
+        default="",
+        help="non-empty: a fresh run (no checkpoint in `directory`) "
+        "initializes its PARAMS from the latest committed checkpoint "
+        "here (step/optimizer state start at zero). StudyJob renders "
+        "this from spec.warmStartFrom into every trial.",
+    )
 
     def validate(self) -> None:
         if self.interval_steps < 1:
             raise ConfigError("checkpoint.interval_steps must be >= 1")
         if self.keep < 1:
             raise ConfigError("checkpoint.keep must be >= 1")
+        if self.keep_every < 0:
+            raise ConfigError("checkpoint.keep_every must be >= 0")
+        if self.max_in_flight < 1:
+            raise ConfigError("checkpoint.max_in_flight must be >= 1")
 
 
 @dataclasses.dataclass

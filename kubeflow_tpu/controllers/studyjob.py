@@ -21,6 +21,10 @@ Spec:
                  min: 0.001, max: 0.1, step?: …, list?: […]}]
   maxTrials, parallelism
   trialTemplate: a TPUTrainJob spec (slice + training + runPolicy)
+  warmStartFrom: a checkpoint directory — every trial initializes its
+                 params from the latest committed checkpoint there
+                 (kubeflow_tpu/checkpointing restore_subtree; fine-tune
+                 sweeps start from the parent run instead of from scratch)
 """
 
 from __future__ import annotations
@@ -274,6 +278,14 @@ class StudyJobController(Controller):
     ) -> Dict[str, Any]:
         m = study["metadata"]
         template = copy.deepcopy(study["spec"].get("trialTemplate", {}))
+        warm_start = study["spec"].get("warmStartFrom")
+        if warm_start:
+            # parent-checkpoint warm start: the trial's run driver restores
+            # params (not step/optimizer) from this directory on a fresh
+            # start — runtime/train_run.py::run_training
+            set_by_path(
+                template, "training.checkpoint.warm_start_dir", warm_start
+            )
         for dotted, value in assignment.items():
             set_by_path(template, dotted, value)
         trial = new_object(

@@ -361,8 +361,17 @@ class TPUTrainJobController(Controller):
         env["KFT_TRAINING_SPEC"] = json.dumps(spec.get("training") or {})
         ckpt = (spec.get("training") or {}).get("checkpoint") or {}
         ckpt_dir = ckpt.get("directory")
+        if ckpt_dir and ckpt.get("enabled", True):
+            # the platform checkpoint knob (checkpointing subsystem,
+            # docs/CHECKPOINTING.md): every gang pod saves/restores through
+            # this one directory; the env wins over the spec in-pod so an
+            # operator can repoint a job without editing it
+            env["KFT_CHECKPOINT_DIR"] = ckpt_dir
         if ckpt_dir and restarts > 0:
-            # resume-on-gang-restart: the in-pod runner restores latest step
+            # resume-on-gang-restart: the in-pod runner restores the latest
+            # COMMITTED step (an interrupted save's uncommitted shards are
+            # invisible to the manifest scan, so a preemption mid-save can
+            # never resume from a torn checkpoint)
             env["KFT_RESTORE_DIR"] = ckpt_dir
         profiler_logdir = (spec.get("training") or {}).get("profiler_logdir")
         if profiler_logdir:

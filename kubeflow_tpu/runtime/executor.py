@@ -129,6 +129,11 @@ class InProcessTrainerRunner(PodRunner):
                 restore=bool(env.get("KFT_RESTORE_DIR")),
                 steps_override=self.steps_override,
                 mesh=mesh,
+                # the POD's rendered env, not this process's: the
+                # controller's env-wins contract (KFT_CHECKPOINT_DIR,
+                # KFT_COMPILE_CACHE_DIR) must hold in-process too, and a
+                # host-process env var must not leak into simulated jobs
+                environ=env,
             )
         except FloatingPointError as e:
             # diverged training is a real failure, not a Succeeded job with

@@ -9,6 +9,7 @@ final checkpoint land in exactly one place.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Dict, Optional, Set
 
 from kubeflow_tpu.config.platform import TrainingConfig
@@ -20,6 +21,12 @@ log = get_logger(__name__)
 # Rendered by the TPUJob controller into every gang pod; wins over the
 # config knob so operators can repoint a job's cache without editing specs.
 ENV_COMPILE_CACHE_DIR = "KFT_COMPILE_CACHE_DIR"
+
+# Rendered by the TPUJob controller into every gang pod whenever the job
+# checkpoints: the one directory both the periodic saves and the
+# restart-resume path (KFT_RESTORE_DIR) read. Wins over the config knob for
+# the same repoint-without-editing-specs reason as the compile cache.
+ENV_CHECKPOINT_DIR = "KFT_CHECKPOINT_DIR"
 
 # The dir the process's cache object was last built for: jax materializes
 # it once, so re-pointing requires an explicit reset (tests re-point per
@@ -45,6 +52,21 @@ def configure_compile_cache(
     )
     global _active_cache_dir
     if not cache_dir:
+        if _active_cache_dir:
+            # a PREVIOUS run in this process enabled the cache; an uncached
+            # run must actually run uncached, not silently keep compiling
+            # into (and reading from) the earlier run's directory while
+            # reporting "" — that skews compile_s and leaks state across
+            # simulated jobs in the in-process executor
+            try:
+                import jax
+                from jax._src import compilation_cache
+
+                compilation_cache.reset_cache()
+                jax.config.update("jax_compilation_cache_dir", None)
+                _active_cache_dir = None
+            except Exception as e:  # noqa: BLE001 - cache flags vary
+                log.warning("compile cache disable failed (%s)", e)
         return ""
     import jax
 
@@ -83,43 +105,114 @@ def _cache_entries(cache_dir: str) -> Set[str]:
     }
 
 
+def _install_preempt_handler(stop_event: threading.Event):
+    """SIGTERM → a final checkpoint + clean exit instead of a torn save.
+
+    Kubernetes (and GKE's TPU preemption notice) delivers SIGTERM with a
+    grace period before SIGKILL; the training loop treats the event as
+    "save now, stop cleanly", so the gang restart resumes from the very
+    step the preemption landed on. Returns an undo callable (signal
+    handlers only install from the main thread; elsewhere — the in-process
+    executor's threads — the event can still be set directly)."""
+    import signal
+
+    if threading.current_thread() is not threading.main_thread():
+        return lambda: None
+    try:
+        previous = signal.signal(
+            signal.SIGTERM, lambda signum, frame: stop_event.set()
+        )
+    except ValueError:  # no signal support in this context
+        return lambda: None
+    return lambda: signal.signal(signal.SIGTERM, previous)
+
+
 def run_training(
     cfg: TrainingConfig,
     restore: bool = False,
     steps_override: Optional[int] = None,
     mesh=None,
+    stop_event: Optional[threading.Event] = None,
+    environ=None,
 ) -> Dict[str, Any]:
     """Run one training job to completion; returns the result metrics.
 
-    `restore=True` resumes from the latest checkpoint in cfg.checkpoint's
-    directory (no-op if none exists). The step budget is cfg.steps total —
-    a resumed run executes only the remaining steps, and a checkpoint at or
-    past the budget short-circuits to done (gang restarts after the final
-    save must not train past the configured total).
+    `restore=True` resumes from the latest checkpoint in the job's
+    checkpoint directory (no-op if none exists). The step budget is
+    cfg.steps total — a resumed run executes only the remaining steps, and
+    a checkpoint at or past the budget short-circuits to done (gang
+    restarts after the final save must not train past the configured
+    total). `stop_event` (set by SIGTERM, or injected by tests/agents)
+    requests a preemption-style stop: final save, clean exit, resumable.
+    `environ` is the pod's rendered env (in-pod the process env IS the pod
+    env; the in-process runner passes the pod's env block explicitly so
+    the controller's env-wins contract holds there too and nothing leaks
+    in from the host process).
     """
     import jax
 
     from kubeflow_tpu.training.trainer import Trainer
 
-    cache_dir = configure_compile_cache(cfg)
+    env = os.environ if environ is None else environ
+    cache_dir = configure_compile_cache(cfg, environ=env)
     entries_before = _cache_entries(cache_dir)
     trainer = Trainer(cfg, mesh=mesh)
     ckpt_mgr = None
     state = None
     restored_step = 0
-    if cfg.checkpoint.enabled and cfg.checkpoint.directory:
+    warm_started = False
+    # the controller-rendered dir wins over the spec knob (repoint a job's
+    # checkpoints without editing it, same contract as the compile cache)
+    ckpt_dir = env.get(ENV_CHECKPOINT_DIR, "") or cfg.checkpoint.directory
+    if cfg.checkpoint.enabled and ckpt_dir:
         from kubeflow_tpu.training.checkpoint import CheckpointManager
 
         ckpt_mgr = CheckpointManager(
-            cfg.checkpoint.directory,
+            ckpt_dir,
             keep=cfg.checkpoint.keep,
             async_save=cfg.checkpoint.async_save,
+            keep_every=cfg.checkpoint.keep_every,
+            max_in_flight=cfg.checkpoint.max_in_flight,
         )
-        if restore and ckpt_mgr.latest_step() is not None:
+    if restore and ckpt_dir:
+        # restore is independent of SAVE enablement: a restarted gang with
+        # checkpoint.enabled since flipped off (stop saving) must still
+        # resume from the committed steps on disk — KFT_RESTORE_DIR
+        # promises it — not silently retrain from step 0
+        from kubeflow_tpu.checkpointing import (
+            latest_committed_step,
+            restore_latest,
+        )
+
+        if latest_committed_step(ckpt_dir) is not None:
             state = trainer.init_state()
-            state = ckpt_mgr.restore(state)
+            state = (
+                ckpt_mgr.restore(state)
+                if ckpt_mgr is not None
+                else restore_latest(ckpt_dir, state)
+            )
             restored_step = int(jax.device_get(state.step))
             log.info("resumed from step %d", restored_step)
+    if state is None and cfg.checkpoint.warm_start_dir:
+        # parent-checkpoint warm start (StudyJob trials): params only, step
+        # and optimizer state fresh. Independent of whether THIS run writes
+        # checkpoints, and never taken over a real resume above.
+        from kubeflow_tpu.checkpointing import (
+            latest_committed_step,
+            restore_subtree,
+        )
+
+        parent = cfg.checkpoint.warm_start_dir
+        if latest_committed_step(parent) is not None:
+            state = trainer.init_state()
+            state = state.replace(params=restore_subtree(parent, state.params))
+            warm_started = True
+            log.info("warm-started params from %s", parent)
+        else:
+            log.warning(
+                "warm_start_dir %s has no committed checkpoint; "
+                "starting from scratch", parent
+            )
 
     total = steps_override if steps_override is not None else cfg.steps
     if restored_step >= total:
@@ -131,21 +224,73 @@ def run_training(
             "loss": None,
             "items_per_sec": 0.0,
             "already_complete": True,
+            # same key set as every other exit path — callers index these
+            "preempted": False,
         }
-    metrics = trainer.fit(
-        steps=total - restored_step, state=state, checkpoint_manager=ckpt_mgr
-    )
-    if ckpt_mgr is not None:
-        ckpt_mgr.save(metrics.step, trainer._final_state)
-        ckpt_mgr.close()
+    stop_event = stop_event if stop_event is not None else threading.Event()
+    restore_sigterm = _install_preempt_handler(stop_event)
+    fit_ok = False
+    try:
+        metrics = trainer.fit(
+            steps=total - restored_step,
+            state=state,
+            checkpoint_manager=ckpt_mgr,
+            stop_event=stop_event,
+        )
+        preempted = getattr(trainer, "_stop_reason", "") == "preempted"
+        final_state = getattr(trainer, "_final_state", None)
+        # the state's own step, not the last LOGGED step: on a preempted run
+        # the log window may trail the step the preempt-save just committed
+        final_step = (
+            int(jax.device_get(final_state.step))
+            if final_state is not None
+            else restored_step
+        )
+        if ckpt_mgr is not None and final_state is not None:
+            # normal completion ends every host at the same step; a
+            # PREEMPTED multi-host gang does not (each host observed the
+            # notice at its own loop position), and divergent forced saves
+            # would starve the commit barrier — those resume from the last
+            # committed interval save instead
+            if not (preempted and jax.process_count() > 1):
+                ckpt_mgr.save(final_step, final_state, force=True)
+        fit_ok = True
+    finally:
+        # the manager owns a NON-daemon writer thread: every exit — normal,
+        # FloatingPointError, eval crash — must join it, or the pod hangs
+        # at interpreter shutdown instead of reporting the failure. The
+        # SIGTERM handler stays installed until the close() below finishes
+        # draining the writer: a preemption notice landing during the final
+        # commit must be absorbed, not kill the process mid-write — and the
+        # handler restore must survive a close() that raises (a failed
+        # async write re-raises there), or a stale handler bound to this
+        # run's dead stop_event leaks into the process.
+        try:
+            if ckpt_mgr is not None:
+                if fit_ok:
+                    ckpt_mgr.close()
+                else:
+                    try:
+                        ckpt_mgr.close()
+                    except Exception as e:  # noqa: BLE001 - don't mask fit's error
+                        log.warning(
+                            "checkpoint close failed during unwind: %s", e
+                        )
+        finally:
+            restore_sigterm()
     result = {
-        "final_step": metrics.step,
-        "loss": metrics.loss,
+        "final_step": final_step,
+        "loss": metrics.loss if metrics is not None else None,
         # steady-state: trainer.fit fences the first (compile) step out of
         # its timing windows and reports the one-time cost as compile_s
-        "items_per_sec": metrics.items_per_sec,
+        "items_per_sec": metrics.items_per_sec if metrics is not None else 0.0,
         "already_complete": False,
+        "preempted": preempted,
     }
+    if warm_started:
+        result["warm_started"] = True
+    if metrics is None:
+        return result
     if "compile_s" in metrics.aux:
         result["compile_s"] = metrics.aux["compile_s"]
     if cache_dir:

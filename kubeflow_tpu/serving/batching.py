@@ -30,12 +30,13 @@ from kubeflow_tpu.utils.metrics import default_registry
 
 
 class _Pending:
-    __slots__ = ("x", "event", "result", "error")
+    __slots__ = ("x", "event", "result", "aux", "error")
 
     def __init__(self, x: np.ndarray):
         self.x = x
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
+        self.aux = None  # per-fused-batch aux from the run fn (see submit)
         self.error: Optional[BaseException] = None
 
 
@@ -79,6 +80,14 @@ class MicroBatcher:
 
     def submit(self, x: np.ndarray) -> np.ndarray:
         """Block until this request's rows come back from a fused batch."""
+        return self.submit_with_aux(x)[0]
+
+    def submit_with_aux(self, x: np.ndarray):
+        """Like submit, additionally returning the aux value the run fn
+        reported for the fused batch THIS request rode (None when the run
+        fn returns a bare array). The aux rides the same completion event
+        as the rows, so a caller never sees another batch's attribution —
+        the race that reading shared server state after submit() had."""
         p = _Pending(np.asarray(x))
         with self._cv:
             # the stop check must share the collector's lock: checked
@@ -91,7 +100,7 @@ class MicroBatcher:
         p.event.wait()
         if p.error is not None:
             raise p.error
-        return p.result
+        return p.result, p.aux
 
     # -- collector thread -------------------------------------------------
 
@@ -125,11 +134,16 @@ class MicroBatcher:
             xs = np.concatenate([p.x for p in members], axis=0)
             self._fused.observe(xs.shape[0], model=self._name)
             try:
-                ys = self._run(xs)
+                out = self._run(xs)
+                # run may return (ys, aux): aux (e.g. the device-call
+                # latency decomposition) fans out to every member of the
+                # fused batch alongside its rows
+                ys, aux = out if isinstance(out, tuple) else (out, None)
                 off = 0
                 for p in members:
                     n = p.x.shape[0]
                     p.result = ys[off : off + n]
+                    p.aux = aux
                     off += n
             except BaseException as e:  # propagate per request
                 for p in members:

@@ -199,8 +199,8 @@ class ServedLm:
         scan_layers: bool = True,
         **model_kwargs,
     ) -> "ServedLm":
-        """Build from the platform model registry; params from an orbax
-        checkpoint's TrainState if a directory is given.
+        """Build from the platform model registry; params from the latest
+        committed platform checkpoint if a directory is given.
 
         Serving defaults to scan_layers=True (depth-independent decode
         lowering); the params convert between the named-layer and

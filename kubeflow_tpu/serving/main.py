@@ -67,7 +67,7 @@ def build_server(
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="kubeflow-tpu model server")
     ap.add_argument("--model", required=True, help="registry model name")
-    ap.add_argument("--checkpoint-dir", default="", help="orbax checkpoint dir")
+    ap.add_argument("--checkpoint-dir", default="", help="platform checkpoint dir (kubeflow_tpu/checkpointing)")
     ap.add_argument("--port", type=int, default=8500)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument(

@@ -6,8 +6,9 @@ only the wiring + smoke test: POST /v1/models/<name>:predict with
 testing/test_tf_serving.py:60-145, request at :112-127, tolerance compare
 :40-57). This server is the TPU-native replacement for the image itself:
 
-- models from the platform registry with params restored from an orbax
-  checkpoint (or injected directly),
+- models from the platform registry with params restored from a platform
+  checkpoint manifest (kubeflow_tpu/checkpointing — the same path training
+  saves through) or injected directly,
 - inference is one jitted XLA program per (model, padded batch size);
   requests are padded to bucketed batch sizes so arbitrary instance counts
   hit a small set of compiled programs instead of recompiling — the
@@ -41,18 +42,17 @@ def bucket_for(n: int) -> int:
 
 
 def restore_checkpoint_params(checkpoint_dir: Optional[str]):
-    """Params from an orbax checkpoint's TrainState (latest step) — the
-    one restore used by every serving loader (ServedModel + ServedLm)."""
+    """Params from the latest committed platform checkpoint — the one
+    restore used by every serving loader (ServedModel + ServedLm). Reads
+    the same manifest path training saves through
+    (kubeflow_tpu/checkpointing), so a gang's checkpoints serve directly:
+    uncommitted (torn) saves are invisible, and the shard layout the
+    training mesh used is irrelevant to the host-side assembly here."""
     if checkpoint_dir is None:
         raise ValueError("need checkpoint_dir or params")
-    import orbax.checkpoint as ocp
+    from kubeflow_tpu.checkpointing import restore_params
 
-    with ocp.CheckpointManager(checkpoint_dir) as mgr:
-        step = mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint in {checkpoint_dir}")
-        restored = mgr.restore(step)
-    return restored["params"]
+    return restore_params(checkpoint_dir)
 
 
 class ServedModel:
@@ -79,9 +79,11 @@ class ServedModel:
         self.transfer_dtype = transfer_dtype
         self._jitted = jax.jit(apply_fn)
         self._lock = threading.Lock()
-        # last device call's transfer/compute split (attribution for the
-        # X-*-Ms response headers; under the batcher this is the most
-        # recent fused batch, which is what a concurrent client rode)
+        # most recent device call's transfer/compute split — a monitoring
+        # convenience only. Request handlers must NOT read this for their
+        # X-*-Ms headers: use predict_array_with_decomp, which threads the
+        # decomp of the exact batch the request rode (concurrent requests
+        # would otherwise report a neighbor's split).
         self.last_device_decomp: Dict[str, float] = {}
         reg = default_registry()
         self._latency = reg.histogram(
@@ -118,8 +120,8 @@ class ServedModel:
         batch_window_ms: float = 0.0,
         **model_kwargs,
     ) -> "ServedModel":
-        """Build from the platform model registry; params from an orbax
-        checkpoint's TrainState if a directory is given."""
+        """Build from the platform model registry; params from the latest
+        committed platform checkpoint if a directory is given."""
         from kubeflow_tpu.models.registry import get_model
 
         model = get_model(model_name, **model_kwargs)
@@ -141,6 +143,14 @@ class ServedModel:
         The binary (:predict_npy) path — no per-row Python conversion.
         With micro-batching enabled, concurrent calls fuse into one
         device batch per collection window."""
+        return self.predict_array_with_decomp(x)[0]
+
+    def predict_array_with_decomp(self, x: np.ndarray):
+        """predict_array plus the device-call latency decomposition of the
+        batch THIS request actually rode. Threaded from _device_predict
+        (through the micro-batcher's aux channel when batching), not read
+        back from shared server state — concurrent requests each get their
+        own batch's attribution, never a neighbor's."""
         n = x.shape[0]
         if n == 0:
             # prediction-shaped empty: trace (not run) a 1-row batch
@@ -149,35 +159,34 @@ class ServedModel:
                 self.params,
                 jax.ShapeDtypeStruct((bucket_for(1),) + x.shape[1:], x.dtype),
             )
-            return np.zeros((0,) + out.shape[1:], out.dtype)
+            return np.zeros((0,) + out.shape[1:], out.dtype), {}
         if n > BATCH_BUCKETS[-1]:
-            # large request: chunk through the biggest bucket
-            return np.concatenate(
-                [
-                    self.predict_array(x[i : i + BATCH_BUCKETS[-1]])
-                    for i in range(0, n, BATCH_BUCKETS[-1])
-                ],
-                axis=0,
-            )
+            # large request: chunk through the biggest bucket (the decomp
+            # reported is the final chunk's — one device call's worth)
+            chunks = [
+                self.predict_array_with_decomp(x[i : i + BATCH_BUCKETS[-1]])
+                for i in range(0, n, BATCH_BUCKETS[-1])
+            ]
+            return np.concatenate([c[0] for c in chunks], axis=0), chunks[-1][1]
         self._requests.inc(model=self.name)
         if self._batcher is not None:
             with self._latency.time(model=self.name):
-                return self._batcher.submit(x)
+                y, decomp = self._batcher.submit_with_aux(x)
+                return y, decomp or {}
         with self._latency.time(model=self.name):
             return self._device_predict(x)
 
-    def _device_predict(self, x: np.ndarray) -> np.ndarray:
-        """Padded, locked device call(s); chunks past the largest bucket
-        (a fused micro-batch can exceed it when submits race the window)."""
+    def _device_predict(self, x: np.ndarray):
+        """Padded, locked device call(s) → (rows, decomp); chunks past the
+        largest bucket (a fused micro-batch can exceed it when submits race
+        the window)."""
         n = x.shape[0]
         if n > BATCH_BUCKETS[-1]:
-            return np.concatenate(
-                [
-                    self._device_predict(x[i : i + BATCH_BUCKETS[-1]])
-                    for i in range(0, n, BATCH_BUCKETS[-1])
-                ],
-                axis=0,
-            )
+            chunks = [
+                self._device_predict(x[i : i + BATCH_BUCKETS[-1]])
+                for i in range(0, n, BATCH_BUCKETS[-1])
+            ]
+            return np.concatenate([c[0] for c in chunks], axis=0), chunks[-1][1]
         padded_n = bucket_for(n)
         if padded_n != n:
             pad = np.repeat(x[:1], padded_n - n, axis=0)
@@ -196,13 +205,14 @@ class ServedModel:
             t2 = _time.monotonic()
             out = np.asarray(jax.device_get(y))
             t3 = _time.monotonic()
-            self.last_device_decomp = {
+            decomp = {
                 "rows": float(padded_n),
                 "transfer_in_ms": (t1 - t0) * 1e3,
                 "device_ms": (t2 - t1) * 1e3,
                 "transfer_out_ms": (t3 - t2) * 1e3,
             }
-        return out[:n]
+            self.last_device_decomp = decomp
+        return out[:n], decomp
 
     def warmup(
         self,
@@ -342,7 +352,9 @@ class ModelServer:
                 raise BadRequest("instances tensor must be at least rank 1")
             t1 = _time.monotonic()
             try:
-                y = model.predict_array(np.asarray(x, dtype=np.float32))
+                y, decomp = model.predict_array_with_decomp(
+                    np.asarray(x, dtype=np.float32)
+                )
             except (ValueError, TypeError) as e:
                 raise BadRequest(f"bad instances: {e}")
             t2 = _time.monotonic()
@@ -358,11 +370,11 @@ class ModelServer:
                 ("X-Serialize-Ms", f"{(t3 - t2) * 1e3:.2f}"),
             ]
             # compute further split into host→device transfer / XLA run /
-            # device→host (the most recent device call — under the batcher,
-            # the fused batch this request rode): on remote-device
-            # transports the transfer legs dominate, and without this split
-            # they masquerade as model compute
-            decomp = model.last_device_decomp
+            # device→host, threaded from the exact device batch this
+            # request rode (under the batcher: its fused batch — never a
+            # concurrent neighbor's): on remote-device transports the
+            # transfer legs dominate, and without this split they
+            # masquerade as model compute
             for key, hdr in (
                 ("transfer_in_ms", "X-Transfer-In-Ms"),
                 ("device_ms", "X-Device-Ms"),

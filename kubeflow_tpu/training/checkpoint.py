@@ -1,89 +1,28 @@
-"""Checkpoint / resume — async orbax to GCS-or-local.
+"""Checkpoint / resume — compatibility surface over the checkpointing
+subsystem.
 
-The reference delegates checkpointing to the ML framework and contributes
-storage plumbing only (SURVEY.md §5: PVCs for notebooks, logdir handling,
-the openmpi sidecar's S3 stage-in/out, reference: components/
-openmpi-controller/controller/controller.py:104-116). For the TPU platform
-checkpoint/resume is first-class: gang restart on slice failure resumes from
-the latest step (controllers/tpujob.py drives this), so the trainer must
-save asynchronously (no step-time stall) and restore onto the *current* mesh
-layout regardless of the layout that saved it — orbax handles the resharding
-given target abstract arrays.
+The original implementation delegated to orbax; the platform now owns the
+whole path (kubeflow_tpu/checkpointing/): per-shard async saves behind a
+bounded in-flight window, a two-phase atomic commit (shards, then the
+manifest rename) so a preemption mid-save can never corrupt `latest`, and a
+resharding restore that re-assembles state onto the *current* mesh from the
+manifest's shard map — a gang restarted on a different slice shape still
+resumes (controllers/tpujob.py drives this). This module stays as the
+import point the training stack and existing tests use.
 """
 
 from __future__ import annotations
 
-import os
-from typing import Any, Optional
+from kubeflow_tpu.checkpointing import (  # noqa: F401
+    CheckpointManager,
+    latest_committed_step,
+    restore_params,
+    restore_subtree,
+)
 
-import jax
-import orbax.checkpoint as ocp
-
-from kubeflow_tpu.utils.logging import get_logger
-from kubeflow_tpu.utils.metrics import default_registry
-
-log = get_logger(__name__)
-
-
-class CheckpointManager:
-    """Thin wrapper over orbax CheckpointManager bound to one train run."""
-
-    def __init__(
-        self,
-        directory: str,
-        keep: int = 3,
-        async_save: bool = True,
-        save_interval_steps: int = 1,
-    ):
-        directory = os.path.abspath(os.path.expanduser(directory))
-        os.makedirs(directory, exist_ok=True)
-        self.directory = directory
-        options = ocp.CheckpointManagerOptions(
-            max_to_keep=keep,
-            save_interval_steps=save_interval_steps,
-            enable_async_checkpointing=async_save,
-        )
-        self._mgr = ocp.CheckpointManager(directory, options=options)
-        reg = default_registry()
-        self._save_total = reg.counter(
-            "checkpoint_save_total", "checkpoints saved"
-        )
-        self._save_seconds = reg.histogram(
-            "checkpoint_save_seconds", "blocking save time"
-        )
-
-    def save(self, step: int, state: Any) -> bool:
-        with self._save_seconds.time():
-            saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
-        if saved:
-            self._save_total.inc()
-        return saved
-
-    def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
-
-    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
-        """Restore into the sharding/layout of `state_like`.
-
-        `state_like` may be a concrete TrainState or a pytree of
-        jax.ShapeDtypeStruct with shardings — orbax reshards as needed, so a
-        run restarted on a different mesh layout still resumes.
-        """
-        step = self.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-            if hasattr(x, "sharding")
-            else x,
-            state_like,
-        )
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-
-    def wait(self) -> None:
-        """Block until in-flight async saves land (call before process exit)."""
-        self._mgr.wait_until_finished()
-
-    def close(self) -> None:
-        self.wait()
-        self._mgr.close()
+__all__ = [
+    "CheckpointManager",
+    "latest_committed_step",
+    "restore_params",
+    "restore_subtree",
+]

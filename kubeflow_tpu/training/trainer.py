@@ -409,6 +409,7 @@ class Trainer:
         state: Optional[TrainState] = None,
         log_every: int = 10,
         checkpoint_manager=None,
+        stop_event=None,
     ) -> StepMetrics:
         """Run the training loop; returns the final step's metrics.
 
@@ -417,7 +418,11 @@ class Trainer:
         `cfg.data.target_accuracy` > 0 training stops early once eval top-1
         reaches it (the BASELINE.json train-to-accuracy contract). Final
         eval metrics land in the returned StepMetrics.aux as
-        eval_top1/eval_loss.
+        eval_top1/eval_loss. `stop_event` (a threading.Event) is the
+        preemption hook: once set, the loop finishes the in-flight step,
+        saves a final checkpoint (when a manager is attached) and exits
+        cleanly — runtime/train_run.py wires SIGTERM to it so a preempted
+        gang pod resumes from the exact step the notice landed on.
         """
         cfg = self.cfg
         steps = cfg.steps if steps is None else steps
@@ -487,6 +492,7 @@ class Trainer:
                 eval_data,
                 checkpoint_manager,
                 log_every,
+                stop_event,
             )
         finally:
             # every exit — normal, early-stop, FloatingPointError, eval
@@ -507,6 +513,7 @@ class Trainer:
         eval_data,
         checkpoint_manager,
         log_every: int,
+        stop_event=None,
     ) -> Optional[StepMetrics]:
         cfg = self.cfg
         steps = end_step - start_step
@@ -528,6 +535,7 @@ class Trainer:
         t_last = time.monotonic()
         steps_since_log = 0
         stop_reason = ""
+        self._stop_reason = ""
         compile_s = 0.0
         for i in range(start_step, end_step):
             t_wait = time.monotonic()
@@ -551,7 +559,14 @@ class Trainer:
                 # making its items_per_sec useless for comparing trials.
                 # All reported throughput is steady-state; the compile cost
                 # is surfaced separately as aux["compile_s"].
-                _ = float(jax.device_get(metrics["loss"]))
+                loss0 = float(jax.device_get(metrics["loss"]))
+                if not np.isfinite(loss0):
+                    # the fence already paid the host sync — check here so a
+                    # run that NaNs at step 1 dies immediately instead of
+                    # training log_every-1 more garbage steps first
+                    raise FloatingPointError(
+                        f"non-finite loss at step {i + 1}"
+                    )
                 now = time.monotonic()
                 compile_s = now - t_last
                 t_last = now
@@ -560,8 +575,25 @@ class Trainer:
                 (i + 1) % cfg.checkpoint.interval_steps == 0
             ):
                 checkpoint_manager.save(i + 1, state)
+            if (
+                stop_event is not None
+                and stop_event.is_set()
+                and not stop_reason
+                and i != end_step - 1
+                # a notice landing on the FINAL step is not a preemption:
+                # the run is completing its full budget anyway — let the
+                # normal path finish (end-of-run eval, unlabeled result)
+            ):
+                # preemption notice (SIGTERM → runtime/train_run.py): finish
+                # this step, skip eval, break cleanly. The final save of the
+                # completed step — and the single-host-only policy around it
+                # — lives in ONE place, run_training's post-fit save.
+                stop_reason = f"preempted at step {i + 1}"
+                self._stop_reason = "preempted"
             is_last = i == end_step - 1
-            if eval_data is not None and (
+            # a stopping run must not spend its SIGTERM grace period on a
+            # full eval pass while the preempt save sits uncommitted
+            if eval_data is not None and not stop_reason and (
                 is_last or (eval_every and (i + 1) % eval_every == 0)
             ):
                 t_eval = time.monotonic()

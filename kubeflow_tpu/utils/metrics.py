@@ -329,6 +329,56 @@ def compile_cache_hits_counter() -> Counter:
     )
 
 
+# ---------------------------------------------------------------------------
+# Checkpointing metrics (one definition point: the manager, the bench entry
+# and any dashboard all read the same series — see docs/CHECKPOINTING.md).
+# ---------------------------------------------------------------------------
+
+# Blocked time spans µs (async enqueue) to seconds (sync save / full
+# in-flight window); save wall time spans ms (tiny CI states) to minutes
+# (multi-GB sharded states on network volumes).
+CHECKPOINT_SECONDS_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1, 2.5, 5, 10, 30, 60, 120, 300,
+)
+
+
+def checkpoint_save_histogram() -> Histogram:
+    """End-to-end wall time of one checkpoint save: host snapshot through
+    the committed manifest rename (the async writer's cost)."""
+    return default_registry().histogram(
+        "checkpoint_save_seconds",
+        "wall seconds per checkpoint save (snapshot to committed manifest)",
+        buckets=CHECKPOINT_SECONDS_BUCKETS,
+    )
+
+
+def checkpoint_blocked_histogram() -> Histogram:
+    """Time the train loop itself blocked inside save() — the device-idle
+    cost of checkpointing. Async saves keep this at the host-copy time;
+    the bench contract (bench_checkpoint) is blocked < 10% of save wall."""
+    return default_registry().histogram(
+        "checkpoint_blocked_seconds",
+        "seconds the train loop blocked in checkpoint save()",
+        buckets=CHECKPOINT_SECONDS_BUCKETS,
+    )
+
+
+def checkpoint_bytes_counter() -> Counter:
+    """Shard bytes this process persisted across all saves."""
+    return default_registry().counter(
+        "checkpoint_bytes_total", "checkpoint shard bytes written"
+    )
+
+
+def checkpoint_restores_counter() -> Counter:
+    """Completed restores (full-state resumes, warm starts and serving
+    loads all count — each is one manifest-driven assembly)."""
+    return default_registry().counter(
+        "checkpoint_restores_total", "checkpoint restores completed"
+    )
+
+
 def start_heartbeat(
     gauge: Gauge, period_s: float = 10.0, stop_event: Optional[threading.Event] = None
 ) -> threading.Thread:

@@ -131,15 +131,16 @@ class TestModelServerRest:
             srv.stop()
 
     def test_from_registry_with_checkpoint(self, tmp_path):
-        """Restore served params from a real orbax checkpoint."""
-        import orbax.checkpoint as ocp
+        """Restore served params from a real platform checkpoint — the
+        same manifest path training saves through."""
+        from kubeflow_tpu.checkpointing import CheckpointManager
 
         model = get_model("mlp", hidden=(8,), num_classes=3)
         params = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 8)))["params"]
         ckpt_dir = str(tmp_path / "ckpt")
-        with ocp.CheckpointManager(ckpt_dir) as mgr:
-            mgr.save(5, args=ocp.args.StandardSave({"params": params}))
-            mgr.wait_until_finished()
+        with CheckpointManager(ckpt_dir) as mgr:
+            mgr.save(5, {"params": params})
+            mgr.wait()
         served = ServedModel.from_registry(
             "mlp", checkpoint_dir=ckpt_dir, hidden=(8,), num_classes=3
         )
