@@ -1630,6 +1630,70 @@ def _entry_specs(batch: int, steps: int):
     ]
 
 
+# One headline scalar per entry for the compact final record: the first
+# present key wins (entries carry many fields; the driver tail needs one).
+_HEADLINE_KEYS = (
+    "images_per_sec_per_chip",
+    "tokens_per_sec_per_chip",
+    "generate_tokens_per_sec",
+    "rest_generate_tokens_per_sec",
+    "steps_per_sec_ratio_async_vs_sync",
+    "speedup_vs_sync",
+    "images_per_sec",
+    "tokens_per_sec",
+    "steps_per_sec",
+    "items_per_sec",
+    "p50_ms",
+    "ring_flash_causal_speedup",
+    "best_trial_loss",
+    "trials",
+)
+
+
+def _final_line(results: dict, complete: bool, t0: float) -> str:
+    """A compact (<= ~1.5 KB) one-line JSON record: headline scalars only.
+
+    The cumulative summary above grew past the driver's bounded stdout
+    tail, which cut it mid-line — three rounds of BENCH_r0*.json carried
+    `parsed: null` (VERDICT r5 next-round #1). This record is printed
+    AFTER every cumulative emit, so whatever the tail captures, it always
+    ENDS with one short parseable line."""
+    probe = results.get("probe") or {}
+    entries = {}
+    for key, value in results.items():
+        if key == "probe" or not isinstance(value, dict):
+            continue
+        if "skipped" in value:
+            entries[key] = "skipped"
+            continue
+        if "error" in value:
+            entries[key] = "error"
+            continue
+        for hk in _HEADLINE_KEYS:
+            v = value.get(hk)
+            if isinstance(v, (int, float)):
+                entries[key] = round(float(v), 3)
+                break
+        else:
+            entries[key] = "ok"
+    record = {
+        "kft_bench_final": True,
+        "complete": complete,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "backend": probe.get("backend", "unknown"),
+        "device_kind": probe.get("device_kind"),
+        "n_devices": probe.get("n_devices"),
+        "entries": entries,
+    }
+    line = json.dumps(record)
+    while len(line) > 1536 and entries:
+        # shed the longest entry key first; the record must stay one line
+        entries.pop(max(entries, key=lambda k: len(k)))
+        record["truncated"] = True
+        line = json.dumps(record)
+    return line
+
+
 def _summary(results: dict, batch: int, complete: bool, t0: float) -> dict:
     resnet = results.get("resnet50") or {}
     per_chip = resnet.get("images_per_sec_per_chip")
@@ -1684,6 +1748,9 @@ def main() -> int:
 
     def emit(complete: bool):
         print(json.dumps(_summary(results, batch, complete, t0)), flush=True)
+        # the bounded-tail contract: the LAST stdout line is always this
+        # short parseable record, even if the driver kills us mid-suite
+        print(_final_line(results, complete, t0), flush=True)
 
     results["probe"] = _bench_in_subprocess(
         "bench_probe()", min(300.0, budget_s)

@@ -1,0 +1,47 @@
+"""kft-analyze — the platform static-analysis subsystem.
+
+Two analyzer families behind one finding/severity/baseline model and one
+CLI (`python -m kubeflow_tpu.analysis`; catalog in docs/ANALYSIS.md):
+
+- SPMD program lint (analysis/spmd.py): abstract-lower every dryrun plan
+  and shipped YAML config to jaxpr+StableHLO on virtual CPU devices and
+  flag replicate-then-reshard compiles, large fully-replicated params,
+  DCN-axis collectives in the scanned train body.
+- Control-plane invariant lint (analysis/control_plane.py,
+  analysis/consistency.py): lock discipline, thread hygiene, the single
+  audited `check_vma` exception, metric-registry consistency, config-knob
+  and KFT_* env reachability.
+
+Importing this package is jax-free; the SPMD passes import jax lazily in
+their own subprocesses.
+"""
+
+from kubeflow_tpu.analysis.findings import (
+    Finding,
+    Severity,
+    apply_baseline,
+    exit_code,
+    load_baseline,
+    render_report,
+    write_baseline,
+)
+from kubeflow_tpu.analysis.sources import SourceSet
+from kubeflow_tpu.analysis.diagnostics import (
+    REMAT_WARNING,
+    capture_compiler_diagnostics,
+    remat_warnings,
+)
+
+__all__ = [
+    "Finding",
+    "Severity",
+    "SourceSet",
+    "REMAT_WARNING",
+    "capture_compiler_diagnostics",
+    "remat_warnings",
+    "apply_baseline",
+    "exit_code",
+    "load_baseline",
+    "render_report",
+    "write_baseline",
+]

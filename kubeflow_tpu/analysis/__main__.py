@@ -1,0 +1,8 @@
+"""Module entry point: `python -m kubeflow_tpu.analysis`."""
+
+import sys
+
+from kubeflow_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

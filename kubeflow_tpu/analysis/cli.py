@@ -1,0 +1,161 @@
+"""`python -m kubeflow_tpu.analysis` — the kft-analyze CLI.
+
+Runs the control-plane AST lints in-process and each SPMD plan in its own
+subprocess (the plan's topology decides the forced virtual device count).
+Exit 0 = clean; 1 = findings at ERROR (or WARNING under --strict); 2 =
+usage error. CI runs this baseline-free (ci/config.yaml static-analysis
+workflow); scripts/run_analysis.py is the boilerplate-check-style wrapper.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from kubeflow_tpu.analysis.findings import (
+    Finding,
+    apply_baseline,
+    exit_code,
+    load_baseline,
+    render_report,
+    write_baseline,
+)
+from kubeflow_tpu.analysis.sources import SourceSet
+
+
+def _repo_root(start: str) -> str:
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.isdir(os.path.join(cur, "kubeflow_tpu")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kft-analyze",
+        description="platform static analysis: SPMD program lint + "
+        "control-plane invariant checks (docs/ANALYSIS.md)",
+    )
+    ap.add_argument("--root", default=".", help="repo root (auto-detected)")
+    ap.add_argument(
+        "--ast",
+        choices=("on", "off"),
+        default="on",
+        help="control-plane AST lints (off: SPMD plan sweep only — the CI "
+        "spmd-lint step sets this, its dependency already ran the AST "
+        "pass)",
+    )
+    ap.add_argument(
+        "--spmd",
+        choices=("off", "lower", "full"),
+        default="full",
+        help="SPMD plan lint: off; lower = trace/lower-only checks; "
+        "full = also XLA-compile the tiny dryrun plans for the "
+        "replicate-then-reshard (remat) diagnostic (default)",
+    )
+    ap.add_argument(
+        "--plans",
+        choices=("dryrun", "configs", "all"),
+        default="all",
+        help="which SPMD plan families to analyze",
+    )
+    ap.add_argument(
+        "--devices", type=int, default=8,
+        help="virtual device count for the dryrun plan sweep",
+    )
+    ap.add_argument(
+        "--param-threshold", type=int, default=None,
+        help="element count above which a replicated param is 'large'",
+    )
+    ap.add_argument(
+        "--plan-timeout", type=float, default=900.0,
+        help="per-plan subprocess timeout (seconds)",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--strict", action="store_true", help="warnings also fail the run"
+    )
+    ap.add_argument("--baseline", default="", help="suppression key file")
+    ap.add_argument(
+        "--write-baseline", default="",
+        help="write current findings' keys to this file and exit 0",
+    )
+    args = ap.parse_args(argv)
+
+    root = _repo_root(args.root)
+    findings: List[Finding] = []
+    stats = []
+
+    if args.ast == "on":
+        from kubeflow_tpu.analysis.consistency import run_consistency
+        from kubeflow_tpu.analysis.control_plane import run_control_plane
+
+        sources = SourceSet(root)
+        findings.extend(run_control_plane(sources))
+        findings.extend(run_consistency(sources))
+
+    if args.spmd != "off":
+        from kubeflow_tpu.analysis.plans import (
+            dryrun_plan_specs,
+            yaml_plan_specs,
+        )
+        from kubeflow_tpu.analysis.spmd import (
+            DEFAULT_PARAM_THRESHOLD,
+            analyze_plan_subprocess,
+        )
+
+        threshold = (
+            args.param_threshold
+            if args.param_threshold is not None
+            else DEFAULT_PARAM_THRESHOLD
+        )
+        specs = []
+        if args.plans in ("dryrun", "all"):
+            specs += dryrun_plan_specs(
+                args.devices, compile=args.spmd == "full"
+            )
+        if args.plans in ("configs", "all"):
+            specs += yaml_plan_specs(root)
+        for spec in specs:
+            print(
+                f"kft-analyze: plan {spec.name} "
+                f"({spec.n_devices} devices"
+                f"{', compile' if spec.compile else ', lower-only'})...",
+                file=sys.stderr,
+                flush=True,
+            )
+            fs, st = analyze_plan_subprocess(
+                spec, root,
+                timeout_s=args.plan_timeout,
+                param_threshold=threshold,
+            )
+            findings.extend(fs)
+            stats.append(st)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print(
+            f"kft-analyze: wrote {args.write_baseline} "
+            f"({len(findings)} findings)",
+            file=sys.stderr,
+        )
+        return 0
+
+    baseline = load_baseline(args.baseline) if args.baseline else None
+    findings = apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "plans": stats,
+        }, indent=1))
+    else:
+        print(render_report(findings))
+    return exit_code(findings, strict=args.strict)

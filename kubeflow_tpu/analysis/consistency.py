@@ -1,0 +1,364 @@
+"""Registry/consistency lint: metric declarations, config-knob and env-var
+reachability.
+
+- **metrics-consistency**: the MetricsRegistry dedups by name at runtime but
+  only checks the metric KIND — two call sites registering the same name
+  with different label sets silently share one series and the second's
+  labels raise at first use. Statically: every metric name must have one
+  (kind, label-set) signature across the codebase, and `.inc/.set/.observe`
+  call sites must pass exactly the declared labels.
+- **config-reachability**: every typed field in config/platform.py must be
+  read somewhere (attribute access or exact-string key); an orphan knob is
+  config the operator can set that changes nothing — the silent-downgrade
+  bug class.
+- **env-reachability**: every `KFT_*` env var the controllers render into
+  pod env must be consumed by the runtime side (runtime/, training/,
+  parallel/, checkpointing/, serving/, images.py); a rendered-but-unread
+  var means a controller contract the pods silently ignore.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from kubeflow_tpu.analysis.findings import Finding, Severity
+from kubeflow_tpu.analysis.sources import (
+    SourceSet,
+    call_name,
+    keyword,
+    string_list,
+)
+
+_METRIC_KINDS = ("counter", "gauge", "histogram")
+_OBSERVE_METHODS = {
+    "inc": 1, "dec": 1, "set": 1, "observe": 1,
+    "time": 0, "value": 0, "count": 0, "sum": 0,
+}
+_CONFIG_MODULE = "kubeflow_tpu/config/platform.py"
+_ENV_RENDER_PREFIX = "kubeflow_tpu/controllers/"
+_ENV_CONSUMER_PREFIXES = (
+    "kubeflow_tpu/runtime/",
+    "kubeflow_tpu/training/",
+    "kubeflow_tpu/parallel/",
+    "kubeflow_tpu/checkpointing/",
+    "kubeflow_tpu/serving/",
+    "kubeflow_tpu/images.py",
+)
+_ENV_RE = re.compile(r"^KFT_[A-Z0-9_]+$")
+
+
+# ---------------------------------------------------------------------------
+# metrics-consistency
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Decl:
+    name: str
+    kind: str
+    labels: Optional[Tuple[str, ...]]  # None = not statically known
+    location: str
+
+
+def _metric_decl(node: ast.Call, path: str) -> Optional[_Decl]:
+    cname = call_name(node)
+    kind = cname.rsplit(".", 1)[-1]
+    if kind not in _METRIC_KINDS or "." not in cname:
+        return None
+    if not node.args or not (
+        isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)
+    ):
+        return None
+    labels_node = keyword(node, "label_names")
+    if labels_node is None and len(node.args) >= 3:
+        labels_node = node.args[2]
+    labels = string_list(labels_node)
+    return _Decl(
+        name=node.args[0].value,
+        kind=kind,
+        labels=labels,
+        location=f"{path}:{node.lineno}",
+    )
+
+
+def check_metrics_consistency(sources: SourceSet) -> List[Finding]:
+    rule = "metrics-consistency"
+    findings: List[Finding] = []
+    decls: Dict[str, List[_Decl]] = {}
+    # helper functions in utils/metrics.py that return one registry call:
+    # {helper_name: declared labels} so `X = host_wait_histogram()` call
+    # sites resolve to the central declaration's label set
+    helper_labels: Dict[str, Optional[Tuple[str, ...]]] = {}
+
+    for sf in sources:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                d = _metric_decl(node, sf.path)
+                if d is not None:
+                    decls.setdefault(d.name, []).append(d)
+        if sf.path.endswith("utils/metrics.py"):
+            for fn in ast.walk(sf.tree):
+                if not isinstance(fn, ast.FunctionDef):
+                    continue
+                rets = [
+                    n for n in ast.walk(fn)
+                    if isinstance(n, ast.Return) and isinstance(n.value, ast.Call)
+                ]
+                if len(rets) == 1:
+                    d = _metric_decl(rets[0].value, sf.path)
+                    if d is not None:
+                        helper_labels[fn.name] = d.labels
+
+    for name, dl in sorted(decls.items()):
+        kinds = sorted({d.kind for d in dl})
+        if len(kinds) > 1:
+            findings.append(
+                Finding(
+                    analyzer=rule,
+                    severity=Severity.ERROR,
+                    location=dl[0].location,
+                    symbol=name,
+                    message=(
+                        f"metric {name!r} registered as {' and '.join(kinds)} "
+                        f"at {', '.join(d.location for d in dl)} — the "
+                        f"registry raises on the kind mismatch at runtime"
+                    ),
+                )
+            )
+        label_sets = {d.labels for d in dl if d.labels is not None}
+        if len(label_sets) > 1:
+            findings.append(
+                Finding(
+                    analyzer=rule,
+                    severity=Severity.ERROR,
+                    location=dl[0].location,
+                    symbol=name,
+                    message=(
+                        f"metric {name!r} registered with different label "
+                        f"sets {sorted(label_sets)} at "
+                        f"{', '.join(d.location for d in dl)} — the first "
+                        f"registration wins and later label kwargs raise"
+                    ),
+                )
+            )
+
+    # call-site label check: resolve assignments to their declared label
+    # sets, then verify x.inc(model=...) kwargs. Resolution is SCOPED —
+    # `self.X` per enclosing class, bare names per enclosing function —
+    # so two classes (or functions) in one module reusing an attribute or
+    # variable name cannot cross-talk into false positives.
+    def bind(node: ast.Assign, want_self: bool):
+        if len(node.targets) != 1 or not isinstance(node.value, ast.Call):
+            return None
+        tgt = node.targets[0]
+        if want_self:
+            if not (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                return None
+            key = f"self.{tgt.attr}"
+        else:
+            if not isinstance(tgt, ast.Name):
+                return None
+            key = tgt.id
+        d = _metric_decl(node.value, sf.path)
+        if d is not None and d.labels is not None:
+            return key, (d.labels, d.name)
+        helper = call_name(node.value).rsplit(".", 1)[-1]
+        if helper in helper_labels and helper_labels[helper] is not None:
+            return key, (helper_labels[helper], helper)
+        return None
+
+    def receiver_key(node: ast.Call, want_self: bool):
+        if not isinstance(node.func, ast.Attribute):
+            return None, None
+        method = node.func.attr
+        if method not in _OBSERVE_METHODS:
+            return None, None
+        recv = node.func.value
+        if want_self:
+            if (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+            ):
+                return f"self.{recv.attr}", method
+        elif isinstance(recv, ast.Name):
+            return recv.id, method
+        return None, None
+
+    def check_scope(scope: ast.AST, want_self: bool):
+        var_labels: Dict[str, Tuple[Tuple[str, ...], str]] = {}
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign):
+                bound = bind(node, want_self)
+                if bound is not None:
+                    var_labels[bound[0]] = bound[1]
+        if not var_labels:
+            return
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            key, method = receiver_key(node, want_self)
+            if key is None or key not in var_labels:
+                continue
+            declared, mname = var_labels[key]
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **labels — not statically checkable
+            passed = tuple(sorted(kw.arg for kw in node.keywords))
+            if passed != tuple(sorted(declared)):
+                if sources.suppressed(sf.path, node.lineno, rule):
+                    continue
+                findings.append(
+                    Finding(
+                        analyzer=rule,
+                        severity=Severity.ERROR,
+                        location=f"{sf.path}:{node.lineno}",
+                        symbol=mname,
+                        message=(
+                            f"{key}.{method}() passes labels "
+                            f"{sorted(passed)} but metric {mname!r} declares "
+                            f"{sorted(declared)} — raises at first call"
+                        ),
+                    )
+                )
+
+    for sf in sources:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                check_scope(node, want_self=True)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_scope(node, want_self=False)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# config-reachability
+# ---------------------------------------------------------------------------
+
+
+def _config_fields(sources: SourceSet) -> List[Tuple[str, str, int]]:
+    """(class, field, line) for every dataclass field in config/platform.py."""
+    sf = sources.files.get(_CONFIG_MODULE)
+    if sf is None or sf.tree is None:
+        return []
+    out = []
+    for cls in [n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)]:
+        for stmt in cls.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                out.append((cls.name, stmt.target.id, stmt.lineno))
+    return out
+
+
+def _non_docstring_strings(tree: ast.AST) -> Set[str]:
+    """String constants that are real expressions (docstrings excluded)."""
+    doc_nodes: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Constant):
+            doc_nodes.add(id(node.value))
+    return {
+        n.value
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Constant)
+        and isinstance(n.value, str)
+        and id(n) not in doc_nodes
+    }
+
+
+def check_config_reachability(sources: SourceSet) -> List[Finding]:
+    rule = "config-reachability"
+    fields = _config_fields(sources)
+    if not fields:
+        return []
+    attr_reads: Set[str] = set()
+    string_uses: Set[str] = set()
+    for sf in sources:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+                attr_reads.add(node.attr)
+        string_uses |= _non_docstring_strings(sf.tree)
+    findings: List[Finding] = []
+    for cls, field, line in fields:
+        if field in attr_reads or field in string_uses:
+            continue
+        if sources.suppressed(_CONFIG_MODULE, line, rule):
+            continue
+        findings.append(
+            Finding(
+                analyzer=rule,
+                severity=Severity.ERROR,
+                location=f"{_CONFIG_MODULE}:{line}",
+                symbol=f"{cls}.{field}",
+                message=(
+                    f"config knob {cls}.{field} is never read anywhere — "
+                    f"an operator setting it changes nothing (orphan knob)"
+                ),
+            )
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# env-reachability
+# ---------------------------------------------------------------------------
+
+
+def check_env_reachability(sources: SourceSet) -> List[Finding]:
+    rule = "env-reachability"
+    rendered: Dict[str, str] = {}  # var -> first render location
+    consumed: Set[str] = set()
+    for sf in sources:
+        if sf.tree is None:
+            continue
+        is_controller = sf.path.startswith(_ENV_RENDER_PREFIX)
+        is_consumer = sf.path.startswith(_ENV_CONSUMER_PREFIXES)
+        if not (is_controller or is_consumer):
+            continue
+        doc_filtered = _non_docstring_strings(sf.tree)
+        for s in doc_filtered:
+            if not _ENV_RE.match(s):
+                continue
+            if is_controller:
+                rendered.setdefault(s, sf.path)
+            if is_consumer:
+                consumed.add(s)
+    findings: List[Finding] = []
+    for var, where in sorted(rendered.items()):
+        if var in consumed:
+            continue
+        findings.append(
+            Finding(
+                analyzer=rule,
+                severity=Severity.ERROR,
+                location=where,
+                symbol=var,
+                message=(
+                    f"{var} is rendered into pod env by the controllers but "
+                    f"never consumed under {', '.join(_ENV_CONSUMER_PREFIXES)}"
+                    f" — the pods silently ignore this contract"
+                ),
+            )
+        )
+    return findings
+
+
+def run_consistency(sources: SourceSet) -> List[Finding]:
+    out: List[Finding] = []
+    out.extend(check_metrics_consistency(sources))
+    out.extend(check_config_reachability(sources))
+    out.extend(check_env_reachability(sources))
+    return out

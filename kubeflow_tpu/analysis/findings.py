@@ -1,0 +1,126 @@
+"""Finding / severity / baseline model for `kft-analyze`.
+
+Every analyzer (AST invariant passes in control_plane.py / consistency.py,
+SPMD program lint in spmd.py) reports through this one vocabulary so the
+CLI, the CI tier, and the tests all consume the same shape — the platform
+twin of the reference's per-language checkers (check_boilerplate) unified
+behind one finding stream.
+
+A finding's `key()` is stable across line-number drift (analyzer + file +
+symbol, not line), which is what the optional baseline suppresses. The
+repo itself ships with NO baseline: every pre-existing violation was fixed
+when the subsystem landed, and CI runs baseline-free (docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from typing import Dict, Iterable, List, Optional, Sequence
+
+
+class Severity(enum.IntEnum):
+    """Ordered so max() over findings yields the process exit policy."""
+
+    INFO = 0      # context / stats, never fails the run
+    WARNING = 1   # fails only under --strict
+    ERROR = 2     # fails the run
+
+    def __str__(self) -> str:  # render "error", not "Severity.ERROR"
+        return self.name.lower()
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: which analyzer, where, and what went wrong.
+
+    `location` is "path:line" for source findings and "plan:<name>" for
+    SPMD program findings; `symbol` names the offending entity (attribute,
+    metric name, config field, parameter path) so baseline keys survive
+    unrelated edits to the same file.
+    """
+
+    analyzer: str
+    severity: Severity
+    location: str
+    message: str
+    symbol: str = ""
+
+    def key(self) -> str:
+        # drop only a trailing :<line> (line drift must not churn
+        # baselines); plan names legitimately contain colons and must
+        # stay whole or distinct plans would share one suppression key
+        path = self.location
+        head, sep, tail = path.rpartition(":")
+        if sep and tail.isdigit():
+            path = head
+        return f"{self.analyzer}::{path}::{self.symbol or self.message}"
+
+    def render(self) -> str:
+        return f"{self.location}: {self.severity}: [{self.analyzer}] {self.message}"
+
+    def to_dict(self) -> Dict[str, str]:
+        return {
+            "analyzer": self.analyzer,
+            "severity": str(self.severity),
+            "location": self.location,
+            "message": self.message,
+            "symbol": self.symbol,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, str]) -> "Finding":
+        return cls(
+            analyzer=d["analyzer"],
+            severity=Severity[d["severity"].upper()],
+            location=d["location"],
+            message=d["message"],
+            symbol=d.get("symbol", ""),
+        )
+
+
+def load_baseline(path: str) -> List[str]:
+    """Baseline file: JSON list of finding keys to suppress."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, list) or not all(isinstance(k, str) for k in data):
+        raise ValueError(f"{path}: baseline must be a JSON list of keys")
+    return data
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    keys = sorted({f.key() for f in findings if f.severity >= Severity.WARNING})
+    with open(path, "w") as f:
+        json.dump(keys, f, indent=1)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Optional[Sequence[str]]
+) -> List[Finding]:
+    if not baseline:
+        return list(findings)
+    keys = set(baseline)
+    return [f for f in findings if f.key() not in keys]
+
+
+def exit_code(findings: Sequence[Finding], strict: bool = False) -> int:
+    """0 = clean; 1 = findings at or above the failing severity."""
+    bar = Severity.WARNING if strict else Severity.ERROR
+    return 1 if any(f.severity >= bar for f in findings) else 0
+
+
+def render_report(findings: Sequence[Finding]) -> str:
+    """Human report, most severe first, stable within a severity."""
+    ordered = sorted(
+        findings, key=lambda f: (-int(f.severity), f.analyzer, f.location)
+    )
+    lines = [f.render() for f in ordered]
+    n_err = sum(1 for f in findings if f.severity == Severity.ERROR)
+    n_warn = sum(1 for f in findings if f.severity == Severity.WARNING)
+    lines.append(
+        f"kft-analyze: {n_err} error(s), {n_warn} warning(s), "
+        f"{len(findings) - n_err - n_warn} info"
+    )
+    return "\n".join(lines)

@@ -1,0 +1,149 @@
+"""Source collection + parsed-AST cache for the control-plane analyzers.
+
+The `jscheck` idiom applied to Python: analyzers never re-read or re-parse
+files themselves — they consume one `SourceSet` so every pass agrees on
+which files exist, what their ASTs are, and which lines carry inline
+suppressions (`# kft-analyze: ignore[rule]`, the escape hatch for the rare
+deliberate exception; CI greps for these in review, they are not a silent
+baseline).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+_SKIP_DIRS = {
+    "__pycache__", ".git", "build", "dist", "artifacts", "node_modules",
+    ".venv", "venv", ".tox", ".eggs", ".mypy_cache", ".pytest_cache",
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*kft-analyze:\s*ignore\[([a-z0-9_,\- ]+)\]")
+
+
+@dataclasses.dataclass
+class SourceFile:
+    path: str          # repo-relative, forward slashes
+    text: str
+    tree: Optional[ast.AST]          # None when the file fails to parse
+    parse_error: Optional[str]
+    suppressions: Dict[int, Set[str]]  # line -> suppressed rule names
+
+
+def _scan_suppressions(text: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out[i] = rules
+    return out
+
+
+class SourceSet:
+    """All first-party Python sources under a root, parsed once."""
+
+    def __init__(self, root: str, subdirs: Optional[List[str]] = None):
+        self.root = os.path.abspath(root)
+        self.files: Dict[str, SourceFile] = {}
+        roots = subdirs if subdirs is not None else ["kubeflow_tpu"]
+        for sub in roots:
+            base = os.path.join(self.root, sub)
+            if os.path.isfile(base) and base.endswith(".py"):
+                self._add(base)
+                continue
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in _SKIP_DIRS
+                )
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        self._add(os.path.join(dirpath, fname))
+
+    def _add(self, abspath: str) -> None:
+        rel = os.path.relpath(abspath, self.root).replace(os.sep, "/")
+        with open(abspath, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        tree: Optional[ast.AST] = None
+        err: Optional[str] = None
+        try:
+            tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:
+            err = f"line {e.lineno}: {e.msg}"
+        self.files[rel] = SourceFile(
+            path=rel,
+            text=text,
+            tree=tree,
+            parse_error=err,
+            suppressions=_scan_suppressions(text),
+        )
+
+    def __iter__(self) -> Iterator[SourceFile]:
+        return iter(self.files.values())
+
+    def suppressed(self, path: str, line: int, rule: str) -> bool:
+        sf = self.files.get(path)
+        if sf is None:
+            return False
+        rules = sf.suppressions.get(line, set())
+        return rule in rules or "all" in rules
+
+
+# ---------------------------------------------------------------------------
+# Small AST conveniences shared by the analyzers.
+# ---------------------------------------------------------------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target: `threading.Thread(...)` -> "threading.Thread",
+    `reg.counter(...)` -> "reg.counter". Unresolvable shapes -> ""."""
+    parts: List[str] = []
+    cur = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif parts:
+        parts.append("?")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def keyword(node: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def string_list(node: Optional[ast.expr]) -> Optional[Tuple[str, ...]]:
+    """A list/tuple of string literals, or None when not statically known."""
+    if node is None:
+        return ()
+    if isinstance(node, (ast.List, ast.Tuple)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def walk_with_parents(tree: ast.AST) -> Iterator[Tuple[ast.AST, List[ast.AST]]]:
+    """Yield (node, ancestor-stack) pairs, outermost ancestor first."""
+    stack: List[ast.AST] = []
+
+    def visit(node: ast.AST) -> Iterator[Tuple[ast.AST, List[ast.AST]]]:
+        yield node, list(stack)
+        stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child)
+        stack.pop()
+
+    yield from visit(tree)

@@ -104,7 +104,12 @@ def build_app(
         name = form.get("name", "")
         if not name or not name.replace("-", "").isalnum():
             raise BadRequest(f"invalid notebook name {name!r}")
-        tpu = form.get("tpu", "") or form.get("tpuTopology", "")
+        # explicit form value (even "" = no TPU) wins; an absent field
+        # falls back to the admin's NotebookDefaults.tpu_topology
+        if "tpu" in form or "tpuTopology" in form:
+            tpu = form.get("tpu", "") or form.get("tpuTopology", "")
+        else:
+            tpu = defaults.tpu_topology
         if tpu and tpu not in TPU_TOPOLOGIES:
             raise BadRequest(
                 f"unknown TPU topology {tpu!r}; known: {sorted(TPU_TOPOLOGIES)}"

@@ -232,7 +232,12 @@ def build_workflow(
 
 def main(argv=None) -> int:
     """CLI: `python -m kubeflow_tpu.ci.workflow --config ci/config.yaml
-    --workflow unit-tests [--changed-files f1,f2] [--artifacts DIR]`."""
+    --workflow unit-tests [--changed-files f1,f2] [--artifacts DIR]`.
+
+    `--workflow all` runs every configured workflow whose include_dirs
+    match the changed files (all of them when no filter is given) — the
+    single-invocation CI entry, so repo-wide tiers like static-analysis
+    cannot be forgotten when a new workflow list is driven by hand."""
     import argparse
 
     ap = argparse.ArgumentParser(prog="kft-ci")
@@ -242,17 +247,26 @@ def main(argv=None) -> int:
     ap.add_argument("--artifacts", default="artifacts")
     args = ap.parse_args(argv)
     entries = {e["name"]: e for e in load_workflows(args.config)}
-    if args.workflow not in entries:
+    changed = [f for f in args.changed_files.split(",") if f]
+    if args.workflow == "all":
+        selected = list(entries.values())
+    elif args.workflow in entries:
+        selected = [entries[args.workflow]]
+    else:
         log.error("unknown workflow %r; known: %s", args.workflow, sorted(entries))
         return 2
-    entry = entries[args.workflow]
-    changed = [f for f in args.changed_files.split(",") if f]
-    if changed and not should_run(entry.get("include_dirs", []), changed):
-        log.info("workflow %s skipped: no changed files match", args.workflow)
-        return 0
-    wf = build_workflow(entry, artifacts_dir=args.artifacts)
-    results = wf.run()
-    return 0 if wf.succeeded(results) else 1
+    rc = 0
+    for entry in selected:
+        if changed and not should_run(entry.get("include_dirs", []), changed):
+            log.info(
+                "workflow %s skipped: no changed files match", entry["name"]
+            )
+            continue
+        wf = build_workflow(entry, artifacts_dir=args.artifacts)
+        results = wf.run()
+        if not wf.succeeded(results):
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -406,7 +406,12 @@ class NotebookDefaults(ConfigNode):
     memory: str = config_field(default="16Gi")
     tpu_topology: str = config_field(default="", help="empty = no TPU attached")
     workspace_size: str = config_field(default="10Gi")
-    enable_culling: bool = config_field(default=True)
+    enable_culling: bool = config_field(
+        default=False,
+        help="auto-stop idle notebooks; OFF by default (matching the "
+        "reference culler's env contract) — flipping this on is an "
+        "explicit operator decision, idle running workloads get stopped",
+    )
     idle_time_minutes: int = config_field(default=60)
     culling_check_period_minutes: int = config_field(default=1)
 
@@ -469,6 +474,14 @@ class PlatformDef(ConfigNode):
     def validate(self) -> None:
         if self.kind != "PlatformDef":
             raise ConfigError(f"kind must be PlatformDef, got {self.kind!r}")
+        # apiVersion gates schema evolution exactly like kind: a spec from
+        # a different group/version must fail loudly, not half-parse
+        group = self.api_version.split("/", 1)[0]
+        if group != "platform.kubeflow-tpu.dev":
+            raise ConfigError(
+                f"api_version must be in the platform.kubeflow-tpu.dev "
+                f"group, got {self.api_version!r}"
+            )
         names = [c.name for c in self.components]
         if len(names) != len(set(names)):
             raise ConfigError("duplicate component names")

@@ -37,26 +37,45 @@ DEFAULT_CHECK_PERIOD_MINUTES = 1
 
 ActivityProbe = Callable[[Dict[str, Any]], Optional[dt.datetime]]
 
+# Knob resolution order (each function below): the reference's env names
+# WIN (the per-controller override contract), then the PlatformDef's
+# NotebookDefaults tree when the controller passes it (`defaults=` —
+# config/platform.py enable_culling / idle_time_minutes /
+# culling_check_period_minutes), then the hardcoded reference defaults.
 
-def culling_enabled() -> bool:
-    return os.environ.get(ENV_ENABLE_CULLING, "false").lower() == "true"
+
+def culling_enabled(defaults=None) -> bool:
+    raw = os.environ.get(ENV_ENABLE_CULLING)
+    if raw is not None:
+        return raw.lower() == "true"
+    if defaults is not None:
+        return bool(defaults.enable_culling)
+    return False
 
 
-def idle_minutes() -> float:
+def idle_minutes(defaults=None) -> float:
     # float (not the reference's int) so sub-minute thresholds work in demos
+    fallback = (
+        float(defaults.idle_time_minutes)
+        if defaults is not None
+        else float(DEFAULT_IDLE_MINUTES)
+    )
     try:
-        return float(os.environ.get(ENV_IDLE_TIME, DEFAULT_IDLE_MINUTES))
+        return float(os.environ.get(ENV_IDLE_TIME, fallback))
     except ValueError:
-        return DEFAULT_IDLE_MINUTES
+        return fallback
 
 
-def check_period_minutes() -> float:
+def check_period_minutes(defaults=None) -> float:
+    fallback = (
+        float(defaults.culling_check_period_minutes)
+        if defaults is not None
+        else float(DEFAULT_CHECK_PERIOD_MINUTES)
+    )
     try:
-        return float(
-            os.environ.get(ENV_CULLING_CHECK_PERIOD, DEFAULT_CHECK_PERIOD_MINUTES)
-        )
+        return float(os.environ.get(ENV_CULLING_CHECK_PERIOD, fallback))
     except ValueError:
-        return DEFAULT_CHECK_PERIOD_MINUTES
+        return fallback
 
 
 def http_activity_probe(notebook: Dict[str, Any]) -> Optional[dt.datetime]:
@@ -83,10 +102,11 @@ def needs_culling(
     notebook: Dict[str, Any],
     probe: ActivityProbe,
     now: Optional[dt.datetime] = None,
+    defaults=None,
 ) -> bool:
     """True if the notebook is idle past the threshold
     (reference culler.go:191 NotebookNeedsCulling)."""
-    if not culling_enabled():
+    if not culling_enabled(defaults):
         return False
     if is_stopped(notebook):
         return False
@@ -96,7 +116,7 @@ def needs_culling(
     now = now or dt.datetime.now(dt.timezone.utc)
     if last.tzinfo is None:
         last = last.replace(tzinfo=dt.timezone.utc)
-    return (now - last) >= dt.timedelta(minutes=idle_minutes())
+    return (now - last) >= dt.timedelta(minutes=idle_minutes(defaults))
 
 
 def stop_annotation_value() -> str:

@@ -156,11 +156,15 @@ class NotebookController(Controller):
         use_istio: bool = True,
         istio_gateway: str = "kubeflow/kubeflow-gateway",
         activity_probe: Optional[culler.ActivityProbe] = None,
+        culling_defaults=None,
     ) -> None:
         super().__init__()
         self.use_istio = use_istio
         self.istio_gateway = istio_gateway
         self.activity_probe = activity_probe or culler.http_activity_probe
+        # PlatformDef's NotebookDefaults culling knobs (enable_culling /
+        # idle_time_minutes / culling_check_period_minutes); env still wins
+        self.culling_defaults = culling_defaults
         self.watches = {
             "StatefulSet": self.map_owned,
             "Pod": self._map_pod,
@@ -227,8 +231,10 @@ class NotebookController(Controller):
         self._mirror_status(store, nb, namespace, name)
 
         # culling check (reference notebook_controller.go:229-247)
-        if not stopped and culler.culling_enabled():
-            if culler.needs_culling(nb, self.activity_probe):
+        if not stopped and culler.culling_enabled(self.culling_defaults):
+            if culler.needs_culling(
+                nb, self.activity_probe, defaults=self.culling_defaults
+            ):
                 fresh = store.get(KIND, name, namespace)
                 fresh["metadata"].setdefault("annotations", {})[
                     culler.STOP_ANNOTATION
@@ -240,7 +246,9 @@ class NotebookController(Controller):
                 )
                 return Result(requeue=True)
             return Result(
-                requeue_after_s=culler.check_period_minutes() * 60.0
+                requeue_after_s=culler.check_period_minutes(
+                    self.culling_defaults
+                ) * 60.0
             )
         return Result()
 

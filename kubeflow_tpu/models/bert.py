@@ -308,9 +308,16 @@ class Bert(nn.Module):
             cfg.vocab_size, dtype=cfg.dtype, name="mlm_out"
         )(h.astype(cfg.dtype)).astype(jnp.float32)
 
+        # pin the pooled [batch, hidden] slice batch-sharded: without the
+        # constraint the partitioner propagates the pooler kernel's fsdp
+        # sharding onto this activation and falls back to an involuntary
+        # full rematerialization on {data, fsdp, pipeline} meshes (caught
+        # by the kft-analyze spmd-remat sweep / test_spmd_diagnostics)
+        cls_tok = shard_constraint(x[:, 0], ("batch", None))
         pooled = nn.tanh(
-            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(x[:, 0])
+            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="pooler")(cls_tok)
         )
+        pooled = shard_constraint(pooled, ("batch", None))
         nsp_logits = nn.Dense(2, dtype=jnp.float32, name="nsp_out")(pooled)
         return {"mlm_logits": logits, "nsp_logits": nsp_logits, "pooled": pooled}
 

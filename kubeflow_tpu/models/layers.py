@@ -175,6 +175,9 @@ def pipeline_scan(
     """
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    out_spec = (
+        P(*tuple(state_spec)[1:]) if state_spec is not None else None
+    )
     m = x_mb.shape[0]
     s = num_stages
     ticks = m + s - 1
@@ -231,7 +234,12 @@ def pipeline_scan(
         state = _constrain(state, state_spec)
         tstate = [_constrain(ts, sp) for ts, sp in zip(tstate, travel_specs)]
         y = stack(state, *tstate, deterministic)
-        out = y[s - 1]
+        # the collected last-stage slab drops the stage dim: pin it to the
+        # remaining (batch, ...) layout or the partitioner keeps the
+        # stage-stacked sharding on the scan's output buffer and falls
+        # into involuntary full rematerialization at S > 2 (caught by the
+        # kft-analyze spmd-remat sweep on the data2 x pipeline4 plan)
+        out = _constrain(y[s - 1], out_spec)
         # inter-stage activations cross in the injection dtype (the model's
         # compute dtype, e.g. bf16 — halves CollectivePermute bytes over
         # ICI); collected outputs keep the stage-output precision

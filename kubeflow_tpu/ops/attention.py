@@ -32,14 +32,14 @@ def auto_attention_impl(
     residual) would blow HBM. Gate on per-device score bytes — under pjit
     the traced batch dim is GLOBAL, so divide by the ambient mesh's batch
     sharding."""
-    from jax.sharding import get_abstract_mesh
+    from kubeflow_tpu.parallel.shard_map import active_mesh
 
     on_tpu = jax.default_backend() == "tpu"
     if not on_tpu:
         return "dense"  # the compiled kernel path only exists on TPU
     if causal and seq_len >= _CAUSAL_FLASH_MIN_SEQ:
         return "flash"
-    mesh = get_abstract_mesh()
+    mesh = active_mesh()
     dp = 1
     if mesh is not None and mesh.axis_names:
         for a in ("data", "fsdp"):

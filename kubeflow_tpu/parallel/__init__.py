@@ -17,8 +17,16 @@ from kubeflow_tpu.parallel.distributed import (
     initialize_from_env,
     render_gang_env,
 )
+from kubeflow_tpu.parallel.shard_map import (
+    active_mesh,
+    mark_varying,
+    shard_map_pallas,
+)
 
 __all__ = [
+    "active_mesh",
+    "mark_varying",
+    "shard_map_pallas",
     "MESH_AXIS_ORDER",
     "MeshSpec",
     "build_mesh",

@@ -29,6 +29,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from kubeflow_tpu.parallel.shard_map import (
+    active_mesh,
+    mark_varying,
+    shard_map_pallas,
+)
+
 
 def _block_attn(q, k, v, mask_kv, dtype, pos_mask=None):
     """One (q_block, kv_block) tile, dense jnp path: normalized output +
@@ -154,12 +160,9 @@ def ring_attention_inner(
 
     # mark the fresh accumulators as device-varying over the ring axis
     # so the scan carry type matches the ppermute-produced K/V blocks
-    # (pcast supersedes the deprecated jax.lax.pvary).
+    # (parallel/shard_map.py handles the pcast/pvary/pre-vma spellings).
     def _varying(x):
-        pcast = getattr(jax.lax, "pcast", None)
-        if pcast is not None:
-            return pcast(x, (axis_name,), to="varying")
-        return jax.lax.pvary(x, (axis_name,))  # pre-pcast jax
+        return mark_varying(x, (axis_name,))
 
     o0 = _varying(jnp.zeros((b, qs, h, d), jnp.float32))
     # the first step is never the -inf branch for a row that sees anything
@@ -193,7 +196,7 @@ def ring_attention(
     (impl="dense" keeps the einsum-block baseline). Otherwise fall back to
     dense attention — same numerics.
     """
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = active_mesh()
     seq_real = (
         mesh is not None
         and axis_name in mesh.axis_names
@@ -213,23 +216,20 @@ def ring_attention(
         causal=causal,
         impl=impl,
     )
-    # check_vma off: the pallas kernels inside the ring body produce
-    # outputs without varying-mesh-axes metadata (their out_shape cannot
-    # declare vma), which the checker would reject
+    # vma checking off for the pallas bodies — through the ONE audited
+    # helper (parallel/shard_map.py; enforced by kft-analyze shard-map-vma)
     if mask is None:
-        mapped = jax.shard_map(
+        mapped = shard_map_pallas(
             lambda q_, k_, v_: fn(q_, k_, v_, None),
             in_specs=(qkv_spec, qkv_spec, qkv_spec),
             out_specs=qkv_spec,
-            axis_names={axis_name},
-            check_vma=False,
+            axis_names=(axis_name,),
         )
         return mapped(q, k, v)
-    mapped = jax.shard_map(
+    mapped = shard_map_pallas(
         lambda q_, k_, v_, m_: fn(q_, k_, v_, m_),
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec,
-        axis_names={axis_name},
-        check_vma=False,
+        axis_names=(axis_name,),
     )
     return mapped(q, k, v, mask)

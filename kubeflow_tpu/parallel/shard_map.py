@@ -1,0 +1,148 @@
+"""The single audited `check_vma=False` shard_map call site.
+
+Sequence-parallel attention (parallel/ring_attention.py, parallel/
+ulysses.py) runs pallas kernels inside shard_map bodies. Pallas outputs
+carry no varying-mesh-axes metadata (their out_shape cannot declare vma),
+so jax's vma checker rejects the body wholesale; the only fix is
+`check_vma=False`. Scattering that escape across call sites disables the
+checker for ANY future mistake in those bodies (advisor round-5 finding;
+VERDICT next-round #9) — so the exception lives HERE, once, documented,
+and the static analyzer (kubeflow_tpu/analysis, rule shard-map-vma) fails
+the build on any direct `check_vma=`/`check_rep=` elsewhere. Policy for
+adding another exception: docs/ANALYSIS.md.
+
+This is also the version-portability seam. Newer jax spells the API
+`jax.shard_map(..., axis_names=..., check_vma=...)` (partial-manual: the
+named axes go manual, the rest stay GSPMD-auto). The CI image's jax
+(0.4.37) predates that: the API is `jax.experimental.shard_map.shard_map
+(..., mesh=..., check_rep=...)`, and its partial-manual mode (`auto=`)
+hard-crashes the jaxlib SPMD partitioner once the body contains
+collectives (manual-subgroup check failure). There the map goes FULLY
+manual instead: the platform's batch layout convention (batch dim sharded
+over ("data", "fsdp"), parallel/sharding.py) is substituted into the
+specs' leading dim so data parallelism survives, and every other
+unnamed axis is replicated inside the body — the explicit spelling of
+the same program, identical numerics.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+# The platform batch-layout convention: activations' leading dim is
+# sharded over these axes when present (parallel/sharding.py LOGICAL_RULES).
+BATCH_AXES: Tuple[str, ...] = ("data", "fsdp")
+
+
+def active_mesh():
+    """The ambient mesh, version-portably, or None.
+
+    Newer jax: `jax.sharding.get_abstract_mesh()` (set by jax.set_mesh /
+    use_mesh). Older jax: the legacy global physical mesh that a
+    `with mesh:` block (what parallel.mesh.set_mesh degrades to there)
+    installs in the thread's resource env.
+    """
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract is not None:
+        mesh = get_abstract()
+        if mesh is None or not getattr(mesh, "axis_names", ()):
+            return None
+        return mesh
+    from jax._src import mesh as mesh_lib
+
+    mesh = mesh_lib.thread_resources.env.physical_mesh
+    return None if mesh.empty else mesh
+
+
+def mark_varying(x, axis_names: Sequence[str]):
+    """Mark fresh per-device values as device-varying over `axis_names` so
+    scan carries type-match collective-produced values (ring attention's
+    accumulators). pcast supersedes the deprecated pvary; runtimes that
+    predate the vma system need no marking at all."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, tuple(axis_names), to="varying")
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, tuple(axis_names))
+    return x  # pre-vma jax: nothing to mark
+
+
+def _present_batch_axes(mesh) -> Tuple[str, ...]:
+    return tuple(
+        a for a in BATCH_AXES
+        if a in mesh.axis_names and dict(mesh.shape)[a] > 1
+    )
+
+
+def _widen_batch(spec: P, batch: Tuple[str, ...]) -> P:
+    """Full-manual specs must name every sharded dim explicitly: widen a
+    None leading (batch) dim to the mesh's present batch axes."""
+    entries = tuple(spec)
+    if entries and entries[0] is None and batch:
+        first = batch if len(batch) > 1 else batch[0]
+        entries = (first,) + entries[1:]
+    return P(*entries)
+
+
+def shard_map_pallas(
+    fn,
+    *,
+    in_specs: Tuple[P, ...],
+    out_specs: P,
+    axis_names: Sequence[str],
+    mesh=None,
+):
+    """shard_map for bodies that run pallas kernels — vma checking off.
+
+    `in_specs`/`out_specs` are written in the partial-manual style (only
+    the manual `axis_names` appear; the batch dim is None). On jax with
+    `jax.shard_map` that is passed through directly; on the legacy API the
+    specs are widened per the batch convention and the map runs fully
+    manual with `check_rep=False` (see module docstring).
+    """
+    axis_set = set(axis_names)
+    new_shard_map = getattr(jax, "shard_map", None)
+    if new_shard_map is not None:
+        kwargs = {} if mesh is None else {"mesh": mesh}
+        return new_shard_map(
+            fn,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            axis_names=axis_set,
+            check_vma=False,
+            **kwargs,
+        )
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+
+    m = mesh if mesh is not None else active_mesh()
+    if m is None:
+        raise ValueError(
+            "shard_map_pallas needs an ambient mesh on this jax "
+            "(wrap the call in parallel.mesh.set_mesh)"
+        )
+
+    def call(*args):
+        # batch widening is a call-time decision: a batch dim smaller than
+        # (or ragged against) the data axes cannot be manually split — it
+        # stays replicated inside the body instead, which is the same
+        # program partial-manual mode would have produced
+        batch = _present_batch_axes(m)
+        dp = 1
+        for a in batch:
+            dp *= dict(m.shape)[a]
+        if not args or args[0].shape[0] % dp != 0:
+            batch = ()
+        mapped = legacy_shard_map(
+            fn,
+            mesh=m,
+            in_specs=tuple(_widen_batch(s, batch) for s in in_specs),
+            out_specs=_widen_batch(out_specs, batch),
+            check_rep=False,
+        )
+        return mapped(*args)
+
+    return call

@@ -78,6 +78,7 @@ class Platform:
                 use_istio=use_istio,
                 istio_gateway=gw,
                 activity_probe=activity_probe,
+                culling_defaults=self.platform_def.notebooks,
             ),
             TensorboardController(use_istio=use_istio, istio_gateway=gw),
             InferenceServiceController(use_istio=use_istio, istio_gateway=gw),

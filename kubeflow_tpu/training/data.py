@@ -22,6 +22,24 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+def ensure_layout_invariant_rng() -> None:
+    """Pin partitionable threefry: device-side RNG must be LAYOUT-INVARIANT.
+
+    The on-device synthetic generator (device_batch_fn) and dropout both
+    draw sharded random bits, and with the legacy non-partitionable
+    threefry this jax version computes DIFFERENT bits per mesh layout — a
+    gang resumed on a reshaped mesh would silently train on different data
+    (found by the kft-analyze plan sweep: DP-vs-SP trainer losses diverged
+    at step 1). Newer jax defaults to the partitionable implementation.
+
+    Called from the platform's process entry points (Trainer construction,
+    the analysis subprocess, the test conftest) — NOT at import time, so
+    merely importing the package never flips a process-global RNG flag
+    under unrelated user code.
+    """
+    if hasattr(jax.config, "jax_threefry_partitionable"):
+        jax.config.update("jax_threefry_partitionable", True)
+
 
 class SyntheticData:
     """Deterministic synthetic batches for image or MLM tasks."""

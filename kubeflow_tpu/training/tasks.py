@@ -89,10 +89,12 @@ class ImageClassificationTask:
         self.image_size = image_size
         self.num_classes = num_classes
 
-    def synthetic_data(self) -> SyntheticData:
+    def synthetic_data(self, batch_size: Optional[int] = None) -> SyntheticData:
+        # batch_size override: analysis-only probes (kubeflow_tpu/analysis)
+        # need the batch SCHEMA without materializing a production-size batch
         return SyntheticData(
             "image",
-            self.cfg.global_batch_size,
+            batch_size or self.cfg.global_batch_size,
             seed=self.cfg.seed,
             image_size=self.image_size,
             num_classes=self.num_classes,
@@ -164,10 +166,10 @@ class MlmTask:
             getattr(cfg, "assume_full_attention", False)
         )
 
-    def synthetic_data(self) -> SyntheticData:
+    def synthetic_data(self, batch_size: Optional[int] = None) -> SyntheticData:
         return SyntheticData(
             "mlm",
-            self.cfg.global_batch_size,
+            batch_size or self.cfg.global_batch_size,
             seed=self.cfg.seed,
             seq_len=self.seq_len,
             vocab_size=self.vocab_size,
@@ -257,10 +259,10 @@ class CausalLmTask:
             getattr(cfg, "assume_full_attention", False)
         )
 
-    def synthetic_data(self) -> SyntheticData:
+    def synthetic_data(self, batch_size: Optional[int] = None) -> SyntheticData:
         return SyntheticData(
             "lm",
-            self.cfg.global_batch_size,
+            batch_size or self.cfg.global_batch_size,
             seed=self.cfg.seed,
             seq_len=self.seq_len,
             vocab_size=self.vocab_size,

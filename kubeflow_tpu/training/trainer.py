@@ -35,7 +35,10 @@ from kubeflow_tpu.models.registry import get_model
 from kubeflow_tpu.parallel.mesh import mesh_from_config, set_mesh
 from kubeflow_tpu.parallel.sharding import logical_to_spec
 from kubeflow_tpu.training.annotations import logical_axes_for
-from kubeflow_tpu.training.data import make_global_batch
+from kubeflow_tpu.training.data import (
+    ensure_layout_invariant_rng,
+    make_global_batch,
+)
 from kubeflow_tpu.training.prefetch import DevicePrefetcher
 from kubeflow_tpu.training.tasks import make_optimizer, task_for_model
 from kubeflow_tpu.utils.logging import get_logger
@@ -73,6 +76,9 @@ class Trainer:
         model_kwargs: Optional[Dict[str, Any]] = None,
     ):
         self.cfg = cfg
+        # every training program must draw layout-invariant random bits
+        # (resume on a reshaped mesh = identical data + dropout streams)
+        ensure_layout_invariant_rng()
         self.mesh = mesh if mesh is not None else mesh_from_config(
             cfg.mesh, num_slices=num_slices
         )
@@ -132,12 +138,9 @@ class Trainer:
 
     # ---- state init ----------------------------------------------------
 
-    def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
-        """Initialize params already laid out per the mesh (no host round-trip)."""
-        if rng is None:
-            rng = jax.random.PRNGKey(self.cfg.seed)
-        sample = self.task.synthetic_data().batch_at(0)
-        sample = {k: v[:1] for k, v in sample.items()}
+    def _make_init_fn(self, sample):
+        """State-init closure over a one-row sample batch (shared by the
+        executing init_state and the analysis-only abstract_state)."""
 
         def init_fn(rng):
             variables = self.task.init_variables(self.model, rng, sample)
@@ -155,12 +158,39 @@ class Trainer:
                 opt_state=opt_state,
             )
 
+        return init_fn
+
+    def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
+        """Initialize params already laid out per the mesh (no host round-trip)."""
+        if rng is None:
+            rng = jax.random.PRNGKey(self.cfg.seed)
+        sample = self.task.synthetic_data().batch_at(0)
+        sample = {k: v[:1] for k, v in sample.items()}
+        init_fn = self._make_init_fn(sample)
         with set_mesh(self.mesh):
             shapes = jax.eval_shape(init_fn, rng)
             shardings = self.state_shardings(shapes)
             state = jax.jit(init_fn, out_shardings=shardings)(rng)
         self._state_shardings = shardings
         return state
+
+    def abstract_state(self, sample=None) -> Tuple[TrainState, TrainState]:
+        """(state shapes, shardings) WITHOUT touching devices — the static
+        analyzer's entry (kubeflow_tpu/analysis/spmd.py): eval_shape over
+        the init closure, shardings from the same logical-annotation path
+        init_state uses, nothing executed. `sample` is a one-row batch
+        giving the data schema (defaults to the task's synthetic batch)."""
+        if sample is None:
+            sample = self.task.synthetic_data(batch_size=1).batch_at(0)
+        sample = {k: v[:1] for k, v in sample.items()}
+        init_fn = self._make_init_fn(sample)
+        with set_mesh(self.mesh):
+            shapes = jax.eval_shape(
+                init_fn, jax.random.PRNGKey(self.cfg.seed)
+            )
+            shardings = self.state_shardings(shapes)
+        self._state_shardings = shardings
+        return shapes, shardings
 
     def state_shardings(self, state_shapes: TrainState) -> TrainState:
         """Derive NamedShardings for every leaf of the state."""
@@ -212,8 +242,11 @@ class Trainer:
 
     # ---- the step ------------------------------------------------------
 
-    def _build_train_step(self, state: TrainState):
-        mesh = self.mesh
+    def _make_step_fn(self, state: TrainState):
+        """The raw (unjitted) step closure — `state` is only inspected for
+        its variable structure, so ShapeDtypeStruct trees work (the
+        analyzer traces this with jax.make_jaxpr; _build_train_step wraps
+        it in the sharded jit)."""
         task = self.task
         model = self.model
         tx = self.tx
@@ -226,8 +259,6 @@ class Trainer:
                 "statistics (BatchNorm): per-microbatch stats != "
                 "full-batch stats"
             )
-        batch_sh = NamedSharding(mesh, P(("data", "fsdp")))
-        shardings = self._state_shardings
 
         def step_fn(state: TrainState, batch, rng):
             # every stream is a pure function of (seed rng, step): a
@@ -333,8 +364,14 @@ class Trainer:
             metrics = {"loss": loss, **out["aux"]}
             return new_state, metrics
 
+        return step_fn
+
+    def _build_train_step(self, state: TrainState):
+        mesh = self.mesh
+        batch_sh = NamedSharding(mesh, P(("data", "fsdp")))
+        shardings = self._state_shardings
         return jax.jit(
-            step_fn,
+            self._make_step_fn(state),
             in_shardings=(shardings, batch_sh, NamedSharding(mesh, P())),
             out_shardings=(shardings, NamedSharding(mesh, P())),
             donate_argnums=(0,),

@@ -1,0 +1,27 @@
+#!/usr/bin/env python3
+"""Static-analysis entry point (the check_boilerplate.py-style wrapper
+around `python -m kubeflow_tpu.analysis`): run from anywhere, repo root
+auto-detected, args forwarded to the kft-analyze CLI. The CI
+static-analysis workflow (ci/config.yaml) invokes this; exits 1 on any
+ERROR finding, 0 when the repo is clean."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    sys.path.insert(0, REPO)
+    from kubeflow_tpu.analysis.cli import main as analyze
+
+    argv = sys.argv[1:]
+    if not any(a.startswith("--root") for a in argv):
+        argv = ["--root", REPO] + argv
+    return analyze(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

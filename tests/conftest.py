@@ -22,6 +22,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Layout-invariant device RNG for every test, exactly as the platform's
+# entry points pin it (training/data.py::ensure_layout_invariant_rng):
+# mesh-layout-equivalence tests rely on identical bits across shardings.
+if hasattr(jax.config, "jax_threefry_partitionable"):
+    jax.config.update("jax_threefry_partitionable", True)
+
 # NOTE: do NOT point the whole suite at a persistent compile cache here.
 # Tried and reverted: this image's jaxlib (0.4.36) hard-aborts (Fatal
 # Python error) serializing some programs (test_augment's) into the
@@ -30,6 +36,14 @@ jax.config.update("jax_platforms", "cpu")
 # covered by test_compile_cache.py against tmp dirs).
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: production-topology sweeps excluded from the tier-1 budget "
+        "(run by the static-analysis CI workflow)",
+    )
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,515 @@
+"""kft-analyze subsystem tests — the jscheck seeded-typo discipline.
+
+Both directions, per analyzer: a seeded violation of every class is
+DETECTED (lock misuse, leaked thread, direct check_vma, metric label
+drift, orphan config knob, unconsumed KFT_* env, replicated large param,
+DCN collective in the scanned body), and the shipped repo / shipped plans
+are CLEAN. The clean half is the merge gate: `python -m
+kubeflow_tpu.analysis` must exit 0 baseline-free (ISSUE 3 acceptance).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.analysis import Finding, Severity, SourceSet
+from kubeflow_tpu.analysis.consistency import (
+    check_config_reachability,
+    check_env_reachability,
+    check_metrics_consistency,
+)
+from kubeflow_tpu.analysis.control_plane import (
+    check_lock_discipline,
+    check_shard_map_vma,
+    check_thread_hygiene,
+)
+from kubeflow_tpu.analysis.findings import (
+    apply_baseline,
+    exit_code,
+    load_baseline,
+    write_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(tmp_path, files):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return SourceSet(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# seeded violations: every analyzer class must fire
+# ---------------------------------------------------------------------------
+
+
+class TestSeededLockDiscipline:
+    def test_read_outside_lock_detected(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/bad.py": '''
+            """seed"""
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stats = {}
+
+                def update(self, d):
+                    with self._lock:
+                        self.stats = d
+
+                def handler(self):
+                    return self.stats["x"]  # the PR-2 race class
+        '''})
+        findings = check_lock_discipline(src)
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.analyzer == "lock-discipline"
+        assert f.symbol == "Server.stats"
+        assert "without the lock" in f.message
+
+    def test_write_outside_lock_detected(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/bad.py": '''
+            """seed"""
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.state = 0
+
+                def locked(self):
+                    with self._lock:
+                        self.state = 1
+
+                def unlocked(self):
+                    self.state = 2
+        '''})
+        assert [f.symbol for f in check_lock_discipline(src)] == ["Server.state"]
+
+    def test_disciplined_class_clean(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/good.py": '''
+            """seed"""
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stats = {}
+
+                def update(self, d):
+                    with self._lock:
+                        self.stats = d
+
+                def read(self):
+                    with self._lock:
+                        return dict(self.stats)
+        '''})
+        assert check_lock_discipline(src) == []
+
+    def test_suppression_comment(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/sup.py": '''
+            """seed"""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def w(self):
+                    with self._lock:
+                        self.v = 1
+
+                def r(self):
+                    return self.v  # kft-analyze: ignore[lock-discipline]
+        '''})
+        assert check_lock_discipline(src) == []
+
+
+class TestSeededThreadHygiene:
+    def test_bare_thread_detected(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/bad.py": '''
+            """seed"""
+            import threading
+
+            def go():
+                t = threading.Thread(target=print)
+                t.start()
+        '''})
+        findings = check_thread_hygiene(src)
+        assert len(findings) == 1
+        assert findings[0].analyzer == "thread-hygiene"
+
+    def test_daemon_and_joined_clean(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/good.py": '''
+            """seed"""
+            import threading
+
+            def daemonized():
+                threading.Thread(target=print, daemon=True).start()
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=print, daemon=False)
+                    self._t.start()
+
+                def close(self):
+                    self._t.join(timeout=2)
+        '''})
+        assert check_thread_hygiene(src) == []
+
+
+class TestSeededVma:
+    def test_direct_check_vma_detected(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/parallel/rogue.py": '''
+            """seed"""
+            import jax
+
+            def f(fn, specs):
+                return jax.shard_map(
+                    fn, in_specs=specs, out_specs=specs,
+                    axis_names={"sequence"}, check_vma=False,
+                )
+        '''})
+        findings = check_shard_map_vma(src)
+        assert len(findings) == 1
+        assert findings[0].analyzer == "shard-map-vma"
+        assert "shard_map_pallas" in findings[0].message
+
+    def test_legacy_check_rep_detected(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/parallel/rogue.py": '''
+            """seed"""
+            from jax.experimental.shard_map import shard_map
+
+            def f(fn, mesh, specs):
+                return shard_map(fn, mesh=mesh, in_specs=specs,
+                                 out_specs=specs, check_rep=False)
+        '''})
+        assert [f.symbol for f in check_shard_map_vma(src)] == ["check_rep"]
+
+    def test_helper_module_exempt(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/parallel/shard_map.py": '''
+            """the audited exception"""
+            import jax
+
+            def shard_map_pallas(fn, specs):
+                return jax.shard_map(fn, in_specs=specs, out_specs=specs,
+                                     axis_names={"sequence"}, check_vma=False)
+        '''})
+        assert check_shard_map_vma(src) == []
+
+
+class TestSeededMetrics:
+    def test_conflicting_labels_detected(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/m.py": '''
+            """seed"""
+            def a(reg):
+                return reg.counter("requests_total", "h", ["model"])
+
+            def b(reg):
+                return reg.counter("requests_total", "h", ["model", "code"])
+        '''})
+        findings = check_metrics_consistency(src)
+        assert any(
+            f.symbol == "requests_total" and "label sets" in f.message
+            for f in findings
+        )
+
+    def test_kind_conflict_detected(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/m.py": '''
+            """seed"""
+            def a(reg):
+                return reg.counter("depth", "h")
+
+            def b(reg):
+                return reg.gauge("depth", "h")
+        '''})
+        findings = check_metrics_consistency(src)
+        assert any(f.symbol == "depth" and "counter and gauge" in f.message
+                   for f in findings)
+
+    def test_call_site_label_mismatch_detected(self, tmp_path):
+        src = _tree(tmp_path, {"kubeflow_tpu/m.py": '''
+            """seed"""
+            class S:
+                def __init__(self, reg):
+                    self._requests = reg.counter("reqs_total", "h", ["model"])
+
+                def handle(self):
+                    self._requests.inc(route="/x")  # wrong label name
+        '''})
+        findings = check_metrics_consistency(src)
+        assert any("declares" in f.message and f.symbol == "reqs_total"
+                   for f in findings)
+
+
+class TestSeededReachability:
+    def test_orphan_config_knob_detected(self, tmp_path):
+        src = _tree(tmp_path, {
+            "kubeflow_tpu/config/platform.py": '''
+                """seed"""
+                import dataclasses
+
+                @dataclasses.dataclass
+                class TrainingConfig:
+                    steps: int = 100
+                    orphan_knob: int = 3
+            ''',
+            "kubeflow_tpu/runtime/run.py": '''
+                """seed"""
+                def run(cfg):
+                    return cfg.steps
+            ''',
+        })
+        findings = check_config_reachability(src)
+        assert [f.symbol for f in findings] == ["TrainingConfig.orphan_knob"]
+
+    def test_unconsumed_env_detected(self, tmp_path):
+        src = _tree(tmp_path, {
+            "kubeflow_tpu/controllers/job.py": '''
+                """seed"""
+                def render(env):
+                    env["KFT_CONSUMED_DIR"] = "/x"
+                    env["KFT_GHOST_KNOB"] = "1"
+            ''',
+            "kubeflow_tpu/runtime/run.py": '''
+                """seed"""
+                import os
+
+                def run():
+                    return os.environ.get("KFT_CONSUMED_DIR")
+            ''',
+        })
+        findings = check_env_reachability(src)
+        assert [f.symbol for f in findings] == ["KFT_GHOST_KNOB"]
+
+    def test_docstring_mention_is_not_a_render(self, tmp_path):
+        src = _tree(tmp_path, {
+            "kubeflow_tpu/controllers/job.py": '''
+                """Controller docs mention KFT_DOC_ONLY but render nothing."""
+            ''',
+        })
+        assert check_env_reachability(src) == []
+
+
+class TestSeededSpmd:
+    def test_replicated_large_param_detected(self, devices8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kubeflow_tpu.analysis.spmd import check_replicated_params
+        from kubeflow_tpu.parallel.mesh import default_mesh_for
+
+        mesh = default_mesh_for(8, fsdp=2)
+        shapes = {
+            "embed": jax.ShapeDtypeStruct((4096, 512), np.float32),
+            "bias": jax.ShapeDtypeStruct((512,), np.float32),
+        }
+        replicated = {
+            "embed": NamedSharding(mesh, P()),
+            "bias": NamedSharding(mesh, P()),
+        }
+        findings = check_replicated_params(
+            shapes, replicated, dict(mesh.shape), "seed", threshold=1 << 20
+        )
+        assert findings and findings[0].analyzer == "spmd-replicated-param"
+        assert "embed" in findings[0].symbol
+        # the small bias replicating is fine
+        assert all("bias" not in f.symbol for f in findings)
+
+        sharded = {
+            "embed": NamedSharding(mesh, P("fsdp", None)),
+            "bias": NamedSharding(mesh, P()),
+        }
+        assert check_replicated_params(
+            shapes, sharded, dict(mesh.shape), "seed", threshold=1 << 20
+        ) == []
+
+    def test_replicated_param_inert_without_shard_axes(self, devices8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kubeflow_tpu.analysis.spmd import check_replicated_params
+        from kubeflow_tpu.parallel.mesh import default_mesh_for
+
+        mesh = default_mesh_for(8)  # pure DP: replication is correct
+        shapes = {"w": jax.ShapeDtypeStruct((4096, 512), np.float32)}
+        specs = {"w": NamedSharding(mesh, P())}
+        assert check_replicated_params(
+            shapes, specs, dict(mesh.shape), "seed", threshold=1
+        ) == []
+
+    def test_dcn_collective_in_scan_detected(self, devices8):
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.analysis.spmd import (
+            check_dcn_collectives,
+            collect_collectives,
+        )
+        from kubeflow_tpu.parallel.mesh import default_mesh_for, set_mesh
+        from kubeflow_tpu.parallel.shard_map import shard_map_pallas
+        from jax.sharding import PartitionSpec as P
+
+        mesh = default_mesh_for(8, sequence=2)
+
+        def body(x):
+            n = jax.lax.psum(1, "sequence")
+            perm = [(j, (j + 1) % n) for j in range(n)]
+
+            def step(c, _):
+                c = jax.lax.ppermute(c, "sequence", perm)
+                return c, c.sum()
+
+            out, _ = jax.lax.scan(step, x, jnp.arange(n))
+            return out
+
+        with set_mesh(mesh):
+            mapped = shard_map_pallas(
+                body,
+                in_specs=(P(None, "sequence"),),
+                out_specs=P(None, "sequence"),
+                axis_names=("sequence",),
+            )
+            closed = jax.make_jaxpr(mapped)(
+                jax.ShapeDtypeStruct((4, 8), np.float32)
+            )
+        colls = collect_collectives(closed.jaxpr)
+        assert any(p == "ppermute" and lp for p, _, lp in colls)
+
+        # the same program is fine on ICI...
+        assert check_dcn_collectives(closed.jaxpr, set(), "seed") == []
+        # ...and a finding when this plan lays `sequence` across DCN
+        findings = check_dcn_collectives(closed.jaxpr, {"sequence"}, "seed")
+        assert findings and findings[0].analyzer == "spmd-dcn-collective"
+
+
+# ---------------------------------------------------------------------------
+# the shipped repo is clean (the merge gate)
+# ---------------------------------------------------------------------------
+
+
+class TestRepoClean:
+    def test_control_plane_clean(self):
+        from kubeflow_tpu.analysis.control_plane import run_control_plane
+
+        findings = run_control_plane(SourceSet(REPO))
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_consistency_clean(self):
+        from kubeflow_tpu.analysis.consistency import run_consistency
+
+        findings = run_consistency(SourceSet(REPO))
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_check_vma_single_call_site(self):
+        """`check_vma=`/`check_rep=` keyword CALL SITES exist in exactly
+        one parallel/ module: the audited helper (ISSUE 3 acceptance) —
+        one per jax API generation inside shard_map_pallas."""
+        import ast
+
+        hits = []
+        pdir = os.path.join(REPO, "kubeflow_tpu", "parallel")
+        for fname in sorted(os.listdir(pdir)):
+            if not fname.endswith(".py"):
+                continue
+            tree = ast.parse(open(os.path.join(pdir, fname)).read())
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call):
+                    for kw in node.keywords:
+                        if kw.arg in ("check_vma", "check_rep"):
+                            hits.append(fname)
+        assert hits == ["shard_map.py", "shard_map.py"], hits
+
+    def test_cli_ast_only_clean(self, capsys):
+        from kubeflow_tpu.analysis.cli import main
+
+        rc = main(["--root", REPO, "--spmd", "off"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "0 error(s)" in out
+
+
+class TestShippedPlansClean:
+    def test_dryrun_plans_lower_clean(self, devices8):
+        """Every dryrun plan traces/lowers clean in-process (the compile-
+        mode remat capture over these same meshes is exercised by CI's
+        dryrun and tests/test_spmd_diagnostics.py)."""
+        from kubeflow_tpu.analysis.plans import dryrun_plan_specs
+        from kubeflow_tpu.analysis.spmd import analyze_plan
+
+        for spec in dryrun_plan_specs(8, compile=False):
+            findings, stats = analyze_plan(spec)
+            bad = [f for f in findings if f.severity >= Severity.ERROR]
+            assert bad == [], (
+                spec.name + "\n" + "\n".join(f.render() for f in bad)
+            )
+            assert stats["jaxpr_eqns"] > 0
+
+    @pytest.mark.slow
+    def test_yaml_configs_clean(self):
+        """Every shipped configs/*.yaml lowers clean at its real topology
+        (16 virtual devices per plan, one subprocess each)."""
+        from kubeflow_tpu.analysis.plans import yaml_plan_specs
+        from kubeflow_tpu.analysis.spmd import analyze_plan_subprocess
+
+        specs = yaml_plan_specs(REPO)
+        assert len(specs) == 3
+        for spec in specs:
+            findings, stats = analyze_plan_subprocess(
+                spec, REPO, timeout_s=600.0
+            )
+            bad = [f for f in findings if f.severity >= Severity.ERROR]
+            assert bad == [], (
+                spec.name + "\n" + "\n".join(f.render() for f in bad)
+            )
+
+    def test_dryrun_specs_match_graft_entry(self):
+        """The dryrun and the analyzer share one plan list (plans.py is
+        the source of truth __graft_entry__ imports)."""
+        import __graft_entry__ as ge
+
+        from kubeflow_tpu.analysis.plans import factor_axes, mesh_plans
+
+        assert ge._factor_axes is factor_axes
+        assert ge._mesh_plans is mesh_plans
+
+
+# ---------------------------------------------------------------------------
+# findings / baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestFindingModel:
+    def test_baseline_roundtrip(self, tmp_path):
+        f1 = Finding("lock-discipline", Severity.ERROR, "a.py:3", "m", "C.x")
+        f2 = Finding("thread-hygiene", Severity.ERROR, "b.py:9", "m", "t")
+        path = tmp_path / "baseline.json"
+        write_baseline(str(path), [f1])
+        keys = load_baseline(str(path))
+        assert keys == [f1.key()]
+        left = apply_baseline([f1, f2], keys)
+        assert left == [f2]
+
+    def test_key_stable_across_line_drift(self):
+        a = Finding("lock-discipline", Severity.ERROR, "a.py:3", "m", "C.x")
+        b = Finding("lock-discipline", Severity.ERROR, "a.py:30", "m2", "C.x")
+        assert a.key() == b.key()
+
+    def test_exit_codes(self):
+        warn = Finding("x", Severity.WARNING, "a.py:1", "m")
+        err = Finding("x", Severity.ERROR, "a.py:1", "m")
+        assert exit_code([]) == 0
+        assert exit_code([warn]) == 0
+        assert exit_code([warn], strict=True) == 1
+        assert exit_code([err]) == 1
+
+    def test_serialization_roundtrip(self):
+        f = Finding("spmd-remat", Severity.ERROR, "plan:p", "msg", "sym")
+        assert Finding.from_dict(json.loads(json.dumps(f.to_dict()))) == f

@@ -125,10 +125,24 @@ class TestTriggerConfig:
     def test_repo_config_parses_and_builds(self):
         entries = load_workflows(os.path.join(REPO, "ci", "config.yaml"))
         names = {e["name"] for e in entries}
-        assert {"unit-tests", "e2e", "images"} <= names
+        assert {"unit-tests", "e2e", "images", "static-analysis"} <= names
         for e in entries:
             wf = build_workflow(e)  # validates DAG + step shapes
             assert wf.steps
+
+    def test_static_analysis_tier_wired_into_dag(self):
+        """The analyzer tier (ISSUE 3): repo-wide (never filtered), and the
+        SPMD plan sweep DEPENDS on the fast AST pass in the DAG."""
+        entries = {
+            e["name"]: e
+            for e in load_workflows(os.path.join(REPO, "ci", "config.yaml"))
+        }
+        tier = entries["static-analysis"]
+        assert tier.get("include_dirs", []) == []  # unskippable
+        wf = build_workflow(tier)
+        assert "control-plane-lint" in wf.steps
+        assert "spmd-lint" in wf.steps
+        assert "control-plane-lint" in wf.steps["spmd-lint"].deps
 
     def test_config_step_files_exist(self):
         """Every pytest path in ci/config.yaml must exist (no drift)."""
@@ -171,6 +185,44 @@ class TestRunnerCli:
             "--config", os.path.join(REPO, "ci", "config.yaml"),
             "--workflow", "nope",
         ]) == 2
+
+    def test_workflow_all_respects_trigger_filters(self, tmp_path):
+        """`--workflow all` is the one-invocation CI entry: with a
+        changed-files filter matching nothing, every filtered workflow
+        skips; the unfiltered (include_dirs []) tiers would still run, so
+        use a config where everything is filtered."""
+        from kubeflow_tpu.ci.workflow import main
+
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text(
+            "workflows:\n"
+            "  - name: a\n"
+            "    include_dirs: [images]\n"
+            "    steps:\n"
+            "      - {name: ok, command: ['true']}\n"
+            "  - name: b\n"
+            "    include_dirs: [docs]\n"
+            "    steps:\n"
+            "      - {name: ok, command: ['true']}\n"
+        )
+        rc = main([
+            "--config", str(cfg),
+            "--workflow", "all",
+            "--changed-files", "kubeflow_tpu/models/bert.py",
+            "--artifacts", str(tmp_path / "a1"),
+        ])
+        assert rc == 0
+        assert not (tmp_path / "a1").exists()  # everything skipped
+
+        rc = main([
+            "--config", str(cfg),
+            "--workflow", "all",
+            "--changed-files", "images/x,docs/y",
+            "--artifacts", str(tmp_path / "a2"),
+        ])
+        assert rc == 0
+        assert (tmp_path / "a2" / "junit_a.xml").exists()
+        assert (tmp_path / "a2" / "junit_b.xml").exists()
 
 
 class TestRelease:

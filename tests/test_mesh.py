@@ -65,10 +65,15 @@ class TestBuildMesh:
         assert m.shape["data"] == 4
 
     def test_psum_over_mesh(self, devices8):
+        # version shim: jax.shard_map is the modern spelling; this CI
+        # image's jax only has the experimental one (same mesh= signature)
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:
+            from jax.experimental.shard_map import shard_map
         m = meshlib.mesh_from_config(MeshConfig(data=8))
         x = jnp.arange(8.0)
         y = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda v: jax.lax.psum(v, "data"),
                 mesh=m,
                 in_specs=P("data"),

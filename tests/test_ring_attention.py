@@ -13,7 +13,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.config.platform import MeshConfig
 from kubeflow_tpu.ops.attention import dense_attention
-from kubeflow_tpu.parallel.mesh import mesh_from_config
+from kubeflow_tpu.parallel.mesh import mesh_from_config, set_mesh
 from kubeflow_tpu.parallel.ring_attention import ring_attention
 
 
@@ -32,7 +32,7 @@ class TestRingAttention:
         dense = dense_attention(q, k, v, mask=None, dtype=jnp.float32)
 
         spec = NamedSharding(mesh, P(None, "sequence"))
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             ring = jax.jit(
                 lambda q, k, v: ring_attention(q, k, v, dtype=jnp.float32)
             )(
@@ -53,7 +53,7 @@ class TestRingAttention:
         dense = dense_attention(q, k, v, mask=mask, dtype=jnp.float32)
         spec = NamedSharding(mesh, P(None, "sequence"))
         mspec = NamedSharding(mesh, P(None, "sequence"))
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             ring = jax.jit(
                 lambda q, k, v, m: ring_attention(q, k, v, m, dtype=jnp.float32)
             )(
@@ -73,7 +73,7 @@ class TestRingAttention:
         q, k, v = _rand_qkv(jax.random.PRNGKey(4))
         dense = dense_attention(q, k, v, dtype=jnp.float32, causal=True)
         spec = NamedSharding(mesh, P(None, "sequence"))
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             ring = jax.jit(
                 lambda q, k, v: ring_attention(
                     q, k, v, dtype=jnp.float32, causal=True
@@ -106,7 +106,7 @@ class TestRingAttention:
 
             return f
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             g_flash = jax.jit(jax.grad(loss("flash"), argnums=(0, 1, 2)))(
                 qs, ks_, vs
             )
@@ -122,7 +122,7 @@ class TestRingAttention:
         mesh = mesh_from_config(MeshConfig(data=8))
         q, k, v = _rand_qkv(jax.random.PRNGKey(3))
         dense = dense_attention(q, k, v, mask=None, dtype=jnp.float32)
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             out = ring_attention(q, k, v, dtype=jnp.float32)
         np.testing.assert_allclose(
             np.asarray(dense), np.asarray(out), rtol=1e-5, atol=1e-5
@@ -139,7 +139,7 @@ class TestRingAttention:
         variables = dense_model.init(jax.random.PRNGKey(0), ids, deterministic=True)
         out_dense = dense_model.apply(variables, ids, deterministic=True)
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             sharding = NamedSharding(mesh, P("data", "sequence"))
             ids_sh = jax.device_put(ids, sharding)
             out_ring = jax.jit(

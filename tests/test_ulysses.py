@@ -13,6 +13,7 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+from kubeflow_tpu.parallel.mesh import set_mesh
 from kubeflow_tpu.parallel.ulysses import ulysses_attention
 from kubeflow_tpu.training.tasks import MlmTask
 from kubeflow_tpu.training.trainer import Trainer
@@ -50,7 +51,7 @@ class TestUlyssesNumerics:
             mask = jnp.arange(s)[None, :] < jnp.array([[s], [s // 2]])
         mesh = seq_mesh(devices8)
         want = dense_reference(q, k, v, mask)
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             got = jax.jit(
                 lambda q, k, v: ulysses_attention(
                     q, k, v, mask=mask, dtype=jnp.float32
@@ -95,7 +96,7 @@ class TestUlyssesNumerics:
 
             return f
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             g_flash = jax.jit(
                 jax.grad(loss("flash"), argnums=(0, 1, 2)),
                 in_shardings=(spec,) * 3,
@@ -123,7 +124,7 @@ class TestUlyssesNumerics:
         mesh = seq_mesh(devices8)
         want = dense_reference(q, k, v, mask)
         spec = NamedSharding(mesh, P(("data", "fsdp"), "sequence"))
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             got = jax.jit(
                 lambda q, k, v: ulysses_attention(
                     q, k, v, mask=mask, dtype=jnp.float32,
@@ -150,7 +151,7 @@ class TestUlyssesNumerics:
             for i in range(3)
         )
         mesh = seq_mesh(devices8)
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             with pytest.raises(ValueError, match="divisible by the sequence"):
                 jax.jit(
                     lambda q, k, v: ulysses_attention(
@@ -170,7 +171,7 @@ class TestUlyssesNumerics:
         )
         mesh = seq_mesh(devices8)
         want = dense_reference(q, k, v, None)
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             got = jax.jit(
                 lambda q, k, v: ulysses_attention(
                     q, k, v, dtype=jnp.float32, impl="flash"
