@@ -770,6 +770,177 @@ def bench_serving_generate(
         srv.stop()
 
 
+def bench_serving_continuous(
+    num_requests: int = 10,
+    mean_interarrival_ms: float = 25.0,
+    num_slots: int = 8,
+    new_tokens: int = 16,
+) -> dict:
+    """Open-loop Poisson-arrival load against the REST `:generate` path:
+    the continuous-batching DecodeEngine (serving/engine.py) vs the static
+    per-request ServedLm fused scan, SAME arrival trace, same model, same
+    socket surface. This is the gap the engine exists to close: the batch
+    sweep (bench_generate) proves decode throughput comes from keeping the
+    batch full, and staggered arrivals are exactly what request-granular
+    scans cannot batch. Reports tokens/sec, client-observed TTFT p50/p99
+    (engine TTFT from the X-TTFT-Ms header; the static path has no
+    first-token moment before completion, so TTFT = full latency there),
+    and mean slot occupancy over the engine phase. Programs are warmed
+    per shape before either timed phase: this measures scheduling, not
+    XLA compiles.
+
+    Defaults are sized so the STATIC phase — which serializes the whole
+    trace on the CPU mesh — fits the entry's 480 s battery cap with room
+    to spare; the curated 24-request/32-token run in docs/PERF.md is the
+    same trace scaled up (same ratio, starker absolute numbers)."""
+    import json as _json
+    import threading
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from kubeflow_tpu.api.wsgi import Server
+    from kubeflow_tpu.serving.engine import DecodeEngine
+    from kubeflow_tpu.serving.generate import ServedLm
+    from kubeflow_tpu.serving.server import ModelServer
+
+    max_len = 64  # largest prompt bucket (32) + new_tokens + slack
+    model, params = _gpt_small_with_params(max_len)
+    buckets = [8, 16, 32]
+    prompt_lens = [8, 12, 24]  # ragged; 3 static programs, 3 buckets
+    lm = ServedLm("gpt_static", model, params, max_batch=8)
+    engine = DecodeEngine(
+        "gpt_engine", model, params, num_slots=num_slots,
+        prefill_buckets=buckets, max_queue=max(64, num_requests),
+    )
+    model_server = ModelServer()
+    model_server.add_lm(lm)
+    model_server.add_engine(engine)
+    server = Server(model_server.app, port=0)
+    server.start()
+
+    rng = np.random.default_rng(0)
+    offsets = np.cumsum(
+        rng.exponential(mean_interarrival_ms / 1e3, num_requests)
+    )
+    payloads = []
+    for i in range(num_requests):
+        p = prompt_lens[i % len(prompt_lens)]
+        prompt = rng.integers(0, 50257, (1, p)).tolist()
+        payloads.append(_json.dumps(
+            {"prompt_ids": prompt, "max_new_tokens": new_tokens}
+        ).encode())
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url, data=payload, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return _json.loads(resp.read()), resp.headers
+
+    def run_phase(name: str, on_warm=None) -> dict:
+        url = f"http://127.0.0.1:{server.port}/v1/models/{name}:generate"
+        # warm every program this phase can reach (one request per
+        # distinct prompt length covers the static shape keys AND the
+        # engine's buckets + step + insert)
+        for p in prompt_lens:
+            post(url, _json.dumps({
+                "prompt_ids": rng.integers(0, 50257, (1, p)).tolist(),
+                "max_new_tokens": new_tokens,
+            }).encode())
+        if on_warm is not None:
+            # snapshot engine counters AFTER warm-up: the serial warm
+            # requests run at 1/num_slots occupancy and must not dilute
+            # the measured trace's occupancy
+            on_warm()
+        lat = [None] * num_requests
+        ttft = [None] * num_requests
+        done_at = [None] * num_requests
+        errors = []
+        lock = threading.Lock()
+        t0 = time.monotonic() + 0.05
+
+        def fire(i):
+            time.sleep(max(0.0, t0 + offsets[i] - time.monotonic()))
+            t_send = time.monotonic()
+            try:
+                body, hdr = post(url, payloads[i])
+                assert len(body["sequences"][0]) >= new_tokens
+            except Exception as e:  # noqa: BLE001 - recorded, not lost
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+            t_done = time.monotonic()
+            with lock:
+                lat[i] = t_done - t_send
+                done_at[i] = t_done
+                ttft[i] = (
+                    float(hdr["X-TTFT-Ms"]) / 1e3
+                    if hdr.get("X-TTFT-Ms")
+                    else t_done - t_send
+                )
+
+        threads = [
+            threading.Thread(target=fire, args=(i,))
+            for i in range(num_requests)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ok = [x for x in lat if x is not None]
+        if not ok:
+            raise RuntimeError(
+                f"all {num_requests} requests failed; first: "
+                f"{errors[0] if errors else 'unknown'}"
+            )
+        wall = max(x for x in done_at if x is not None) - t0
+        lats = sorted(ok)
+        tfs = sorted(t for t in ttft if t is not None)
+        pct = lambda xs, q: xs[min(len(xs) - 1, int(len(xs) * q))]  # noqa: E731
+        return {
+            "failed_requests": len(errors),
+            "tokens_per_sec": round(len(ok) * new_tokens / wall, 1),
+            "ttft_p50_ms": round(pct(tfs, 0.5) * 1e3, 2),
+            "ttft_p99_ms": round(pct(tfs, 0.99) * 1e3, 2),
+            "latency_p50_ms": round(pct(lats, 0.5) * 1e3, 2),
+            "latency_p99_ms": round(pct(lats, 0.99) * 1e3, 2),
+        }
+
+    try:
+        static = run_phase("gpt_static")
+        pre = {}
+        cont = run_phase(
+            "gpt_engine", on_warm=lambda: pre.update(engine.stats())
+        )
+        post_stats = engine.stats()
+        steps = post_stats["decode_steps"] - pre["decode_steps"]
+        occ_steps = (
+            post_stats["mean_occupancy"] * post_stats["decode_steps"]
+            - pre["mean_occupancy"] * pre["decode_steps"]
+        )
+        cont["mean_occupancy"] = round(occ_steps / steps, 3) if steps else 0.0
+    finally:
+        server.stop()
+        model_server.close()
+    return {
+        "model": "gpt_small",
+        "num_requests": num_requests,
+        "new_tokens": new_tokens,
+        "mean_interarrival_ms": mean_interarrival_ms,
+        "num_slots": num_slots,
+        "prompt_lens": prompt_lens,
+        "max_len": max_len,
+        "static": static,
+        "engine": cont,
+        "engine_tokens_per_sec": cont["tokens_per_sec"],
+        "speedup_vs_static": round(
+            cont["tokens_per_sec"] / static["tokens_per_sec"], 2
+        ),
+    }
+
+
 def bench_generate(
     batch: int = 8,
     prompt_len: int = 64,
@@ -1624,6 +1795,15 @@ def _entry_specs(batch: int, steps: int):
         ("ring_attention", "bench_ring_microbench()", 300, None, True),
         # decode through the REST surface (what a platform client sees)
         ("serving_generate", "bench_serving_generate()", 300, None, False),
+        # continuous batching vs the static path under Poisson arrivals —
+        # the engine's raison d'être (docs/SERVING.md)
+        (
+            "serving_continuous",
+            "bench_serving_continuous()",
+            480,
+            None,
+            False,
+        ),
         # the cache-less decode baseline the KV cache is supposed to beat;
         # one plain-forward compile, cheap at the tail
         ("generate_floor", "bench_generate_nocache()", 240, None, False),
@@ -1636,6 +1816,7 @@ _HEADLINE_KEYS = (
     "images_per_sec_per_chip",
     "tokens_per_sec_per_chip",
     "generate_tokens_per_sec",
+    "engine_tokens_per_sec",
     "rest_generate_tokens_per_sec",
     "steps_per_sec_ratio_async_vs_sync",
     "speedup_vs_sync",
@@ -1724,6 +1905,7 @@ def _summary(results: dict, batch: int, complete: bool, t0: float) -> dict:
         "generate_floor": results.get("generate_floor"),
         "ring_attention": results.get("ring_attention"),
         "serving_generate": results.get("serving_generate"),
+        "serving_continuous": results.get("serving_continuous"),
         "long_context_attention": results.get("long_context_attention"),
         "attention_sweep": sweep or None,
         "device_kind": probe.get("device_kind"),

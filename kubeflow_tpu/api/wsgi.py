@@ -127,6 +127,7 @@ _STATUS_TEXT = {
     404: "404 Not Found",
     405: "405 Method Not Allowed",
     409: "409 Conflict",
+    429: "429 Too Many Requests",
     500: "500 Internal Server Error",
 }
 

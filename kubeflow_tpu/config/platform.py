@@ -390,6 +390,48 @@ class TrainingConfig(ConfigNode):
 
 
 @dataclasses.dataclass
+class ServingConfig(ConfigNode):
+    """Continuous-batching decode-engine knobs (serving/engine.py;
+    docs/SERVING.md). The InferenceService controller renders these as
+    KFT_SERVING_* into every serving pod (controllers/inference.py), so
+    operators tune the TTFT/throughput tradeoff without editing the
+    serving command line."""
+
+    num_slots: int = config_field(
+        default=8,
+        help="resident KV-cache decode slots — the engine's fixed batch "
+        "capacity. More slots = more throughput under load, more HBM "
+        "(num_slots x max_len KV) and marginally slower steps; 0 "
+        "disables the engine (per-request fused-scan :generate).",
+    )
+    prefill_buckets: List[int] = config_field(
+        default_factory=list,
+        help="explicit prompt-length buckets (ascending powers of two); "
+        "empty = the power-of-two ladder from 8 to the model's max_len. "
+        "Each bucket is one compiled prefill program.",
+    )
+    max_queue: int = config_field(
+        default=64,
+        help="admission-queue bound: requests past it get 429 instead of "
+        "queueing unboundedly (backpressure the client can act on)",
+    )
+
+    def validate(self) -> None:
+        if self.num_slots < 0:
+            raise ConfigError("serving.num_slots must be >= 0")
+        if self.max_queue < 1:
+            raise ConfigError("serving.max_queue must be >= 1")
+        for b in self.prefill_buckets:
+            if b < 1 or b & (b - 1):
+                raise ConfigError(
+                    f"serving.prefill_buckets entries must be positive "
+                    f"powers of two, got {b}"
+                )
+        if self.prefill_buckets != sorted(self.prefill_buckets):
+            raise ConfigError("serving.prefill_buckets must be ascending")
+
+
+@dataclasses.dataclass
 class NotebookDefaults(ConfigNode):
     """Spawner-form defaults (the admin YAML role, reference: jupyter-web-app
     backend spawner_ui_config utils.py:88-117) re-targeted at TPU-VM images."""
@@ -465,6 +507,7 @@ class PlatformDef(ConfigNode):
     user_id_prefix: str = config_field(default="")
     slice: SliceConfig = config_field(default_factory=SliceConfig)
     training: TrainingConfig = config_field(default_factory=TrainingConfig)
+    serving: ServingConfig = config_field(default_factory=ServingConfig)
     notebooks: NotebookDefaults = config_field(default_factory=NotebookDefaults)
     auth: AuthConfig = config_field(default_factory=AuthConfig)
     components: List[ComponentSpec] = config_field(

@@ -11,13 +11,13 @@ controller (reference: tensorboard_controller.go:54-260).
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 from kubeflow_tpu.cluster.objects import new_object, set_condition, set_owner
 from kubeflow_tpu.cluster.reconciler import Controller, Result
 from kubeflow_tpu.cluster.store import StateStore
 from kubeflow_tpu.config.core import from_dict
-from kubeflow_tpu.config.platform import SliceConfig
+from kubeflow_tpu.config.platform import ServingConfig, SliceConfig
 from kubeflow_tpu.controllers.statefulset import new_deployment
 
 KIND = "InferenceService"
@@ -33,6 +33,7 @@ def new_inference_service(
     tpu_topology: str = "",
     replicas: int = 1,
     image: str = DEFAULT_IMAGE,
+    serving: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     return new_object(
         KIND,
@@ -44,6 +45,10 @@ def new_inference_service(
             "tpu": {"topology": tpu_topology} if tpu_topology else {},
             "replicas": replicas,
             "image": image,
+            # decode-engine knob overrides (config/platform.py
+            # ServingConfig field names: num_slots/prefill_buckets/
+            # max_queue); absent keys fall back to the platform defaults
+            "serving": dict(serving or {}),
         },
     )
 
@@ -53,12 +58,39 @@ class InferenceServiceController(Controller):
     name = "inference-controller"
 
     def __init__(
-        self, use_istio: bool = True, istio_gateway: str = "kubeflow/kubeflow-gateway"
+        self,
+        use_istio: bool = True,
+        istio_gateway: str = "kubeflow/kubeflow-gateway",
+        serving_defaults: Optional[ServingConfig] = None,
     ) -> None:
         super().__init__()
         self.use_istio = use_istio
         self.istio_gateway = istio_gateway
+        # platform-wide engine defaults (PlatformDef.serving); per-CR
+        # spec.serving keys override field-by-field
+        self.serving_defaults = serving_defaults or ServingConfig()
         self.watches = {"Deployment": self.map_owned}
+
+    def _serving_env(self, spec: Dict[str, Any]) -> Dict[str, str]:
+        """The engine contract rendered into every serving pod — consumed
+        by serving/main.py engine_knobs_from_env. Always rendered (also
+        at defaults): the pod's env documents the engine configuration it
+        actually runs."""
+        merged = {
+            "num_slots": self.serving_defaults.num_slots,
+            "prefill_buckets": list(self.serving_defaults.prefill_buckets),
+            "max_queue": self.serving_defaults.max_queue,
+        }
+        merged.update(spec.get("serving") or {})
+        cfg = from_dict(ServingConfig, merged)
+        cfg.validate()
+        return {
+            "KFT_SERVING_NUM_SLOTS": str(cfg.num_slots),
+            "KFT_SERVING_MAX_QUEUE": str(cfg.max_queue),
+            "KFT_SERVING_PREFILL_BUCKETS": ",".join(
+                str(b) for b in cfg.prefill_buckets
+            ),
+        }
 
     def reconcile(self, store: StateStore, namespace: str, name: str) -> Result:
         svc_cr = store.try_get(KIND, name, namespace)
@@ -78,6 +110,10 @@ class InferenceServiceController(Controller):
                 "--port", str(SERVE_PORT),
             ],
             "ports": [{"containerPort": SERVE_PORT}],
+            "env": [
+                {"name": k, "value": v}
+                for k, v in sorted(self._serving_env(spec).items())
+            ],
         }
         pod_spec: Dict[str, Any] = {"containers": [container]}
         topology = (spec.get("tpu") or {}).get("topology", "")

@@ -140,23 +140,43 @@ class CausalSelfAttention(nn.Module):
         if decode:
             # single-token autoregressive step over the KV cache (the
             # flax decode idiom): write this step's K/V at `index`, attend
-            # over positions <= index. x is [B, 1, D].
+            # over positions <= index. x is [B, 1, D]. The cursor comes in
+            # two shapes: a scalar (one batch, every row the same age —
+            # serving/generate.py's fused scan) or per-row [B] (the
+            # slot-batch continuous-batching engine, serving/engine.py,
+            # where staggered admission gives every slot its own age).
             cached_k, cached_v, cache_index, valid_mask = self._cache_vars(
                 x.shape[0], head_dim
             )
             idx = cache_index.value
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)
-            )
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
-            )
+            if idx.ndim == 0:
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)
+                )
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
+                )
+                row_idx = idx[None]
+            else:
+                # per-row write: one-hot select along the cache axis (a
+                # per-row dynamic_update_slice does not exist; the where
+                # costs one cache-sized select, the same order as the
+                # attention read below). A cursor at/past max_len writes
+                # nothing — retired slots idle safely until reuse.
+                oh = jnp.arange(cfg.max_len)[None, :] == idx[:, None]
+                cached_k.value = jnp.where(
+                    oh[:, :, None, None], k.astype(cfg.dtype), cached_k.value
+                )
+                cached_v.value = jnp.where(
+                    oh[:, :, None, None], v.astype(cfg.dtype), cached_v.value
+                )
+                row_idx = idx
             cache_index.value = idx + 1
             k, v = cached_k.value, cached_v.value
             # visible = real (non-pad) cache positions written so far
             visible = (
-                (jnp.arange(cfg.max_len) <= idx)[None, :] & valid_mask.value
-            )
+                jnp.arange(cfg.max_len)[None, :] <= row_idx[:, None]
+            ) & valid_mask.value
             from kubeflow_tpu.ops.attention import dense_attention
 
             out = dense_attention(
@@ -297,6 +317,90 @@ def unstack_layer_params(params, num_layers: int):
     for i in range(num_layers):
         rest[f"layer_{i}"] = jax.tree.map(lambda a, i=i: a[i], stacked)
     return rest
+
+
+# ---------------------------------------------------------------------------
+# Cache-as-value slot helpers (the continuous-batching engine's view of the
+# KV cache, serving/engine.py). The cache collection is a pytree whose
+# leaves are identified by NAME, not position, because the batch axis sits
+# at a different depth per leaf — and scan_layers prepends a layer axis to
+# all of them. Counting axes from the RIGHT makes one rule cover both the
+# named-layer and scanned layouts:
+#   cached_key / cached_value  [..., B, max_len, heads, head_dim]  -> -4
+#   valid_mask                 [..., B, max_len]                   -> -2
+#   position                   [B]                                 -> -1
+#   cache_index                model form has NO batch axis (a shared
+#                              scalar cursor, [] or [L]); the engine form
+#                              appends a trailing per-slot axis [..., S]
+#                              which the decode path reads as a per-row
+#                              cursor (see CausalSelfAttention).
+# ---------------------------------------------------------------------------
+
+
+def _cache_leaf_name(path) -> str:
+    last = path[-1]
+    return getattr(last, "key", str(last))
+
+
+def _slot_axis(name: str, ndim: int) -> int:
+    if name in ("cached_key", "cached_value"):
+        return ndim - 4
+    if name == "valid_mask":
+        return ndim - 2
+    if name in ("position", "cache_index"):
+        return ndim - 1
+    raise ValueError(f"unknown cache leaf {name!r}")
+
+
+def make_slot_cache(cache_one, num_slots: int):
+    """Zeroed slot-batch cache shaped like `cache_one` (a batch-1 prefill
+    cache or its eval_shape) with batch axes widened to `num_slots` and
+    cache_index converted to the engine's per-slot cursor form."""
+    import jax.tree_util as jtu
+
+    def widen(path, leaf):
+        name = _cache_leaf_name(path)
+        if name == "cache_index":
+            return jnp.zeros(tuple(leaf.shape) + (num_slots,), leaf.dtype)
+        shape = list(leaf.shape)
+        shape[_slot_axis(name, len(shape))] = num_slots
+        return jnp.zeros(shape, leaf.dtype)
+
+    return jtu.tree_map_with_path(widen, cache_one)
+
+
+def insert_cache_slot(cache, cache_one, slot):
+    """Write a batch-1 prefill cache into slot `slot` of a slot-batch
+    cache, along each leaf's batch axis. `slot` may be a traced int32 —
+    one compiled program serves every slot."""
+    import jax.tree_util as jtu
+
+    def ins(path, dst, src):
+        name = _cache_leaf_name(path)
+        if name == "cache_index":
+            src = src[..., None]  # model form (no batch axis) -> engine form
+        ax = _slot_axis(name, dst.ndim)
+        return jax.lax.dynamic_update_slice_in_dim(
+            dst, src.astype(dst.dtype), slot, axis=ax
+        )
+
+    return jtu.tree_map_with_path(ins, cache, cache_one)
+
+
+def extract_cache_slot(cache, slot):
+    """One slot of a slot-batch cache as a batch-1 cache (the inverse of
+    `insert_cache_slot`; introspection/debugging and tests)."""
+    import jax.tree_util as jtu
+
+    def ext(path, leaf):
+        name = _cache_leaf_name(path)
+        ax = _slot_axis(name, leaf.ndim)
+        out = jax.lax.dynamic_slice_in_dim(leaf, slot, 1, axis=ax)
+        if name == "cache_index":
+            out = jnp.squeeze(out, axis=-1)  # engine form -> model form
+        return out
+
+    return jtu.tree_map_with_path(ext, cache)
 
 
 class DecoderStage(nn.Module):

@@ -81,7 +81,11 @@ class Platform:
                 culling_defaults=self.platform_def.notebooks,
             ),
             TensorboardController(use_istio=use_istio, istio_gateway=gw),
-            InferenceServiceController(use_istio=use_istio, istio_gateway=gw),
+            InferenceServiceController(
+                use_istio=use_istio,
+                istio_gateway=gw,
+                serving_defaults=self.platform_def.serving,
+            ),
             ProfileController(
                 user_id_header=self.platform_def.user_id_header,
                 user_id_prefix=self.platform_def.user_id_prefix,

@@ -29,15 +29,48 @@ import numpy as np
 from kubeflow_tpu.utils.metrics import default_registry
 
 
+class Completion:
+    """One waiter's completion slot: a value or an error behind an event.
+
+    The blocking-caller/background-worker handoff shared by the
+    micro-batcher (per fused-batch slice) and the continuous-batching
+    decode engine's request futures (serving/engine.py): the worker calls
+    exactly one of set()/fail(); the caller blocks in wait()."""
+
+    __slots__ = ("_event", "value", "error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.value = None
+        self.error: Optional[BaseException] = None
+
+    def set(self, value) -> None:
+        self.value = value
+        self._event.set()
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"no completion within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
 class _Pending:
-    __slots__ = ("x", "event", "result", "aux", "error")
+    __slots__ = ("x", "done")
 
     def __init__(self, x: np.ndarray):
         self.x = x
-        self.event = threading.Event()
-        self.result: Optional[np.ndarray] = None
-        self.aux = None  # per-fused-batch aux from the run fn (see submit)
-        self.error: Optional[BaseException] = None
+        # completes with (rows, aux): this request's slice of the fused
+        # batch plus the run fn's per-batch aux (see submit_with_aux)
+        self.done = Completion()
 
 
 class MicroBatcher:
@@ -97,10 +130,7 @@ class MicroBatcher:
                 raise RuntimeError("batcher is closed")
             self._queue.append(p)
             self._cv.notify_all()
-        p.event.wait()
-        if p.error is not None:
-            raise p.error
-        return p.result, p.aux
+        return p.done.wait()
 
     # -- collector thread -------------------------------------------------
 
@@ -142,12 +172,8 @@ class MicroBatcher:
                 off = 0
                 for p in members:
                     n = p.x.shape[0]
-                    p.result = ys[off : off + n]
-                    p.aux = aux
+                    p.done.set((ys[off : off + n], aux))
                     off += n
             except BaseException as e:  # propagate per request
                 for p in members:
-                    p.error = e
-            finally:
-                for p in members:
-                    p.event.set()
+                    p.done.fail(e)

@@ -1,0 +1,607 @@
+"""Continuous-batching decode engine — token-level scheduling over one
+resident slot-batch KV cache.
+
+The static `:generate` path (serving/generate.py ServedLm) is
+request-granular: every request runs its own fused prefill+scan program,
+every row in a batch waits for the slowest row, and arriving requests wait
+for the whole scan. The batch sweep in bench_generate shows decode
+throughput is a function of KEEPING THE BATCH FULL (4.3k tok/s at batch 8
+→ 9.1k at 64 on the same model), which request-granular execution cannot
+do. This engine is the Orca/vLLM iteration-level-scheduling insight
+transplanted to the JAX static-shape world:
+
+- ONE resident KV cache of fixed capacity `num_slots` lives on device for
+  the engine's lifetime (models/gpt.py `make_slot_cache`); its batch axis
+  is the slot table.
+- Admission is a bucketed, jitted batch-1 prefill (`prompt_len` rounded up
+  to a power-of-two bucket so prompt-length jitter mints a bounded set of
+  XLA programs) whose KV is `dynamic_update_slice`d into the request's
+  slot (`insert_cache_slot` — one compiled insert serves every slot).
+- Decode is ONE jitted single-token step over ALL slots, forever. Each
+  slot carries its own cursor (`cache_index` in the per-row engine form),
+  `position` and `valid_mask`, so ragged prompts and staggered admission
+  ages coexist in one program.
+- A scheduler thread runs the iteration loop: retire EOS/length-exhausted
+  slots, refill free slots FIFO from a bounded admission queue, run the
+  fused step, stream each slot's token to its waiting request future.
+
+Greedy engine output is bitwise-identical to `generate()`'s fused scan
+(enforced by tests/test_engine.py): the decode step runs the same
+attention over the same max_len cache buffer — masked positions contribute
+exactly zero — and greedy sampling is the same f32 argmax.
+
+Sampling is per-request and DYNAMIC (temperature / top-k / top-p ride the
+step as per-slot arrays, not compile-time constants), so mixed sampling
+traffic shares the one decode program; the sort-based dynamic path is
+skipped via `lax.cond` while every active slot is greedy. Per-request
+seeds: token `n` of a request is drawn with `fold_in(PRNGKey(seed), n)` —
+deterministic regardless of admission timing or slot placement.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.serving.batching import Completion
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import (
+    serving_decode_steps_counter,
+    serving_queue_depth_gauge,
+    serving_slot_occupancy_gauge,
+    serving_tokens_counter,
+    serving_ttft_histogram,
+)
+
+log = get_logger(__name__)
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity — the server maps this to HTTP 429."""
+
+
+class EngineCapacityError(ValueError):
+    """The request is valid for the MODEL but not for the engine's bucketed
+    slot layout: its prompt exceeds the largest prefill bucket, or the
+    bucket-rounded prompt plus max_new_tokens overruns max_len (prefill
+    leaves the slot cursor at the BUCKET boundary, so decode really does
+    need bucket + n <= max_len). The server falls back to the static
+    per-request fused scan for these instead of 400ing traffic the
+    platform served before the engine existed."""
+
+
+def default_prefill_buckets(max_len: int, smallest: int = 8) -> Tuple[int, ...]:
+    """Powers of two from `smallest` up to max_len: the compile-bound set
+    of prefill programs. The smallest bucket floors the set so tiny-prompt
+    traffic doesn't mint 1/2/4-length programs for no measurable win."""
+    out: List[int] = []
+    b = 1
+    while b < smallest:
+        b *= 2
+    while b <= max_len:
+        out.append(b)
+        b *= 2
+    return tuple(out)
+
+
+def _sample_slots(logits, keys, counters, temps, top_ks, top_ps):
+    """[S, V] logits → [S] tokens with PER-SLOT dynamic sampling knobs.
+
+    temps <= 0 rows are greedy f32 argmax (bitwise what generate() does);
+    sampled rows draw categorical over logits/temp restricted by dynamic
+    top-k (value at sorted rank k-1) and top-p (nucleus = prefix of the
+    sorted distribution). One descending sort powers both restrictions;
+    the whole sort path is skipped via cond while no slot samples — the
+    greedy steady state pays only the argmax.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sample(_):
+        sub = jax.vmap(jax.random.fold_in)(keys, counters)
+        safe_t = jnp.where(temps > 0.0, temps, jnp.float32(1.0))
+        scaled = logits / safe_t[:, None]
+        vocab = logits.shape[-1]
+        srt = jnp.sort(scaled, axis=-1)[:, ::-1]
+        kth = jnp.take_along_axis(
+            srt, jnp.clip(top_ks, 1, vocab)[:, None] - 1, axis=-1
+        )
+        keep_k = (top_ks[:, None] <= 0) | (srt >= kth)
+        keep = (top_ks[:, None] <= 0) | (scaled >= kth)
+        # top-p composes AFTER top-k (matching serving/generate.py
+        # sample_logits): the nucleus is a prefix of the top-k-
+        # RENORMALIZED distribution. The sorted view of the k-masked
+        # logits is srt with the dropped tail at -inf, so the one sort
+        # still powers both restrictions.
+        srt_k = jnp.where(keep_k, srt, jnp.float32(-jnp.inf))
+        probs = jax.nn.softmax(srt_k, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose EXCLUSIVE sorted prefix mass < top_p (top-1
+        # always survives, matching serving/generate.py sample_logits)
+        keep_sorted = (cum - probs) < top_ps[:, None]
+        thr = jnp.min(jnp.where(keep_sorted, srt_k, jnp.inf), axis=-1,
+                      keepdims=True)
+        keep &= (top_ps[:, None] >= 1.0) | (scaled >= thr)
+        masked = jnp.where(keep, scaled, jnp.float32(-jnp.inf))
+        return jax.vmap(jax.random.categorical)(sub, masked).astype(
+            jnp.int32
+        )
+
+    sampled = jax.lax.cond(
+        jnp.any(temps > 0.0), sample, lambda _: greedy, None
+    )
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+class _Request:
+    """One admitted-or-queued generation request."""
+
+    __slots__ = (
+        "prompt", "max_new", "temperature", "top_k", "top_p", "eos_id",
+        "seed", "t_submit", "future",
+    )
+
+    def __init__(self, prompt, max_new, temperature, top_k, top_p, eos_id,
+                 seed):
+        self.prompt = prompt  # np.int32 [P], real tokens only
+        self.max_new = max_new
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self.seed = seed
+        self.t_submit = time.monotonic()
+        # completes with {"tokens": [...], "ttft_s": float}
+        self.future = Completion()
+
+
+class _Slot:
+    """Host bookkeeping for one occupied decode slot."""
+
+    __slots__ = ("req", "tokens", "ttft_s")
+
+    def __init__(self, req: _Request):
+        self.req = req
+        self.tokens: List[int] = []
+        self.ttft_s = 0.0
+
+
+class DecodeEngine:
+    """The persistent slot-batch decode engine for one causal LM.
+
+    Thread model: `submit()` (any thread) only touches the admission queue
+    under the condition lock; the scheduler thread owns ALL device state
+    (resident cache, per-slot arrays) and the slot table, so the hot loop
+    never takes a lock around device work. Aggregate counters live behind
+    their own lock (`stats()`).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        model,
+        params,
+        *,
+        num_slots: int = 8,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        max_queue: int = 64,
+        autostart: bool = True,
+    ):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.name = name
+        self.model = model
+        self.params = params
+        self.num_slots = num_slots
+        self.max_queue = max_queue
+        cfg = model.cfg
+        buckets = tuple(
+            sorted(prefill_buckets)
+            if prefill_buckets
+            else default_prefill_buckets(cfg.max_len)
+        )
+        for b in buckets:
+            if b < 1 or b > cfg.max_len:
+                raise ValueError(
+                    f"prefill bucket {b} outside [1, max_len={cfg.max_len}]"
+                )
+            if b & (b - 1):
+                raise ValueError(f"prefill bucket {b} not a power of two")
+        self.prefill_buckets = buckets
+
+        # -- device state (scheduler-thread-owned after start) ----------
+        from kubeflow_tpu.models.gpt import insert_cache_slot, make_slot_cache
+
+        dummy = jax.ShapeDtypeStruct((1, buckets[0]), jnp.int32)
+        dummy_mask = jax.ShapeDtypeStruct((1, buckets[0]), jnp.bool_)
+        _, shapes = jax.eval_shape(
+            lambda p, ids, m: model.apply(
+                {"params": p}, ids, attention_mask=m, prefill=True,
+                mutable=["cache"],
+            ),
+            params, dummy, dummy_mask,
+        )
+        self._cache_shapes = shapes["cache"]
+        self._make_slot_cache = make_slot_cache
+        self._cache = make_slot_cache(self._cache_shapes, num_slots)
+        # the resident cache is always consumed-and-replaced: donate it so
+        # XLA aliases input→output instead of copying the engine's
+        # dominant buffer on every admission and every one-token step
+        # (undonated = 2× cache HBM + one full cache copy per token)
+        self._insert = jax.jit(insert_cache_slot, donate_argnums=(0,))
+        self._step = jax.jit(self._step_fn, donate_argnums=(1,))
+        # one wrapper serves every bucket: jit caches one executable per
+        # input shape, so the bucket set bounds the program set by itself
+        self._prefill = jax.jit(self._prefill_fn)
+        # per-slot host mirrors, scheduler-thread-owned
+        self._slots: List[Optional[_Slot]] = [None] * num_slots
+        self._tok_np = np.zeros((num_slots,), np.int32)
+        self._key_np = np.zeros((num_slots, 2), np.uint32)
+        self._cnt_np = np.zeros((num_slots,), np.int32)
+        self._temp_np = np.zeros((num_slots,), np.float32)
+        self._topk_np = np.zeros((num_slots,), np.int32)
+        self._topp_np = np.ones((num_slots,), np.float32)
+
+        # -- shared state (condition-lock-guarded) ----------------------
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._stop = False
+
+        self._stats_lock = threading.Lock()
+        self._admitted = 0
+        self._steps = 0
+        self._emitted = 0
+        self._occupied_slot_steps = 0
+
+        self._ttft = serving_ttft_histogram()
+        self._queue_depth = serving_queue_depth_gauge()
+        self._occupancy = serving_slot_occupancy_gauge()
+        self._decode_steps = serving_decode_steps_counter()
+        self._tokens_total = serving_tokens_counter()
+        self._queue_depth.set(0, model=name)
+        self._occupancy.set(0.0, model=name)
+
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"decode-engine-{name}"
+        )
+        if autostart:
+            self._thread.start()
+
+    # -- jitted programs ---------------------------------------------------
+
+    def _prefill_fn(self, params, ids, mask, key, temp, top_k, top_p):
+        out, mutated = self.model.apply(
+            {"params": params}, ids, attention_mask=mask, prefill=True,
+            mutable=["cache"],
+        )
+        last = jnp.maximum(mask.astype(jnp.int32).sum(1) - 1, 0)
+        logits = out["logits"][jnp.arange(ids.shape[0]), last]
+        tok = _sample_slots(
+            logits, key[None], jnp.zeros((1,), jnp.int32), temp[None],
+            top_k[None], top_p[None],
+        )
+        return mutated["cache"], tok[0]
+
+    def _step_fn(self, params, cache, tokens, keys, counters, temps,
+                 top_ks, top_ps):
+        out, mutated = self.model.apply(
+            {"params": params, "cache": cache}, tokens[:, None],
+            decode=True, mutable=["cache"],
+        )
+        nxt = _sample_slots(
+            out["logits"][:, 0], keys, counters, temps, top_ks, top_ps
+        )
+        return mutated["cache"], nxt
+
+    # -- public API --------------------------------------------------------
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        raise EngineCapacityError(
+            f"prompt length {prompt_len} exceeds the largest prefill "
+            f"bucket {self.prefill_buckets[-1]}"
+        )
+
+    def _make_request(self, prompt_ids, max_new_tokens, temperature,
+                      top_k, top_p, eos_id, seed) -> _Request:
+        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        vocab = self.model.cfg.vocab_size
+        if prompt.min() < 0 or prompt.max() >= vocab:
+            raise ValueError(f"prompt ids must be in [0, {vocab})")
+        n = int(max_new_tokens)
+        if n < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        bucket = self.bucket_for(prompt.size)
+        if bucket + n > self.model.cfg.max_len:
+            raise EngineCapacityError(
+                f"prompt bucket {bucket} + {n} new tokens exceeds "
+                f"max_len {self.model.cfg.max_len}"
+            )
+        temperature = float(temperature)
+        if temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        top_k = int(top_k)
+        if top_k < 0:
+            raise ValueError("top_k must be >= 0")
+        top_p = float(top_p)
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        if eos_id is not None:
+            eos_id = int(eos_id)
+            if not 0 <= eos_id < vocab:
+                raise ValueError(f"eos_id must be in [0, {vocab})")
+        return _Request(prompt, n, temperature, top_k, top_p, eos_id,
+                        int(seed))
+
+    def _enqueue(self, reqs: List[_Request]) -> None:
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("engine is closed")
+            if len(self._queue) + len(reqs) > self.max_queue:
+                raise QueueFullError(
+                    f"admission queue full ({len(self._queue)} waiting, "
+                    f"capacity {self.max_queue})"
+                )
+            self._queue.extend(reqs)
+            self._queue_depth.set(len(self._queue), model=self.name)
+            self._cv.notify_all()
+
+    def submit(
+        self,
+        prompt_ids,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> Completion:
+        """Enqueue one UNPADDED prompt row; returns the request future
+        (completes with {"tokens", "ttft_s"}). Raises QueueFullError when
+        the admission queue is at max_queue — callers map it to 429."""
+        req = self._make_request(
+            prompt_ids, max_new_tokens, temperature, top_k, top_p, eos_id,
+            seed,
+        )
+        self._enqueue([req])
+        return req.future
+
+    def submit_batch(
+        self,
+        rows,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> List[Completion]:
+        """Atomic multi-row admission (one REST request's rows): every row
+        validates and enters the queue, or none do (queue-full on a
+        half-admitted batch would strand the accepted rows' work). Row i's
+        sampling stream is seeded `seed + i` so rows draw independently
+        while the whole batch stays reproducible from one seed."""
+        reqs = [
+            self._make_request(
+                row, max_new_tokens, temperature, top_k, top_p, eos_id,
+                int(seed) + i,
+            )
+            for i, row in enumerate(rows)
+        ]
+        if not reqs:
+            raise ValueError("submit_batch needs at least one row")
+        self._enqueue(reqs)
+        return [r.future for r in reqs]
+
+    def generate_row(self, prompt_ids, max_new_tokens: int,
+                     timeout: Optional[float] = 300.0, **kw) -> dict:
+        """Blocking submit: {"tokens": [...], "ttft_s": float}."""
+        return self.submit(prompt_ids, max_new_tokens, **kw).wait(timeout)
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            steps = self._steps
+            return {
+                "admitted": self._admitted,
+                "decode_steps": steps,
+                "tokens": self._emitted,
+                "mean_occupancy": (
+                    self._occupied_slot_steps / (steps * self.num_slots)
+                    if steps
+                    else 0.0
+                ),
+            }
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10)
+        # the scheduler is down (or never started, autostart=False): fail
+        # whatever is still queued or resident so no caller blocks forever
+        err = RuntimeError("engine closed")
+        with self._cv:
+            leftover = list(self._queue)
+            self._queue.clear()
+            self._queue_depth.set(0, model=self.name)
+        for req in leftover:
+            req.future.fail(err)
+        if self._thread.is_alive():
+            # stuck in a device call past the join timeout: the slot
+            # table is scheduler-owned and must not be mutated under a
+            # live scheduler — leave resident futures to their callers'
+            # wait() timeouts
+            log.warning(
+                "engine %s scheduler still running after close timeout; "
+                "leaving slot state to it", self.name,
+            )
+            return
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._slots[i] = None
+                slot.req.future.fail(err)
+        self._occupancy.set(0.0, model=self.name)
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _admit(self, slot_idx: int, req: _Request) -> None:
+        bucket = self.bucket_for(req.prompt.size)
+        fn = self._prefill
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, : req.prompt.size] = req.prompt
+        mask = np.zeros((1, bucket), bool)
+        mask[0, : req.prompt.size] = True
+        base = jax.random.PRNGKey(req.seed)
+        cache_one, tok = fn(
+            self.params, jnp.asarray(ids), jnp.asarray(mask), base,
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.float32(req.top_p),
+        )
+        self._cache = self._insert(
+            self._cache, cache_one, jnp.int32(slot_idx)
+        )
+        first = int(jax.device_get(tok))
+        slot = _Slot(req)
+        slot.ttft_s = time.monotonic() - req.t_submit
+        slot.tokens.append(first)
+        self._ttft.observe(slot.ttft_s, model=self.name)
+        self._tokens_total.inc(model=self.name)
+        self._tok_np[slot_idx] = first
+        self._key_np[slot_idx] = np.asarray(jax.device_get(base))
+        self._cnt_np[slot_idx] = 1
+        self._temp_np[slot_idx] = req.temperature
+        self._topk_np[slot_idx] = req.top_k
+        self._topp_np[slot_idx] = req.top_p
+        self._slots[slot_idx] = slot
+        with self._stats_lock:
+            self._admitted += 1
+
+    def _finish(self, slot_idx: int) -> None:
+        slot = self._slots[slot_idx]
+        self._slots[slot_idx] = None
+        self._temp_np[slot_idx] = 0.0  # freed slots cost only the argmax
+        slot.req.future.set(
+            {"tokens": list(slot.tokens), "ttft_s": slot.ttft_s}
+        )
+
+    @staticmethod
+    def _done(slot: _Slot) -> bool:
+        req = slot.req
+        if len(slot.tokens) >= req.max_new:
+            return True
+        return req.eos_id is not None and slot.tokens[-1] == req.eos_id
+
+    def _recover(self, exc: BaseException) -> None:
+        """A device call escaped the per-request handling (step failure, or
+        an admit that invalidated the DONATED resident cache before
+        raising). Without this the scheduler thread dies and every resident
+        and queued request blocks until its caller's wait() timeout. Fail
+        the resident futures (their slot state is gone), rebuild a zeroed
+        resident cache — the old buffer may be a donated tombstone — and
+        keep scheduling: queued requests were never admitted and remain
+        servable."""
+        log.exception(
+            "engine %s decode iteration failed; failing %d resident "
+            "request(s) and rebuilding the slot cache",
+            self.name, sum(s is not None for s in self._slots),
+        )
+        err = RuntimeError(f"engine {self.name} decode step failed: {exc!r}")
+        err.__cause__ = exc
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._slots[i] = None
+                slot.req.future.fail(err)
+        self._temp_np[:] = 0.0
+        self._cache = self._make_slot_cache(
+            self._cache_shapes, self.num_slots
+        )
+        self._occupancy.set(0.0, model=self.name)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while (
+                    not self._stop
+                    and not self._queue
+                    and not any(s is not None for s in self._slots)
+                ):
+                    self._cv.wait()
+                if self._stop:
+                    return  # close() drains the queue and the slot table
+            try:
+                self._iterate()
+            except BaseException as e:  # noqa: BLE001 - thread must live
+                self._recover(e)
+
+    def _iterate(self) -> None:
+        # retire finished slots, then refill FIFO from the queue
+        for i, slot in enumerate(self._slots):
+            if slot is not None and self._done(slot):
+                self._finish(i)
+        for i in range(self.num_slots):
+            if self._slots[i] is not None:
+                continue
+            with self._cv:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+                self._queue_depth.set(len(self._queue), model=self.name)
+            try:
+                self._admit(i, req)
+            except BaseException as e:  # noqa: BLE001 - per-request
+                req.future.fail(e)
+                # _insert donates the resident cache: a failure past
+                # dispatch leaves self._cache a deleted tombstone. With
+                # active slots the next _step raises into _recover, but an
+                # IDLE engine never steps — every later admit would hit
+                # the tombstone and fail, poisoning the engine forever.
+                if any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in jax.tree_util.tree_leaves(self._cache)
+                ):
+                    self._recover(e)
+                continue
+            if self._done(self._slots[i]):
+                # one-token request (or instant EOS): never steps
+                self._finish(i)
+        active = [
+            i for i, s in enumerate(self._slots) if s is not None
+        ]
+        self._occupancy.set(
+            len(active) / self.num_slots, model=self.name
+        )
+        if not active:
+            return
+        self._cache, tok = self._step(
+            self.params, self._cache,
+            jnp.asarray(self._tok_np), jnp.asarray(self._key_np),
+            jnp.asarray(self._cnt_np), jnp.asarray(self._temp_np),
+            jnp.asarray(self._topk_np), jnp.asarray(self._topp_np),
+        )
+        toks = np.asarray(jax.device_get(tok))
+        self._decode_steps.inc(model=self.name)
+        self._tokens_total.inc(len(active), model=self.name)
+        with self._stats_lock:
+            self._steps += 1
+            self._emitted += len(active)
+            self._occupied_slot_steps += len(active)
+        for i in active:
+            slot = self._slots[i]
+            slot.tokens.append(int(toks[i]))
+            self._tok_np[i] = toks[i]
+            self._cnt_np[i] += 1

@@ -49,9 +49,15 @@ def sample_logits(
     logits = logits / jnp.float32(temperature)
     neg_inf = jnp.float32(-jnp.inf)
     if top_k > 0 and top_k < logits.shape[-1]:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        # O(V log k) partial selection — the kth value is all we need.
+        # The previous full jnp.sort was O(V log V) over the whole vocab
+        # per sampled token.
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, neg_inf, logits)
     if top_p < 1.0:
+        # top-p genuinely needs the FULL descending sort: the nucleus is
+        # defined as a prefix of the whole sorted distribution (cumulative
+        # mass), so a partial top-k selection cannot compute it
         sort = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sort, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
@@ -330,7 +336,12 @@ class ServedLm:
                 fn = jax.jit(run, static_argnums=())
                 self._compiled[key] = fn
                 if len(self._compiled) > self.max_cached:
-                    self._compiled.popitem(last=False)
+                    _, evicted = self._compiled.popitem(last=False)
+                    # dropping the wrapper alone leaves the lowered
+                    # executable alive in jax's global jit cache — the LRU
+                    # bounded the dict, not the memory. clear_cache()
+                    # frees the compiled program too.
+                    evicted.clear_cache()
             else:
                 self._compiled.move_to_end(key)
         rng = jax.random.PRNGKey(int(seed))

@@ -8,7 +8,28 @@ stock TF Serving image.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    return int(raw) if raw.strip() else default
+
+
+def engine_knobs_from_env():
+    """The serving-pod engine contract the InferenceService controller
+    renders (controllers/inference.py ← config/platform.py ServingConfig):
+    KFT_SERVING_NUM_SLOTS (0 disables the engine), KFT_SERVING_MAX_QUEUE,
+    KFT_SERVING_PREFILL_BUCKETS (comma-separated powers of two; empty =
+    auto power-of-two ladder)."""
+    buckets_raw = os.environ.get("KFT_SERVING_PREFILL_BUCKETS", "")
+    buckets = [int(b) for b in buckets_raw.split(",") if b.strip()]
+    return {
+        "num_slots": _env_int("KFT_SERVING_NUM_SLOTS", 8),
+        "max_queue": _env_int("KFT_SERVING_MAX_QUEUE", 64),
+        "prefill_buckets": buckets or None,
+    }
 
 
 def is_causal_family(model_name: str) -> bool:
@@ -27,11 +48,17 @@ def build_server(
     checkpoint_dir: str = "",
     batch_window_ms: float = 2.0,
     params=None,
+    num_slots: int = None,
+    max_queue: int = None,
+    prefill_buckets=None,
 ):
     """Assemble the ModelServer for one registry model (testable core of
-    the entrypoint): causal families serve :generate via ServedLm
-    (scanned-layer decode); everything else serves :predict via
-    ServedModel with cross-request micro-batching."""
+    the entrypoint): causal families serve :generate via the
+    continuous-batching DecodeEngine (serving/engine.py; num_slots=0
+    falls back to the per-request ServedLm fused scan); everything else
+    serves :predict via ServedModel with cross-request micro-batching.
+    Engine knobs default from the controller-rendered KFT_SERVING_* env
+    (engine_knobs_from_env)."""
     from kubeflow_tpu.serving.server import ModelServer, ServedModel
 
     server = ModelServer()
@@ -39,19 +66,39 @@ def build_server(
         from kubeflow_tpu.serving.generate import ServedLm
 
         if batch_window_ms:
-            # ServedLm has no cross-request batcher (decode requests
-            # carry per-request lengths); say so instead of silently
-            # accepting the flag
+            # :generate cross-request batching happens at token level in
+            # the engine, not in the :predict micro-batcher; say so
+            # instead of silently accepting the flag
             print(
                 "note: --batch-window-ms does not apply to the "
-                ":generate path; serving unbatched",
+                ":generate path (the decode engine batches at token "
+                "granularity)",
                 flush=True,
             )
-        server.add_lm(
-            ServedLm.from_registry(
-                model, checkpoint_dir=checkpoint_dir or None, params=params
-            )
+        env = engine_knobs_from_env()
+        if num_slots is None:
+            num_slots = env["num_slots"]
+        if max_queue is None:
+            max_queue = env["max_queue"]
+        if prefill_buckets is None:
+            prefill_buckets = env["prefill_buckets"]
+        lm = ServedLm.from_registry(
+            model, checkpoint_dir=checkpoint_dir or None, params=params
         )
+        server.add_lm(lm)
+        if num_slots > 0:
+            from kubeflow_tpu.serving.engine import DecodeEngine
+
+            server.add_engine(
+                DecodeEngine(
+                    lm.name,
+                    lm.model,
+                    lm.params,
+                    num_slots=num_slots,
+                    max_queue=max_queue,
+                    prefill_buckets=prefill_buckets,
+                )
+            )
     else:
         server.add(
             ServedModel.from_registry(
@@ -74,12 +121,23 @@ def main(argv=None) -> int:
         "--batch-window-ms", type=float, default=2.0,
         help="cross-request micro-batch window for :predict (0 disables)",
     )
+    ap.add_argument(
+        "--num-slots", type=int, default=None,
+        help="decode-engine slot count for :generate (0 = static "
+        "per-request path; default from KFT_SERVING_NUM_SLOTS, else 8)",
+    )
+    ap.add_argument(
+        "--max-queue", type=int, default=None,
+        help="engine admission-queue bound — 429 past it (default from "
+        "KFT_SERVING_MAX_QUEUE, else 64)",
+    )
     args = ap.parse_args(argv)
 
     from kubeflow_tpu.api.wsgi import Server
 
     server = build_server(
-        args.model, args.checkpoint_dir, args.batch_window_ms
+        args.model, args.checkpoint_dir, args.batch_window_ms,
+        num_slots=args.num_slots, max_queue=args.max_queue,
     )
     httpd = Server(server.app, host=args.host, port=args.port)
     print(f"serving {args.model} on :{httpd.port}", flush=True)
@@ -91,6 +149,7 @@ def main(argv=None) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         httpd.stop()
+        server.close()
     return 0
 
 

@@ -19,13 +19,14 @@ testing/test_tf_serving.py:60-145, request at :112-127, tolerance compare
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubeflow_tpu.api.wsgi import App, BadRequest, NotFoundError
+from kubeflow_tpu.api.wsgi import App, BadRequest, HttpError, NotFoundError
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import default_registry
 
@@ -266,6 +267,7 @@ class ModelServer:
     def __init__(self) -> None:
         self._models: Dict[str, ServedModel] = {}
         self._lms: Dict[str, Any] = {}  # ServedLm (serving/generate.py)
+        self._engines: Dict[str, Any] = {}  # DecodeEngine (serving/engine.py)
         self.app = self._build()
 
     def add(self, model: ServedModel) -> None:
@@ -275,9 +277,102 @@ class ModelServer:
         """Register a generative model for :generate (ServedLm)."""
         self._lms[lm.name] = lm
 
+    def add_engine(self, engine) -> None:
+        """Attach a continuous-batching DecodeEngine for a generative
+        model: `:generate` requests for `engine.name` ride the engine's
+        token-level scheduler instead of the per-request ServedLm fused
+        scan (same wire contract, plus X-TTFT-Ms; queue-full is 429)."""
+        self._engines[engine.name] = engine
+
     def remove(self, name: str) -> None:
         self._models.pop(name, None)
         self._lms.pop(name, None)
+        engine = self._engines.pop(name, None)
+        if engine is not None:
+            engine.close()
+
+    def close(self) -> None:
+        """Stop background machinery (engines' scheduler threads, the
+        micro-batchers) — the server-process shutdown hook."""
+        for engine in self._engines.values():
+            engine.close()
+        for model in self._models.values():
+            model.close()
+
+    # generous bound: an engine request waits behind at most max_queue
+    # admissions; a hung engine must surface as a 500, not a stuck socket
+    ENGINE_WAIT_S = 600.0
+
+    def _generate_via_engine(self, engine, req, body, n: int):
+        """:generate through the continuous-batching engine: one engine
+        request per prompt row (each row's sampling stream is seeded
+        `seed + row`), admitted atomically — either every row enters the
+        queue or the whole request 429s. The response keeps the static
+        path's rectangular wire shape: rows that hit EOS early are padded
+        with eos_id, exactly the fused scan's freeze-at-EOS behavior.
+
+        Raises EngineCapacityError untouched: a request the MODEL could
+        serve but the engine's bucketed slots cannot (long prompt) belongs
+        on the static path, and the caller decides whether one exists."""
+        from kubeflow_tpu.serving.engine import (
+            EngineCapacityError,
+            QueueFullError,
+        )
+
+        try:
+            x = np.asarray(body["prompt_ids"], dtype=np.int32)
+        except (ValueError, TypeError) as e:
+            raise BadRequest(f"bad generate request: {e}")
+        if x.ndim != 2:
+            raise BadRequest(
+                "bad generate request: prompt_ids must be "
+                "[batch, prompt_len]"
+            )
+        mask = body.get("attention_mask")
+        if mask is not None:
+            mask = np.asarray(mask).astype(bool)
+            if mask.shape != x.shape:
+                raise BadRequest(
+                    "bad generate request: attention_mask shape must "
+                    "match prompt_ids"
+                )
+        else:
+            mask = np.ones_like(x, dtype=bool)
+        eos_id = body.get("eos_id")
+        try:
+            futures = engine.submit_batch(
+                [x[i][mask[i]] for i in range(x.shape[0])],
+                n,
+                temperature=body.get("temperature", 0.0),
+                top_k=body.get("top_k", 0),
+                top_p=body.get("top_p", 1.0),
+                eos_id=eos_id,
+                seed=body.get("seed", 0),
+            )
+        except QueueFullError as e:
+            raise HttpError(429, str(e))
+        except EngineCapacityError:
+            raise  # a ValueError, but NOT a 400: caller may have a fallback
+        except (ValueError, TypeError) as e:
+            raise BadRequest(f"bad generate request: {e}")
+        # one deadline for the whole request: sequential per-row waits
+        # against a hung engine would hold the socket rows × ENGINE_WAIT_S
+        deadline = time.monotonic() + self.ENGINE_WAIT_S
+        results = [
+            f.wait(max(0.0, deadline - time.monotonic())) for f in futures
+        ]
+        sequences = []
+        for i, r in enumerate(results):
+            toks = r["tokens"]
+            if len(toks) < n:
+                # EOS'd early (only reachable with an eos_id): pad to the
+                # rectangular contract, = the fused scan's finished rows
+                # emitting eos_id to the end
+                toks = toks + [int(eos_id)] * (n - len(toks))
+            sequences.append(x[i].tolist() + toks)
+        ttft = max(r["ttft_s"] for r in results)
+        req.response_headers.append(("X-TTFT-Ms", f"{ttft * 1e3:.2f}"))
+        return {"sequences": sequences}
 
     def _build(self) -> App:
         app = App("model-server")
@@ -286,7 +381,11 @@ class ModelServer:
         def model_status(req):
             name = req.params["name"]
             model = self._models.get(name)
-            if model is None and name not in self._lms:
+            if (
+                model is None
+                and name not in self._lms
+                and name not in self._engines
+            ):
                 raise NotFoundError(f"model {name} not loaded")
             version = model.version if model is not None else "1"
             return {
@@ -389,17 +488,24 @@ class ModelServer:
 
         @app.post("/v1/models/<name>:generate")
         def generate(req):
-            """Autoregressive continuation (serving/generate.py): body
-            {"prompt_ids": [[...]], "max_new_tokens": N} plus optional
-            "attention_mask" (ragged/padded batches), "temperature",
-            "top_k", "top_p", "eos_id", "seed" → {"sequences": [[prompt +
-            continuation]]}. temperature 0 (default) = greedy; KV-cache
-            decode throughout."""
-            lm = self._lms.get(req.params["name"])
-            if lm is None:
-                raise NotFoundError(
-                    f"generative model {req.params['name']} not loaded"
-                )
+            """Autoregressive continuation: body {"prompt_ids": [[...]],
+            "max_new_tokens": N} plus optional "attention_mask" (ragged/
+            padded batches), "temperature", "top_k", "top_p", "eos_id",
+            "seed" → {"sequences": [[prompt + continuation]]}.
+            temperature 0 (default) = greedy; KV-cache decode throughout.
+
+            With a DecodeEngine attached (serving/engine.py) the request
+            rides token-level continuous batching: rows are admitted into
+            decode slots between engine steps, the response carries
+            X-TTFT-Ms (worst row's submit→first-token wall time), and a
+            full admission queue returns 429 instead of blocking. Without
+            an engine it falls back to the per-request ServedLm fused
+            scan (serving/generate.py)."""
+            name = req.params["name"]
+            lm = self._lms.get(name)
+            engine = self._engines.get(name)
+            if lm is None and engine is None:
+                raise NotFoundError(f"generative model {name} not loaded")
             body = req.body or {}
             if not isinstance(body, dict):
                 raise BadRequest("request body must be a JSON object")
@@ -408,6 +514,21 @@ class ModelServer:
                 raise BadRequest("request body must contain 'prompt_ids'")
             try:
                 n = int(body.get("max_new_tokens", 16))
+            except (ValueError, TypeError) as e:
+                raise BadRequest(f"bad generate request: {e}")
+            if engine is not None:
+                from kubeflow_tpu.serving.engine import EngineCapacityError
+
+                try:
+                    return self._generate_via_engine(engine, req, body, n)
+                except EngineCapacityError as e:
+                    # valid for the model, too big for the engine's
+                    # bucketed slots (prompt > largest bucket, or bucket +
+                    # n > max_len): serve it the pre-engine way instead of
+                    # 400ing traffic the static path always handled
+                    if lm is None:
+                        raise BadRequest(f"bad generate request: {e}")
+            try:
                 sequences = lm.generate(
                     prompt,
                     n,
@@ -430,8 +551,25 @@ class ModelServer:
                     for m in self._models.values()
                 ]
                 + [
-                    {"name": lm.name, "version": "1", "generative": True}
+                    {
+                        "name": lm.name,
+                        "version": "1",
+                        "generative": True,
+                        "continuous_batching": lm.name in self._engines,
+                    }
                     for lm in self._lms.values()
+                ]
+                + [
+                    # engine-only models (no static ServedLm registered)
+                    # still serve :generate — discovery must agree
+                    {
+                        "name": engine.name,
+                        "version": "1",
+                        "generative": True,
+                        "continuous_batching": True,
+                    }
+                    for engine in self._engines.values()
+                    if engine.name not in self._lms
                 ]
             }
 

@@ -379,6 +379,72 @@ def checkpoint_restores_counter() -> Counter:
     )
 
 
+# ---------------------------------------------------------------------------
+# Continuous-batching serving metrics (one definition point: the decode
+# engine, the server handlers and the bench all hit the same series — see
+# docs/SERVING.md).
+# ---------------------------------------------------------------------------
+
+# TTFT spans one prefill (ms) on an idle engine to queue-wait seconds under
+# saturation; the deployment-latency default buckets flatten the healthy
+# sub-100ms range into two buckets.
+SERVING_TTFT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5,
+    10, 30, 60,
+)
+
+
+def serving_ttft_histogram() -> Histogram:
+    """Submit-to-first-token wall time per engine request: queue wait plus
+    one bucketed prefill — the latency half of the TTFT/throughput
+    tradeoff the slot count tunes."""
+    return default_registry().histogram(
+        "serving_time_to_first_token_seconds",
+        "seconds from request admission-queue entry to its first token",
+        ["model"],
+        buckets=SERVING_TTFT_BUCKETS,
+    )
+
+
+def serving_queue_depth_gauge() -> Gauge:
+    """Requests waiting in the engine admission queue (429 at max_queue)."""
+    return default_registry().gauge(
+        "serving_queue_depth",
+        "requests waiting for a decode slot",
+        ["model"],
+    )
+
+
+def serving_slot_occupancy_gauge() -> Gauge:
+    """Fraction of decode slots holding a live request at the last engine
+    iteration — sustained < 1 under load means admission (prefill) or
+    arrivals, not decode, bound throughput."""
+    return default_registry().gauge(
+        "serving_slot_occupancy",
+        "occupied fraction of the engine's decode slots",
+        ["model"],
+    )
+
+
+def serving_decode_steps_counter() -> Counter:
+    """Fused one-token decode steps the engine has run (all slots at once
+    — tokens/step = occupancy x num_slots)."""
+    return default_registry().counter(
+        "serving_decode_steps_total",
+        "fused slot-batch decode steps executed",
+        ["model"],
+    )
+
+
+def serving_tokens_counter() -> Counter:
+    """Tokens emitted to engine requests (prefill first-tokens included)."""
+    return default_registry().counter(
+        "serving_tokens_total",
+        "tokens emitted by the decode engine",
+        ["model"],
+    )
+
+
 def start_heartbeat(
     gauge: Gauge, period_s: float = 10.0, stop_event: Optional[threading.Event] = None
 ) -> threading.Thread:

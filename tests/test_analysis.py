@@ -436,6 +436,60 @@ class TestRepoClean:
         assert "0 error(s)" in out
 
 
+class TestEngineUnderControlPlanePasses:
+    """The continuous-batching engine (serving/engine.py) is control-plane
+    concurrency machinery — a scheduler thread plus a condition-guarded
+    admission queue — and it must sit UNDER the existing thread-hygiene /
+    lock-discipline passes, not beside them: covered by the repo sweep,
+    with no inline ignores."""
+
+    ENGINE = "kubeflow_tpu/serving/engine.py"
+
+    def test_engine_module_is_swept_with_no_ignores(self):
+        src = [sf for sf in SourceSet(REPO) if sf.path == self.ENGINE]
+        assert len(src) == 1, "engine module missing from the repo sweep"
+        assert src[0].tree is not None
+        assert not src[0].suppressions, (
+            "engine.py must pass the control-plane passes without "
+            "kft-analyze ignores"
+        )
+        assert "threading.Condition" in src[0].text  # the slot-state lock
+        assert "threading.Thread" in src[0].text  # the scheduler thread
+
+    def test_engine_shaped_violations_are_caught(self, tmp_path):
+        """A stripped-down engine with its two canonical mistakes — the
+        stop flag read without the condition lock, a non-daemon unjoined
+        scheduler thread — fires BOTH passes (proof the analyzers see the
+        engine's constructs, Condition included)."""
+        from kubeflow_tpu.analysis.control_plane import (
+            check_lock_discipline,
+            check_thread_hygiene,
+        )
+
+        src = _tree(tmp_path, {"kubeflow_tpu/serving/bad_engine.py": '''
+            """seed"""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._stop = False
+                    threading.Thread(target=self._loop).start()
+
+                def close(self):
+                    with self._cv:
+                        self._stop = True
+
+                def _loop(self):
+                    while not self._stop:  # racy read, no lock
+                        pass
+        '''})
+        locks = check_lock_discipline(src)
+        assert any(f.symbol == "Engine._stop" for f in locks), locks
+        threads = check_thread_hygiene(src)
+        assert len(threads) == 1 and threads[0].analyzer == "thread-hygiene"
+
+
 class TestShippedPlansClean:
     def test_dryrun_plans_lower_clean(self, devices8):
         """Every dryrun plan traces/lowers clean in-process (the compile-

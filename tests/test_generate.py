@@ -163,7 +163,12 @@ class TestGenerateEndpoint:
         server = self._server(gpt_and_params)
         status, body = server.app.handle("GET", "/v1/models")
         assert status == 200
-        assert {"name": "gpt", "version": "1", "generative": True} in body["models"]
+        assert {
+            "name": "gpt",
+            "version": "1",
+            "generative": True,
+            "continuous_batching": False,  # no DecodeEngine attached here
+        } in body["models"]
         status, body = server.app.handle("GET", "/v1/models/gpt")
         assert status == 200
         assert body["model_version_status"][0]["state"] == "AVAILABLE"
@@ -188,6 +193,22 @@ class TestGenerateEndpoint:
         for p in (2, 3, 4):
             lm.generate([list(range(p))], 2)
         assert len(lm._compiled) == 2  # oldest evicted
+
+    def test_lru_eviction_frees_compiled_executables(self, gpt_and_params):
+        """Eviction must shrink LIVE executables, not just the wrapper
+        dict: a dropped jax.jit wrapper leaves its lowered program in
+        jax's global jit cache until clear_cache() — the LRU bound was
+        bounding the OrderedDict, not memory."""
+        from kubeflow_tpu.serving.generate import ServedLm
+
+        model, params = gpt_and_params
+        lm = ServedLm("gpt", model, params, max_cached=1)
+        lm.generate([[1, 2, 3]], 2)
+        (evictee,) = lm._compiled.values()
+        assert evictee._cache_size() == 1  # one live executable
+        lm.generate([[1, 2, 3, 4]], 2)  # new prompt length -> eviction
+        assert len(lm._compiled) == 1
+        assert evictee._cache_size() == 0  # executable actually freed
 
 
 class TestScanLayers:

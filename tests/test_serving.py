@@ -182,6 +182,64 @@ class TestInferenceServiceController:
         conds = {c["type"]: c["status"] for c in isvc["status"]["conditions"]}
         assert conds["Ready"] == "True"
 
+    def test_renders_decode_engine_env(self):
+        """The engine contract: platform ServingConfig defaults merged
+        with per-CR spec.serving overrides, rendered as KFT_SERVING_*
+        into the serving container (consumed by serving/main.py
+        engine_knobs_from_env)."""
+        from kubeflow_tpu.config.platform import ServingConfig
+
+        store = StateStore()
+        cm = ControllerManager(store)
+        cm.register(DeploymentController())
+        cm.register(
+            InferenceServiceController(
+                serving_defaults=ServingConfig(num_slots=4)
+            )
+        )
+        store.create(
+            new_inference_service(
+                "lm-serve",
+                "team-a",
+                model="gpt_small",
+                serving={"max_queue": 16, "prefill_buckets": [8, 32]},
+            )
+        )
+        cm.run_until_idle(max_seconds=5)
+        dep = store.get("Deployment", "lm-serve", "team-a")
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env == {
+            "KFT_SERVING_NUM_SLOTS": "4",  # platform default (override)
+            "KFT_SERVING_MAX_QUEUE": "16",  # per-CR spec.serving
+            "KFT_SERVING_PREFILL_BUCKETS": "8,32",
+        }
+
+    def test_invalid_spec_serving_rejected(self):
+        from kubeflow_tpu.config.core import ConfigError
+
+        ctl = InferenceServiceController()
+        with pytest.raises(ConfigError, match="powers of two"):
+            ctl._serving_env({"serving": {"prefill_buckets": [3]}})
+
+    def test_engine_knobs_env_roundtrip(self, monkeypatch):
+        """serving/main.py parses exactly what the controller renders."""
+        from kubeflow_tpu.serving.main import engine_knobs_from_env
+
+        monkeypatch.setenv("KFT_SERVING_NUM_SLOTS", "4")
+        monkeypatch.setenv("KFT_SERVING_MAX_QUEUE", "16")
+        monkeypatch.setenv("KFT_SERVING_PREFILL_BUCKETS", "8,32")
+        assert engine_knobs_from_env() == {
+            "num_slots": 4,
+            "max_queue": 16,
+            "prefill_buckets": [8, 32],
+        }
+        monkeypatch.setenv("KFT_SERVING_PREFILL_BUCKETS", "")
+        monkeypatch.setenv("KFT_SERVING_NUM_SLOTS", "")
+        knobs = engine_knobs_from_env()
+        assert knobs["num_slots"] == 8  # default
+        assert knobs["prefill_buckets"] is None  # auto ladder
+
 
 class TestNpyFastPath:
     """Binary predict endpoint: one .npy body each way (the JSON wire
