@@ -770,11 +770,73 @@ def bench_serving_generate(
         srv.stop()
 
 
+def _spec_pair(max_len: int, vocab: int = 2048, draft_layers: int = 2,
+               decay: float = 0.2):
+    """Target + shallow self-draft for the speculative-decoding phases.
+
+    The draft is the first `draft_layers` decoder layers of the TARGET
+    sharing the target's embeddings, final LN and LM head — the
+    self-speculative early-exit construction — and the target's stacked
+    block output projections are scaled by `decay**layer` so its residual
+    stream converges early the way a trained model's does (late layers
+    refine rather than rewrite; a random-init stack has no such structure
+    and would accept ~nothing, which measures the draft, not the
+    machinery). The small vocabulary keeps the shared head from
+    dominating the draft's weight traffic: the draft streams ~1/6 of the
+    target's bytes, which is the regime speculation exists for. The
+    measured accept rate is reported, not assumed."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.registry import get_model
+
+    model = get_model(
+        "gpt_small", dtype=jnp.bfloat16, scan_layers=True,
+        max_len=max_len, vocab_size=vocab,
+    )
+    params = jax.jit(
+        lambda rng: model.init(
+            rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
+        )
+    )(jax.random.PRNGKey(0))["params"]
+    params = jax.device_get(params)
+    layers = model.cfg.num_layers
+    g = (decay ** np.arange(layers)).astype(np.float32)
+    blk = params["layers"]["block"]
+    for path in (("attention", "out"), ("mlp_wo",)):
+        node = blk
+        for p in path:
+            node = node[p]
+        for leaf in ("kernel", "bias"):
+            a = np.asarray(node[leaf], np.float32)
+            node[leaf] = (
+                a * g.reshape((layers,) + (1,) * (a.ndim - 1))
+            ).astype(np.asarray(node[leaf]).dtype)
+    draft = get_model(
+        "gpt_small", dtype=jnp.bfloat16, scan_layers=True,
+        max_len=max_len, vocab_size=vocab, num_layers=draft_layers,
+    )
+    draft_params = {
+        "tok_emb": params["tok_emb"],
+        "pos_emb": params["pos_emb"],
+        "ln_final": params["ln_final"],
+        "head": params["head"],
+        "layers": {
+            "block": jax.tree.map(
+                lambda a: a[:draft_layers], params["layers"]["block"]
+            )
+        },
+    }
+    return model, params, draft, draft_params
+
+
 def bench_serving_continuous(
     num_requests: int = 10,
     mean_interarrival_ms: float = 25.0,
     num_slots: int = 8,
     new_tokens: int = 16,
+    num_draft_tokens: int = 4,
 ) -> dict:
     """Open-loop Poisson-arrival load against the REST `:generate` path:
     the continuous-batching DecodeEngine (serving/engine.py) vs the static
@@ -820,17 +882,47 @@ def bench_serving_continuous(
     server = Server(model_server.app, port=0)
     server.start()
 
+    # the speculative comparison rides the SAME arrival trace through the
+    # same engine machinery at K=0 vs K=num_draft_tokens, on a dedicated
+    # target+self-draft pair (_spec_pair — the big-vocab random-init
+    # gpt_small above stays the cross-round-comparable headline pair)
+    spec_model, spec_params, spec_draft, spec_draft_params = _spec_pair(
+        max_len
+    )
+    spec_vocab = spec_model.cfg.vocab_size
+    spec_k0 = DecodeEngine(
+        "gpt_spec_k0", spec_model, spec_params, num_slots=num_slots,
+        prefill_buckets=buckets, max_queue=max(64, num_requests),
+    )
+    spec_kd = DecodeEngine(
+        "gpt_spec_kd", spec_model, spec_params, num_slots=num_slots,
+        prefill_buckets=buckets, max_queue=max(64, num_requests),
+        draft_model=spec_draft, draft_params=spec_draft_params,
+        num_draft_tokens=num_draft_tokens,
+    )
+    model_server.add_engine(spec_k0)
+    model_server.add_engine(spec_kd)
+
     rng = np.random.default_rng(0)
     offsets = np.cumsum(
         rng.exponential(mean_interarrival_ms / 1e3, num_requests)
     )
-    payloads = []
-    for i in range(num_requests):
-        p = prompt_lens[i % len(prompt_lens)]
-        prompt = rng.integers(0, 50257, (1, p)).tolist()
-        payloads.append(_json.dumps(
-            {"prompt_ids": prompt, "max_new_tokens": new_tokens}
-        ).encode())
+
+    def make_payloads(vocab: int):
+        prng = np.random.default_rng(1)
+        out = []
+        for i in range(num_requests):
+            p = prompt_lens[i % len(prompt_lens)]
+            prompt = prng.integers(0, vocab, (1, p)).tolist()
+            out.append(_json.dumps(
+                {"prompt_ids": prompt, "max_new_tokens": new_tokens}
+            ).encode())
+        return out
+
+    payloads_main = make_payloads(50257)
+    # identical prompt CONTENT for the K=0 and drafted phases: the two
+    # engines must decode the same work
+    payloads_spec = make_payloads(spec_vocab)
 
     def post(url, payload):
         req = urllib.request.Request(
@@ -839,14 +931,14 @@ def bench_serving_continuous(
         with urllib.request.urlopen(req, timeout=600) as resp:
             return _json.loads(resp.read()), resp.headers
 
-    def run_phase(name: str, on_warm=None) -> dict:
+    def run_phase(name: str, payloads, on_warm=None, vocab=50257) -> dict:
         url = f"http://127.0.0.1:{server.port}/v1/models/{name}:generate"
         # warm every program this phase can reach (one request per
         # distinct prompt length covers the static shape keys AND the
-        # engine's buckets + step + insert)
+        # engine's buckets + step/draft/verify + insert)
         for p in prompt_lens:
             post(url, _json.dumps({
-                "prompt_ids": rng.integers(0, 50257, (1, p)).tolist(),
+                "prompt_ids": rng.integers(0, vocab, (1, p)).tolist(),
                 "max_new_tokens": new_tokens,
             }).encode())
         if on_warm is not None:
@@ -909,10 +1001,11 @@ def bench_serving_continuous(
         }
 
     try:
-        static = run_phase("gpt_static")
+        static = run_phase("gpt_static", payloads_main)
         pre = {}
         cont = run_phase(
-            "gpt_engine", on_warm=lambda: pre.update(engine.stats())
+            "gpt_engine", payloads_main,
+            on_warm=lambda: pre.update(engine.stats()),
         )
         post_stats = engine.stats()
         steps = post_stats["decode_steps"] - pre["decode_steps"]
@@ -921,6 +1014,21 @@ def bench_serving_continuous(
             - pre["mean_occupancy"] * pre["decode_steps"]
         )
         cont["mean_occupancy"] = round(occ_steps / steps, 3) if steps else 0.0
+        k0 = run_phase("gpt_spec_k0", payloads_spec, vocab=spec_vocab)
+        pre_spec = {}
+        kd = run_phase(
+            "gpt_spec_kd", payloads_spec,
+            on_warm=lambda: pre_spec.update(spec_kd.stats()),
+            vocab=spec_vocab,
+        )
+        spec_stats = spec_kd.stats()
+        proposed = (
+            spec_stats["draft_proposed"] - pre_spec["draft_proposed"]
+        )
+        accepted = (
+            spec_stats["draft_accepted"] - pre_spec["draft_accepted"]
+        )
+        accept_rate = round(accepted / proposed, 3) if proposed else 0.0
     finally:
         server.stop()
         model_server.close()
@@ -938,6 +1046,20 @@ def bench_serving_continuous(
         "speedup_vs_static": round(
             cont["tokens_per_sec"] / static["tokens_per_sec"], 2
         ),
+        # speculative decoding: same trace, same engine machinery, K=0 vs
+        # drafted on the self-draft pair (vocab spec_vocab)
+        "spec_decode": {
+            "num_draft_tokens": num_draft_tokens,
+            "vocab": spec_vocab,
+            "k0": k0,
+            "drafted": kd,
+            "accept_rate": accept_rate,
+            "drafted_speedup": round(
+                kd["tokens_per_sec"] / k0["tokens_per_sec"], 2
+            ) if k0["tokens_per_sec"] else 0.0,
+        },
+        "engine_accept_rate": accept_rate,
+        "drafted_tokens_per_sec": kd["tokens_per_sec"],
     }
 
 
@@ -1830,6 +1952,11 @@ _HEADLINE_KEYS = (
     "trials",
 )
 
+# Secondary scalars that join the final line beside an entry's headline
+# when present (speculative decoding: serving_continuous reports both the
+# undrafted headline and what the draft buys).
+_EXTRA_FINAL_KEYS = ("engine_accept_rate", "drafted_tokens_per_sec")
+
 
 def _final_line(results: dict, complete: bool, t0: float) -> str:
     """A compact (<= ~1.5 KB) one-line JSON record: headline scalars only.
@@ -1857,6 +1984,14 @@ def _final_line(results: dict, complete: bool, t0: float) -> str:
                 break
         else:
             entries[key] = "ok"
+        # speculative-decoding surface: the accept rate and drafted
+        # throughput ride the final line beside the entry's headline
+        # (they answer a different question — what K buys — and the
+        # driver tail is the only always-parseable record)
+        for extra in _EXTRA_FINAL_KEYS:
+            v = value.get(extra)
+            if isinstance(v, (int, float)):
+                entries[f"{key}.{extra}"] = round(float(v), 3)
     record = {
         "kft_bench_final": True,
         "complete": complete,

@@ -415,12 +415,48 @@ class ServingConfig(ConfigNode):
         help="admission-queue bound: requests past it get 429 instead of "
         "queueing unboundedly (backpressure the client can act on)",
     )
+    draft_model: str = config_field(
+        default="",
+        help="registry model that drafts speculative tokens beside the "
+        "served model (its own resident slot cache; must share the "
+        "target's vocabulary). Empty = no draft resident.",
+    )
+    num_draft_tokens: int = config_field(
+        default=0,
+        help="speculative tokens drafted per slot per verify step (K). "
+        "Each engine iteration then runs K+1 cheap draft steps plus ONE "
+        "target verify forward and emits 1..K+1 tokens per slot; greedy "
+        "output stays bitwise identical to K=0. 0 disables speculative "
+        "decoding (the one-token step path).",
+    )
+    draft_checkpoint_dir: str = config_field(
+        default="",
+        help="platform checkpoint dir holding the draft model's trained "
+        "params (same manifest format the target serves from). Empty = "
+        "seed-0 init: output stays correct (verify rejects bad drafts) "
+        "but the accept rate is noise, so drafted serving is SLOWER than "
+        "K=0 until real params are supplied.",
+    )
 
     def validate(self) -> None:
         if self.num_slots < 0:
             raise ConfigError("serving.num_slots must be >= 0")
         if self.max_queue < 1:
             raise ConfigError("serving.max_queue must be >= 1")
+        if self.num_draft_tokens < 0:
+            raise ConfigError("serving.num_draft_tokens must be >= 0")
+        if self.num_draft_tokens > 0 and not self.draft_model:
+            raise ConfigError(
+                "serving.num_draft_tokens > 0 needs serving.draft_model "
+                "(speculative decoding drafts from a second model)"
+            )
+        if self.num_draft_tokens > 0 and self.num_slots < 1:
+            raise ConfigError(
+                "serving.num_draft_tokens > 0 needs serving.num_slots "
+                ">= 1: speculation lives inside the decode engine, and "
+                "num_slots=0 disables it (the drafted knobs would be "
+                "silently ignored)"
+            )
         for b in self.prefill_buckets:
             if b < 1 or b & (b - 1):
                 raise ConfigError(

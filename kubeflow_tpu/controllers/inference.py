@@ -80,6 +80,9 @@ class InferenceServiceController(Controller):
             "num_slots": self.serving_defaults.num_slots,
             "prefill_buckets": list(self.serving_defaults.prefill_buckets),
             "max_queue": self.serving_defaults.max_queue,
+            "draft_model": self.serving_defaults.draft_model,
+            "num_draft_tokens": self.serving_defaults.num_draft_tokens,
+            "draft_checkpoint_dir": self.serving_defaults.draft_checkpoint_dir,
         }
         merged.update(spec.get("serving") or {})
         cfg = from_dict(ServingConfig, merged)
@@ -90,6 +93,9 @@ class InferenceServiceController(Controller):
             "KFT_SERVING_PREFILL_BUCKETS": ",".join(
                 str(b) for b in cfg.prefill_buckets
             ),
+            "KFT_SERVING_DRAFT_MODEL": cfg.draft_model,
+            "KFT_SERVING_DRAFT_TOKENS": str(cfg.num_draft_tokens),
+            "KFT_SERVING_DRAFT_CHECKPOINT_DIR": cfg.draft_checkpoint_dir,
         }
 
     def reconcile(self, store: StateStore, namespace: str, name: str) -> Result:

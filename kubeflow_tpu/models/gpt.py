@@ -138,17 +138,21 @@ class CausalSelfAttention(nn.Module):
             # attention itself is the ordinary causal path below
 
         if decode:
-            # single-token autoregressive step over the KV cache (the
-            # flax decode idiom): write this step's K/V at `index`, attend
-            # over positions <= index. x is [B, 1, D]. The cursor comes in
-            # two shapes: a scalar (one batch, every row the same age —
-            # serving/generate.py's fused scan) or per-row [B] (the
-            # slot-batch continuous-batching engine, serving/engine.py,
-            # where staggered admission gives every slot its own age).
+            # autoregressive step(s) over the KV cache (the flax decode
+            # idiom): write this step's K/V at `index`, attend over
+            # positions <= index. x is [B, s, D]; s == 1 is the ordinary
+            # one-token step, s > 1 is the speculative-decoding verify
+            # window (serving/engine.py: the K drafted tokens plus the
+            # last accepted one ride ONE target forward). The cursor
+            # comes in two shapes: a scalar (one batch, every row the
+            # same age — serving/generate.py's fused scan) or per-row [B]
+            # (the slot-batch continuous-batching engine, where staggered
+            # admission gives every slot its own age).
             cached_k, cached_v, cache_index, valid_mask = self._cache_vars(
                 x.shape[0], head_dim
             )
             idx = cache_index.value
+            s = x.shape[1]
             if idx.ndim == 0:
                 cached_k.value = jax.lax.dynamic_update_slice(
                     cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)
@@ -157,7 +161,7 @@ class CausalSelfAttention(nn.Module):
                     cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
                 )
                 row_idx = idx[None]
-            else:
+            elif s == 1:
                 # per-row write: one-hot select along the cache axis (a
                 # per-row dynamic_update_slice does not exist; the where
                 # costs one cache-sized select, the same order as the
@@ -171,14 +175,48 @@ class CausalSelfAttention(nn.Module):
                     oh[:, :, None, None], v.astype(cfg.dtype), cached_v.value
                 )
                 row_idx = idx
-            cache_index.value = idx + 1
+            else:
+                # per-row MULTI-token write (the verify window): window
+                # position j of row b lands at cache position idx[b]+j.
+                # The one-hot matmul scatters each row's s new K/V
+                # vectors to their cache positions exactly (x*1 + 0 is
+                # exact in any float dtype, so the written values are
+                # bitwise the ones s sequential one-token steps would
+                # have written); rows whose positions run past max_len
+                # write nothing, same as the one-token path.
+                pos = idx[:, None] + jnp.arange(s)[None, :]
+                oh = (
+                    pos[:, :, None] == jnp.arange(cfg.max_len)[None, None, :]
+                )
+                written = oh.any(axis=1)
+                ohd = oh.astype(cfg.dtype)
+                upd_k = jnp.einsum("bst,bshd->bthd", ohd, k.astype(cfg.dtype))
+                upd_v = jnp.einsum("bst,bshd->bthd", ohd, v.astype(cfg.dtype))
+                cached_k.value = jnp.where(
+                    written[:, :, None, None], upd_k, cached_k.value
+                )
+                cached_v.value = jnp.where(
+                    written[:, :, None, None], upd_v, cached_v.value
+                )
+                row_idx = idx
+            cache_index.value = idx + s
             k, v = cached_k.value, cached_v.value
-            # visible = real (non-pad) cache positions written so far
-            visible = (
-                jnp.arange(cfg.max_len)[None, :] <= row_idx[:, None]
-            ) & valid_mask.value
             from kubeflow_tpu.ops.attention import dense_attention
 
+            if s == 1:
+                # visible = real (non-pad) cache positions written so far
+                visible = (
+                    jnp.arange(cfg.max_len)[None, :] <= row_idx[:, None]
+                ) & valid_mask.value
+            else:
+                # per-query causal visibility inside the window: query j
+                # (at cache position row_idx+j) sees positions <=
+                # row_idx+j — the same set its one-token step would see
+                q_pos = row_idx[:, None] + jnp.arange(s)[None, :]
+                visible = (
+                    jnp.arange(cfg.max_len)[None, None, :]
+                    <= q_pos[:, :, None]
+                ) & valid_mask.value[:, None, :]
             out = dense_attention(
                 q, k, v, mask=visible, dtype=cfg.dtype, causal=False
             )
@@ -401,6 +439,26 @@ def extract_cache_slot(cache, slot):
         return out
 
     return jtu.tree_map_with_path(ext, cache)
+
+
+def rewind_slot_cache(cache, rollback):
+    """Rewind an engine-form slot cache's per-slot cursors by
+    `rollback[S]` positions — the speculative-decoding rollback: a decode
+    window wrote s tokens' K/V and advanced cache_index AND position by
+    s; subtracting the rejected tail makes those cache entries invisible
+    (the decode read masks positions past the cursor) without touching
+    the K/V buffers, and the next accepted token simply overwrites them.
+    `rollback` may be a traced int32 array — one compiled program serves
+    every acceptance pattern."""
+    import jax.tree_util as jtu
+
+    def fix(path, leaf):
+        name = _cache_leaf_name(path)
+        if name in ("cache_index", "position"):
+            return leaf - rollback.astype(leaf.dtype)
+        return leaf
+
+    return jtu.tree_map_with_path(fix, cache)
 
 
 class DecoderStage(nn.Module):

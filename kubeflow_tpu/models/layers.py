@@ -185,6 +185,27 @@ def pipeline_scan(
         travel_specs = [None] * len(travel)
     travel = list(travel)
 
+    # Pin the injection streams' layout: microbatch dim UNSHARDED, inner
+    # dims as in the scan state. When the caller's [B, ...] activations
+    # arrive batch-sharded over `data`, GSPMD resolves the [B]→[M, mb]
+    # microbatch reshape by splitting the M dim across `data` instead
+    # (zero data movement), and on a materialized `pipeline` axis this
+    # jax version's partitioner MISCOMPILES the scan-over-injections that
+    # follows — each stage reads wrong microbatch rows, output off by
+    # O(1), not rounding (pure-jax repro: scan + stage-sharded state +
+    # M-sharded injections; root cause of the pipeline-mesh loss
+    # "invariance" failures carried red since PR 2). Forcing the reshard
+    # here keeps the per-tick dynamic slice over an unsharded M dim,
+    # which partitions correctly.
+    inj_spec = (
+        P(None, *tuple(state_spec)[1:]) if state_spec is not None else None
+    )
+    x_mb = _constrain(x_mb, inj_spec)
+    travel = [
+        _constrain(a, P(None, *tuple(sp)[1:]) if sp is not None else None)
+        for a, sp in zip(travel, travel_specs)
+    ]
+
     stack = nn.vmap(
         stage_cls,
         in_axes=(0,) * (1 + len(travel)) + (None,),

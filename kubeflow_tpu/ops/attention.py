@@ -61,15 +61,18 @@ def dense_attention(
     causal: bool = False,
 ) -> jax.Array:
     """Plain attention over [B, S, H, D]; XLA fuses softmax into the MXU
-    matmuls. `mask` is a [B, S] key-padding mask (True = attend);
-    `causal` adds the autoregressive triangle (decoder-only models)."""
+    matmuls. `mask` is a [B, S] key-padding mask (True = attend) or a
+    [B, S_q, S_k] per-query visibility mask (the KV-cache multi-token
+    decode window, models/gpt.py); `causal` adds the autoregressive
+    triangle (decoder-only models)."""
     depth = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(
         dtype
     )
     big_neg = jnp.finfo(jnp.float32).min
     if mask is not None:
-        scores = jnp.where(mask[:, None, None, :], scores, big_neg)
+        bmask = mask[:, None, None, :] if mask.ndim == 2 else mask[:, None]
+        scores = jnp.where(bmask, scores, big_neg)
     if causal:
         s_q, s_k = scores.shape[-2], scores.shape[-1]
         tri = jnp.tril(jnp.ones((s_q, s_k), dtype=bool))

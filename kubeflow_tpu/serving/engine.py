@@ -36,6 +36,22 @@ traffic shares the one decode program; the sort-based dynamic path is
 skipped via `lax.cond` while every active slot is greedy. Per-request
 seeds: token `n` of a request is drawn with `fold_in(PRNGKey(seed), n)` —
 deterministic regardless of admission timing or slot placement.
+
+Speculative decoding (num_draft_tokens=K > 0, a resident draft model):
+every emitted token in the K=0 loop costs one full target forward — the
+memory-bound regime of Leviathan et al. 2023 / Chen et al. 2023. With a
+draft attached, each scheduler iteration runs K+1 cheap draft steps that
+propose K tokens per slot, then ONE jitted verify step drives the target
+over all slots x (K+1) window positions at once (the multi-token per-row
+decode path in models/gpt.py), accepts each slot's longest valid prefix —
+greedy: exact match against the target argmax, which makes the output
+BITWISE identical to the K=0 engine; sampled: the rejection-sampling rule
+in serving/sampling.py, which makes the output distribution exactly the
+target's — and rewinds both caches' per-slot cursors past the rejected
+tail (models/gpt.py rewind_slot_cache). Each iteration emits between 1
+token (all drafts rejected: the verify step IS the ordinary decode step
+plus a correction) and K+1 tokens (all accepted plus the bonus token), so
+the target's weight traffic is amortized over up to K+1 tokens per slot.
 """
 
 from __future__ import annotations
@@ -50,16 +66,35 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.serving.batching import Completion
+from kubeflow_tpu.serving.sampling import (
+    sample_slots as _sample_slots_shared,
+    slot_filtered_logits,
+    speculative_accept,
+)
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import (
+    serving_accept_rate_histogram,
     serving_decode_steps_counter,
+    serving_draft_accepted_counter,
+    serving_draft_proposed_counter,
     serving_queue_depth_gauge,
     serving_slot_occupancy_gauge,
     serving_tokens_counter,
     serving_ttft_histogram,
+    serving_verify_steps_counter,
 )
 
 log = get_logger(__name__)
+
+# rng-stream salts: speculative positions draw through
+# fold_in(fold_in(key, draw_counter + j), SALT) so the draft proposal,
+# the accept test and the correction resample at one position are
+# independent, and no uniform is ever reused across verify iterations
+# (reusing the accept uniform after a rejection would bias acceptance —
+# the draw counter advances by K+1 every iteration, consumed or not)
+_SALT_DRAFT = 1
+_SALT_ACCEPT = 2
+_SALT_CORRECT = 3
 
 
 class QueueFullError(RuntimeError):
@@ -90,53 +125,10 @@ def default_prefill_buckets(max_len: int, smallest: int = 8) -> Tuple[int, ...]:
     return tuple(out)
 
 
-def _sample_slots(logits, keys, counters, temps, top_ks, top_ps):
-    """[S, V] logits → [S] tokens with PER-SLOT dynamic sampling knobs.
-
-    temps <= 0 rows are greedy f32 argmax (bitwise what generate() does);
-    sampled rows draw categorical over logits/temp restricted by dynamic
-    top-k (value at sorted rank k-1) and top-p (nucleus = prefix of the
-    sorted distribution). One descending sort powers both restrictions;
-    the whole sort path is skipped via cond while no slot samples — the
-    greedy steady state pays only the argmax.
-    """
-    logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-    def sample(_):
-        sub = jax.vmap(jax.random.fold_in)(keys, counters)
-        safe_t = jnp.where(temps > 0.0, temps, jnp.float32(1.0))
-        scaled = logits / safe_t[:, None]
-        vocab = logits.shape[-1]
-        srt = jnp.sort(scaled, axis=-1)[:, ::-1]
-        kth = jnp.take_along_axis(
-            srt, jnp.clip(top_ks, 1, vocab)[:, None] - 1, axis=-1
-        )
-        keep_k = (top_ks[:, None] <= 0) | (srt >= kth)
-        keep = (top_ks[:, None] <= 0) | (scaled >= kth)
-        # top-p composes AFTER top-k (matching serving/generate.py
-        # sample_logits): the nucleus is a prefix of the top-k-
-        # RENORMALIZED distribution. The sorted view of the k-masked
-        # logits is srt with the dropped tail at -inf, so the one sort
-        # still powers both restrictions.
-        srt_k = jnp.where(keep_k, srt, jnp.float32(-jnp.inf))
-        probs = jax.nn.softmax(srt_k, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep tokens whose EXCLUSIVE sorted prefix mass < top_p (top-1
-        # always survives, matching serving/generate.py sample_logits)
-        keep_sorted = (cum - probs) < top_ps[:, None]
-        thr = jnp.min(jnp.where(keep_sorted, srt_k, jnp.inf), axis=-1,
-                      keepdims=True)
-        keep &= (top_ps[:, None] >= 1.0) | (scaled >= thr)
-        masked = jnp.where(keep, scaled, jnp.float32(-jnp.inf))
-        return jax.vmap(jax.random.categorical)(sub, masked).astype(
-            jnp.int32
-        )
-
-    sampled = jax.lax.cond(
-        jnp.any(temps > 0.0), sample, lambda _: greedy, None
-    )
-    return jnp.where(temps > 0.0, sampled, greedy)
+# the per-slot dynamic sampling kernel — shared with the verify step's
+# acceptance math through serving/sampling.py (one definition point; the
+# historical private name stays importable for callers and tests)
+_sample_slots = _sample_slots_shared
 
 
 class _Request:
@@ -192,6 +184,9 @@ class DecodeEngine:
         prefill_buckets: Optional[Sequence[int]] = None,
         max_queue: int = 64,
         autostart: bool = True,
+        draft_model=None,
+        draft_params=None,
+        num_draft_tokens: int = 0,
     ):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -203,6 +198,31 @@ class DecodeEngine:
         self.num_slots = num_slots
         self.max_queue = max_queue
         cfg = model.cfg
+        self.num_draft_tokens = int(num_draft_tokens)
+        if self.num_draft_tokens < 0:
+            raise ValueError("num_draft_tokens must be >= 0")
+        if self.num_draft_tokens > 0:
+            if draft_model is None or draft_params is None:
+                raise ValueError(
+                    "num_draft_tokens > 0 needs draft_model and "
+                    "draft_params (speculative decoding drafts from a "
+                    "resident second model)"
+                )
+            dcfg = draft_model.cfg
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}: the verify step compares token "
+                    "ids, so the models must share a vocabulary"
+                )
+            if dcfg.max_len < cfg.max_len:
+                raise ValueError(
+                    f"draft max_len {dcfg.max_len} < target max_len "
+                    f"{cfg.max_len}: the draft cache tracks the same "
+                    "token positions as the target's"
+                )
+        self.draft_model = draft_model
+        self.draft_params = draft_params
         buckets = tuple(
             sorted(prefill_buckets)
             if prefill_buckets
@@ -241,11 +261,37 @@ class DecodeEngine:
         # one wrapper serves every bucket: jit caches one executable per
         # input shape, so the bucket set bounds the program set by itself
         self._prefill = jax.jit(self._prefill_fn)
+        if self.num_draft_tokens > 0:
+            # the draft's resident slot cache mirrors the target's slot
+            # table position-for-position; its cursors advance and rewind
+            # in lockstep with the target's inside the verify program
+            _, dshapes = jax.eval_shape(
+                lambda p, ids, m: draft_model.apply(
+                    {"params": p}, ids, attention_mask=m, prefill=True,
+                    mutable=["cache"],
+                ),
+                draft_params, dummy, dummy_mask,
+            )
+            self._draft_cache_shapes = dshapes["cache"]
+            self._draft_cache = make_slot_cache(
+                self._draft_cache_shapes, num_slots
+            )
+            self._draft_insert = jax.jit(
+                insert_cache_slot, donate_argnums=(0,)
+            )
+            self._draft_prefill = jax.jit(self._draft_prefill_fn)
+            self._draft = jax.jit(self._draft_fn, donate_argnums=(1,))
+            self._verify = jax.jit(self._verify_fn, donate_argnums=(1, 2))
+        else:
+            self._draft_cache = None
         # per-slot host mirrors, scheduler-thread-owned
         self._slots: List[Optional[_Slot]] = [None] * num_slots
         self._tok_np = np.zeros((num_slots,), np.int32)
         self._key_np = np.zeros((num_slots, 2), np.uint32)
         self._cnt_np = np.zeros((num_slots,), np.int32)
+        # rng-stream position (draws consumed, != tokens emitted once the
+        # verify window starts drawing K+1 positions per iteration)
+        self._draw_np = np.zeros((num_slots,), np.int32)
         self._temp_np = np.zeros((num_slots,), np.float32)
         self._topk_np = np.zeros((num_slots,), np.int32)
         self._topp_np = np.ones((num_slots,), np.float32)
@@ -260,8 +306,15 @@ class DecodeEngine:
         self._steps = 0
         self._emitted = 0
         self._occupied_slot_steps = 0
+        self._drafted = 0
+        self._accepted = 0
+        self._verifies = 0
 
         self._ttft = serving_ttft_histogram()
+        self._draft_proposed = serving_draft_proposed_counter()
+        self._draft_accepted = serving_draft_accepted_counter()
+        self._accept_rate = serving_accept_rate_histogram()
+        self._verify_steps = serving_verify_steps_counter()
         self._queue_depth = serving_queue_depth_gauge()
         self._occupancy = serving_slot_occupancy_gauge()
         self._decode_steps = serving_decode_steps_counter()
@@ -300,6 +353,165 @@ class DecodeEngine:
             out["logits"][:, 0], keys, counters, temps, top_ks, top_ps
         )
         return mutated["cache"], nxt
+
+    # -- speculative draft-and-verify programs -----------------------------
+
+    def _draft_prefill_fn(self, dparams, ids, mask):
+        """Seed the draft's batch-1 cache over the same bucketed prompt
+        the target prefilled — the draft's first token is never used (the
+        engine's first token comes from the TARGET prefill, bitwise the
+        K=0 behavior), so this returns only the cache."""
+        _, mutated = self.draft_model.apply(
+            {"params": dparams}, ids, attention_mask=mask, prefill=True,
+            mutable=["cache"],
+        )
+        return mutated["cache"]
+
+    def _draft_fn(self, dparams, dcache, tokens, keys, draws, temps,
+                  top_ks, top_ps):
+        """K+1 sequential one-token draft steps over all slots: proposals
+        d_1..d_K plus their per-step sampling distributions q (what the
+        verify step's rejection rule needs). The (K+1)-th step's output
+        is discarded — it runs only to WRITE d_K's K/V, so the draft
+        cache ends the iteration having written exactly the same K+1
+        window positions as the target's verify forward and the two
+        caches rewind identically."""
+        kk = self.num_draft_tokens
+
+        def body(carry, j):
+            cache, tok = carry
+            out, mutated = self.draft_model.apply(
+                {"params": dparams, "cache": cache}, tok[:, None],
+                decode=True, mutable=["cache"],
+            )
+            logits = out["logits"][:, 0].astype(jnp.float32)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            def sample(_):
+                masked = slot_filtered_logits(logits, temps, top_ks,
+                                              top_ps)
+                sub = jax.vmap(jax.random.fold_in)(keys, draws + j)
+                sub = jax.vmap(jax.random.fold_in)(
+                    sub, jnp.full_like(draws, _SALT_DRAFT)
+                )
+                tok = jax.vmap(jax.random.categorical)(sub, masked)
+                return (
+                    jnp.where(temps > 0.0, tok.astype(jnp.int32), greedy),
+                    jax.nn.softmax(masked, axis=-1),
+                )
+
+            nxt, q = jax.lax.cond(
+                jnp.any(temps > 0.0),
+                sample,
+                lambda _: (greedy, jnp.zeros_like(logits)),
+                None,
+            )
+            return (mutated["cache"], nxt), (nxt, q)
+
+        (dcache, _), (proposals, qs) = jax.lax.scan(
+            body, (dcache, tokens), jnp.arange(kk + 1)
+        )
+        # [K+1, S] / [K+1, S, V] scan stacks -> the K proposals
+        return dcache, proposals[:kk].T, qs[:kk]
+
+    def _verify_fn(self, params, cache, dcache, window, qs, keys, draws,
+                   temps, top_ks, top_ps):
+        """ONE target forward over all slots x (K+1) window positions
+        (window[:, 0] is each slot's last emitted token, window[:, 1:]
+        the draft's proposals), then per-slot longest-valid-prefix
+        acceptance and cursor rollback for BOTH resident caches.
+
+        Greedy slots accept while the proposal equals the target argmax;
+        the first mismatch position emits the argmax itself (the target's
+        correction — exactly the token the K=0 step would have emitted),
+        which is what makes greedy output bitwise K=0-identical. Sampled
+        slots run the rejection rule in serving/sampling.py; the first
+        rejected position resamples from the residual distribution and a
+        fully-accepted window appends the bonus token from the (K+1)-th
+        target distribution. Every iteration emits acc+1 tokens per slot
+        (1..K+1)."""
+        from kubeflow_tpu.models.gpt import rewind_slot_cache
+
+        kk = self.num_draft_tokens
+        out, mutated = self.model.apply(
+            {"params": params, "cache": cache}, window,
+            decode=True, mutable=["cache"],
+        )
+        logits = out["logits"].astype(jnp.float32)  # [S, K+1, V]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        drafted = window[:, 1:]  # [S, K]
+        match = drafted == greedy[:, :kk]
+
+        def sampled(_):
+            # the target's per-position sampling distribution, filtered
+            # by the same per-slot knobs the draft used — vmapped over
+            # the window axis so the one [S]-knob kernel serves [S, K+1]
+            filt = jax.vmap(
+                lambda lg: slot_filtered_logits(lg, temps, top_ks,
+                                                top_ps),
+                in_axes=1, out_axes=1,
+            )(logits)
+            p = jax.nn.softmax(filt, axis=-1)  # [S, K+1, V]
+
+            def keys_for(salt):
+                def one(key, d, j):
+                    return jax.random.fold_in(
+                        jax.random.fold_in(key, d + j), salt
+                    )
+
+                return jax.vmap(
+                    jax.vmap(one, in_axes=(None, None, 0)),
+                    in_axes=(0, 0, None),
+                )(keys, draws, jnp.arange(kk + 1))  # [S, K+1, 2]
+
+            a_keys = keys_for(_SALT_ACCEPT)
+            c_keys = keys_for(_SALT_CORRECT)
+            uniforms = jax.vmap(jax.vmap(jax.random.uniform))(
+                a_keys[:, :kk]
+            )
+            accept, residual = speculative_accept(
+                p[:, :kk], qs.transpose(1, 0, 2), drafted, uniforms
+            )
+            # correction at a rejected position j: resample from the
+            # residual; bonus after a clean sweep: sample p's last column
+            corr = jax.vmap(jax.vmap(jax.random.categorical))(
+                c_keys[:, :kk], jnp.log(residual)
+            ).astype(jnp.int32)
+            bonus = jax.vmap(jax.random.categorical)(
+                c_keys[:, kk], jnp.log(p[:, kk])
+            ).astype(jnp.int32)
+            repl = jnp.concatenate([corr, bonus[:, None]], axis=1)
+            is_samp = temps > 0.0
+            return (
+                jnp.where(is_samp[:, None], accept, match),
+                jnp.where(is_samp[:, None], repl, greedy),
+            )
+
+        accept, replacement = jax.lax.cond(
+            jnp.any(temps > 0.0), sampled, lambda _: (match, greedy), None
+        )
+        # longest accepted prefix, then one replacement token (correction
+        # at the first rejection, bonus after a clean sweep)
+        acc = jnp.sum(
+            jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+        )  # [S] in [0, K]
+        out_len = acc + 1
+        padded = jnp.concatenate(
+            [drafted, jnp.zeros_like(drafted[:, :1])], axis=1
+        )
+        out_tokens = jnp.where(
+            jnp.arange(kk + 1)[None, :] < acc[:, None], padded, replacement
+        )
+        # both caches consumed K+1 window positions; keep out_len of them
+        # (the replacement token's K/V is NOT resident — it is the next
+        # iteration's window[:, 0], exactly like the K=0 step's output)
+        rollback = (kk + 1) - out_len
+        return (
+            rewind_slot_cache(mutated["cache"], rollback),
+            rewind_slot_cache(dcache, rollback),
+            out_tokens,
+            out_len,
+        )
 
     # -- public API --------------------------------------------------------
 
@@ -424,6 +636,12 @@ class DecodeEngine:
                     if steps
                     else 0.0
                 ),
+                "draft_proposed": self._drafted,
+                "draft_accepted": self._accepted,
+                "verify_steps": self._verifies,
+                "accept_rate": (
+                    self._accepted / self._drafted if self._drafted else 0.0
+                ),
             }
 
     def close(self) -> None:
@@ -475,6 +693,16 @@ class DecodeEngine:
         self._cache = self._insert(
             self._cache, cache_one, jnp.int32(slot_idx)
         )
+        if self.num_draft_tokens > 0:
+            # the draft tracks the same context from the same bucketed
+            # prompt; its cursors now sit at the same bucket boundary as
+            # the target's and stay in lockstep through verify rollbacks
+            draft_one = self._draft_prefill(
+                self.draft_params, jnp.asarray(ids), jnp.asarray(mask)
+            )
+            self._draft_cache = self._draft_insert(
+                self._draft_cache, draft_one, jnp.int32(slot_idx)
+            )
         first = int(jax.device_get(tok))
         slot = _Slot(req)
         slot.ttft_s = time.monotonic() - req.t_submit
@@ -484,6 +712,7 @@ class DecodeEngine:
         self._tok_np[slot_idx] = first
         self._key_np[slot_idx] = np.asarray(jax.device_get(base))
         self._cnt_np[slot_idx] = 1
+        self._draw_np[slot_idx] = 1  # the prefill drew fold_in(key, 0)
         self._temp_np[slot_idx] = req.temperature
         self._topk_np[slot_idx] = req.top_k
         self._topp_np[slot_idx] = req.top_p
@@ -511,13 +740,14 @@ class DecodeEngine:
         an admit that invalidated the DONATED resident cache before
         raising). Without this the scheduler thread dies and every resident
         and queued request blocks until its caller's wait() timeout. Fail
-        the resident futures (their slot state is gone), rebuild a zeroed
-        resident cache — the old buffer may be a donated tombstone — and
-        keep scheduling: queued requests were never admitted and remain
-        servable."""
+        the resident futures (their slot state is gone), rebuild BOTH
+        zeroed resident caches — the draft/verify programs donate the
+        target AND draft buffers, so either may be a donated tombstone —
+        and keep scheduling: queued requests were never admitted and
+        remain servable."""
         log.exception(
             "engine %s decode iteration failed; failing %d resident "
-            "request(s) and rebuilding the slot cache",
+            "request(s) and rebuilding the slot cache(s)",
             self.name, sum(s is not None for s in self._slots),
         )
         err = RuntimeError(f"engine {self.name} decode step failed: {exc!r}")
@@ -530,6 +760,10 @@ class DecodeEngine:
         self._cache = self._make_slot_cache(
             self._cache_shapes, self.num_slots
         )
+        if self.num_draft_tokens > 0:
+            self._draft_cache = self._make_slot_cache(
+                self._draft_cache_shapes, self.num_slots
+            )
         self._occupancy.set(0.0, model=self.name)
 
     def _loop(self) -> None:
@@ -565,14 +799,18 @@ class DecodeEngine:
                 self._admit(i, req)
             except BaseException as e:  # noqa: BLE001 - per-request
                 req.future.fail(e)
-                # _insert donates the resident cache: a failure past
-                # dispatch leaves self._cache a deleted tombstone. With
-                # active slots the next _step raises into _recover, but an
-                # IDLE engine never steps — every later admit would hit
-                # the tombstone and fail, poisoning the engine forever.
+                # the inserts donate the resident caches: a failure past
+                # dispatch leaves self._cache (or the draft's) a deleted
+                # tombstone. With active slots the next step raises into
+                # _recover, but an IDLE engine never steps — every later
+                # admit would hit the tombstone and fail, poisoning the
+                # engine forever.
+                leaves = list(jax.tree_util.tree_leaves(self._cache))
+                if self.num_draft_tokens > 0:
+                    leaves += jax.tree_util.tree_leaves(self._draft_cache)
                 if any(
                     getattr(leaf, "is_deleted", lambda: False)()
-                    for leaf in jax.tree_util.tree_leaves(self._cache)
+                    for leaf in leaves
                 ):
                     self._recover(e)
                 continue
@@ -586,6 +824,9 @@ class DecodeEngine:
             len(active) / self.num_slots, model=self.name
         )
         if not active:
+            return
+        if self.num_draft_tokens > 0:
+            self._iterate_spec(active)
             return
         self._cache, tok = self._step(
             self.params, self._cache,
@@ -605,3 +846,64 @@ class DecodeEngine:
             slot.tokens.append(int(toks[i]))
             self._tok_np[i] = toks[i]
             self._cnt_np[i] += 1
+
+    def _iterate_spec(self, active: List[int]) -> None:
+        """One draft-and-verify iteration: K+1 draft steps propose K
+        tokens per slot, one target verify forward over all slots x (K+1)
+        positions accepts each slot's longest valid prefix and rewinds
+        both caches past the rejected tail. Emits 1..K+1 tokens per
+        active slot; slots that hit max_new_tokens or EOS inside the
+        window keep only the prefix they asked for (their device cursors
+        are off-by-a-few but the slot retires and admission resets every
+        cursor it reuses)."""
+        kk = self.num_draft_tokens
+        keys = jnp.asarray(self._key_np)
+        draws = jnp.asarray(self._draw_np)
+        temps = jnp.asarray(self._temp_np)
+        top_ks = jnp.asarray(self._topk_np)
+        top_ps = jnp.asarray(self._topp_np)
+        self._draft_cache, proposals, qs = self._draft(
+            self.draft_params, self._draft_cache,
+            jnp.asarray(self._tok_np), keys, draws, temps, top_ks, top_ps,
+        )
+        window = jnp.concatenate(
+            [jnp.asarray(self._tok_np)[:, None], proposals], axis=1
+        )
+        self._cache, self._draft_cache, out_tok, out_len = self._verify(
+            self.params, self._cache, self._draft_cache, window, qs,
+            keys, draws, temps, top_ks, top_ps,
+        )
+        out_tok = np.asarray(jax.device_get(out_tok))
+        out_len = np.asarray(jax.device_get(out_len))
+        self._draw_np += kk + 1  # the window consumed K+1 rng positions
+        emitted = 0
+        accepted = 0
+        for i in active:
+            slot = self._slots[i]
+            req = slot.req
+            budget = req.max_new - len(slot.tokens)
+            toks = [int(t) for t in out_tok[i, : min(int(out_len[i]),
+                                                     budget)]]
+            if req.eos_id is not None and req.eos_id in toks:
+                toks = toks[: toks.index(req.eos_id) + 1]
+            slot.tokens.extend(toks)
+            self._tok_np[i] = toks[-1]
+            # _cnt_np (the K=0 step's rng counter) stays untouched: the
+            # spec path's rng position is _draw_np, and a drafted engine
+            # never runs _step
+            emitted += len(toks)
+            accepted += int(out_len[i]) - 1
+        proposed = kk * len(active)
+        self._decode_steps.inc(model=self.name)
+        self._verify_steps.inc(model=self.name)
+        self._tokens_total.inc(emitted, model=self.name)
+        self._draft_proposed.inc(proposed, model=self.name)
+        self._draft_accepted.inc(accepted, model=self.name)
+        self._accept_rate.observe(accepted / proposed, model=self.name)
+        with self._stats_lock:
+            self._steps += 1
+            self._emitted += emitted
+            self._occupied_slot_steps += len(active)
+            self._drafted += proposed
+            self._accepted += accepted
+            self._verifies += 1

@@ -28,46 +28,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-
-def sample_logits(
-    logits: jax.Array,
-    rng: Optional[jax.Array],
-    temperature: float = 0.0,
-    top_k: int = 0,
-    top_p: float = 1.0,
-) -> jax.Array:
-    """[B, V] logits → [B] int32 token ids.
-
-    temperature <= 0 is greedy argmax (rng unused). top_k keeps the k
-    highest logits; top_p keeps the smallest prefix of the sorted
-    distribution with cumulative probability >= top_p (both always keep
-    the argmax, so they compose).
-    """
-    logits = logits.astype(jnp.float32)
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / jnp.float32(temperature)
-    neg_inf = jnp.float32(-jnp.inf)
-    if top_k > 0 and top_k < logits.shape[-1]:
-        # O(V log k) partial selection — the kth value is all we need.
-        # The previous full jnp.sort was O(V log V) over the whole vocab
-        # per sampled token.
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, neg_inf, logits)
-    if top_p < 1.0:
-        # top-p genuinely needs the FULL descending sort: the nucleus is
-        # defined as a prefix of the whole sorted distribution (cumulative
-        # mass), so a partial top-k selection cannot compute it
-        sort = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sort, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep tokens whose EXCLUSIVE prefix mass < top_p (top-1 always in)
-        keep = (cum - probs) < top_p
-        threshold = jnp.min(
-            jnp.where(keep, sort, jnp.inf), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits >= threshold, logits, neg_inf)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+# the one shared temperature/top-k/top-p kernel (serving/sampling.py);
+# re-exported because this module was its historical home and external
+# callers import it from here
+from kubeflow_tpu.serving.sampling import sample_logits  # noqa: F401
 
 
 def generate(

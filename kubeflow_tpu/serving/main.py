@@ -22,13 +22,20 @@ def engine_knobs_from_env():
     renders (controllers/inference.py ← config/platform.py ServingConfig):
     KFT_SERVING_NUM_SLOTS (0 disables the engine), KFT_SERVING_MAX_QUEUE,
     KFT_SERVING_PREFILL_BUCKETS (comma-separated powers of two; empty =
-    auto power-of-two ladder)."""
+    auto power-of-two ladder), KFT_SERVING_DRAFT_MODEL +
+    KFT_SERVING_DRAFT_TOKENS (speculative decoding: registry draft model
+    and tokens drafted per verify step; 0 disables)."""
     buckets_raw = os.environ.get("KFT_SERVING_PREFILL_BUCKETS", "")
     buckets = [int(b) for b in buckets_raw.split(",") if b.strip()]
     return {
         "num_slots": _env_int("KFT_SERVING_NUM_SLOTS", 8),
         "max_queue": _env_int("KFT_SERVING_MAX_QUEUE", 64),
         "prefill_buckets": buckets or None,
+        "draft_model": os.environ.get("KFT_SERVING_DRAFT_MODEL", "").strip(),
+        "num_draft_tokens": _env_int("KFT_SERVING_DRAFT_TOKENS", 0),
+        "draft_checkpoint_dir": os.environ.get(
+            "KFT_SERVING_DRAFT_CHECKPOINT_DIR", ""
+        ).strip(),
     }
 
 
@@ -51,6 +58,10 @@ def build_server(
     num_slots: int = None,
     max_queue: int = None,
     prefill_buckets=None,
+    draft_model: str = None,
+    num_draft_tokens: int = None,
+    draft_params=None,
+    draft_checkpoint_dir: str = None,
 ):
     """Assemble the ModelServer for one registry model (testable core of
     the entrypoint): causal families serve :generate via the
@@ -58,7 +69,13 @@ def build_server(
     falls back to the per-request ServedLm fused scan); everything else
     serves :predict via ServedModel with cross-request micro-batching.
     Engine knobs default from the controller-rendered KFT_SERVING_* env
-    (engine_knobs_from_env)."""
+    (engine_knobs_from_env). A draft model + num_draft_tokens>0 turns on
+    speculative decoding inside the engine; trained draft params come
+    from `draft_checkpoint_dir` (the same platform-checkpoint restore
+    the target uses), falling back to the draft registry model's
+    deterministic seed-0 init (correct output regardless — verify
+    rejects bad drafts — just a useless accept rate until real params
+    arrive)."""
     from kubeflow_tpu.serving.server import ModelServer, ServedModel
 
     server = ModelServer()
@@ -82,6 +99,23 @@ def build_server(
             max_queue = env["max_queue"]
         if prefill_buckets is None:
             prefill_buckets = env["prefill_buckets"]
+        if draft_model is None:
+            draft_model = env["draft_model"]
+        if num_draft_tokens is None:
+            num_draft_tokens = env["num_draft_tokens"]
+        if draft_checkpoint_dir is None:
+            draft_checkpoint_dir = env["draft_checkpoint_dir"]
+        if num_draft_tokens > 0 and not draft_model:
+            raise ValueError(
+                "num_draft_tokens > 0 needs a draft model "
+                "(--draft-model / KFT_SERVING_DRAFT_MODEL)"
+            )
+        if num_draft_tokens > 0 and num_slots < 1:
+            raise ValueError(
+                "num_draft_tokens > 0 needs num_slots >= 1: speculation "
+                "lives inside the decode engine, and num_slots=0 "
+                "disables it — drop the draft knobs or enable the engine"
+            )
         lm = ServedLm.from_registry(
             model, checkpoint_dir=checkpoint_dir or None, params=params
         )
@@ -89,6 +123,38 @@ def build_server(
         if num_slots > 0:
             from kubeflow_tpu.serving.engine import DecodeEngine
 
+            draft = None
+            if num_draft_tokens > 0:
+                import jax
+                import jax.numpy as jnp
+
+                from kubeflow_tpu.models.registry import get_model
+
+                draft = get_model(draft_model, scan_layers=True)
+                if draft_params is None and draft_checkpoint_dir:
+                    # trained draft params from a platform checkpoint —
+                    # the same manifest restore the target serves from
+                    from kubeflow_tpu.serving.server import (
+                        restore_checkpoint_params,
+                    )
+
+                    draft_params = restore_checkpoint_params(
+                        draft_checkpoint_dir
+                    )
+                if draft_params is None:
+                    print(
+                        f"note: draft model {draft_model} initialized "
+                        "from seed 0 (no draft checkpoint plumbed); "
+                        "output stays correct, accept rate will be noise "
+                        "until trained draft params are provided",
+                        flush=True,
+                    )
+                    draft_params = jax.jit(
+                        lambda rng: draft.init(
+                            rng, jnp.zeros((1, 8), jnp.int32),
+                            deterministic=True,
+                        )
+                    )(jax.random.PRNGKey(0))["params"]
             server.add_engine(
                 DecodeEngine(
                     lm.name,
@@ -97,6 +163,9 @@ def build_server(
                     num_slots=num_slots,
                     max_queue=max_queue,
                     prefill_buckets=prefill_buckets,
+                    draft_model=draft,
+                    draft_params=draft_params,
+                    num_draft_tokens=num_draft_tokens,
                 )
             )
     else:
@@ -131,6 +200,22 @@ def main(argv=None) -> int:
         help="engine admission-queue bound — 429 past it (default from "
         "KFT_SERVING_MAX_QUEUE, else 64)",
     )
+    ap.add_argument(
+        "--draft-model", default=None,
+        help="registry model drafting speculative tokens beside the "
+        "target (default from KFT_SERVING_DRAFT_MODEL; empty disables)",
+    )
+    ap.add_argument(
+        "--num-draft-tokens", type=int, default=None,
+        help="speculative tokens drafted per verify step (K; 0 disables; "
+        "default from KFT_SERVING_DRAFT_TOKENS, else 0)",
+    )
+    ap.add_argument(
+        "--draft-checkpoint-dir", default=None,
+        help="platform checkpoint dir with the draft's trained params "
+        "(default from KFT_SERVING_DRAFT_CHECKPOINT_DIR; empty = seed-0 "
+        "init, accept rate will be noise)",
+    )
     args = ap.parse_args(argv)
 
     from kubeflow_tpu.api.wsgi import Server
@@ -138,6 +223,9 @@ def main(argv=None) -> int:
     server = build_server(
         args.model, args.checkpoint_dir, args.batch_window_ms,
         num_slots=args.num_slots, max_queue=args.max_queue,
+        draft_model=args.draft_model,
+        num_draft_tokens=args.num_draft_tokens,
+        draft_checkpoint_dir=args.draft_checkpoint_dir,
     )
     httpd = Server(server.app, host=args.host, port=args.port)
     print(f"serving {args.model} on :{httpd.port}", flush=True)

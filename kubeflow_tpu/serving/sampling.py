@@ -1,0 +1,160 @@
+"""The one sampling kernel for every serving decode path.
+
+Temperature / top-k / top-p logit filtering used to live twice — once with
+compile-time scalar knobs (`serving/generate.py sample_logits`, the fused
+scan) and once with per-slot dynamic-array knobs (`serving/engine.py
+_sample_slots`, the continuous-batching step) — and the round-4 review
+found the two had drifted on top-p-over-renormalized-top-k composition.
+Both call sites now import from here, and the speculative-decoding verify
+step (the third consumer: rejection-sampling acceptance needs the *exact*
+distribution the draft and target would have sampled from) reuses the same
+filtered-logits core, so the three cannot drift again.
+
+Composition contract (all paths): temperature scales first, top-k keeps
+the k highest scaled logits, and the top-p nucleus is a prefix of the
+**top-k-renormalized** distribution — both filters always keep the argmax,
+so they compose.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(
+    logits: jax.Array,
+    rng: Optional[jax.Array],
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """[B, V] logits → [B] int32 token ids; knobs are COMPILE-TIME scalars
+    (the fused-scan path: knobs join the jit cache key).
+
+    temperature <= 0 is greedy argmax (rng unused). top_k keeps the k
+    highest logits; top_p keeps the smallest prefix of the sorted
+    distribution with cumulative probability >= top_p (both always keep
+    the argmax, so they compose).
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.float32(temperature)
+    neg_inf = jnp.float32(-jnp.inf)
+    if top_k > 0 and top_k < logits.shape[-1]:
+        # O(V log k) partial selection — the kth value is all we need.
+        # A full jnp.sort would be O(V log V) over the whole vocab per
+        # sampled token.
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg_inf, logits)
+    if top_p < 1.0:
+        # top-p genuinely needs the FULL descending sort: the nucleus is
+        # defined as a prefix of the whole sorted distribution (cumulative
+        # mass), so a partial top-k selection cannot compute it
+        sort = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sort, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose EXCLUSIVE prefix mass < top_p (top-1 always in)
+        keep = (cum - probs) < top_p
+        threshold = jnp.min(
+            jnp.where(keep, sort, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= threshold, logits, neg_inf)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def slot_filtered_logits(logits, temps, top_ks, top_ps):
+    """[S, V] f32 logits → temperature-scaled logits with every token
+    outside the per-slot top-k/top-p restriction at -inf. Knobs are
+    PER-SLOT ARRAYS (the engine path: mixed sampling traffic shares one
+    compiled program). `softmax(result)` is the exact distribution
+    `sample_slots` draws from — which is what makes this the shared core
+    for the speculative verify step's rejection sampling.
+
+    temps <= 0 rows pass through unfiltered (their callers take the
+    argmax and never consult the filtered row). One descending sort
+    powers both restrictions; top-p composes AFTER top-k (the nucleus is
+    a prefix of the top-k-RENORMALIZED distribution), matching
+    `sample_logits`.
+    """
+    safe_t = jnp.where(temps > 0.0, temps, jnp.float32(1.0))
+    scaled = logits / safe_t[:, None]
+    vocab = logits.shape[-1]
+    srt = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        srt, jnp.clip(top_ks, 1, vocab)[:, None] - 1, axis=-1
+    )
+    keep_k = (top_ks[:, None] <= 0) | (srt >= kth)
+    keep = (top_ks[:, None] <= 0) | (scaled >= kth)
+    # the sorted view of the k-masked logits is srt with the dropped tail
+    # at -inf, so the one sort still powers both restrictions
+    srt_k = jnp.where(keep_k, srt, jnp.float32(-jnp.inf))
+    probs = jax.nn.softmax(srt_k, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens whose EXCLUSIVE sorted prefix mass < top_p (top-1
+    # always survives, matching sample_logits)
+    keep_sorted = (cum - probs) < top_ps[:, None]
+    thr = jnp.min(jnp.where(keep_sorted, srt_k, jnp.inf), axis=-1,
+                  keepdims=True)
+    keep &= (top_ps[:, None] >= 1.0) | (scaled >= thr)
+    return jnp.where(keep, scaled, jnp.float32(-jnp.inf))
+
+
+def sample_slots(logits, keys, counters, temps, top_ks, top_ps):
+    """[S, V] logits → [S] tokens with PER-SLOT dynamic sampling knobs.
+
+    temps <= 0 rows are greedy f32 argmax (bitwise what sample_logits'
+    greedy path does); sampled rows draw categorical over the
+    slot_filtered_logits restriction with the per-slot key
+    `fold_in(keys[s], counters[s])`. The whole sort path is skipped via
+    cond while no slot samples — the greedy steady state pays only the
+    argmax.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sample(_):
+        sub = jax.vmap(jax.random.fold_in)(keys, counters)
+        masked = slot_filtered_logits(logits, temps, top_ks, top_ps)
+        return jax.vmap(jax.random.categorical)(sub, masked).astype(
+            jnp.int32
+        )
+
+    sampled = jax.lax.cond(
+        jnp.any(temps > 0.0), sample, lambda _: greedy, None
+    )
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+def speculative_accept(p, q, drafted, uniforms):
+    """The Leviathan/Chen rejection-sampling acceptance rule, vectorized
+    over slots and draft positions.
+
+    p        [S, K, V]  target sampling distribution at each position
+    q        [S, K, V]  draft sampling distribution the proposal was
+                        drawn from
+    drafted  [S, K]     proposed tokens
+    uniforms [S, K]     one U[0,1) draw per position
+
+    Returns (accept [S, K] bool, residual [S, K, V]): position j is
+    accepted iff u_j < p_j(d_j)/q_j(d_j); on the first rejection the
+    caller resamples from `residual` = normalize(max(p - q, 0)), which is
+    exactly what makes the emitted stream distributed as the target's
+    (the speculative-sampling correctness lemma — tested against an
+    empirical histogram in tests/test_spec_decode.py). Rows whose
+    residual is all-zero (p == q pointwise: the correction is never
+    reached, or reached with probability 0) fall back to p so the
+    categorical stays well-defined.
+    """
+    p_d = jnp.take_along_axis(p, drafted[..., None], axis=-1)[..., 0]
+    q_d = jnp.take_along_axis(q, drafted[..., None], axis=-1)[..., 0]
+    # u < p/q  ⟺  u*q < p, without dividing by a possibly-tiny q; a
+    # proposal can only carry q(d) > 0, and p == q accepts always (u < 1)
+    accept = uniforms * q_d < p_d
+    residual = jnp.maximum(p - q, 0.0)
+    total = residual.sum(axis=-1, keepdims=True)
+    residual = jnp.where(total > 0.0, residual / total, p)
+    return accept, residual

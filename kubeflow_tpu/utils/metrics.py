@@ -445,6 +445,59 @@ def serving_tokens_counter() -> Counter:
     )
 
 
+# Speculative decoding (serving/engine.py draft-and-verify): the accept
+# rate IS the knob-tuning signal — tokens/verify = 1 + rate x K, so a low
+# rate means the draft model is wasted work and K should shrink (or the
+# draft improve); see docs/SERVING.md.
+
+# acceptance is a fraction of K proposals per verify step; uniform bins
+# resolve the whole 0..1 tuning range
+SERVING_ACCEPT_RATE_BUCKETS = (
+    0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0,
+)
+
+
+def serving_draft_proposed_counter() -> Counter:
+    """Draft tokens proposed to the verify step (K x active slots per
+    iteration)."""
+    return default_registry().counter(
+        "serving_draft_proposed_total",
+        "speculative draft tokens proposed",
+        ["model"],
+    )
+
+
+def serving_draft_accepted_counter() -> Counter:
+    """Draft tokens the target's verify step accepted (the emitted bonus/
+    correction token is not a draft and is not counted)."""
+    return default_registry().counter(
+        "serving_draft_accepted_total",
+        "speculative draft tokens accepted by verify",
+        ["model"],
+    )
+
+
+def serving_accept_rate_histogram() -> Histogram:
+    """Per-verify-step acceptance fraction (accepted / proposed across
+    the step's active slots)."""
+    return default_registry().histogram(
+        "serving_accept_rate",
+        "per-verify-step draft acceptance fraction",
+        ["model"],
+        buckets=SERVING_ACCEPT_RATE_BUCKETS,
+    )
+
+
+def serving_verify_steps_counter() -> Counter:
+    """Fused draft-and-verify iterations (each runs ONE target forward
+    over all slots x K+1 window positions)."""
+    return default_registry().counter(
+        "serving_verify_steps_total",
+        "speculative verify steps executed",
+        ["model"],
+    )
+
+
 def start_heartbeat(
     gauge: Gauge, period_s: float = 10.0, stop_event: Optional[threading.Event] = None
 ) -> threading.Thread:

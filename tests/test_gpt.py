@@ -298,8 +298,14 @@ class TestGptTrainer:
                 state, m = tr.train_step(state, gb, rng)
                 got.append(float(jax.device_get(m["loss"])))
             losses[label] = got
+        # Tight tolerance on purpose: the historical ~1e-3..1e-2 "noise"
+        # here was a real GSPMD miscompile of the microbatch injection
+        # reshape on materialized pipeline meshes, fixed by the inj_spec
+        # constraint in models/layers.py::pipeline_scan (see the comment
+        # there and test_pipeline.py's twin). Residual rtol covers f32
+        # reduction-order drift only (~1e-7 measured).
         np.testing.assert_allclose(
-            losses["flat"], losses["pp"], rtol=2e-4, atol=2e-4
+            losses["flat"], losses["pp"], rtol=1e-5, atol=0.0
         )
 
     def test_moe_ep_matches_dp_loss(self, devices8):

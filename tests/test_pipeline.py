@@ -148,8 +148,19 @@ class TestPipelinedBert:
                 state, metrics = trainer.train_step(state, batch, rng)
                 got.append(float(jax.device_get(metrics["loss"])))
             losses[label] = got
+        # Tight tolerance on purpose (triaged r6): the ~1e-2 divergence
+        # this test carried red was NOT accumulation noise — with
+        # bitwise-identical params and batches, the pp-mesh forward's
+        # logits were off by O(1). Root cause: GSPMD resolves the
+        # [B]→[M, mb] microbatch reshape of a data-sharded activation by
+        # splitting the M dim across `data`, and this jax version's
+        # partitioner miscompiles the scan-over-injections that follows
+        # (pure-jax repro in the pipeline_scan comment). Fixed by pinning
+        # the injection streams to an unsharded-M layout in
+        # models/layers.py::pipeline_scan; residual rtol covers f32
+        # reduction-order drift only (~1e-7 measured, bitwise at step 2).
         np.testing.assert_allclose(
-            losses["flat"], losses["pp"], rtol=2e-4, atol=2e-4
+            losses["flat"], losses["pp"], rtol=1e-5, atol=0.0
         )
 
     def test_pipeline_params_sharded_over_pipeline_axis(self, devices8):

@@ -213,6 +213,9 @@ class TestInferenceServiceController:
             "KFT_SERVING_NUM_SLOTS": "4",  # platform default (override)
             "KFT_SERVING_MAX_QUEUE": "16",  # per-CR spec.serving
             "KFT_SERVING_PREFILL_BUCKETS": "8,32",
+            "KFT_SERVING_DRAFT_MODEL": "",  # speculation off by default
+            "KFT_SERVING_DRAFT_TOKENS": "0",
+            "KFT_SERVING_DRAFT_CHECKPOINT_DIR": "",
         }
 
     def test_invalid_spec_serving_rejected(self):
@@ -233,6 +236,9 @@ class TestInferenceServiceController:
             "num_slots": 4,
             "max_queue": 16,
             "prefill_buckets": [8, 32],
+            "draft_model": "",
+            "num_draft_tokens": 0,
+            "draft_checkpoint_dir": "",
         }
         monkeypatch.setenv("KFT_SERVING_PREFILL_BUCKETS", "")
         monkeypatch.setenv("KFT_SERVING_NUM_SLOTS", "")

@@ -1,0 +1,445 @@
+"""Speculative decoding in the continuous-batching engine
+(serving/engine.py draft-and-verify; serving/sampling.py acceptance).
+
+The load-bearing contract mirrors PR 4's: with greedy sampling the
+drafted engine's output is BITWISE identical to both the K=0 engine and
+the fused-scan `generate()` — speculation changes how many target
+forwards run, never what is computed — and that must hold for ANY draft,
+including an adversarial one that never matches. Acceptance bookkeeping
+is pinned at both extremes (an identical draft accepts K every window, a
+provably-wrong draft accepts 0), `_recover()` must rebuild the draft
+cache beside the target's, and sampled mode must emit the TARGET's
+distribution (the rejection-sampling lemma, checked empirically on a
+discriminating toy).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import get_model
+from kubeflow_tpu.serving.engine import DecodeEngine
+from kubeflow_tpu.serving.generate import generate
+
+
+@pytest.fixture(scope="module")
+def gpt_and_params():
+    model = get_model("gpt_tiny", dtype=jnp.float32)
+    prompt = jnp.arange(6)[None, :].astype(jnp.int32) % 512
+    params = model.init(jax.random.PRNGKey(0), prompt, deterministic=True)[
+        "params"
+    ]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def wrong_draft_params(gpt_and_params):
+    """Draft params whose argmax provably NEVER matches the target's:
+    the head kernel rolled one vocab position shifts every logit row by
+    one, so the draft's greedy token is always target_argmax + 1 mod V —
+    deterministic acceptance == 0 without relying on randomness."""
+    _, params = gpt_and_params
+    dparams = jax.device_get(params)
+    dparams["head"]["kernel"] = np.roll(
+        np.asarray(dparams["head"]["kernel"]), 1, axis=-1
+    )
+    return dparams
+
+
+def _rows(*lens):
+    return [
+        (np.arange(n) * (3 + 2 * i) + i + 1).astype(np.int32) % 512
+        for i, n in enumerate(lens)
+    ]
+
+
+def _ref_tokens(model, params, row, n):
+    out = generate(model, params, jnp.asarray(row, jnp.int32)[None, :], n)
+    return np.asarray(out)[0, len(row):].tolist()
+
+
+def _drafted_engine(model, params, draft_params, k=3, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_queue", 16)
+    return DecodeEngine(
+        "spec", model, params, draft_model=model,
+        draft_params=draft_params, num_draft_tokens=k, **kw,
+    )
+
+
+class TestGreedyParity:
+    def test_bitwise_vs_generate_and_k0_engine_ragged_staggered(
+        self, gpt_and_params, wrong_draft_params
+    ):
+        """4 ragged requests through 2 slots (staggered admission by
+        construction) — drafted engines at acceptance-1.0 AND
+        acceptance-0 must both emit bitwise the K=0 engine's stream,
+        which is bitwise the fused scan's."""
+        model, params = gpt_and_params
+        rows = _rows(4, 6, 7, 3)
+        n_new = [6, 7, 5, 8]
+        streams = {}
+        for label, eng in (
+            ("k0", DecodeEngine("k0", model, params, num_slots=2,
+                                max_queue=16)),
+            ("perfect", _drafted_engine(model, params, params)),
+            ("hostile", _drafted_engine(model, params, wrong_draft_params)),
+        ):
+            try:
+                futs = [eng.submit(r, n) for r, n in zip(rows, n_new)]
+                streams[label] = [f.wait(120)["tokens"] for f in futs]
+            finally:
+                eng.close()
+        oracle = [
+            _ref_tokens(model, params, r, n) for r, n in zip(rows, n_new)
+        ]
+        assert streams["k0"] == oracle
+        assert streams["perfect"] == oracle
+        assert streams["hostile"] == oracle
+
+    # engine-compile-heavy variants (each distinct (K, num_slots) pair
+    # compiles its own draft/verify programs): excluded from the tier-1
+    # budget, always run by the `spec-decode-parity` CI job (no marker
+    # filter there)
+    @pytest.mark.slow
+    def test_slot_finishing_mid_verify_window(self, gpt_and_params):
+        """max_new smaller than the verify window: a perfect draft
+        accepts K+1 tokens but the request asked for 2 — the host keeps
+        exactly the prefix, and a neighbor with a longer budget is
+        unaffected."""
+        model, params = gpt_and_params
+        eng = _drafted_engine(model, params, params, k=4)
+        try:
+            rows = _rows(4, 5)
+            f_short = eng.submit(rows[0], 2)
+            f_long = eng.submit(rows[1], 9)
+            short = f_short.wait(120)["tokens"]
+            long = f_long.wait(120)["tokens"]
+        finally:
+            eng.close()
+        assert short == _ref_tokens(model, params, rows[0], 2)
+        assert long == _ref_tokens(model, params, rows[1], 9)
+
+    @pytest.mark.slow
+    def test_eos_mid_window_stops_at_first_eos(self, gpt_and_params):
+        """EOS landing inside an accepted window: the engine must stop AT
+        the first eos even though the verify step accepted past it."""
+        model, params = gpt_and_params
+        row = _rows(4)[0]
+        base = _ref_tokens(model, params, row, 8)
+        eos = base[2]  # mid-window for K=4
+        eng = _drafted_engine(model, params, params, k=4, num_slots=1)
+        try:
+            out = eng.generate_row(row, 8, eos_id=eos)
+        finally:
+            eng.close()
+        assert out["tokens"] == base[: len(out["tokens"])]
+        assert out["tokens"][-1] == eos
+        assert len(out["tokens"]) < 8
+
+    def test_k0_draftless_engine_unchanged(self, gpt_and_params):
+        """num_draft_tokens=0 (the default) must not build any draft
+        machinery — the PR 4 step path as-is."""
+        model, params = gpt_and_params
+        eng = DecodeEngine("k0", model, params, num_slots=1,
+                           autostart=False)
+        try:
+            assert eng.num_draft_tokens == 0
+            assert eng._draft_cache is None
+            assert not hasattr(eng, "_verify")
+        finally:
+            eng.close()
+
+
+class TestAcceptanceBookkeeping:
+    def test_identical_draft_accepts_everything(self, gpt_and_params):
+        """Draft == target: every proposal matches, every verify window
+        emits K+1 tokens, and the accept-rate surface reads 1.0. This
+        also pins the multi-token window forward being bitwise the
+        sequential steps' (a single float of drift would reject)."""
+        model, params = gpt_and_params
+        k = 3
+        eng = _drafted_engine(model, params, params, k=k, num_slots=1)
+        try:
+            row = _rows(5)[0]
+            out = eng.generate_row(row, 9)
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, row, 9)
+        st = eng.stats()
+        # 8 post-prefill tokens at K+1=4 per iteration = 2 full windows
+        assert st["verify_steps"] == 2
+        assert st["draft_proposed"] == k * st["verify_steps"]
+        assert st["draft_accepted"] == st["draft_proposed"]
+        assert st["accept_rate"] == 1.0
+
+    def test_hostile_draft_accepts_nothing(
+        self, gpt_and_params, wrong_draft_params
+    ):
+        """The rolled-head draft never matches: acceptance 0, one
+        (correction) token per verify step — the degenerate K>0 mode IS
+        the one-token step plus wasted drafts, never wrong output."""
+        model, params = gpt_and_params
+        eng = _drafted_engine(
+            model, params, wrong_draft_params, k=3, num_slots=1
+        )
+        try:
+            row = _rows(5)[0]
+            out = eng.generate_row(row, 6)
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, row, 6)
+        st = eng.stats()
+        assert st["draft_accepted"] == 0
+        assert st["accept_rate"] == 0.0
+        # 5 post-prefill tokens, one per verify iteration
+        assert st["verify_steps"] == 5
+
+    def test_metrics_surface(self, gpt_and_params):
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "specmetrics", model, params, draft_model=model,
+            draft_params=params, num_draft_tokens=2, num_slots=1,
+            max_queue=4,
+        )
+        try:
+            eng.generate_row(_rows(4)[0], 5)
+        finally:
+            eng.close()
+        reg = default_registry()
+        m = dict(model="specmetrics")
+        proposed = reg.get("serving_draft_proposed_total").value(**m)
+        accepted = reg.get("serving_draft_accepted_total").value(**m)
+        verifies = reg.get("serving_verify_steps_total").value(**m)
+        assert verifies >= 1
+        assert proposed == 2 * verifies
+        assert accepted == proposed  # identical draft
+        assert reg.get("serving_accept_rate").count(**m) == verifies
+        assert reg.get("serving_tokens_total").value(**m) == 5
+
+
+class TestRecovery:
+    def test_verify_failure_fails_residents_rebuilds_both_caches(
+        self, gpt_and_params
+    ):
+        """A device failure in the verify step with a draft cache
+        resident: residents fail fast, BOTH caches are rebuilt (either
+        may be a donated tombstone), and the engine then serves drafted
+        requests bitwise-correctly again."""
+        model, params = gpt_and_params
+        eng = _drafted_engine(
+            model, params, params, k=2, num_slots=1, max_queue=4,
+            autostart=False,
+        )
+        orig_verify = eng._verify
+
+        def broken_verify(params_, cache, dcache, *a, **kw):
+            # simulate a post-dispatch failure: donation already consumed
+            # both resident caches when the error surfaces
+            jax.tree_util.tree_map(lambda x: x.delete(), cache)
+            jax.tree_util.tree_map(lambda x: x.delete(), dcache)
+            raise RuntimeError("injected verify failure")
+
+        eng._verify = broken_verify
+        eng._thread.start()
+        try:
+            fut = eng.submit([1, 2, 3], 4)
+            with pytest.raises(RuntimeError, match="decode step failed"):
+                fut.wait(60)
+            assert eng._thread.is_alive()
+            eng._verify = orig_verify
+            row = _rows(4)[0]
+            out = eng.generate_row(row, 5, timeout=120)
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, row, 5)
+        assert eng.stats()["draft_accepted"] > 0  # draft cache live again
+
+    def test_draft_config_validation(self, gpt_and_params):
+        model, params = gpt_and_params
+        with pytest.raises(ValueError, match="draft_model"):
+            DecodeEngine("v", model, params, num_draft_tokens=2,
+                         autostart=False)
+        small = get_model("gpt_tiny", dtype=jnp.float32, vocab_size=256)
+        with pytest.raises(ValueError, match="vocab"):
+            DecodeEngine(
+                "v", model, params, num_draft_tokens=2, draft_model=small,
+                draft_params=params, autostart=False,
+            )
+        short = get_model("gpt_tiny", dtype=jnp.float32, max_len=64)
+        with pytest.raises(ValueError, match="max_len"):
+            DecodeEngine(
+                "v", model, params, num_draft_tokens=2, draft_model=short,
+                draft_params=params, autostart=False,
+            )
+
+
+class TestSampled:
+    def test_rejection_sampling_recovers_target_distribution(self):
+        """The speculative-sampling lemma on a discriminating toy: with
+        proposal q VERY different from target p (q concentrates where p
+        is thin), accept-or-resample through `speculative_accept` must
+        still emit tokens distributed as p. 20k Monte-Carlo trials of
+        one drafted position, L1 distance to p under 0.03 — a broken
+        acceptance rule (e.g. always-accept: emits q, L1(p, q) = 1.04
+        here; or correction drawn from p instead of the residual) fails
+        by an order of magnitude."""
+        from kubeflow_tpu.serving.sampling import speculative_accept
+
+        p = jnp.asarray([[0.50, 0.05, 0.25, 0.05, 0.15]], jnp.float32)
+        q = jnp.asarray([[0.02, 0.58, 0.05, 0.30, 0.05]], jnp.float32)
+
+        def one_trial(key):
+            kd, ka, kc = jax.random.split(key, 3)
+            drafted = jax.random.categorical(kd, jnp.log(q[0]))[None]
+            accept, residual = speculative_accept(
+                p[:, None], q[:, None], drafted[:, None],
+                jax.random.uniform(ka)[None, None],
+            )
+            corr = jax.random.categorical(kc, jnp.log(residual[0, 0]))
+            return jnp.where(accept[0, 0], drafted[0], corr)
+
+        n = 20000
+        toks = jax.vmap(one_trial)(
+            jax.random.split(jax.random.PRNGKey(7), n)
+        )
+        hist = np.bincount(np.asarray(toks), minlength=5) / n
+        l1 = float(np.abs(hist - np.asarray(p[0])).sum())
+        assert l1 < 0.03, (hist, l1)
+
+    @pytest.mark.slow
+    def test_sampled_spec_deterministic_and_placement_independent(
+        self, gpt_and_params, wrong_draft_params
+    ):
+        """Same seed → identical sampled output even when the repeat runs
+        beside different neighbors (the draw-counter rng stream depends
+        only on the request's own history); tokens stay in-vocab."""
+        model, params = gpt_and_params
+        eng = _drafted_engine(model, params, wrong_draft_params, k=2)
+        try:
+            kw = dict(temperature=0.9, top_k=12, seed=42)
+            a = eng.generate_row([5, 6, 7], 6, **kw)
+            crowd = [
+                eng.submit(r, 5, temperature=1.0, seed=100 + i)
+                for i, r in enumerate(_rows(3, 4, 5))
+            ]
+            b = eng.generate_row([5, 6, 7], 6, **kw)
+            for f in crowd:
+                f.wait(120)
+        finally:
+            eng.close()
+        assert a["tokens"] == b["tokens"]
+        assert all(0 <= t < 512 for t in a["tokens"])
+
+    @pytest.mark.slow
+    def test_sampled_neighbor_does_not_perturb_greedy_slot(
+        self, gpt_and_params
+    ):
+        """Mixed traffic through the drafted engine: a sampled request in
+        the next slot must leave a greedy row bitwise intact."""
+        model, params = gpt_and_params
+        eng = _drafted_engine(model, params, params, k=2)
+        try:
+            row = _rows(5)[0]
+            f_greedy = eng.submit(row, 6)
+            f_sample = eng.submit(
+                [9, 8, 7], 6, temperature=1.0, top_p=0.9, seed=7
+            )
+            got = f_greedy.wait(120)["tokens"]
+            sampled = f_sample.wait(120)["tokens"]
+        finally:
+            eng.close()
+        assert got == _ref_tokens(model, params, row, 6)
+        assert all(0 <= t < 512 for t in sampled)
+
+
+class TestPlatformWiring:
+    def test_serving_config_validation(self):
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import ServingConfig
+
+        cfg = ServingConfig(draft_model="gpt_tiny", num_draft_tokens=4)
+        cfg.validate()
+        with pytest.raises(ConfigError, match="draft_model"):
+            ServingConfig(num_draft_tokens=4).validate()
+        with pytest.raises(ConfigError, match="num_draft_tokens"):
+            ServingConfig(num_draft_tokens=-1).validate()
+        # speculation needs the engine: num_slots=0 would silently serve
+        # the static path with the drafted knobs ignored
+        with pytest.raises(ConfigError, match="num_slots"):
+            ServingConfig(
+                draft_model="gpt_tiny", num_draft_tokens=4, num_slots=0
+            ).validate()
+
+    def test_controller_renders_draft_env(self):
+        from kubeflow_tpu.config.platform import ServingConfig
+        from kubeflow_tpu.controllers.inference import (
+            InferenceServiceController,
+        )
+
+        ctl = InferenceServiceController(
+            serving_defaults=ServingConfig(
+                draft_model="gpt_tiny", num_draft_tokens=4,
+                draft_checkpoint_dir="/ckpt/draft",
+            )
+        )
+        env = ctl._serving_env({})
+        assert env["KFT_SERVING_DRAFT_MODEL"] == "gpt_tiny"
+        assert env["KFT_SERVING_DRAFT_TOKENS"] == "4"
+        assert env["KFT_SERVING_DRAFT_CHECKPOINT_DIR"] == "/ckpt/draft"
+        # per-CR override wins field-by-field
+        env = ctl._serving_env({"serving": {"num_draft_tokens": 0}})
+        assert env["KFT_SERVING_DRAFT_TOKENS"] == "0"
+        # an invalid combination is rejected at reconcile time
+        ctl_plain = InferenceServiceController()
+        with pytest.raises(Exception, match="draft_model"):
+            ctl_plain._serving_env({"serving": {"num_draft_tokens": 2}})
+
+    def test_engine_knobs_from_env(self, monkeypatch):
+        from kubeflow_tpu.serving.main import engine_knobs_from_env
+
+        monkeypatch.setenv("KFT_SERVING_DRAFT_MODEL", "gpt_tiny")
+        monkeypatch.setenv("KFT_SERVING_DRAFT_TOKENS", "3")
+        monkeypatch.setenv("KFT_SERVING_DRAFT_CHECKPOINT_DIR", "/ckpt/d")
+        knobs = engine_knobs_from_env()
+        assert knobs["draft_model"] == "gpt_tiny"
+        assert knobs["num_draft_tokens"] == 3
+        assert knobs["draft_checkpoint_dir"] == "/ckpt/d"
+        monkeypatch.setenv("KFT_SERVING_DRAFT_MODEL", "")
+        monkeypatch.setenv("KFT_SERVING_DRAFT_TOKENS", "")
+        knobs = engine_knobs_from_env()
+        assert knobs["draft_model"] == ""
+        assert knobs["num_draft_tokens"] == 0
+
+    @pytest.mark.slow
+    def test_rest_roundtrip_through_drafted_engine(self, gpt_and_params):
+        """The wire contract is unchanged by speculation: a drafted
+        engine behind the REST surface answers :generate bitwise like
+        the fused scan, TTFT header included."""
+        from kubeflow_tpu.serving.generate import ServedLm
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "gpt", model, params, num_slots=2, max_queue=8,
+            draft_model=model, draft_params=params, num_draft_tokens=3,
+        )
+        server = ModelServer()
+        server.add_lm(ServedLm("gpt", model, params))
+        server.add_engine(eng)
+        try:
+            prompt = [[1, 2, 3, 4]]
+            status, body, headers = server.app.handle_full(
+                "POST",
+                "/v1/models/gpt:generate",
+                body={"prompt_ids": prompt, "max_new_tokens": 5},
+            )
+        finally:
+            server.close()
+        assert status == 200, body
+        want = generate(model, params, jnp.asarray(prompt, jnp.int32), 5)
+        assert body["sequences"] == np.asarray(want).tolist()
+        assert float(dict(headers)["X-TTFT-Ms"]) > 0
