@@ -48,25 +48,11 @@ import time
 
 REFERENCE_V100_IMAGES_PER_SEC = 341.0
 
-# bf16 peak TFLOP/s and HBM GB/s per chip, by device_kind substring.
-# (Public TPU spec sheets; used only for utilization denominators.)
-_CHIP_SPECS = (
-    ("v6", 918e12, 1640e9),        # Trillium / v6e
-    ("v5p", 459e12, 2765e9),
-    ("v5 lite", 197e12, 819e9),    # v5e reports "TPU v5 lite"
-    ("v5e", 197e12, 819e9),
-    ("v4", 275e12, 1228e9),
-    ("v3", 123e12, 900e9),
-    ("v2", 45e12, 700e9),
-)
-
-
-def _chip_peaks(device) -> tuple:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, flops, bw in _CHIP_SPECS:
-        if key in kind:
-            return flops, bw
-    return None, None
+# Per-chip peak FLOP/s + HBM bandwidth (utilization denominators): ONE
+# definition point shared with the platform's own MFU accounting
+# (observability/mfu.py top-level imports no jax, so the never-imports-jax
+# parent-process rule below holds).
+from kubeflow_tpu.observability.mfu import chip_peaks as _chip_peaks  # noqa: E402
 
 
 def _cost_analysis(jitted, *args):
@@ -111,6 +97,35 @@ def _param_count(tree) -> int:
     import jax
 
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
+
+
+# ---------------------------------------------------------------------------
+# Per-entry self-budgeting: the parent exports the entry's wall-clock cap as
+# KFT_BENCH_DEADLINE_S; entries with scalable workloads SHRINK (fewer
+# requests / steps) when the remaining budget is below their sized-for cap,
+# instead of letting the subprocess timeout kill them mid-write — a killed
+# entry loses its whole measurement, a shrunk one degrades gracefully
+# (BENCH_r03/r04 died rc=124 with nothing on the final line).
+# ---------------------------------------------------------------------------
+
+ENV_ENTRY_DEADLINE = "KFT_BENCH_DEADLINE_S"
+
+
+def _entry_deadline_s() -> float:
+    raw = os.environ.get(ENV_ENTRY_DEADLINE, "").strip()
+    return float(raw) if raw else float("inf")
+
+
+def _budget_scaled(n: int, sized_for_s: float, floor: int) -> int:
+    """Scale a workload knob to the entry's deadline: `n` was sized for a
+    `sized_for_s`-second cap; a smaller deadline shrinks proportionally
+    (with a write-out margin so the result lands before the kill), never
+    below `floor` (a too-small trace measures nothing). A deadline at or
+    above the sized-for cap runs the exact historical workload."""
+    deadline = _entry_deadline_s()
+    if deadline >= sized_for_s:
+        return n
+    return max(floor, int(n * max(deadline - 30.0, 30.0) / sized_for_s))
 
 
 # ResNet-50 @224 analytic forward cost: the standard published figure is
@@ -189,6 +204,7 @@ def bench_resnet(batch: int, steps: int) -> dict:
     from kubeflow_tpu.training.data import make_global_batch
     from kubeflow_tpu.training.trainer import Trainer
 
+    steps = _budget_scaled(steps, sized_for_s=700, floor=5)
     n_dev = len(jax.devices())
     cfg = TrainingConfig(
         model="resnet50",
@@ -252,6 +268,7 @@ def bench_bert(steps: int) -> dict:
     from kubeflow_tpu.training.trainer import Trainer
 
     on_tpu = jax.default_backend() == "tpu"
+    steps = _budget_scaled(steps, sized_for_s=600, floor=3)
     n_dev = len(jax.devices())
     seq_len = int(os.environ.get("KFT_BENCH_BERT_SEQ", "512"))
     per_chip_batch = int(os.environ.get("KFT_BENCH_BERT_BATCH", "32"))
@@ -863,10 +880,15 @@ def bench_serving_continuous(
     import numpy as np
 
     from kubeflow_tpu.api.wsgi import Server
+    from kubeflow_tpu.observability.trace import default_tracer
     from kubeflow_tpu.serving.engine import DecodeEngine
     from kubeflow_tpu.serving.generate import ServedLm
     from kubeflow_tpu.serving.server import ModelServer
 
+    # self-budgeting: a shrunk deadline shrinks the TRACE (fewer requests
+    # through every phase), not the measurement method — the per-phase
+    # ratios stay comparable, the entry always finishes inside its cap
+    num_requests = _budget_scaled(num_requests, sized_for_s=480, floor=4)
     max_len = 64  # largest prompt bucket (32) + new_tokens + slack
     model, params = _gpt_small_with_params(max_len)
     buckets = [8, 16, 32]
@@ -1014,6 +1036,71 @@ def bench_serving_continuous(
             - pre["mean_occupancy"] * pre["decode_steps"]
         )
         cont["mean_occupancy"] = round(occ_steps / steps, 3) if steps else 0.0
+        # -- kft-trace evidence + overhead gate (docs/OBSERVABILITY.md) --
+        # the engine phase above ran with tracing ON (the default); pull
+        # the /debug/trace dump it produced and verify it is a valid
+        # Chrome trace with per-request TTFT decomposed into queue/
+        # prefill/decode spans, then re-run the SAME trace with tracing
+        # OFF for the overhead comparison (<2% engine tok/s contract)
+        trace_url = f"http://127.0.0.1:{server.port}/debug/trace"
+        try:
+            with urllib.request.urlopen(trace_url, timeout=60) as resp:
+                dump = _json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 - evidence, not the headline
+            dump = {"traceEvents": [], "fetch_error": type(e).__name__}
+        events = dump.get("traceEvents", [])
+        xs = [e for e in events if e.get("ph") == "X"]
+        schema_ok = bool(xs) and all(
+            all(k in e for k in ("name", "ts", "dur", "pid", "tid"))
+            for e in xs
+        )
+        by_req = {}
+        for e in xs:
+            rid = e.get("args", {}).get("trace_id")
+            if rid:
+                by_req.setdefault(rid, set()).add(e["name"])
+        decomposed = sum(
+            1
+            for names in by_req.values()
+            if {"request.queue_wait", "request.prefill",
+                "request.decode"} <= names
+        )
+        tracer = default_tracer()
+        tracer.configure(enabled=False)
+        try:
+            notrace = run_phase("gpt_engine", payloads_main)
+        finally:
+            tracer.configure(enabled=True)
+        nt_tps = notrace["tokens_per_sec"]
+        overhead_pct = (
+            round((nt_tps - cont["tokens_per_sec"]) / nt_tps * 100.0, 2)
+            if nt_tps
+            else None
+        )
+        # the A/B number above is bounded by trace noise (open-loop
+        # Poisson on a small box: ±10% run-to-run); the per-span
+        # microbench is the noise-immune bound — cost/span x spans
+        # recorded during the traced phase over its wall time
+        n_bench = 20000
+        t0_span = time.monotonic()
+        for _ in range(n_bench):
+            with tracer.span("bench.overhead", model="x", step=0):
+                pass
+        span_cost_s = (time.monotonic() - t0_span) / n_bench
+        tracing = {
+            "trace_events": len(events),
+            "trace_valid": schema_ok,
+            "requests_decomposed": decomposed,
+            "notrace_tokens_per_sec": nt_tps,
+            "trace_overhead_pct": overhead_pct,
+            "span_cost_us": round(span_cost_s * 1e6, 2),
+            # spans the engine records per emitted token is ~O(1); the
+            # derived ceiling assumes one span per token (generous: the
+            # fused step amortizes one span over `active` tokens)
+            "derived_overhead_pct": round(
+                span_cost_s * cont["tokens_per_sec"] * 100.0, 4
+            ),
+        }
         k0 = run_phase("gpt_spec_k0", payloads_spec, vocab=spec_vocab)
         pre_spec = {}
         kd = run_phase(
@@ -1042,6 +1129,8 @@ def bench_serving_continuous(
         "max_len": max_len,
         "static": static,
         "engine": cont,
+        "tracing": tracing,
+        "trace_overhead_pct": tracing["trace_overhead_pct"],
         "engine_tokens_per_sec": cont["tokens_per_sec"],
         "speedup_vs_static": round(
             cont["tokens_per_sec"] / static["tokens_per_sec"], 2
@@ -1397,6 +1486,7 @@ def bench_input_pipeline(steps: int = 24) -> dict:
     from kubeflow_tpu.training.trainer import Trainer
 
     on_tpu = jax.default_backend() == "tpu"
+    steps = _budget_scaled(steps, sized_for_s=600, floor=8)
     n_dev = len(jax.devices())
     model = "resnet50" if on_tpu else "resnet18"
     image_size = 224 if on_tpu else 64
@@ -1437,6 +1527,15 @@ def bench_input_pipeline(steps: int = 24) -> dict:
 
     sync = run(0)
     overlapped = run(2)
+    # MFU/goodput accounting (observability/mfu.py): trainer.fit set the
+    # derived gauges during the runs above — surface them here so the
+    # always-parseable kft_bench_final line carries the MFU the platform
+    # itself computed (not a bench-side formula)
+    from kubeflow_tpu.utils.metrics import default_registry
+
+    reg = default_registry()
+    mfu_gauge = reg.get("training_model_flops_utilization")
+    goodput_gauge = reg.get("training_goodput")
     out = {
         "model": model,
         "image_size": image_size,
@@ -1450,6 +1549,14 @@ def bench_input_pipeline(steps: int = 24) -> dict:
         # the determinism contract, checked where the claim is made
         "loss_bitwise_identical": sync["final_loss"]
         == overlapped["final_loss"],
+        "training_model_flops_utilization": round(
+            mfu_gauge.value(model=model), 5
+        )
+        if mfu_gauge is not None
+        else None,
+        "training_goodput": round(goodput_gauge.value(model=model), 4)
+        if goodput_gauge is not None
+        else None,
     }
     return out
 
@@ -1484,6 +1591,7 @@ def bench_checkpoint(steps: int = 8) -> dict:
     )
 
     on_tpu = jax.default_backend() == "tpu"
+    steps = _budget_scaled(steps, sized_for_s=600, floor=4)
     n_dev = len(jax.devices())
     model = "resnet50" if on_tpu else "resnet18"
     image_size = 224 if on_tpu else 64
@@ -1823,6 +1931,12 @@ def _bench_in_subprocess(expr: str, timeout_s: float, extra_env=None) -> dict:
         f"print({_RESULT_MARK!r} + json.dumps(r))"
     )
     env = dict(os.environ)
+    # the entry's own wall-clock cap: scalable entries shrink their
+    # workload when the budget hands them LESS than the cap they were
+    # sized for (instead of dying at the kill); a full-budget run
+    # (deadline == the entry's sized-for cap) is exactly the historical
+    # workload, so round-over-round numbers stay comparable
+    env[ENV_ENTRY_DEADLINE] = str(timeout_s)
     env.update(extra_env or {})
     try:
         out = subprocess.run(
@@ -1954,8 +2068,15 @@ _HEADLINE_KEYS = (
 
 # Secondary scalars that join the final line beside an entry's headline
 # when present (speculative decoding: serving_continuous reports both the
-# undrafted headline and what the draft buys).
-_EXTRA_FINAL_KEYS = ("engine_accept_rate", "drafted_tokens_per_sec")
+# undrafted headline and what the draft buys; observability: the platform-
+# computed MFU and the tracing-overhead gate ride the one always-parseable
+# record).
+_EXTRA_FINAL_KEYS = (
+    "engine_accept_rate",
+    "drafted_tokens_per_sec",
+    "training_model_flops_utilization",
+    "trace_overhead_pct",
+)
 
 
 def _final_line(results: dict, complete: bool, t0: float) -> str:
@@ -2068,6 +2189,24 @@ def main() -> int:
         # the bounded-tail contract: the LAST stdout line is always this
         # short parseable record, even if the driver kills us mid-suite
         print(_final_line(results, complete, t0), flush=True)
+
+    # belt-and-braces for the always-emit contract: the driver's outer
+    # `timeout` delivers SIGTERM before SIGKILL — if it ever fires despite
+    # the budget (a subprocess wedged in uninterruptible native code),
+    # flush one last kft_bench_final and exit instead of dying silent
+    # (BENCH_r03/r04: rc=124, nothing parseable on the tail)
+    import signal
+
+    def _terminated(signum, frame):  # noqa: ARG001 - signal signature
+        try:
+            print(_final_line(results, False, t0), flush=True)
+        finally:
+            os._exit(124)
+
+    try:
+        signal.signal(signal.SIGTERM, _terminated)
+    except ValueError:  # not the main thread (embedded use)
+        pass
 
     results["probe"] = _bench_in_subprocess(
         "bench_probe()", min(300.0, budget_s)

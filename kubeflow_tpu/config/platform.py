@@ -185,6 +185,39 @@ class CheckpointConfig(ConfigNode):
 
 
 @dataclasses.dataclass
+class ObservabilityConfig(ConfigNode):
+    """kft-trace knobs (kubeflow_tpu/observability/; docs/OBSERVABILITY.md).
+
+    Rendered as KFT_TRACE_* env into serving pods (InferenceService
+    controller) and gang pods (TPUJob controller); consumed by
+    serving/main.py and runtime/launcher.py through
+    observability.configure_from_env. Tracing is default-ON — the span
+    layer is bounded-memory and bench-gated at <2% engine tok/s overhead."""
+
+    trace_enabled: bool = config_field(
+        default=True,
+        help="record spans into the in-process ring buffer; off = the "
+        "span API becomes a no-op (and /debug/trace dumps empty)",
+    )
+    trace_buffer_spans: int = config_field(
+        default=4096,
+        help="ring-buffer capacity in span records (a few hundred bytes "
+        "each); oldest records drop first",
+    )
+    statusz_enabled: bool = config_field(
+        default=True,
+        help="serve /statusz + /debug/trace (+ /metrics on the training "
+        "runtime's debug port); off = endpoints not mounted",
+    )
+
+    def validate(self) -> None:
+        if self.trace_buffer_spans < 1:
+            raise ConfigError(
+                "observability.trace_buffer_spans must be >= 1"
+            )
+
+
+@dataclasses.dataclass
 class DataConfig(ConfigNode):
     """Input-pipeline selection: synthetic (the tf-cnn default, reference
     launcher.py:81-88 passes no data flags) or a real dataset, plus the eval
@@ -275,6 +308,9 @@ class TrainingConfig(ConfigNode):
     mesh: MeshConfig = config_field(default_factory=MeshConfig)
     data: DataConfig = config_field(default_factory=DataConfig)
     checkpoint: CheckpointConfig = config_field(default_factory=CheckpointConfig)
+    observability: ObservabilityConfig = config_field(
+        default_factory=ObservabilityConfig
+    )
     remat: bool = config_field(default=False, help="jax.checkpoint rematerialisation")
     loss_chunk: int = config_field(
         default=0,
@@ -436,6 +472,9 @@ class ServingConfig(ConfigNode):
         "seed-0 init: output stays correct (verify rejects bad drafts) "
         "but the accept rate is noise, so drafted serving is SLOWER than "
         "K=0 until real params are supplied.",
+    )
+    observability: ObservabilityConfig = config_field(
+        default_factory=ObservabilityConfig
     )
 
     def validate(self) -> None:

@@ -76,6 +76,7 @@ class InferenceServiceController(Controller):
         by serving/main.py engine_knobs_from_env. Always rendered (also
         at defaults): the pod's env documents the engine configuration it
         actually runs."""
+        obs_defaults = self.serving_defaults.observability
         merged = {
             "num_slots": self.serving_defaults.num_slots,
             "prefill_buckets": list(self.serving_defaults.prefill_buckets),
@@ -83,8 +84,19 @@ class InferenceServiceController(Controller):
             "draft_model": self.serving_defaults.draft_model,
             "num_draft_tokens": self.serving_defaults.num_draft_tokens,
             "draft_checkpoint_dir": self.serving_defaults.draft_checkpoint_dir,
+            "observability": {
+                "trace_enabled": obs_defaults.trace_enabled,
+                "trace_buffer_spans": obs_defaults.trace_buffer_spans,
+                "statusz_enabled": obs_defaults.statusz_enabled,
+            },
         }
-        merged.update(spec.get("serving") or {})
+        overrides = dict(spec.get("serving") or {})
+        # the observability subtree merges field-by-field like the
+        # top-level keys (a CR overriding one trace knob must not silently
+        # reset the other two to dataclass defaults)
+        obs_override = overrides.pop("observability", None) or {}
+        merged["observability"].update(obs_override)
+        merged.update(overrides)
         cfg = from_dict(ServingConfig, merged)
         cfg.validate()
         return {
@@ -96,6 +108,14 @@ class InferenceServiceController(Controller):
             "KFT_SERVING_DRAFT_MODEL": cfg.draft_model,
             "KFT_SERVING_DRAFT_TOKENS": str(cfg.num_draft_tokens),
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": cfg.draft_checkpoint_dir,
+            # kft-trace contract (observability/trace.py knobs_from_env)
+            "KFT_TRACE_ENABLED": "1" if cfg.observability.trace_enabled else "0",
+            "KFT_TRACE_BUFFER_SPANS": str(
+                cfg.observability.trace_buffer_spans
+            ),
+            "KFT_TRACE_STATUSZ": (
+                "1" if cfg.observability.statusz_enabled else "0"
+            ),
         }
 
     def reconcile(self, store: StateStore, namespace: str, name: str) -> Result:

@@ -41,7 +41,11 @@ from kubeflow_tpu.cluster.objects import (
 from kubeflow_tpu.cluster.reconciler import Controller, Result
 from kubeflow_tpu.cluster.store import AlreadyExists, StateStore
 from kubeflow_tpu.config.core import ConfigError, from_dict
-from kubeflow_tpu.config.platform import SliceConfig, TrainingConfig
+from kubeflow_tpu.config.platform import (
+    ObservabilityConfig,
+    SliceConfig,
+    TrainingConfig,
+)
 from kubeflow_tpu.controllers.helpers import (
     ensure_finalizer,
     list_owned,
@@ -57,6 +61,8 @@ from kubeflow_tpu.utils.metrics import default_registry
 # slice_agent TCP gang barrier on the coordinator pod — one above the
 # jax.distributed coordinator port so both servers coexist on process 0
 BARRIER_PORT = DEFAULT_COORDINATOR_PORT + 1
+# the runtime debug server (statusz/trace/metrics, runtime/launcher.py)
+DEBUG_PORT = 9432
 
 log = get_logger(__name__)
 
@@ -385,6 +391,23 @@ class TPUTrainJobController(Controller):
             # gang member caches its own compiled programs there, so gang
             # restarts and StudyJob trials 2..N skip the full XLA compile
             env["KFT_COMPILE_CACHE_DIR"] = compile_cache
+        # kft-trace contract (observability/; docs/OBSERVABILITY.md):
+        # TrainingConfig.observability → KFT_TRACE_* consumed by
+        # runtime/launcher.py. Always rendered — the pod env documents
+        # the tracing configuration it actually runs, defaults included.
+        obs = from_dict(
+            ObservabilityConfig,
+            (spec.get("training") or {}).get("observability") or {},
+        )
+        obs.validate()
+        env["KFT_TRACE_ENABLED"] = "1" if obs.trace_enabled else "0"
+        env["KFT_TRACE_BUFFER_SPANS"] = str(obs.trace_buffer_spans)
+        env["KFT_TRACE_STATUSZ"] = "1" if obs.statusz_enabled else "0"
+        if obs.statusz_enabled:
+            # the coordinator serves /statusz + /debug/trace + /metrics on
+            # this port (runtime/launcher.py; same one-endpoint-per-gang
+            # rule as the profiler); unset = no debug server
+            env.setdefault("KFT_DEBUG_PORT", str(DEBUG_PORT))
         pod = new_object(
             "Pod",
             pod_name,

@@ -1,0 +1,38 @@
+"""kft-trace: platform-wide request/step tracing + MFU/goodput accounting.
+
+See docs/OBSERVABILITY.md for the span catalog, the /debug/trace and
+/statusz endpoints, and the MFU definition.
+"""
+
+# NOTE: the `mfu` FUNCTION is deliberately not re-exported here — it would
+# shadow the `observability.mfu` submodule; import it from the submodule
+# (`from kubeflow_tpu.observability.mfu import mfu`).
+from kubeflow_tpu.observability.mfu import (
+    chip_peaks,
+    goodput,
+    peak_flops_per_chip,
+    step_flops,
+)
+from kubeflow_tpu.observability.trace import (
+    DEFAULT_BUFFER_SPANS,
+    Span,
+    SpanRecord,
+    Tracer,
+    configure_from_env,
+    default_tracer,
+    knobs_from_env,
+)
+
+__all__ = [
+    "DEFAULT_BUFFER_SPANS",
+    "Span",
+    "SpanRecord",
+    "Tracer",
+    "chip_peaks",
+    "configure_from_env",
+    "default_tracer",
+    "goodput",
+    "knobs_from_env",
+    "peak_flops_per_chip",
+    "step_flops",
+]

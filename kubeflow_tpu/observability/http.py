@@ -1,0 +1,117 @@
+"""Observability HTTP surface: /debug/trace, /statusz, /metrics routes.
+
+One helper mounts the same three routes on any platform App — the model
+server (serving/server.py) and the training runtime's debug server
+(runtime/launcher.py) expose identical surfaces:
+
+- GET /debug/trace   — the tracer ring buffer as Chrome trace-event JSON;
+  save the body to a file and open it in Perfetto (ui.perfetto.dev) or
+  chrome://tracing. `?trace_id=<id>` filters one request's spans —
+  matching the id exactly OR any `<id>/<row>` child, so the id a client
+  sent (and got echoed back) selects its whole request while `<id>/0`
+  still narrows to one row.
+- GET /statusz       — human-readable text snapshot: tracer state plus
+  caller-provided sections (engine slot maps + recent request phase
+  breakdowns on the serving side, current step timing on the training
+  side).
+- GET /metrics       — the existing registry's Prometheus exposition text
+  (utils/metrics.py renderer; the derived MFU/phase metrics ride it).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.api.wsgi import App, Response
+from kubeflow_tpu.observability.trace import Tracer, default_tracer
+from kubeflow_tpu.utils.metrics import default_registry
+
+# a statusz section: (title, lines-callable) — called per request so the
+# snapshot is always current
+StatuszSection = Tuple[str, Callable[[], List[str]]]
+
+
+def add_debug_routes(
+    app: App,
+    tracer: Optional[Tracer] = None,
+    statusz_sections: Optional[List[StatuszSection]] = None,
+) -> App:
+    """Mount /debug/trace, /statusz and /metrics on `app`."""
+    tr = tracer if tracer is not None else default_tracer()
+    sections = list(statusz_sections or [])
+
+    @app.get("/debug/trace")
+    def debug_trace(req):
+        doc = tr.chrome_trace()
+        trace_id = req.query.get("trace_id")
+        if trace_id:
+            # exact id or its per-row children: multi-row requests tag row
+            # i as `<request id>/<i>` (serving/engine.py submit_batch), so
+            # the id the client sent — and had echoed back — must select
+            # its whole request, not nothing
+            child_prefix = trace_id + "/"
+
+            def _matches(e):
+                rid = e.get("args", {}).get("trace_id")
+                return rid is not None and (
+                    rid == trace_id or rid.startswith(child_prefix)
+                )
+
+            doc["traceEvents"] = [
+                e for e in doc["traceEvents"]
+                if e["ph"] == "M" or _matches(e)
+            ]
+        return Response(json.dumps(doc), "application/json")
+
+    @app.get("/statusz")
+    def statusz(req):
+        st = tr.stats()
+        lines = [
+            f"{app.name} statusz @ {time.strftime('%Y-%m-%d %H:%M:%S')}",
+            "",
+            (
+                f"[kft-trace] enabled={st['enabled']} "
+                f"buffered={st['buffered']}/{st['capacity']} "
+                f"dropped={st['dropped']}"
+            ),
+        ]
+        for title, fn in sections:
+            lines.append("")
+            lines.append(f"[{title}]")
+            try:
+                lines.extend(fn())
+            except Exception as e:  # noqa: BLE001 - statusz must render
+                lines.append(f"  <section failed: {type(e).__name__}: {e}>")
+        return Response("\n".join(lines) + "\n", "text/plain; charset=utf-8")
+
+    @app.get("/metrics")
+    def metrics(req):
+        return Response(
+            default_registry().render(), "text/plain; charset=utf-8"
+        )
+
+    return app
+
+
+def build_debug_app(
+    name: str = "debug",
+    tracer: Optional[Tracer] = None,
+    statusz_sections: Optional[List[StatuszSection]] = None,
+) -> App:
+    """Standalone debug app (the training runtime mounts this next to the
+    profiler endpoint; the model server mounts the routes on its own app)."""
+    return add_debug_routes(App(name), tracer, statusz_sections)
+
+
+def format_phase_row(summary: Dict[str, float]) -> str:
+    """One /statusz line for a finished request's phase breakdown."""
+    return (
+        f"  {summary.get('trace_id', '?'):<28} "
+        f"queue={summary.get('queue_s', 0.0) * 1e3:8.1f}ms "
+        f"prefill={summary.get('prefill_s', 0.0) * 1e3:8.1f}ms "
+        f"decode={summary.get('decode_s', 0.0) * 1e3:9.1f}ms "
+        f"ttft={summary.get('ttft_s', 0.0) * 1e3:8.1f}ms "
+        f"tokens={int(summary.get('tokens', 0)):4d}"
+    )

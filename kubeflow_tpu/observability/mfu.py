@@ -1,0 +1,130 @@
+"""MFU / goodput accounting — the training path's derived metrics.
+
+Model-FLOPs utilization is the lingua franca of serious training stacks:
+`achieved model FLOP/s ÷ hardware peak FLOP/s`. The numerator comes from
+XLA's own cost model over the COMPILED train step (`Lowered.cost_analysis()`
+— no second XLA compile: the analysis runs on unoptimized HLO, ~tens of ms
+even for big steps), the denominator from the per-chip peak table below.
+
+On SPMD partitions the lowered program (and so its FLOPs) is per-device,
+which makes `flops / step_time / peak` directly the per-chip MFU.
+
+Peak resolution order:
+1. `KFT_PEAK_FLOPS_PER_CHIP` env (operators with hardware not in the
+   table, or a deliberate denominator override),
+2. the published bf16 peak for the detected TPU `device_kind`,
+3. a one-time measured dense-matmul peak (CPU meshes in CI/bench: there is
+   no published "peak" for an arbitrary host, so the denominator is what a
+   large jitted matmul actually sustains — a diagnostic fraction, clearly
+   weaker than a spec-sheet peak, but it keeps the metric meaningful
+   instead of hardcoding 0).
+
+The same table serves bench.py's utilization columns (one definition
+point; bench imports from here).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Optional, Tuple
+
+ENV_PEAK_FLOPS = "KFT_PEAK_FLOPS_PER_CHIP"
+
+# bf16 peak TFLOP/s and HBM GB/s per chip, by device_kind substring.
+# (Public TPU spec sheets; used only for utilization denominators.)
+CHIP_SPECS = (
+    ("v6", 918e12, 1640e9),        # Trillium / v6e
+    ("v5p", 459e12, 2765e9),
+    ("v5 lite", 197e12, 819e9),    # v5e reports "TPU v5 lite"
+    ("v5e", 197e12, 819e9),
+    ("v4", 275e12, 1228e9),
+    ("v3", 123e12, 900e9),
+    ("v2", 45e12, 700e9),
+)
+
+_measured_peak_cache: Optional[float] = None
+
+
+def chip_peaks(device) -> Tuple[Optional[float], Optional[float]]:
+    """(peak bf16 FLOP/s, peak HBM bytes/s) for a jax device, or
+    (None, None) when the device kind is not in the table."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key, flops, bw in CHIP_SPECS:
+        if key in kind:
+            return flops, bw
+    return None, None
+
+
+def _measured_matmul_peak() -> float:
+    """Sustained FLOP/s of one large jitted matmul on the default device —
+    the CPU-mesh fallback denominator. Measured once per process."""
+    global _measured_peak_cache
+    if _measured_peak_cache is not None:
+        return _measured_peak_cache
+    import jax
+    import jax.numpy as jnp
+
+    n = 1024
+    f = jax.jit(lambda a, b: a @ b)
+    x = jnp.ones((n, n), jnp.float32)
+    y = f(x, x)
+    jax.block_until_ready(y)  # compile + warm
+    t0 = time.monotonic()
+    iters = 4
+    for _ in range(iters):
+        y = f(y, x)
+    jax.block_until_ready(y)
+    dt = (time.monotonic() - t0) / iters
+    _measured_peak_cache = 2.0 * n**3 / max(dt, 1e-9)
+    return _measured_peak_cache
+
+
+def peak_flops_per_chip(device=None) -> float:
+    """The MFU denominator, resolved env > spec table > measured matmul."""
+    raw = os.environ.get(ENV_PEAK_FLOPS, "").strip()
+    if raw:
+        return float(raw)
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    peak, _ = chip_peaks(dev)
+    if peak is not None:
+        return peak
+    return _measured_matmul_peak()
+
+
+def step_flops(jitted, *args) -> Optional[float]:
+    """Per-device FLOPs of one call to a jitted function, from XLA's cost
+    model over the lowered (NOT re-compiled) program. Returns None when
+    the cost model has nothing to say (it cannot see pallas custom-call
+    FLOPs; bench.py keeps analytic formulas beside it for those)."""
+    try:
+        cost = jitted.lower(*args).cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:  # noqa: BLE001 - cost model is best-effort
+        return None
+
+
+def mfu(flops_per_step: Optional[float], step_time_s: float,
+        peak: Optional[float] = None) -> Optional[float]:
+    """flops/step over wall time over per-chip peak; None when either side
+    is unknown (the gauge is simply not set — never a fabricated 0)."""
+    if not flops_per_step or step_time_s <= 0:
+        return None
+    p = peak if peak is not None else peak_flops_per_chip()
+    if not p:
+        return None
+    return flops_per_step / step_time_s / p
+
+
+def goodput(window_s: float, overhead_s: float) -> float:
+    """Fraction of the training wall window NOT spent on host-side
+    overheads (input wait + checkpoint block + eval): the train loop's
+    device-feeding efficiency. 1.0 = every wall second fed the device."""
+    if window_s <= 0:
+        return 0.0
+    return max(0.0, min(1.0, 1.0 - overhead_s / window_s))

@@ -1,0 +1,429 @@
+"""kft-trace — platform-wide structured tracing on a bounded ring buffer.
+
+The platform's observability previously stopped at aggregate Prometheus
+counters (utils/metrics.py) and an on-demand whole-process `jax.profiler`
+capture (runtime/profiler.py). Neither answers "where did THIS request's
+2.0 s TTFT go" or "what did step 1234 spend on host input" — questions that
+need structured, per-phase, per-request wall-time records. kft-trace is the
+span layer that answers them:
+
+- `Tracer.span(name, **attrs)` — context-managed span on the calling
+  thread; nesting is tracked per thread so a span records its parent.
+- `Tracer.start_span(...)` / `Span.end(...)` — explicit begin/end for
+  spans that START on one thread and END on another (an engine request's
+  queue wait begins on the REST handler thread and ends when the scheduler
+  thread pops it).
+- `Tracer.event(name, **attrs)` — zero-duration instant (compile fence,
+  cache rewind).
+- records land in ONE bounded ring buffer (thread-safe, fixed capacity, a
+  few hundred bytes per span): tracing is always cheap enough to leave on
+  in production — the serving bench gates it at <2% engine tok/s
+  (docs/OBSERVABILITY.md) — and a wedged process still holds its recent
+  history for /debug/trace.
+- `chrome_trace()` exports the buffer in the Chrome trace-event JSON
+  format (one "X" complete event per span, thread-per-track), loadable in
+  Perfetto / chrome://tracing directly from the /debug/trace endpoint.
+
+Trace-id propagation: a request-scoped id (the `X-Request-Id` header on
+the serving path) rides every span recorded for that request, so one
+request's phases can be filtered out of the interleaved buffer. Spans
+inherit the thread's current trace id (`trace_context`); cross-thread
+spans carry it explicitly.
+
+Knobs flow like every other platform knob: ObservabilityConfig
+(config/platform.py) → controller-rendered KFT_TRACE_* env → the
+entrypoints (serving/main.py, runtime/launcher.py) call
+`configure_from_env()`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional
+
+# The env contract rendered by the controllers (controllers/inference.py,
+# controllers/tpujob.py) and consumed by the serving/runtime entrypoints.
+ENV_TRACE_ENABLED = "KFT_TRACE_ENABLED"
+ENV_TRACE_BUFFER_SPANS = "KFT_TRACE_BUFFER_SPANS"
+ENV_TRACE_STATUSZ = "KFT_TRACE_STATUSZ"
+
+DEFAULT_BUFFER_SPANS = 4096
+
+
+class SpanRecord:
+    """One finished span (or instant event, dur_s == 0.0 and phase "i")."""
+
+    __slots__ = (
+        "name", "trace_id", "parent", "t_start", "dur_s", "tid",
+        "thread_name", "attrs", "phase",
+    )
+
+    def __init__(self, name, trace_id, parent, t_start, dur_s, tid,
+                 thread_name, attrs, phase="X"):
+        self.name = name
+        self.trace_id = trace_id
+        self.parent = parent  # enclosing span's name on the same thread
+        self.t_start = t_start  # time.monotonic() seconds
+        self.dur_s = dur_s
+        self.tid = tid
+        self.thread_name = thread_name
+        self.attrs = attrs
+        self.phase = phase
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "parent": self.parent,
+            "t_start": self.t_start,
+            "dur_s": self.dur_s,
+            "tid": self.tid,
+            "thread_name": self.thread_name,
+            "attrs": dict(self.attrs) if self.attrs else {},
+            "phase": self.phase,
+        }
+
+
+class Span:
+    """A live span handle (returned by start_span; span() wraps one).
+
+    `end()` is safe from any thread — the record keeps the STARTING
+    thread's track so a request's queue-wait span renders on the thread
+    that submitted it, per the thread-per-track export convention.
+    """
+
+    __slots__ = (
+        "_tracer", "name", "trace_id", "parent", "t_start", "tid",
+        "thread_name", "attrs", "_ended", "_on_stack",
+    )
+
+    def __init__(self, tracer, name, trace_id, parent, attrs):
+        t = threading.current_thread()
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.parent = parent
+        self.attrs = attrs
+        self.tid = t.ident or 0
+        self.thread_name = t.name
+        self.t_start = time.monotonic()
+        self._ended = False
+        self._on_stack = False
+
+    def end(self, **extra_attrs) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        dur = time.monotonic() - self.t_start
+        if extra_attrs:
+            attrs = dict(self.attrs) if self.attrs else {}
+            attrs.update(extra_attrs)
+            self.attrs = attrs
+        self._tracer._record(
+            SpanRecord(
+                self.name, self.trace_id, self.parent, self.t_start, dur,
+                self.tid, self.thread_name, self.attrs,
+            )
+        )
+
+    # -- context-manager protocol (tracer.span(...)) -----------------------
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._on_stack:
+            self._tracer._pop(self)
+        self.end()
+        return False
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled-tracer fast path allocates
+    nothing and records nothing."""
+
+    __slots__ = ()
+
+    def end(self, **extra_attrs) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class Tracer:
+    """Thread-safe bounded ring buffer of span records.
+
+    Thread model: the buffer deque and the config fields are guarded by
+    `_lock`; per-thread nesting stacks and trace ids live in a
+    threading.local (no lock needed — single-thread by construction).
+    """
+
+    def __init__(self, capacity: int = DEFAULT_BUFFER_SPANS,
+                 enabled: bool = True):
+        if capacity < 1:
+            raise ValueError("trace buffer capacity must be >= 1")
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=capacity)
+        self._capacity = capacity
+        self._enabled = bool(enabled)
+        self._dropped = 0
+        self._tls = threading.local()
+        self._ids = itertools.count(1)
+
+    # -- configuration -----------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def configure(self, enabled: Optional[bool] = None,
+                  capacity: Optional[int] = None) -> None:
+        if enabled is not None:
+            # a bare flag, deliberately NOT lock-guarded: the hot-path
+            # span()/event() reads must stay lock-free, and a torn read of
+            # a Python bool is impossible
+            self._enabled = bool(enabled)
+        if capacity is not None:
+            if capacity < 1:
+                raise ValueError("trace buffer capacity must be >= 1")
+            with self._lock:
+                if capacity != self._capacity:
+                    self._buf = deque(self._buf, maxlen=capacity)
+                    self._capacity = capacity
+
+    @property
+    def capacity(self) -> int:
+        with self._lock:
+            return self._capacity
+
+    # -- trace-id propagation ---------------------------------------------
+
+    def set_trace_id(self, trace_id: Optional[str]) -> None:
+        self._tls.trace_id = trace_id
+
+    def current_trace_id(self) -> Optional[str]:
+        return getattr(self._tls, "trace_id", None)
+
+    def new_trace_id(self, prefix: str = "t") -> str:
+        """Process-unique fallback id for callers without an X-Request-Id."""
+        return f"{prefix}-{os.getpid():x}-{next(self._ids):x}"
+
+    def trace_context(self, trace_id: Optional[str]):
+        """Context manager: set the calling thread's trace id, restore on
+        exit. Spans opened inside inherit it."""
+        return _TraceContext(self, trace_id)
+
+    # -- span API ----------------------------------------------------------
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def span(self, name: str, trace_id: Optional[str] = None,
+             **attrs) -> Any:
+        """Context-managed span on the calling thread. Nested spans record
+        their parent's name; the trace id defaults to the thread's current
+        one (`trace_context`)."""
+        if not self._enabled:
+            return _NOOP
+        stack = self._stack()
+        parent = stack[-1].name if stack else None
+        if trace_id is None:
+            trace_id = self.current_trace_id()
+            if trace_id is None and stack:
+                trace_id = stack[-1].trace_id
+        sp = Span(self, name, trace_id, parent, attrs or None)
+        sp._on_stack = True
+        stack.append(sp)
+        return sp
+
+    def start_span(self, name: str, trace_id: Optional[str] = None,
+                   **attrs) -> Any:
+        """Explicit-end span for cross-thread phases: returned handle's
+        `end()` may be called from any thread. NOT pushed on the nesting
+        stack (the start and end threads' stacks are different objects)."""
+        if not self._enabled:
+            return _NOOP
+        if trace_id is None:
+            trace_id = self.current_trace_id()
+        return Span(self, name, trace_id, None, attrs or None)
+
+    def event(self, name: str, trace_id: Optional[str] = None,
+              **attrs) -> None:
+        """Zero-duration instant (compile fence, rewind, retire)."""
+        if not self._enabled:
+            return
+        t = threading.current_thread()
+        if trace_id is None:
+            trace_id = self.current_trace_id()
+        self._record(
+            SpanRecord(
+                name, trace_id, None, time.monotonic(), 0.0,
+                t.ident or 0, t.name, attrs or None, phase="i",
+            )
+        )
+
+    def _pop(self, span: Span) -> None:
+        stack = self._stack()
+        if stack and stack[-1] is span:
+            stack.pop()
+        elif span in stack:  # pragma: no cover - misnested exit
+            stack.remove(span)
+
+    def _record(self, record: SpanRecord) -> None:
+        with self._lock:
+            if len(self._buf) == self._capacity:
+                self._dropped += 1
+            self._buf.append(record)
+
+    # -- introspection / export -------------------------------------------
+
+    def snapshot(self) -> List[SpanRecord]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._dropped = 0
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": self._enabled,
+                "capacity": self._capacity,
+                "buffered": len(self._buf),
+                "dropped": self._dropped,
+            }
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """The buffer as Chrome trace-event JSON (Perfetto-loadable).
+
+        One "X" complete event per span (ts/dur in µs on the starting
+        thread's track), "i" instants for events, plus thread_name
+        metadata events so Perfetto labels each track. Span attrs and the
+        trace id land in `args` — Perfetto's query/filter surface.
+        """
+        records = self.snapshot()
+        pid = os.getpid()
+        events: List[Dict[str, Any]] = []
+        threads: Dict[int, str] = {}
+        for r in records:
+            threads.setdefault(r.tid, r.thread_name)
+            args: Dict[str, Any] = dict(r.attrs) if r.attrs else {}
+            if r.trace_id is not None:
+                args["trace_id"] = r.trace_id
+            if r.parent is not None:
+                args["parent"] = r.parent
+            ev: Dict[str, Any] = {
+                "name": r.name,
+                "ph": r.phase,
+                "ts": round(r.t_start * 1e6, 3),
+                "pid": pid,
+                "tid": r.tid,
+                "args": args,
+            }
+            if r.phase == "X":
+                ev["dur"] = round(r.dur_s * 1e6, 3)
+            else:
+                ev["s"] = "t"  # thread-scoped instant
+            events.append(ev)
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": name},
+            }
+            for tid, name in sorted(threads.items())
+        ]
+        return {
+            "traceEvents": meta + sorted(events, key=lambda e: e["ts"]),
+            "displayTimeUnit": "ms",
+        }
+
+    def chrome_trace_json(self) -> str:
+        return json.dumps(self.chrome_trace())
+
+
+class _TraceContext:
+    __slots__ = ("_tracer", "_trace_id", "_prev")
+
+    def __init__(self, tracer: Tracer, trace_id: Optional[str]):
+        self._tracer = tracer
+        self._trace_id = trace_id
+
+    def __enter__(self):
+        self._prev = self._tracer.current_trace_id()
+        self._tracer.set_trace_id(self._trace_id)
+        return self._trace_id
+
+    def __exit__(self, *exc) -> bool:
+        self._tracer.set_trace_id(self._prev)
+        return False
+
+
+_default_tracer = Tracer()
+
+
+def default_tracer() -> Tracer:
+    """The process-wide tracer every instrumented subsystem records into
+    (one buffer = one /debug/trace dump covering serving AND training)."""
+    return _default_tracer
+
+
+def knobs_from_env(environ=None) -> Dict[str, Any]:
+    """The observability contract the controllers render
+    (ObservabilityConfig → KFT_TRACE_* env): trace_enabled
+    (KFT_TRACE_ENABLED, "0" disables), trace_buffer_spans
+    (KFT_TRACE_BUFFER_SPANS), statusz_enabled (KFT_TRACE_STATUSZ,
+    "0" disables the /statusz + /debug/trace routes)."""
+    env = os.environ if environ is None else environ
+
+    def _flag(name: str, default: bool) -> bool:
+        raw = env.get(name, "").strip()
+        if not raw:
+            return default
+        return raw not in ("0", "false", "False", "off")
+
+    raw_cap = env.get(ENV_TRACE_BUFFER_SPANS, "").strip()
+    capacity = int(raw_cap) if raw_cap else DEFAULT_BUFFER_SPANS
+    return {
+        "trace_enabled": _flag(ENV_TRACE_ENABLED, True),
+        "trace_buffer_spans": capacity,
+        "statusz_enabled": _flag(ENV_TRACE_STATUSZ, True),
+    }
+
+
+def configure_from_env(environ=None) -> Dict[str, Any]:
+    """Entrypoint hook (serving/main.py, runtime/launcher.py): apply the
+    rendered env to the default tracer; returns the parsed knobs so the
+    caller can also gate its /statusz routes."""
+    knobs = knobs_from_env(environ)
+    _default_tracer.configure(
+        enabled=knobs["trace_enabled"],
+        capacity=knobs["trace_buffer_spans"],
+    )
+    return knobs
+
+
+def iter_trace(records: Iterable[SpanRecord],
+               trace_id: str) -> List[SpanRecord]:
+    """Filter one request's spans out of the interleaved buffer."""
+    return [r for r in records if r.trace_id == trace_id]

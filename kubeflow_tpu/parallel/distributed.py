@@ -102,6 +102,20 @@ def render_gang_env(
 _initialized = False
 
 
+def _cpu_platform_selected() -> bool:
+    """True when jax will (or did) pick the CPU backend — the case that
+    needs gloo collectives for multi-process gangs. Reads the platform
+    SELECTION (env/config), not jax.default_backend(), which would
+    initialize the backend before jax.distributed.initialize runs."""
+    import jax
+
+    selected = (
+        os.environ.get("JAX_PLATFORMS", "")
+        or (getattr(jax.config, "jax_platforms", None) or "")
+    )
+    return selected.split(",")[0].strip().lower() == "cpu"
+
+
 def initialize_from_env(environ: Optional[Dict[str, str]] = None) -> GangEnv:
     """In-pod entrypoint: parse GangEnv and bring up jax.distributed.
 
@@ -118,6 +132,21 @@ def initialize_from_env(environ: Optional[Dict[str, str]] = None) -> GangEnv:
     if _initialized:
         return gang
     import jax
+
+    if _cpu_platform_selected():
+        # XLA's CPU backend cannot run cross-process SPMD programs with
+        # its default (no-op) collectives — a multi-process CPU gang dies
+        # at the first sharded computation with "Multiprocess computations
+        # aren't implemented on the CPU backend" (the long-red gang-test
+        # failure). jaxlib ships a gloo TCP implementation exactly for
+        # this; selecting it here makes localhost CPU gangs (CI, the
+        # subprocess-gang e2e tier) real SPMD instead of dead on arrival.
+        # Must be set before the backend initializes — which is why it
+        # lives here, next to jax.distributed.initialize.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception as e:  # noqa: BLE001 - older jaxlib without gloo
+            log.warning("gloo CPU collectives unavailable (%s)", e)
 
     log.info(
         "initializing jax.distributed: coordinator=%s procs=%d id=%d "

@@ -31,6 +31,10 @@ ENV_RESTORE_DIR = "KFT_RESTORE_DIR"
 ENV_PROFILER_LOGDIR = "KFT_PROFILER_LOGDIR"
 ENV_PROFILER_PORT = "KFT_PROFILER_PORT"
 DEFAULT_PROFILER_PORT = 9431
+# kft-trace debug surface (observability/http.py): /statusz + /debug/trace
+# + /metrics. The TPUJob controller renders the port whenever the job's
+# observability.statusz_enabled knob is on; unset = no debug server.
+ENV_DEBUG_PORT = "KFT_DEBUG_PORT"
 
 
 def maybe_start_profiler_server(environ=None):
@@ -54,6 +58,32 @@ def maybe_start_profiler_server(environ=None):
     server = Server(build_app(ProfilerService(logdir)), port=port)
     server.start()
     log.info("profiler endpoint on :%d → %s", server.port, logdir)
+    return server
+
+
+def maybe_start_debug_server(environ=None):
+    """Serve the kft-trace debug surface (/statusz, /debug/trace,
+    /metrics — observability/http.py) when the controller rendered
+    KFT_DEBUG_PORT. Coordinator-only, same as the profiler endpoint.
+    Best-effort: a taken port degrades to no debug server, never a dead
+    gang pod (the training job does not depend on its own status page).
+    Returns the Server (caller owns shutdown) or None."""
+    env = os.environ if environ is None else environ
+    port_raw = env.get(ENV_DEBUG_PORT, "").strip()
+    if not port_raw:
+        return None
+    if env.get("KFT_PROCESS_ID", "0") != "0":
+        return None
+    from kubeflow_tpu.api.wsgi import Server
+    from kubeflow_tpu.observability.http import build_debug_app
+
+    try:
+        server = Server(build_debug_app("training-debug"), port=int(port_raw))
+        server.start()
+    except (OSError, ValueError) as e:
+        log.warning("debug server on :%s unavailable (%s)", port_raw, e)
+        return None
+    log.info("kft-trace debug endpoint on :%d", server.port)
     return server
 
 
@@ -94,7 +124,14 @@ def run(config_path: Optional[str] = None, steps: Optional[int] = None) -> int:
         len(jax.devices()),
         cfg.model,
     )
+    # kft-trace: the controller-rendered KFT_TRACE_* knobs configure the
+    # process tracer before any instrumented code runs (the spec's
+    # observability subtree is the same contract, env wins like always)
+    from kubeflow_tpu.observability.trace import configure_from_env
+
+    configure_from_env()
     profiler_server = maybe_start_profiler_server()
+    debug_server = maybe_start_debug_server()
     try:
         result = run_training(
             cfg,
@@ -104,6 +141,8 @@ def run(config_path: Optional[str] = None, steps: Optional[int] = None) -> int:
     finally:
         if profiler_server is not None:
             profiler_server.stop()
+        if debug_server is not None:
+            debug_server.stop()
     print(json.dumps({"job": gang.job_name, **result}))
     return 0
 

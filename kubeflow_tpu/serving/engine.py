@@ -65,6 +65,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_tpu.observability.trace import default_tracer
 from kubeflow_tpu.serving.batching import Completion
 from kubeflow_tpu.serving.sampling import (
     sample_slots as _sample_slots_shared,
@@ -77,6 +78,7 @@ from kubeflow_tpu.utils.metrics import (
     serving_decode_steps_counter,
     serving_draft_accepted_counter,
     serving_draft_proposed_counter,
+    serving_phase_histogram,
     serving_queue_depth_gauge,
     serving_slot_occupancy_gauge,
     serving_tokens_counter,
@@ -136,11 +138,11 @@ class _Request:
 
     __slots__ = (
         "prompt", "max_new", "temperature", "top_k", "top_p", "eos_id",
-        "seed", "t_submit", "future",
+        "seed", "t_submit", "future", "trace_id", "queue_span",
     )
 
     def __init__(self, prompt, max_new, temperature, top_k, top_p, eos_id,
-                 seed):
+                 seed, trace_id=None):
         self.prompt = prompt  # np.int32 [P], real tokens only
         self.max_new = max_new
         self.temperature = temperature
@@ -151,17 +153,26 @@ class _Request:
         self.t_submit = time.monotonic()
         # completes with {"tokens": [...], "ttft_s": float}
         self.future = Completion()
+        # request-scoped trace id (X-Request-Id on the REST path): every
+        # span kft-trace records for this request carries it
+        self.trace_id = trace_id
+        self.queue_span = None  # started at enqueue, ended at admission
 
 
 class _Slot:
     """Host bookkeeping for one occupied decode slot."""
 
-    __slots__ = ("req", "tokens", "ttft_s")
+    __slots__ = (
+        "req", "tokens", "ttft_s", "queue_s", "t_admitted", "decode_span",
+    )
 
     def __init__(self, req: _Request):
         self.req = req
         self.tokens: List[int] = []
         self.ttft_s = 0.0
+        self.queue_s = 0.0  # admission-queue wait (ttft_s minus prefill)
+        self.t_admitted = 0.0
+        self.decode_span = None
 
 
 class DecodeEngine:
@@ -310,7 +321,16 @@ class DecodeEngine:
         self._accepted = 0
         self._verifies = 0
 
+        # kft-trace (observability/): request phases + scheduler iteration
+        # spans ride the process tracer; a disabled tracer makes every
+        # span call a no-op (docs/OBSERVABILITY.md span catalog)
+        self._tracer = default_tracer()
+        # recent finished requests (phase breakdowns) for /statusz —
+        # appended by the scheduler thread, read by HTTP handlers
+        self._recent: deque = deque(maxlen=32)
+
         self._ttft = serving_ttft_histogram()
+        self._phase = serving_phase_histogram()
         self._draft_proposed = serving_draft_proposed_counter()
         self._draft_accepted = serving_draft_accepted_counter()
         self._accept_rate = serving_accept_rate_histogram()
@@ -525,7 +545,8 @@ class DecodeEngine:
         )
 
     def _make_request(self, prompt_ids, max_new_tokens, temperature,
-                      top_k, top_p, eos_id, seed) -> _Request:
+                      top_k, top_p, eos_id, seed,
+                      trace_id=None) -> _Request:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must contain at least one token")
@@ -554,8 +575,10 @@ class DecodeEngine:
             eos_id = int(eos_id)
             if not 0 <= eos_id < vocab:
                 raise ValueError(f"eos_id must be in [0, {vocab})")
+        if trace_id is None and self._tracer.enabled:
+            trace_id = self._tracer.new_trace_id("req")
         return _Request(prompt, n, temperature, top_k, top_p, eos_id,
-                        int(seed))
+                        int(seed), trace_id=trace_id)
 
     def _enqueue(self, reqs: List[_Request]) -> None:
         with self._cv:
@@ -565,6 +588,13 @@ class DecodeEngine:
                 raise QueueFullError(
                     f"admission queue full ({len(self._queue)} waiting, "
                     f"capacity {self.max_queue})"
+                )
+            for req in reqs:
+                # cross-thread span: starts here (the submitter's thread),
+                # ends when the scheduler pops the request for admission
+                req.queue_span = self._tracer.start_span(
+                    "request.queue_wait", trace_id=req.trace_id,
+                    model=self.name, prompt_len=int(req.prompt.size),
                 )
             self._queue.extend(reqs)
             self._queue_depth.set(len(self._queue), model=self.name)
@@ -580,13 +610,16 @@ class DecodeEngine:
         top_p: float = 1.0,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        trace_id: Optional[str] = None,
     ) -> Completion:
         """Enqueue one UNPADDED prompt row; returns the request future
         (completes with {"tokens", "ttft_s"}). Raises QueueFullError when
-        the admission queue is at max_queue — callers map it to 429."""
+        the admission queue is at max_queue — callers map it to 429.
+        `trace_id` tags the request's kft-trace spans (the REST handler
+        passes the X-Request-Id header; one is generated if absent)."""
         req = self._make_request(
             prompt_ids, max_new_tokens, temperature, top_k, top_p, eos_id,
-            seed,
+            seed, trace_id=trace_id,
         )
         self._enqueue([req])
         return req.future
@@ -601,16 +634,22 @@ class DecodeEngine:
         top_p: float = 1.0,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        trace_id: Optional[str] = None,
     ) -> List[Completion]:
         """Atomic multi-row admission (one REST request's rows): every row
         validates and enters the queue, or none do (queue-full on a
         half-admitted batch would strand the accepted rows' work). Row i's
         sampling stream is seeded `seed + i` so rows draw independently
-        while the whole batch stays reproducible from one seed."""
+        while the whole batch stays reproducible from one seed. All rows
+        share `trace_id` (the REST request's X-Request-Id) with a per-row
+        suffix so a multi-row request still decomposes per row."""
         reqs = [
             self._make_request(
                 row, max_new_tokens, temperature, top_k, top_p, eos_id,
                 int(seed) + i,
+                trace_id=(
+                    f"{trace_id}/{i}" if trace_id is not None else None
+                ),
             )
             for i, row in enumerate(rows)
         ]
@@ -643,6 +682,39 @@ class DecodeEngine:
                     self._accepted / self._drafted if self._drafted else 0.0
                 ),
             }
+
+    def debug_state(self) -> dict:
+        """The /statusz snapshot: slot map, queue depth, recent finished
+        requests with phase breakdowns, aggregate stats. Slot reads are
+        lock-free snapshots of scheduler-owned state (a torn view across
+        slots is acceptable for a human-readable status page; no device
+        state is touched)."""
+        slots = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                slots.append(None)
+                continue
+            slots.append(
+                {
+                    "slot": i,
+                    "trace_id": slot.req.trace_id or "-",
+                    "prompt_len": int(slot.req.prompt.size),
+                    "tokens": len(slot.tokens),
+                    "max_new": slot.req.max_new,
+                }
+            )
+        with self._cv:
+            depth = len(self._queue)
+        with self._stats_lock:
+            recent = list(self._recent)
+        return {
+            "name": self.name,
+            "num_slots": self.num_slots,
+            "queue_depth": depth,
+            "slots": slots,
+            "recent": recent,
+            "stats": self.stats(),
+        }
 
     def close(self) -> None:
         with self._cv:
@@ -678,7 +750,16 @@ class DecodeEngine:
     # -- scheduler loop ----------------------------------------------------
 
     def _admit(self, slot_idx: int, req: _Request) -> None:
+        # the queue phase ends the moment the scheduler owns the request
+        t_admit = time.monotonic()
+        if req.queue_span is not None:
+            req.queue_span.end(slot=slot_idx)
+            req.queue_span = None
         bucket = self.bucket_for(req.prompt.size)
+        prefill_span = self._tracer.start_span(
+            "request.prefill", trace_id=req.trace_id, model=self.name,
+            slot=slot_idx, bucket=bucket, prompt_len=int(req.prompt.size),
+        )
         fn = self._prefill
         ids = np.zeros((1, bucket), np.int32)
         ids[0, : req.prompt.size] = req.prompt
@@ -704,9 +785,18 @@ class DecodeEngine:
                 self._draft_cache, draft_one, jnp.int32(slot_idx)
             )
         first = int(jax.device_get(tok))
+        prefill_span.end()
         slot = _Slot(req)
         slot.ttft_s = time.monotonic() - req.t_submit
+        slot.queue_s = t_admit - req.t_submit
+        slot.t_admitted = t_admit
         slot.tokens.append(first)
+        # the request's remaining life is the decode phase (cross-
+        # iteration: ended by _finish, possibly many steps later)
+        slot.decode_span = self._tracer.start_span(
+            "request.decode", trace_id=req.trace_id, model=self.name,
+            slot=slot_idx,
+        )
         self._ttft.observe(slot.ttft_s, model=self.name)
         self._tokens_total.inc(model=self.name)
         self._tok_np[slot_idx] = first
@@ -724,6 +814,31 @@ class DecodeEngine:
         slot = self._slots[slot_idx]
         self._slots[slot_idx] = None
         self._temp_np[slot_idx] = 0.0  # freed slots cost only the argmax
+        # the exact phase decomposition: queue + prefill == TTFT, and
+        # queue + prefill + decode == full request wall time
+        prefill_s = slot.ttft_s - slot.queue_s
+        decode_s = time.monotonic() - slot.t_admitted - prefill_s
+        self._phase.observe(slot.queue_s, model=self.name, phase="queue")
+        self._phase.observe(prefill_s, model=self.name, phase="prefill")
+        self._phase.observe(decode_s, model=self.name, phase="decode")
+        if slot.decode_span is not None:
+            slot.decode_span.end(tokens=len(slot.tokens))
+            slot.decode_span = None
+        self._tracer.event(
+            "request.retire", trace_id=slot.req.trace_id, model=self.name,
+            slot=slot_idx, tokens=len(slot.tokens),
+        )
+        with self._stats_lock:
+            self._recent.append(
+                {
+                    "trace_id": slot.req.trace_id or "-",
+                    "queue_s": slot.queue_s,
+                    "prefill_s": prefill_s,
+                    "decode_s": decode_s,
+                    "ttft_s": slot.ttft_s,
+                    "tokens": len(slot.tokens),
+                }
+            )
         slot.req.future.set(
             {"tokens": list(slot.tokens), "ttft_s": slot.ttft_s}
         )
@@ -749,6 +864,11 @@ class DecodeEngine:
             "engine %s decode iteration failed; failing %d resident "
             "request(s) and rebuilding the slot cache(s)",
             self.name, sum(s is not None for s in self._slots),
+        )
+        self._tracer.event(
+            "engine.recover", model=self.name,
+            residents=sum(s is not None for s in self._slots),
+            error=type(exc).__name__,
         )
         err = RuntimeError(f"engine {self.name} decode step failed: {exc!r}")
         err.__cause__ = exc
@@ -828,13 +948,16 @@ class DecodeEngine:
         if self.num_draft_tokens > 0:
             self._iterate_spec(active)
             return
-        self._cache, tok = self._step(
-            self.params, self._cache,
-            jnp.asarray(self._tok_np), jnp.asarray(self._key_np),
-            jnp.asarray(self._cnt_np), jnp.asarray(self._temp_np),
-            jnp.asarray(self._topk_np), jnp.asarray(self._topp_np),
-        )
-        toks = np.asarray(jax.device_get(tok))
+        with self._tracer.span(
+            "engine.step", model=self.name, active=len(active)
+        ):
+            self._cache, tok = self._step(
+                self.params, self._cache,
+                jnp.asarray(self._tok_np), jnp.asarray(self._key_np),
+                jnp.asarray(self._cnt_np), jnp.asarray(self._temp_np),
+                jnp.asarray(self._topk_np), jnp.asarray(self._topp_np),
+            )
+            toks = np.asarray(jax.device_get(tok))
         self._decode_steps.inc(model=self.name)
         self._tokens_total.inc(len(active), model=self.name)
         with self._stats_lock:
@@ -862,19 +985,34 @@ class DecodeEngine:
         temps = jnp.asarray(self._temp_np)
         top_ks = jnp.asarray(self._topk_np)
         top_ps = jnp.asarray(self._topp_np)
-        self._draft_cache, proposals, qs = self._draft(
-            self.draft_params, self._draft_cache,
-            jnp.asarray(self._tok_np), keys, draws, temps, top_ks, top_ps,
-        )
+        with self._tracer.span(
+            "engine.draft", model=self.name, active=len(active), k=kk
+        ):
+            self._draft_cache, proposals, qs = self._draft(
+                self.draft_params, self._draft_cache,
+                jnp.asarray(self._tok_np), keys, draws, temps, top_ks,
+                top_ps,
+            )
         window = jnp.concatenate(
             [jnp.asarray(self._tok_np)[:, None], proposals], axis=1
         )
-        self._cache, self._draft_cache, out_tok, out_len = self._verify(
-            self.params, self._cache, self._draft_cache, window, qs,
-            keys, draws, temps, top_ks, top_ps,
-        )
-        out_tok = np.asarray(jax.device_get(out_tok))
-        out_len = np.asarray(jax.device_get(out_len))
+        with self._tracer.span(
+            "engine.verify", model=self.name, active=len(active), k=kk
+        ):
+            self._cache, self._draft_cache, out_tok, out_len = self._verify(
+                self.params, self._cache, self._draft_cache, window, qs,
+                keys, draws, temps, top_ks, top_ps,
+            )
+            out_tok = np.asarray(jax.device_get(out_tok))
+            out_len = np.asarray(jax.device_get(out_len))
+        rolled = int(sum((kk + 1) - int(out_len[i]) for i in active))
+        if rolled:
+            # the verify program rewound both caches past the rejected
+            # tails — recorded as an instant (the device work is inside
+            # the verify span; this is the acceptance outcome)
+            self._tracer.event(
+                "engine.rewind", model=self.name, tokens=rolled,
+            )
         self._draw_np += kk + 1  # the window consumed K+1 rng positions
         emitted = 0
         accepted = 0

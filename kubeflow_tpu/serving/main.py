@@ -62,6 +62,9 @@ def build_server(
     num_draft_tokens: int = None,
     draft_params=None,
     draft_checkpoint_dir: str = None,
+    trace_enabled: bool = None,
+    trace_buffer_spans: int = None,
+    statusz_enabled: bool = None,
 ):
     """Assemble the ModelServer for one registry model (testable core of
     the entrypoint): causal families serve :generate via the
@@ -76,9 +79,26 @@ def build_server(
     deterministic seed-0 init (correct output regardless — verify
     rejects bad drafts — just a useless accept rate until real params
     arrive)."""
+    from kubeflow_tpu.observability.trace import (
+        default_tracer,
+        knobs_from_env,
+    )
     from kubeflow_tpu.serving.server import ModelServer, ServedModel
 
-    server = ModelServer()
+    # kft-trace knobs: explicit args win, else the controller-rendered
+    # KFT_TRACE_* env (ObservabilityConfig → controllers/inference.py)
+    obs = knobs_from_env()
+    if trace_enabled is None:
+        trace_enabled = obs["trace_enabled"]
+    if trace_buffer_spans is None:
+        trace_buffer_spans = obs["trace_buffer_spans"]
+    if statusz_enabled is None:
+        statusz_enabled = obs["statusz_enabled"]
+    default_tracer().configure(
+        enabled=trace_enabled, capacity=trace_buffer_spans
+    )
+
+    server = ModelServer(statusz_enabled=statusz_enabled)
     if is_causal_family(model):
         from kubeflow_tpu.serving.generate import ServedLm
 

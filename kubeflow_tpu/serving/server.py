@@ -262,13 +262,75 @@ class ServedModel:
 
 
 class ModelServer:
-    """Multi-model server with the TF-Serving REST surface."""
+    """Multi-model server with the TF-Serving REST surface.
 
-    def __init__(self) -> None:
+    Observability surface (kubeflow_tpu/observability/, default on):
+    /debug/trace dumps the process tracer as Perfetto-loadable Chrome
+    trace JSON, /statusz renders engine slot maps + recent request phase
+    breakdowns, /metrics serves the registry's Prometheus text.
+    `statusz_enabled=False` (the ObservabilityConfig knob, rendered as
+    KFT_TRACE_STATUSZ) leaves the wire surface model-endpoints-only."""
+
+    def __init__(self, statusz_enabled: bool = True) -> None:
         self._models: Dict[str, ServedModel] = {}
         self._lms: Dict[str, Any] = {}  # ServedLm (serving/generate.py)
         self._engines: Dict[str, Any] = {}  # DecodeEngine (serving/engine.py)
         self.app = self._build()
+        if statusz_enabled:
+            from kubeflow_tpu.observability.http import add_debug_routes
+
+            add_debug_routes(
+                self.app,
+                statusz_sections=[
+                    ("models", self._statusz_models),
+                    ("engines", self._statusz_engines),
+                ],
+            )
+
+    def _statusz_models(self) -> List[str]:
+        lines = [
+            f"  {m.name} (predict, version {m.version})"
+            for m in self._models.values()
+        ]
+        lines += [
+            f"  {lm.name} (generate, "
+            f"{'engine' if lm.name in self._engines else 'static'})"
+            for lm in self._lms.values()
+        ]
+        lines += [
+            f"  {e.name} (generate, engine-only)"
+            for e in self._engines.values()
+            if e.name not in self._lms
+        ]
+        return lines or ["  <none>"]
+
+    def _statusz_engines(self) -> List[str]:
+        from kubeflow_tpu.observability.http import format_phase_row
+
+        lines: List[str] = []
+        for engine in self._engines.values():
+            state = engine.debug_state()
+            st = state["stats"]
+            lines.append(
+                f"  {state['name']}: queue={state['queue_depth']} "
+                f"slots={sum(s is not None for s in state['slots'])}"
+                f"/{state['num_slots']} steps={st['decode_steps']} "
+                f"tokens={st['tokens']} "
+                f"occupancy={st['mean_occupancy']:.3f}"
+            )
+            for s in state["slots"]:
+                if s is not None:
+                    lines.append(
+                        f"    slot {s['slot']}: {s['trace_id']} "
+                        f"prompt={s['prompt_len']} "
+                        f"tokens={s['tokens']}/{s['max_new']}"
+                    )
+            if state["recent"]:
+                lines.append("    recent requests (newest last):")
+                lines.extend(
+                    "  " + format_phase_row(r) for r in state["recent"]
+                )
+        return lines or ["  <none>"]
 
     def add(self, model: ServedModel) -> None:
         self._models[model.name] = model
@@ -339,6 +401,16 @@ class ModelServer:
         else:
             mask = np.ones_like(x, dtype=bool)
         eos_id = body.get("eos_id")
+        # per-request trace id: the client's X-Request-Id header when
+        # present (wsgi lowercases header names), else a generated one —
+        # every engine span for this request carries it, and the response
+        # echoes it so clients can correlate a /debug/trace dump
+        trace_id = req.headers.get("x-request-id") or None
+        if trace_id is None:
+            from kubeflow_tpu.observability.trace import default_tracer
+
+            trace_id = default_tracer().new_trace_id("req")
+        req.response_headers.append(("X-Request-Id", trace_id))
         try:
             futures = engine.submit_batch(
                 [x[i][mask[i]] for i in range(x.shape[0])],
@@ -348,6 +420,7 @@ class ModelServer:
                 top_p=body.get("top_p", 1.0),
                 eos_id=eos_id,
                 seed=body.get("seed", 0),
+                trace_id=trace_id,
             )
         except QueueFullError as e:
             raise HttpError(429, str(e))

@@ -41,8 +41,19 @@ from kubeflow_tpu.training.data import (
 )
 from kubeflow_tpu.training.prefetch import DevicePrefetcher
 from kubeflow_tpu.training.tasks import make_optimizer, task_for_model
+from kubeflow_tpu.observability.mfu import (
+    goodput as goodput_fraction,
+    mfu as mfu_fraction,
+    step_flops,
+)
+from kubeflow_tpu.observability.trace import default_tracer
 from kubeflow_tpu.utils.logging import get_logger
-from kubeflow_tpu.utils.metrics import default_registry, host_wait_histogram
+from kubeflow_tpu.utils.metrics import (
+    default_registry,
+    host_wait_histogram,
+    training_goodput_gauge,
+    training_mfu_gauge,
+)
 
 log = get_logger(__name__)
 
@@ -135,6 +146,10 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self._state_shardings = None
+        # per-device FLOPs of one compiled train step (XLA cost model over
+        # the lowered program; observability/mfu.py) — memoized per
+        # trainer, the numerator of training_model_flops_utilization
+        self._step_flops: Optional[float] = None
 
     # ---- state init ----------------------------------------------------
 
@@ -565,6 +580,9 @@ class Trainer:
             "training_eval_top1", "held-out top-1 accuracy", ["model"]
         )
         host_wait = host_wait_histogram()
+        mfu_gauge = training_mfu_gauge()
+        goodput_gauge = training_goodput_gauge()
+        tracer = default_tracer()
         eval_every = cfg.data.eval_every_steps if eval_data is not None else 0
         target = cfg.data.target_accuracy if eval_data is not None else 0.0
         eval_metrics: Dict[str, float] = {}
@@ -574,20 +592,32 @@ class Trainer:
         stop_reason = ""
         self._stop_reason = ""
         compile_s = 0.0
+        # goodput accounting (observability/mfu.py): host-side overhead
+        # seconds (input wait + checkpoint block + eval) per log window
+        w_start = time.monotonic()
+        overhead_s = 0.0
         for i in range(start_step, end_step):
             t_wait = time.monotonic()
-            if device_gen is not None:
-                batch = device_gen(i)
-                batch_np = batch  # count_items reads shapes/small masks
-            elif prefetcher is not None:
-                batch_np, batch = prefetcher.get(i)
-            else:
-                batch_np = get_batch(i)
-                batch = make_global_batch(batch_np, self.mesh)
+            with tracer.span("train.host_wait", model=cfg.model, step=i):
+                if device_gen is not None:
+                    batch = device_gen(i)
+                    batch_np = batch  # count_items reads shapes/small masks
+                elif prefetcher is not None:
+                    batch_np, batch = prefetcher.get(i)
+                else:
+                    batch_np = get_batch(i)
+                    batch = make_global_batch(batch_np, self.mesh)
             # the input-bound signal: ~0 when the prefetcher kept up, the
             # full host data time when the loop starved waiting on input
-            host_wait.observe(time.monotonic() - t_wait, model=cfg.model)
-            state, metrics = self.train_step(state, batch, rng)
+            waited = time.monotonic() - t_wait
+            host_wait.observe(waited, model=cfg.model)
+            overhead_s += waited
+            # span covers the DISPATCH of the async step; once the device
+            # pipeline is full the dispatch blocks on the prior step, so at
+            # steady state this IS the device step wall time (and on the
+            # first step it is the XLA compile — see train.compile_fence)
+            with tracer.span("train.device_step", model=cfg.model, step=i):
+                state, metrics = self.train_step(state, batch, rng)
             steps_since_log += 1
             if i == start_step and steps > 1:
                 # fence the first step out of the timing windows: it pays
@@ -608,10 +638,25 @@ class Trainer:
                 compile_s = now - t_last
                 t_last = now
                 steps_since_log = 0
+                # compile (or cache restore) is fenced out of throughput
+                # windows — mark the boundary so a trace shows exactly
+                # where steady state begins; reset the goodput window too
+                # (the fence's wall time is compile, not feeding)
+                tracer.event(
+                    "train.compile_fence", model=cfg.model, step=i + 1,
+                    compile_s=round(compile_s, 4),
+                )
+                w_start = now
+                overhead_s = 0.0
             if checkpoint_manager is not None and (
                 (i + 1) % cfg.checkpoint.interval_steps == 0
             ):
-                checkpoint_manager.save(i + 1, state)
+                t_ckpt = time.monotonic()
+                with tracer.span(
+                    "train.checkpoint_block", model=cfg.model, step=i + 1
+                ):
+                    checkpoint_manager.save(i + 1, state)
+                overhead_s += time.monotonic() - t_ckpt
             if (
                 stop_event is not None
                 and stop_event.is_set()
@@ -634,10 +679,14 @@ class Trainer:
                 is_last or (eval_every and (i + 1) % eval_every == 0)
             ):
                 t_eval = time.monotonic()
-                eval_metrics = self.evaluate(state, eval_data)
+                with tracer.span(
+                    "train.eval", model=cfg.model, step=i + 1
+                ):
+                    eval_metrics = self.evaluate(state, eval_data)
                 # eval wall time must not pollute train-step timing (the
                 # items_per_sec here is the job's headline benchmark number)
                 t_last += time.monotonic() - t_eval
+                overhead_s += time.monotonic() - t_eval
                 acc_gauge.set(eval_metrics["top1"], model=cfg.model)
                 log.info(
                     "step %d eval top1=%.4f loss=%.4f (%d examples)",
@@ -680,6 +729,31 @@ class Trainer:
                 step_hist.observe(dt, model=cfg.model)
                 thpt.set(items / dt, model=cfg.model)
                 aux = {k: float(v) for k, v in metrics.items() if k != "loss"}
+                # MFU: per-device step FLOPs (XLA cost model, computed once
+                # per trainer from the lowered program — no second compile)
+                # over the window's per-step wall over the per-chip peak.
+                # Deliberately NOT gated on the tracing knob: MFU is a
+                # metric, and metrics stay on when span recording is off.
+                # The one-time accounting cost (lowering + the CPU-fallback
+                # peak measurement) is fenced out of the NEXT window's
+                # timing exactly as eval wall time is.
+                t_acct = time.monotonic()
+                if self._step_flops is None:
+                    with set_mesh(self.mesh):
+                        self._step_flops = step_flops(
+                            self._train_step, state, batch, rng
+                        ) or 0.0
+                mfu_val = mfu_fraction(self._step_flops, dt)
+                if mfu_val is not None:
+                    mfu_gauge.set(mfu_val, model=cfg.model)
+                    aux["mfu"] = mfu_val
+                window_wall = now - w_start
+                gp = goodput_fraction(window_wall, overhead_s)
+                goodput_gauge.set(gp, model=cfg.model)
+                aux["goodput"] = gp
+                t_last += time.monotonic() - t_acct
+                w_start = time.monotonic()
+                overhead_s = 0.0
                 if compile_s:
                     # steady-state vs one-time cost, separated: items_per_sec
                     # above excludes the first (compile) step's wall time

@@ -498,6 +498,51 @@ def serving_verify_steps_counter() -> Counter:
     )
 
 
+# ---------------------------------------------------------------------------
+# Observability-derived metrics (kubeflow_tpu/observability/; docs/
+# OBSERVABILITY.md): per-phase request accounting on the serving path and
+# MFU/goodput accounting on the training path. One definition point — the
+# engine, the trainer and the bench all hit the same series.
+# ---------------------------------------------------------------------------
+
+
+def serving_phase_histogram() -> Histogram:
+    """Wall seconds per request phase (phase ∈ queue|prefill|decode): the
+    exact decomposition of a request's life — TTFT = queue + prefill, full
+    latency = TTFT + decode. Sliced per phase, queue growth means
+    admission pressure (scale out), prefill growth means prompt-length
+    drift, decode growth means slot crowding."""
+    return default_registry().histogram(
+        "serving_request_phase_seconds",
+        "wall seconds a request spent in each engine phase",
+        ["model", "phase"],
+        buckets=SERVING_TTFT_BUCKETS,
+    )
+
+
+def training_mfu_gauge() -> Gauge:
+    """Model-FLOPs utilization of the train step: XLA-cost-model FLOPs of
+    the compiled per-device step over step wall time over the per-chip
+    peak (kubeflow_tpu/observability/mfu.py — peak from env override,
+    the TPU spec table, or a measured matmul on unlisted hosts)."""
+    return default_registry().gauge(
+        "training_model_flops_utilization",
+        "train-step model-FLOPs utilization (achieved / per-chip peak)",
+        ["model"],
+    )
+
+
+def training_goodput_gauge() -> Gauge:
+    """Fraction of the training wall window spent feeding the device —
+    1 minus the host-side overhead share (input wait + checkpoint block
+    + eval) per logging window."""
+    return default_registry().gauge(
+        "training_goodput",
+        "fraction of training wall time not lost to host-side overheads",
+        ["model"],
+    )
+
+
 def start_heartbeat(
     gauge: Gauge, period_s: float = 10.0, stop_event: Optional[threading.Event] = None
 ) -> threading.Thread:

@@ -28,12 +28,15 @@ jax.config.update("jax_platforms", "cpu")
 if hasattr(jax.config, "jax_threefry_partitionable"):
     jax.config.update("jax_threefry_partitionable", True)
 
-# NOTE: do NOT point the whole suite at a persistent compile cache here.
-# Tried and reverted: this image's jaxlib (0.4.36) hard-aborts (Fatal
-# Python error) serializing some programs (test_augment's) into the
-# cache, which would take the entire tier down with it. The platform
-# knob stays opt-in per run (KFT_COMPILE_CACHE_DIR / compile_cache_dir;
-# covered by test_compile_cache.py against tmp dirs).
+# NOTE: the persistent compile cache below is ALLOWLISTED per module, not
+# suite-wide. Suite-wide was tried and reverted: this image's jaxlib
+# (0.4.36) intermittently segfaults (heap corruption, ~2/3 of fresh-cache
+# runs) serializing test_augment's programs into the cache, which would
+# take the entire tier down with it. The compile-heavy modules listed in
+# _COMPILE_CACHE_MODULES have been soak-tested against fresh cache dirs;
+# everything else runs with the cache actively DISABLED (the platform knob
+# stays opt-in per run otherwise: KFT_COMPILE_CACHE_DIR /
+# compile_cache_dir, covered by test_compile_cache.py against tmp dirs).
 
 import pytest  # noqa: E402
 
@@ -44,6 +47,71 @@ def pytest_configure(config):
         "slow: production-topology sweeps excluded from the tier-1 budget "
         "(run by the static-analysis CI workflow)",
     )
+
+
+# Modules whose XLA programs are safe to serialize on this jaxlib AND
+# whose compile cost dominates their runtime — the tier-1 time-budget
+# lever (ROADMAP "do this first"): warm runs restore the engine/trainer
+# programs from disk instead of re-paying the XLA compile. Keep this an
+# explicit allowlist: a module added here must survive several fresh-cache
+# runs (the serialization segfault is heap corruption — it can surface
+# ANYWHERE later in the process).
+# Soak data (this image, fresh cache → warm cache, wall seconds):
+#   test_engine 103→66, test_trainer 153→65, test_generate 89→51,
+#   test_pipeline 65→23, test_models 63→32, test_spec_decode ~flat.
+# Excluded on evidence: test_augment and test_checkpointing SEGFAULT
+# serializing their programs on this jaxlib; test_gpt shows no warm win
+# (execution-bound), so it does not earn the serialization risk.
+_COMPILE_CACHE_MODULES = frozenset({
+    "test_engine",
+    "test_spec_decode",
+    "test_generate",
+    "test_trainer",
+    "test_pipeline",
+    "test_models",
+    "test_observability",
+})
+
+# One persistent dir shared with bench.py's battery cache: the workspace
+# outlives test sessions, so tier-1 run N+1 (and CI re-runs) start warm.
+_CACHE_DIR = os.environ.get("KFT_TEST_COMPILE_CACHE_DIR", "") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _module_compile_cache(request):
+    """Point allowlisted compile-heavy modules at the persistent XLA
+    compile cache (KFT_COMPILE_CACHE_DIR, the same knob the platform
+    renders into pods), and keep it OFF everywhere else.
+
+    The env var is also exported for the module's duration so tests that
+    drive run_training/launcher in-process inherit the same dir; it is
+    removed again on teardown so subprocess-spawning modules (gang tests)
+    never leak it into children.
+    """
+    from kubeflow_tpu.runtime.train_run import (
+        ENV_COMPILE_CACHE_DIR,
+        configure_compile_cache,
+    )
+
+    name = request.module.__name__.rsplit(".", 1)[-1]
+    if name not in _COMPILE_CACHE_MODULES:
+        # actively disable: an allowlisted module that ran earlier left
+        # the process cache enabled, and a non-allowlisted module's
+        # programs must not be serialized (the segfault class)
+        os.environ.pop(ENV_COMPILE_CACHE_DIR, None)
+        configure_compile_cache(environ={})
+        yield
+        return
+    os.environ[ENV_COMPILE_CACHE_DIR] = _CACHE_DIR
+    enabled = configure_compile_cache(
+        environ={ENV_COMPILE_CACHE_DIR: _CACHE_DIR}
+    )
+    yield
+    os.environ.pop(ENV_COMPILE_CACHE_DIR, None)
+    if enabled:
+        configure_compile_cache(environ={})
 
 
 @pytest.fixture(scope="session")

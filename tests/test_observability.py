@@ -1,0 +1,570 @@
+"""kft-trace observability subsystem (kubeflow_tpu/observability/).
+
+The load-bearing contracts:
+- span records are CORRECT (nesting parents, cross-thread start/end,
+  trace-id propagation) and the ring buffer is bounded (wraparound drops
+  oldest, never blocks the hot path),
+- the Chrome trace export is schema-valid (Perfetto-loadable) and carries
+  the request trace ids in args,
+- a REST `:generate` round trip propagates X-Request-Id into the engine's
+  spans and decomposes TTFT exactly into queue + prefill,
+- a short Trainer.fit leaves the derived MFU/goodput metrics set,
+- the knobs flow ObservabilityConfig → controller-rendered KFT_TRACE_* →
+  serving/main.py and runtime/launcher.py.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.observability.trace import (
+    ENV_TRACE_BUFFER_SPANS,
+    ENV_TRACE_ENABLED,
+    ENV_TRACE_STATUSZ,
+    Tracer,
+    configure_from_env,
+    default_tracer,
+    knobs_from_env,
+)
+
+
+@pytest.fixture(autouse=True)
+def _restore_default_tracer():
+    """Tests toggle the process tracer — always restore it (other modules'
+    instrumented code paths depend on the default-on state)."""
+    tr = default_tracer()
+    st = tr.stats()
+    yield
+    tr.configure(enabled=st["enabled"], capacity=st["capacity"])
+
+
+class TestTracerCore:
+    def test_span_nesting_records_parent(self):
+        tr = Tracer(capacity=64)
+        with tr.span("outer"):
+            with tr.span("inner"):
+                pass
+        recs = {r.name: r for r in tr.snapshot()}
+        assert recs["inner"].parent == "outer"
+        assert recs["outer"].parent is None
+        # inner closed first: the ring holds it before outer
+        names = [r.name for r in tr.snapshot()]
+        assert names == ["inner", "outer"]
+
+    def test_nested_span_inherits_trace_id(self):
+        tr = Tracer(capacity=16)
+        with tr.span("outer", trace_id="rid-1"):
+            with tr.span("inner"):
+                pass
+        recs = {r.name: r for r in tr.snapshot()}
+        assert recs["inner"].trace_id == "rid-1"
+
+    def test_trace_context_sets_thread_trace_id(self):
+        tr = Tracer(capacity=16)
+        with tr.trace_context("ctx-9"):
+            with tr.span("a"):
+                pass
+            tr.event("b")
+        assert tr.current_trace_id() is None
+        assert all(r.trace_id == "ctx-9" for r in tr.snapshot())
+
+    def test_ring_buffer_wraparound_drops_oldest(self):
+        tr = Tracer(capacity=8)
+        for i in range(20):
+            tr.event(f"e{i}")
+        st = tr.stats()
+        assert st["buffered"] == 8
+        assert st["dropped"] == 12
+        names = [r.name for r in tr.snapshot()]
+        assert names == [f"e{i}" for i in range(12, 20)]
+
+    def test_cross_thread_span_keeps_start_thread_track(self):
+        tr = Tracer(capacity=16)
+        sp = tr.start_span("xthread", trace_id="rid-7")
+        done = threading.Event()
+
+        def worker():
+            time.sleep(0.01)
+            sp.end(tokens=3)
+            done.set()
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        assert done.wait(5)
+        t.join(5)
+        (rec,) = tr.snapshot()
+        assert rec.name == "xthread"
+        assert rec.trace_id == "rid-7"
+        assert rec.tid == threading.main_thread().ident
+        assert rec.dur_s >= 0.01
+        assert rec.attrs["tokens"] == 3
+
+    def test_double_end_records_once(self):
+        tr = Tracer(capacity=16)
+        sp = tr.start_span("once")
+        sp.end()
+        sp.end()
+        assert len(tr.snapshot()) == 1
+
+    def test_disabled_tracer_is_noop(self):
+        tr = Tracer(capacity=16, enabled=False)
+        with tr.span("s", model="m"):
+            pass
+        tr.event("e")
+        sp = tr.start_span("x")
+        sp.end()
+        assert tr.snapshot() == []
+
+    def test_configure_capacity_preserves_recent(self):
+        tr = Tracer(capacity=16)
+        for i in range(10):
+            tr.event(f"e{i}")
+        tr.configure(capacity=4)
+        names = [r.name for r in tr.snapshot()]
+        assert names == ["e6", "e7", "e8", "e9"]
+
+    def test_span_exception_still_records(self):
+        tr = Tracer(capacity=16)
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("x")
+        assert [r.name for r in tr.snapshot()] == ["boom"]
+
+
+class TestChromeExport:
+    def _assert_valid_chrome_trace(self, doc):
+        assert isinstance(doc["traceEvents"], list)
+        for e in doc["traceEvents"]:
+            assert {"name", "ph", "pid", "tid"} <= set(e)
+            if e["ph"] != "M":
+                assert isinstance(e["ts"], (int, float))
+            if e["ph"] == "X":
+                assert isinstance(e["dur"], (int, float))
+
+    def test_chrome_trace_schema_and_roundtrip(self):
+        tr = Tracer(capacity=64)
+        with tr.span("outer", trace_id="rid-1", bucket=8):
+            with tr.span("inner"):
+                pass
+        tr.event("mark", value=1)
+        doc = json.loads(tr.chrome_trace_json())
+        self._assert_valid_chrome_trace(doc)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"outer", "inner"}
+        assert any(
+            e["args"].get("trace_id") == "rid-1" for e in xs
+        )
+        # thread metadata track present, instants marked thread-scoped
+        assert any(e["ph"] == "M" for e in doc["traceEvents"])
+        (inst,) = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+        assert inst["s"] == "t"
+        # events sorted by timestamp (metadata first)
+        body = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+        assert body == sorted(body, key=lambda e: e["ts"])
+
+    def test_span_attrs_land_in_args(self):
+        tr = Tracer(capacity=8)
+        with tr.span("s", model="m", slot=3):
+            pass
+        (ev,) = [
+            e for e in tr.chrome_trace()["traceEvents"] if e["ph"] == "X"
+        ]
+        assert ev["args"]["model"] == "m"
+        assert ev["args"]["slot"] == 3
+
+
+@pytest.fixture(scope="module")
+def gpt_and_params():
+    from kubeflow_tpu.models import get_model
+
+    model = get_model("gpt_tiny", dtype=jnp.float32)
+    prompt = jnp.arange(6)[None, :].astype(jnp.int32) % 512
+    params = model.init(
+        jax.random.PRNGKey(0), prompt, deterministic=True
+    )["params"]
+    return model, params
+
+
+class TestEngineTracing:
+    def _server_with_engine(self, gpt_and_params, **engine_kw):
+        from kubeflow_tpu.serving.engine import DecodeEngine
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model, params = gpt_and_params
+        engine = DecodeEngine(
+            "g", model, params, num_slots=2, max_queue=16, **engine_kw
+        )
+        server = ModelServer()
+        server.add_engine(engine)
+        return server, engine
+
+    def test_request_id_propagates_through_rest_roundtrip(
+        self, gpt_and_params
+    ):
+        tracer = default_tracer()
+        tracer.clear()
+        server, engine = self._server_with_engine(gpt_and_params)
+        try:
+            status, body, headers = server.app.handle_full(
+                "POST",
+                "/v1/models/g:generate",
+                {"prompt_ids": [[1, 2, 3]], "max_new_tokens": 4},
+                headers={"X-Request-Id": "client-abc"},
+            )
+            assert status == 200, body
+            hdrs = dict(headers)
+            assert hdrs["X-Request-Id"] == "client-abc"
+            # row 0 of the request: spans tagged client-abc/0
+            deadline = time.monotonic() + 10
+            names = set()
+            while time.monotonic() < deadline:
+                names = {
+                    r.name
+                    for r in tracer.snapshot()
+                    if r.trace_id == "client-abc/0"
+                }
+                if "request.retire" in names:
+                    break
+                time.sleep(0.02)
+            assert {
+                "request.queue_wait",
+                "request.prefill",
+                "request.decode",
+                "request.retire",
+            } <= names
+        finally:
+            engine.close()
+
+    def test_ttft_decomposes_into_queue_plus_prefill(self, gpt_and_params):
+        server, engine = self._server_with_engine(gpt_and_params)
+        try:
+            out = engine.generate_row([1, 2, 3, 4], 3, timeout=120.0)
+            state = engine.debug_state()
+            (recent,) = [
+                r for r in state["recent"] if r["tokens"] == 3
+            ]
+            assert recent["queue_s"] + recent["prefill_s"] == pytest.approx(
+                recent["ttft_s"], abs=1e-6
+            )
+            assert recent["ttft_s"] == pytest.approx(
+                out["ttft_s"], abs=1e-6
+            )
+        finally:
+            engine.close()
+
+    def test_generated_request_id_when_header_absent(self, gpt_and_params):
+        server, engine = self._server_with_engine(gpt_and_params)
+        try:
+            status, _, headers = server.app.handle_full(
+                "POST",
+                "/v1/models/g:generate",
+                {"prompt_ids": [[5, 6]], "max_new_tokens": 2},
+            )
+            assert status == 200
+            rid = dict(headers).get("X-Request-Id")
+            assert rid  # server minted one and told the client
+        finally:
+            engine.close()
+
+    def test_debug_trace_endpoint_filters_by_trace_id(self, gpt_and_params):
+        tracer = default_tracer()
+        tracer.clear()
+        server, engine = self._server_with_engine(gpt_and_params)
+        try:
+            for rid in ("r1", "r2"):
+                status, _, _ = server.app.handle_full(
+                    "POST",
+                    "/v1/models/g:generate",
+                    {"prompt_ids": [[7, 8, 9]], "max_new_tokens": 2},
+                    headers={"X-Request-Id": rid},
+                )
+                assert status == 200
+            status, resp, _ = server.app.handle_full(
+                "GET", "/debug/trace", query={"trace_id": "r1/0"}
+            )
+            assert status == 200
+            doc = json.loads(resp.body)
+            TestChromeExport()._assert_valid_chrome_trace(doc)
+            body = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+            assert body, "filtered dump empty"
+            assert all(
+                e["args"].get("trace_id") == "r1/0" for e in body
+            )
+            # the id the CLIENT sent (echoed in X-Request-Id) selects its
+            # whole request via the per-row children — never nothing
+            status, resp, _ = server.app.handle_full(
+                "GET", "/debug/trace", query={"trace_id": "r1"}
+            )
+            doc = json.loads(resp.body)
+            whole = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+            assert whole, "bare request id matched no spans"
+            assert {e["args"]["trace_id"] for e in whole} == {"r1/0"}
+        finally:
+            engine.close()
+
+    def test_statusz_renders_engine_and_phases(self, gpt_and_params):
+        server, engine = self._server_with_engine(gpt_and_params)
+        try:
+            engine.generate_row([1, 2, 3], 2, timeout=120.0)
+            status, resp, _ = server.app.handle_full("GET", "/statusz")
+            assert status == 200
+            text = resp.body.decode()
+            assert "[engines]" in text
+            assert "g:" in text
+            assert "queue=" in text and "prefill=" in text
+            status, resp, _ = server.app.handle_full("GET", "/metrics")
+            assert status == 200
+            assert "serving_request_phase_seconds" in resp.body.decode()
+        finally:
+            engine.close()
+
+    def test_statusz_disabled_leaves_model_surface_only(self):
+        from kubeflow_tpu.serving.server import ModelServer
+
+        server = ModelServer(statusz_enabled=False)
+        status, _, _ = server.app.handle_full("GET", "/statusz")
+        assert status == 404
+        status, _, _ = server.app.handle_full("GET", "/debug/trace")
+        assert status == 404
+
+    def test_tracing_off_records_nothing_and_engine_still_serves(
+        self, gpt_and_params
+    ):
+        tracer = default_tracer()
+        tracer.configure(enabled=False)
+        tracer.clear()
+        server, engine = self._server_with_engine(gpt_and_params)
+        try:
+            out = engine.generate_row([1, 2, 3], 3, timeout=120.0)
+            assert len(out["tokens"]) == 3
+            assert tracer.snapshot() == []
+        finally:
+            engine.close()
+
+
+class TestTrainerObservability:
+    def _fit(self, trace_enabled=True, steps=3):
+        from kubeflow_tpu.config.platform import (
+            MeshConfig,
+            ObservabilityConfig,
+            TrainingConfig,
+        )
+        from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = TrainingConfig(
+            model="mlp",
+            global_batch_size=8,
+            steps=steps,
+            dtype="float32",
+            mesh=MeshConfig(data=2),
+            observability=ObservabilityConfig(trace_enabled=trace_enabled),
+        )
+        mesh = build_mesh(
+            MeshSpec.from_config(cfg.mesh), devices=jax.devices()[:2]
+        )
+        trainer = Trainer(cfg, mesh=mesh)
+        return trainer.fit(steps=steps, log_every=steps)
+
+    def test_mfu_and_goodput_present_after_short_fit(self):
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        metrics = self._fit()
+        assert "mfu" in metrics.aux
+        assert metrics.aux["mfu"] > 0.0
+        assert 0.0 <= metrics.aux["goodput"] <= 1.0
+        reg = default_registry()
+        gauge = reg.get("training_model_flops_utilization")
+        assert gauge is not None
+        assert gauge.value(model="mlp") == pytest.approx(
+            metrics.aux["mfu"]
+        )
+        assert reg.get("training_goodput") is not None
+        # the gauges ride the existing /metrics renderer
+        assert "training_model_flops_utilization" in reg.render()
+
+    def test_step_spans_and_compile_fence_recorded(self):
+        tracer = default_tracer()
+        tracer.clear()
+        self._fit()
+        names = {r.name for r in tracer.snapshot()}
+        assert {"train.host_wait", "train.device_step"} <= names
+        fences = [
+            r for r in tracer.snapshot()
+            if r.name == "train.compile_fence"
+        ]
+        assert fences and fences[0].attrs["compile_s"] > 0
+
+    def test_peak_flops_env_override(self, monkeypatch):
+        from kubeflow_tpu.observability.mfu import peak_flops_per_chip
+
+        monkeypatch.setenv("KFT_PEAK_FLOPS_PER_CHIP", "1e12")
+        assert peak_flops_per_chip() == 1e12
+
+    def test_mfu_helper_handles_unknowns(self):
+        from kubeflow_tpu.observability.mfu import goodput, mfu
+
+        assert mfu(None, 0.1, peak=1e12) is None
+        assert mfu(0.0, 0.1, peak=1e12) is None
+        assert mfu(1e9, 0.0, peak=1e12) is None
+        assert mfu(1e9, 1.0, peak=1e12) == pytest.approx(1e-3)
+        assert goodput(0.0, 0.0) == 0.0
+        assert goodput(10.0, 1.0) == pytest.approx(0.9)
+        assert goodput(1.0, 5.0) == 0.0  # clamped
+
+
+class TestKnobFlow:
+    def test_knobs_from_env_defaults_and_parsing(self):
+        assert knobs_from_env({}) == {
+            "trace_enabled": True,
+            "trace_buffer_spans": 4096,
+            "statusz_enabled": True,
+        }
+        knobs = knobs_from_env(
+            {
+                ENV_TRACE_ENABLED: "0",
+                ENV_TRACE_BUFFER_SPANS: "128",
+                ENV_TRACE_STATUSZ: "0",
+            }
+        )
+        assert knobs == {
+            "trace_enabled": False,
+            "trace_buffer_spans": 128,
+            "statusz_enabled": False,
+        }
+
+    def test_configure_from_env_applies_to_default_tracer(self):
+        configure_from_env(
+            {ENV_TRACE_ENABLED: "0", ENV_TRACE_BUFFER_SPANS: "64"}
+        )
+        st = default_tracer().stats()
+        assert st["enabled"] is False
+        assert st["capacity"] == 64
+
+    def test_observability_config_validates(self):
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import ObservabilityConfig
+
+        with pytest.raises(ConfigError):
+            ObservabilityConfig(trace_buffer_spans=0).validate()
+
+    def test_inference_controller_renders_trace_env(self):
+        from kubeflow_tpu.controllers.inference import (
+            InferenceServiceController,
+        )
+
+        ctrl = InferenceServiceController()
+        env = ctrl._serving_env({})
+        assert env["KFT_TRACE_ENABLED"] == "1"
+        assert env["KFT_TRACE_BUFFER_SPANS"] == "4096"
+        assert env["KFT_TRACE_STATUSZ"] == "1"
+        # per-CR override of ONE knob keeps the others at defaults
+        env = ctrl._serving_env(
+            {"serving": {"observability": {"trace_buffer_spans": 99}}}
+        )
+        assert env["KFT_TRACE_BUFFER_SPANS"] == "99"
+        assert env["KFT_TRACE_ENABLED"] == "1"
+
+    def test_tpujob_controller_renders_trace_env(self):
+        from kubeflow_tpu.cluster.reconciler import ControllerManager
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.tpujob import (
+            TPUTrainJobController,
+            new_tpu_train_job,
+        )
+        from kubeflow_tpu.runtime.executor import pod_env
+
+        store = StateStore()
+        cm = ControllerManager(store)
+        cm.register(TPUTrainJobController())
+        store.create(
+            new_tpu_train_job(
+                "obs1",
+                training={
+                    "model": "mlp",
+                    "global_batch_size": 8,
+                    "steps": 1,
+                    "mesh": {"data": 4},
+                    "checkpoint": {"enabled": False},
+                    "observability": {"trace_buffer_spans": 256},
+                },
+                slice_spec={"topology": "v5e-4"},
+            )
+        )
+        cm.run_until_idle(max_seconds=5)
+        (pod,) = store.list("Pod", "default")
+        env = pod_env(pod)
+        assert env["KFT_TRACE_ENABLED"] == "1"
+        assert env["KFT_TRACE_BUFFER_SPANS"] == "256"
+        assert env["KFT_TRACE_STATUSZ"] == "1"
+        assert env["KFT_DEBUG_PORT"]  # statusz on → debug server rendered
+
+    def test_tpujob_statusz_off_renders_no_debug_port(self):
+        from kubeflow_tpu.cluster.reconciler import ControllerManager
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.tpujob import (
+            TPUTrainJobController,
+            new_tpu_train_job,
+        )
+        from kubeflow_tpu.runtime.executor import pod_env
+
+        store = StateStore()
+        cm = ControllerManager(store)
+        cm.register(TPUTrainJobController())
+        store.create(
+            new_tpu_train_job(
+                "obs2",
+                training={
+                    "model": "mlp",
+                    "global_batch_size": 8,
+                    "steps": 1,
+                    "mesh": {"data": 4},
+                    "checkpoint": {"enabled": False},
+                    "observability": {"statusz_enabled": False},
+                },
+                slice_spec={"topology": "v5e-4"},
+            )
+        )
+        cm.run_until_idle(max_seconds=5)
+        (pod,) = store.list("Pod", "default")
+        env = pod_env(pod)
+        assert env["KFT_TRACE_STATUSZ"] == "0"
+        assert "KFT_DEBUG_PORT" not in env
+
+    def test_debug_server_starts_from_env_and_serves(self):
+        import urllib.request
+
+        from kubeflow_tpu.runtime.launcher import maybe_start_debug_server
+
+        server = maybe_start_debug_server({"KFT_DEBUG_PORT": "0"})
+        try:
+            assert server is not None
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/statusz", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                assert b"kft-trace" in resp.read()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/trace", timeout=10
+            ) as resp:
+                doc = json.loads(resp.read())
+                assert "traceEvents" in doc
+        finally:
+            if server is not None:
+                server.stop()
+
+    def test_debug_server_skips_non_coordinator_and_unset(self):
+        from kubeflow_tpu.runtime.launcher import maybe_start_debug_server
+
+        assert maybe_start_debug_server({}) is None
+        assert (
+            maybe_start_debug_server(
+                {"KFT_DEBUG_PORT": "0", "KFT_PROCESS_ID": "1"}
+            )
+            is None
+        )

@@ -216,6 +216,12 @@ class TestInferenceServiceController:
             "KFT_SERVING_DRAFT_MODEL": "",  # speculation off by default
             "KFT_SERVING_DRAFT_TOKENS": "0",
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": "",
+            # kft-trace contract (observability defaults: tracing on,
+            # docs/OBSERVABILITY.md; knob-flow coverage lives in
+            # tests/test_observability.py)
+            "KFT_TRACE_ENABLED": "1",
+            "KFT_TRACE_BUFFER_SPANS": "4096",
+            "KFT_TRACE_STATUSZ": "1",
         }
 
     def test_invalid_spec_serving_rejected(self):
