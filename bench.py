@@ -54,6 +54,21 @@ REFERENCE_V100_IMAGES_PER_SEC = 341.0
 # parent-process rule below holds).
 from kubeflow_tpu.observability.mfu import chip_peaks as _chip_peaks  # noqa: E402
 
+# Serving-engine geometry for bench_serving_continuous: the shared plan
+# registry (also consumed by serving/main.py's knob defaults and swept by
+# kft-analyze's serving lint — the bench engines and the analyzed plans
+# are the same tuples by construction; jax-free import).
+from kubeflow_tpu.analysis.serving_plans import (  # noqa: E402
+    BENCH_DRAFT_LAYERS,
+    BENCH_MAX_LEN,
+    BENCH_NUM_DRAFT_TOKENS,
+    BENCH_PREFILL_BUCKETS,
+    BENCH_PROMPT_LENS,
+    BENCH_SPEC_VOCAB,
+    DEFAULT_NUM_SLOTS,
+    bench_serving_plans as _bench_serving_plans,
+)
+
 
 def _cost_analysis(jitted, *args):
     """{flops, bytes} for a compiled step, via XLA's cost model."""
@@ -787,7 +802,8 @@ def bench_serving_generate(
         srv.stop()
 
 
-def _spec_pair(max_len: int, vocab: int = 2048, draft_layers: int = 2,
+def _spec_pair(max_len: int, vocab: int = BENCH_SPEC_VOCAB,
+               draft_layers: int = BENCH_DRAFT_LAYERS,
                decay: float = 0.2):
     """Target + shallow self-draft for the speculative-decoding phases.
 
@@ -851,9 +867,9 @@ def _spec_pair(max_len: int, vocab: int = 2048, draft_layers: int = 2,
 def bench_serving_continuous(
     num_requests: int = 10,
     mean_interarrival_ms: float = 25.0,
-    num_slots: int = 8,
+    num_slots: int = DEFAULT_NUM_SLOTS,
     new_tokens: int = 16,
-    num_draft_tokens: int = 4,
+    num_draft_tokens: int = BENCH_NUM_DRAFT_TOKENS,
 ) -> dict:
     """Open-loop Poisson-arrival load against the REST `:generate` path:
     the continuous-batching DecodeEngine (serving/engine.py) vs the static
@@ -889,10 +905,13 @@ def bench_serving_continuous(
     # through every phase), not the measurement method — the per-phase
     # ratios stay comparable, the entry always finishes inside its cap
     num_requests = _budget_scaled(num_requests, sized_for_s=480, floor=4)
-    max_len = 64  # largest prompt bucket (32) + new_tokens + slack
+    # engine geometry from the shared serving plan registry (the same
+    # tuples kft-analyze's serving lint sweeps): largest prompt bucket
+    # (32) + new_tokens + slack, ragged prompts over 3 buckets
+    max_len = BENCH_MAX_LEN
     model, params = _gpt_small_with_params(max_len)
-    buckets = [8, 16, 32]
-    prompt_lens = [8, 12, 24]  # ragged; 3 static programs, 3 buckets
+    buckets = list(BENCH_PREFILL_BUCKETS)
+    prompt_lens = list(BENCH_PROMPT_LENS)
     lm = ServedLm("gpt_static", model, params, max_batch=8)
     engine = DecodeEngine(
         "gpt_engine", model, params, num_slots=num_slots,

@@ -1,19 +1,26 @@
 """kft-analyze — the platform static-analysis subsystem.
 
-Two analyzer families behind one finding/severity/baseline model and one
-CLI (`python -m kubeflow_tpu.analysis`; catalog in docs/ANALYSIS.md):
+Three analyzer families behind one finding/severity/baseline model and
+one CLI (`python -m kubeflow_tpu.analysis`; catalog in docs/ANALYSIS.md):
 
 - SPMD program lint (analysis/spmd.py): abstract-lower every dryrun plan
   and shipped YAML config to jaxpr+StableHLO on virtual CPU devices and
   flag replicate-then-reshard compiles, large fully-replicated params,
-  DCN-axis collectives in the scanned train body.
+  DCN-axis collectives in the scanned train body — plus the static HBM
+  budget (analysis/memory.py) for plans that declare a topology.
+- Serving-program lint (analysis/serving.py over the shipped plan
+  registry analysis/serving_plans.py): the decode engine's jitted
+  program family, abstractly lowered — donation really aliases in the
+  HLO, the program set is exactly the declared bucket ladder, no host
+  transfers in the per-token path, KV-cache dtype discipline, and the
+  engine's resident bytes vs per-chip HBM.
 - Control-plane invariant lint (analysis/control_plane.py,
   analysis/consistency.py): lock discipline, thread hygiene, the single
   audited `check_vma` exception, metric-registry consistency, config-knob
   and KFT_* env reachability.
 
-Importing this package is jax-free; the SPMD passes import jax lazily in
-their own subprocesses.
+Importing this package is jax-free; the program passes import jax lazily
+in their own subprocesses.
 """
 
 from kubeflow_tpu.analysis.findings import (

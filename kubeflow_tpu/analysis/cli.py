@@ -62,9 +62,18 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--plans",
-        choices=("dryrun", "configs", "all"),
+        choices=("dryrun", "configs", "train", "serving", "all"),
         default="all",
-        help="which SPMD plan families to analyze",
+        help="which plan families to analyze: dryrun / configs "
+        "(train = both), serving (the decode-engine program lint over "
+        "the shipped serving plan registry), or all",
+    )
+    ap.add_argument(
+        "--list-ignores",
+        action="store_true",
+        help="inventory every inline `# kft-analyze: ignore[rule]` with "
+        "file:line and rule, then exit 0 (the repo ships with zero; "
+        "tests/test_analysis.py enforces it)",
     )
     ap.add_argument(
         "--devices", type=int, default=8,
@@ -93,13 +102,33 @@ def main(argv=None) -> int:
     findings: List[Finding] = []
     stats = []
 
+    if args.list_ignores:
+        sources = SourceSet(root)
+        rows = sources.suppression_inventory()
+        if args.format == "json":
+            print(json.dumps([
+                {"location": f"{p}:{ln}", "rule": rule}
+                for p, ln, rule in rows
+            ], indent=1))
+        else:
+            for p, ln, rule in rows:
+                print(f"{p}:{ln}: ignore[{rule}]")
+            print(f"kft-analyze: {len(rows)} inline ignore(s)")
+        return 0
+
     if args.ast == "on":
         from kubeflow_tpu.analysis.consistency import run_consistency
         from kubeflow_tpu.analysis.control_plane import run_control_plane
+        from kubeflow_tpu.analysis.serving import (
+            check_hot_loop_host_transfer,
+        )
 
         sources = SourceSet(root)
         findings.extend(run_control_plane(sources))
         findings.extend(run_consistency(sources))
+        # the AST half of serve-host-transfer (the scheduler hot loop);
+        # the jaxpr half rides the per-plan serving sweep below
+        findings.extend(check_hot_loop_host_transfer(sources))
 
     if args.spmd != "off":
         from kubeflow_tpu.analysis.plans import (
@@ -117,11 +146,11 @@ def main(argv=None) -> int:
             else DEFAULT_PARAM_THRESHOLD
         )
         specs = []
-        if args.plans in ("dryrun", "all"):
+        if args.plans in ("dryrun", "train", "all"):
             specs += dryrun_plan_specs(
                 args.devices, compile=args.spmd == "full"
             )
-        if args.plans in ("configs", "all"):
+        if args.plans in ("configs", "train", "all"):
             specs += yaml_plan_specs(root)
         for spec in specs:
             print(
@@ -135,6 +164,35 @@ def main(argv=None) -> int:
                 spec, root,
                 timeout_s=args.plan_timeout,
                 param_threshold=threshold,
+            )
+            findings.extend(fs)
+            stats.append(st)
+
+    if args.spmd != "off" and args.plans in ("serving", "all"):
+        from kubeflow_tpu.analysis.serving import (
+            analyze_serving_plan_subprocess,
+        )
+        from kubeflow_tpu.analysis.serving_plans import (
+            shipped_serving_plans,
+        )
+
+        import dataclasses
+
+        for sspec in shipped_serving_plans():
+            if args.spmd == "lower":
+                # --spmd lower means NO XLA compiles anywhere: strip the
+                # per-plan compile flag (loses the step-temp HBM term;
+                # params+cache budgeting still runs)
+                sspec = dataclasses.replace(sspec, compile=False)
+            print(
+                f"kft-analyze: serving plan {sspec.name} "
+                f"(slots={sspec.num_slots}, K={sspec.num_draft_tokens}"
+                f"{', compile' if sspec.compile else ', lower-only'})...",
+                file=sys.stderr,
+                flush=True,
+            )
+            fs, st = analyze_serving_plan_subprocess(
+                sspec, root, timeout_s=args.plan_timeout
             )
             findings.extend(fs)
             stats.append(st)

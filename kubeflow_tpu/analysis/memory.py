@@ -1,0 +1,154 @@
+"""Static HBM budget (`mem-budget`) — does a shipped plan FIT its chips?
+
+Every other pass asks "is the program well-formed"; this one asks the
+question that actually pages an operator: do the plan's resident bytes —
+parameters, optimizer state, the engine's resident slot KV cache(s), and
+(when the plan compiles) XLA's own temp allocation from
+`compiled.memory_analysis()` — fit the per-chip HBM of the topology the
+plan declares? The capacity table lives beside the MFU/bandwidth spec
+table in observability/mfu.py (one spec sheet, three consumers);
+`KFT_HBM_BYTES_PER_CHIP` overrides it for hardware not in the table.
+
+Accounting is deliberately conservative-but-honest:
+
+- Sharded leaves count at `nbytes / prod(mesh axis sizes in their
+  PartitionSpec)` — per-chip bytes under the plan's real mesh; a fully
+  replicated leaf counts whole on every chip (which is exactly why
+  replicated optimizer state is the quiet HBM ceiling).
+- Lower-only plans carry NO temp estimate (stats record that), so a
+  lower-only pass failing is definitive while a lower-only pass at 89 %
+  of budget is not a fit guarantee — hence the headroom factor.
+- XLA temps measured on the CPU backend are a proxy for TPU temps (same
+  caveat as mfu.py's measured-matmul fallback: weaker than a spec sheet,
+  stronger than hardcoding zero).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.analysis.findings import Finding, Severity
+from kubeflow_tpu.observability.mfu import chip_hbm_bytes
+
+ENV_HBM_BYTES = "KFT_HBM_BYTES_PER_CHIP"
+
+# Fraction of physical HBM a plan may claim: the runtime itself needs
+# headroom (XLA's preallocation slack, host transfers staging, the
+# fragmentation a static sum cannot see).
+DEFAULT_HEADROOM = 0.90
+
+
+def hbm_bytes_per_chip(device_kind: str) -> Optional[float]:
+    """The budget denominator: env override wins, else the spec table
+    keyed by device-kind substring; None = unknown hardware (the pass
+    skips rather than inventing a ceiling)."""
+    raw = os.environ.get(ENV_HBM_BYTES, "").strip()
+    if raw:
+        return float(raw)
+    return chip_hbm_bytes(device_kind)
+
+
+def _leaf_nbytes(leaf) -> int:
+    import numpy as np
+
+    nelems = math.prod(leaf.shape) if leaf.shape else 1
+    return nelems * np.dtype(leaf.dtype).itemsize
+
+
+def tree_bytes(shapes) -> int:
+    """Total bytes of a ShapeDtypeStruct (or array) pytree, unsharded."""
+    import jax
+
+    return sum(_leaf_nbytes(x) for x in jax.tree_util.tree_leaves(shapes))
+
+
+def _spec_shards(spec, mesh_axis_sizes: Dict[str, int]) -> int:
+    """How many ways a PartitionSpec splits one leaf on this mesh."""
+    if spec is None:
+        return 1
+    shards = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (list, tuple)) else (entry,)
+        for a in axes:
+            shards *= mesh_axis_sizes.get(a, 1)
+    return max(1, shards)
+
+
+def sharded_tree_bytes(
+    shapes, shardings, mesh_axis_sizes: Dict[str, int]
+) -> int:
+    """Per-chip bytes of a sharded pytree: each leaf's bytes divided by
+    its PartitionSpec's shard count. `shardings` mirrors `shapes`
+    (NamedSharding leaves, the abstract_state contract)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(shapes)
+    spec_leaves = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: hasattr(x, "spec")
+    )
+    if len(leaves) != len(spec_leaves):
+        # a silent zip truncation here would UNDERCOUNT per-chip bytes —
+        # the exact false negative the mem-budget pass exists to prevent;
+        # fail loudly (the subprocess surfaces it as an analysis-error
+        # finding) instead
+        raise ValueError(
+            f"shapes/shardings leaf mismatch: {len(leaves)} state leaves "
+            f"vs {len(spec_leaves)} sharding leaves — the trees must "
+            f"mirror (Trainer.abstract_state contract)"
+        )
+    total = 0
+    for leaf, sharding in zip(leaves, spec_leaves):
+        spec = getattr(sharding, "spec", sharding)
+        total += _leaf_nbytes(leaf) // _spec_shards(spec, mesh_axis_sizes)
+    return total
+
+
+def _fmt_bytes(n: float) -> str:
+    if n >= 1 << 30:
+        return f"{n / (1 << 30):.2f} GiB"
+    return f"{n / (1 << 20):.1f} MiB"
+
+
+def check_mem_budget(
+    plan_name: str,
+    components: Dict[str, int],
+    budget_bytes: Optional[float],
+    device_kind: str = "",
+    headroom: float = DEFAULT_HEADROOM,
+) -> List[Finding]:
+    """One finding when the component sum exceeds headroom x budget.
+    `components` maps a human label ("params", "kv slot cache", "xla
+    temp (step)") to bytes; the message itemizes them so the finding is
+    actionable without re-running the analyzer."""
+    if budget_bytes is None or budget_bytes <= 0:
+        return []
+    total = sum(components.values())
+    ceiling = headroom * budget_bytes
+    if total <= ceiling:
+        return []
+    breakdown = ", ".join(
+        f"{k}={_fmt_bytes(v)}" for k, v in sorted(
+            components.items(), key=lambda kv: -kv[1]
+        )
+    )
+    return [
+        Finding(
+            analyzer="mem-budget",
+            severity=Severity.ERROR,
+            location=f"plan:{plan_name}",
+            symbol="hbm-over-budget",
+            message=(
+                f"static HBM footprint {_fmt_bytes(total)} exceeds "
+                f"{headroom:.0%} of the {_fmt_bytes(budget_bytes)} "
+                f"per-chip HBM"
+                + (f" of {device_kind}" if device_kind else "")
+                + f" ({breakdown}) — this plan cannot fit its declared "
+                f"topology; shard the state, shrink the resident cache, "
+                f"or declare bigger chips"
+            ),
+        )
+    ]

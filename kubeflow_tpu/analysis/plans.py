@@ -77,6 +77,8 @@ class PlanSpec:
     task_family: str = ""             # "mlm" | "causal_lm" | "" (per model)
     seq_len: int = 0                  # tiny-task override (dryrun plans)
     vocab_size: int = 0
+    device_kind: str = ""             # mem-budget HBM table key ("" skips:
+    #                                   dryrun plans have no real chips)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -178,6 +180,9 @@ def yaml_plan_specs(
                 n_devices=slice_cfg.total_chips,
                 num_slices=slice_cfg.num_slices,
                 compile=compile,
+                # "v5e-16" -> "v5e": the per-chip HBM budget the plan's
+                # state must fit (analysis/memory.py mem-budget)
+                device_kind=slice_cfg.topology.split("-")[0],
             )
         )
     return specs

@@ -1,0 +1,628 @@
+"""Serving-program lint: abstract-lower the decode engine's program set.
+
+The DecodeEngine (serving/engine.py) is the platform's perf centerpiece —
+six jitted programs, donation-dependent HBM accounting, a bucketed
+executable set — and none of its invariants were machine-checked before
+this pass: an undonated resident cache (2x cache HBM, caught by hand in
+the PR 4 review) or an unbounded prefill-bucket set would ship silently.
+Every shipped serving plan (analysis/serving_plans.py — the same registry
+serving/main.py and bench.py consume) is traced/lowered in a subprocess
+on virtual CPU devices via the ENGINE'S OWN `EnginePrograms` object, so
+the lint checks the programs the scheduler actually dispatches:
+
+- **serve-donation**: every buffer a program's `donate_argnums` declares
+  must show REAL input->output aliasing in the lowered HLO
+  (`tf.aliasing_output` on the main-function argument). A donation whose
+  shape/dtype no output matches is silently dropped at lowering — the
+  Python-side declaration alone proves nothing.
+- **serve-program-count**: the enumerated jit signature set is exactly
+  the declared bucket set plus one insert/step (and the draft family at
+  K>0) — no shape-jitter recompile mints; the shared `bucket_for` routes
+  every admissible prompt length into the declared set.
+- **serve-host-transfer**: jaxpr half — no host callback/infeed/outfeed
+  primitive inside any engine program; AST half
+  (`check_hot_loop_host_transfer`, runs with the control-plane lints) —
+  no `device_get`/numpy coercion/`.item()` inside a loop in the
+  scheduler's per-token methods (`_iterate*`): one batched transfer per
+  iteration is the contract, one sync per SLOT is the regression.
+- **serve-dtype**: KV-cache dtype discipline — cache leaves leave a
+  program with the dtype they entered (no silent bf16->f32 upcast
+  across a step), and are never wider than the model's weight dtype.
+  The gate the int8-KV roadmap item will extend.
+- **mem-budget** (analysis/memory.py): params + resident slot cache(s)
+  (+ XLA temp allocation when the plan compiles) vs the declared chip's
+  HBM.
+
+The existing SPMD passes (`spmd-dcn-collective`, `spmd-replicated-param`)
+run over the same jaxprs/params: inert while the engine is single-chip,
+already in place for the sharded-serving rung.
+
+Run one plan per subprocess (`python -m kubeflow_tpu.analysis.serving`)
+so a partitioner crash surfaces as a finding, not a dead CLI.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from kubeflow_tpu.analysis.findings import Finding, Severity
+from kubeflow_tpu.analysis.serving_plans import ServingPlanSpec
+from kubeflow_tpu.analysis.sources import (
+    SourceSet,
+    call_name,
+    walk_with_parents,
+)
+from kubeflow_tpu.analysis.spmd import (
+    _force_device_env,
+    _iter_subjaxprs,
+    check_dcn_collectives,
+    check_replicated_params,
+)
+
+# Primitives whose presence in an engine program means a host round-trip
+# per dispatch — none belong in a per-token program.
+_HOST_CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed",
+}
+
+# The scheduler's per-token methods: one batched device_get per iteration
+# is the contract; any host sync inside a loop in these is per-slot.
+_HOT_METHOD_PREFIX = "_iterate"
+_SERVING_DIR = "kubeflow_tpu/serving/"
+_HOST_SYNC_CALLS = {
+    "jax.device_get", "device_get", "jax.device_put", "device_put",
+    "jax.block_until_ready", "np.asarray", "np.array",
+    "numpy.asarray", "numpy.array", "jnp.asarray", "jnp.array",
+}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+_DTYPES = {
+    "bfloat16": "bfloat16", "float32": "float32", "float16": "float16",
+}
+
+
+def resolve_model_kwargs(kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """Registry kwargs from a JSON-serializable plan: dtype strings
+    become jnp dtypes (the plan registry never imports jax)."""
+    import jax.numpy as jnp
+
+    out = dict(kwargs)
+    dt = out.get("dtype")
+    if isinstance(dt, str):
+        if dt not in _DTYPES:
+            raise ValueError(f"unknown plan dtype {dt!r}")
+        out["dtype"] = getattr(jnp, _DTYPES[dt])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# serve-host-transfer: the AST half (runs with the control-plane lints)
+# ---------------------------------------------------------------------------
+
+
+def check_hot_loop_host_transfer(sources: SourceSet) -> List[Finding]:
+    """No per-slot host sync in the scheduler's per-token methods: a
+    `device_get`/`.item()`/numpy-coercion call nested inside a for/while
+    loop of a `_iterate*` method turns the one-transfer-per-iteration
+    hot loop into num_slots device round-trips per token."""
+    rule = "serve-host-transfer"
+    findings: List[Finding] = []
+    for sf in sources:
+        if sf.tree is None or not sf.path.startswith(_SERVING_DIR):
+            continue
+        for cls in [n for n in ast.walk(sf.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                if not fn.name.startswith(_HOT_METHOD_PREFIX):
+                    continue
+                for node, ancestors in walk_with_parents(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    # comprehensions iterate per element too: a sync in
+                    # `[x.item() for x in slots]` is the same per-slot
+                    # round trip as one in an explicit for loop
+                    if not any(
+                        isinstance(a, (
+                            ast.For, ast.While, ast.ListComp,
+                            ast.SetComp, ast.GeneratorExp, ast.DictComp,
+                        ))
+                        for a in ancestors
+                    ):
+                        continue
+                    name = call_name(node)
+                    synced = name in _HOST_SYNC_CALLS or (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _HOST_SYNC_METHODS
+                    )
+                    if not synced:
+                        continue
+                    if sources.suppressed(sf.path, node.lineno, rule):
+                        continue
+                    findings.append(
+                        Finding(
+                            analyzer=rule,
+                            severity=Severity.ERROR,
+                            location=f"{sf.path}:{node.lineno}",
+                            symbol=f"{cls.name}.{fn.name}",
+                            message=(
+                                f"{name or node.func.attr}() inside a "
+                                f"loop in {fn.name} — the scheduler hot "
+                                f"loop must make ONE batched host "
+                                f"transfer per iteration, not one per "
+                                f"slot per token; hoist the sync above "
+                                f"the loop"
+                            ),
+                        )
+                    )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# program-level checks (abstract lowering)
+# ---------------------------------------------------------------------------
+
+
+def _main_signature_line(mlir_text: str) -> str:
+    for line in mlir_text.splitlines():
+        if line.lstrip().startswith("func.func public @main"):
+            return line
+    return ""
+
+
+def check_donation(
+    plan_name: str, sig, mlir_text: str
+) -> List[Finding]:
+    """Count `tf.aliasing_output` marks on the lowered main function vs
+    the leaves the signature declares donated. Lowering only emits the
+    mark for a donated input some output actually matches — so this
+    checks the ALIASING XLA will perform, not the Python declaration."""
+    import jax
+
+    donated = sum(
+        len(jax.tree_util.tree_leaves(sig.args[i]))
+        for i in sig.donate_argnums
+    )
+    if donated == 0:
+        return []
+    aliased = _main_signature_line(mlir_text).count("tf.aliasing_output")
+    if aliased >= donated:
+        return []
+    return [
+        Finding(
+            analyzer="serve-donation",
+            severity=Severity.ERROR,
+            location=f"plan:{plan_name}",
+            symbol=sig.name,
+            message=(
+                f"program {sig.name}: {donated} buffer leaves are "
+                f"declared donated but only {aliased} alias "
+                f"input→output in the lowered HLO — XLA will COPY "
+                f"the resident cache instead of updating it in place "
+                f"(2× cache HBM + one full cache copy per step, the "
+                f"PR 4 review regression); a donated buffer no output "
+                f"matches in shape/dtype is dropped silently at lowering"
+            ),
+        )
+    ]
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _iter_subjaxprs(eqn.params):
+            yield from _walk_eqns(sub)
+
+
+def check_host_transfer_jaxpr(
+    plan_name: str, sig_name: str, jaxpr
+) -> List[Finding]:
+    """No host-callback primitive anywhere in an engine program — a
+    callback in the jitted step is a device->host->device round trip on
+    every token for every slot."""
+    findings: List[Finding] = []
+    seen = set()
+    for eqn in _walk_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in _HOST_CALLBACK_PRIMS or name in seen:
+            continue
+        seen.add(name)
+        findings.append(
+            Finding(
+                analyzer="serve-host-transfer",
+                severity=Severity.ERROR,
+                location=f"plan:{plan_name}",
+                symbol=f"{sig_name}:{name}",
+                message=(
+                    f"program {sig_name} contains host-callback "
+                    f"primitive `{name}` — a per-dispatch host round "
+                    f"trip inside the decode hot path; move the host "
+                    f"work out of the jitted program"
+                ),
+            )
+        )
+    return findings
+
+
+def _kv_leaves(tree) -> Dict[str, Any]:
+    """keystr -> leaf for the K/V buffer leaves of a cache pytree."""
+    import jax
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(path)
+        if "cached_key" in key or "cached_value" in key:
+            out[key] = leaf
+    return out
+
+
+def check_cache_dtype(
+    plan_name: str, sig, out_info, model, draft_model=None
+) -> List[Finding]:
+    """KV dtype discipline over one program: cache leaves keep their
+    dtype across the program (in == out), and the resident cache is
+    never stored wider than its OWN model's weight dtype (a bf16 model
+    with an f32 cache doubles the engine's dominant buffer silently).
+    Each cache_io triple carries which model governs it — the verify
+    program holds the target AND draft caches, and a legal engine config
+    may mix their dtypes."""
+    import numpy as np
+
+    if not sig.cache_io:
+        return []
+    findings: List[Finding] = []
+    for in_argnum, out_index, is_draft in sig.cache_io:
+        cfg = (draft_model if is_draft and draft_model is not None
+               else model).cfg
+        weight_dtype = np.dtype(cfg.dtype)
+        in_kv = (
+            _kv_leaves(sig.args[in_argnum])
+            if in_argnum is not None else {}
+        )
+        out_kv: Dict[str, Any] = {}
+        if out_index is not None:
+            out_tree = out_info if out_index == -1 else out_info[out_index]
+            out_kv = _kv_leaves(out_tree)
+        for key, leaf in sorted(out_kv.items()):
+            if key in in_kv:
+                din = np.dtype(in_kv[key].dtype)
+                dout = np.dtype(leaf.dtype)
+                if din != dout:
+                    findings.append(
+                        Finding(
+                            analyzer="serve-dtype",
+                            severity=Severity.ERROR,
+                            location=f"plan:{plan_name}",
+                            symbol=f"{sig.name}:{key}",
+                            message=(
+                                f"program {sig.name}: cache leaf {key} "
+                                f"enters as {din} but leaves as {dout} "
+                                f"— a silent cache dtype change; decode "
+                                f"math may run in f32, but the RESIDENT "
+                                f"buffer must round-trip at its stored "
+                                f"dtype"
+                            ),
+                        )
+                    )
+        for key, leaf in sorted({**in_kv, **out_kv}.items()):
+            dt = np.dtype(leaf.dtype)
+            if dt.itemsize > weight_dtype.itemsize:
+                findings.append(
+                    Finding(
+                        analyzer="serve-dtype",
+                        severity=Severity.ERROR,
+                        location=f"plan:{plan_name}",
+                        symbol=f"{sig.name}:{key}",
+                        message=(
+                            f"program {sig.name}: cache leaf {key} is "
+                            f"stored as {dt} while the model's weight "
+                            f"dtype is {weight_dtype} — the KV cache is "
+                            f"the engine's dominant resident buffer and "
+                            f"must not be wider than the weights "
+                            f"(int8-KV will tighten this further)"
+                        ),
+                    )
+                )
+                break  # one finding per cache side is enough
+    return findings
+
+
+def expected_program_names(
+    buckets: Sequence[int], num_draft_tokens: int
+) -> set:
+    names = {f"prefill@{b}" for b in buckets} | {"insert", "step"}
+    if num_draft_tokens > 0:
+        names |= {f"draft_prefill@{b}" for b in buckets}
+        names |= {"draft_insert", "draft", "verify"}
+    return names
+
+
+def check_program_set(
+    plan_name: str,
+    sig_names: Sequence[str],
+    buckets: Sequence[int],
+    max_len: int,
+    num_draft_tokens: int,
+) -> List[Finding]:
+    """The enumerated signature set must be exactly the declared bucket
+    set plus one insert/step (and the draft family at K>0); the shared
+    `bucket_for` must route every admissible prompt length into the
+    declared set (an off-bucket shape would mint a fresh XLA program at
+    serve time — unbounded compiles under prompt-length jitter)."""
+    rule = "serve-program-count"
+    findings: List[Finding] = []
+
+    def bad(symbol: str, msg: str) -> None:
+        findings.append(
+            Finding(
+                analyzer=rule,
+                severity=Severity.ERROR,
+                location=f"plan:{plan_name}",
+                symbol=symbol,
+                message=msg,
+            )
+        )
+
+    for b in buckets:
+        if b < 1 or b > max_len:
+            bad(f"bucket:{b}",
+                f"prefill bucket {b} outside [1, max_len={max_len}] — "
+                f"the bucket set no longer bounds the program set")
+        elif b & (b - 1):
+            bad(f"bucket:{b}",
+                f"prefill bucket {b} is not a power of two — the "
+                f"bucket ladder contract (bounded program set under "
+                f"prompt-length jitter) is broken")
+    if list(buckets) != sorted(set(buckets)):
+        bad("buckets",
+            f"prefill buckets {list(buckets)} are not strictly "
+            f"ascending — duplicate/unordered buckets mint redundant "
+            f"programs")
+
+    expected = expected_program_names(buckets, num_draft_tokens)
+    names = list(sig_names)
+    extra = sorted(set(names) - expected)
+    missing = sorted(expected - set(names))
+    for name in extra:
+        bad(name,
+            f"program {name} is enumerated but not in the declared set "
+            f"(buckets {list(buckets)}, K={num_draft_tokens}) — an "
+            f"undeclared jit signature is a recompile mint the bucket "
+            f"ladder cannot bound")
+    for name in missing:
+        bad(name,
+            f"declared program {name} is missing from the enumerated "
+            f"set — the engine would compile it on first dispatch, "
+            f"outside the lint's coverage")
+    if len(names) != len(set(names)):
+        bad("duplicates",
+            f"duplicate program signatures enumerated: {sorted(names)}")
+
+    if not extra and not missing and buckets:
+        from kubeflow_tpu.serving.engine import bucket_for
+
+        reachable = {
+            bucket_for(n, tuple(buckets))
+            for n in range(1, max(buckets) + 1)
+        }
+        off = sorted(reachable - set(buckets))
+        if off:
+            bad("bucket_for",
+                f"bucket_for routes admissible prompt lengths to "
+                f"non-declared buckets {off} — every such shape mints a "
+                f"fresh prefill program at serve time")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# whole-plan analysis (runs in a subprocess on virtual CPU devices)
+# ---------------------------------------------------------------------------
+
+
+def analyze_serving_plan(
+    spec: ServingPlanSpec,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Trace + lower every program of one serving plan and run every
+    serve-* check plus the HBM budget. No device state: params and
+    caches exist only as ShapeDtypeStructs; `spec.compile` additionally
+    XLA-compiles the step program for its temp allocation."""
+    import jax
+
+    from kubeflow_tpu.analysis.memory import (
+        check_mem_budget,
+        hbm_bytes_per_chip,
+        tree_bytes,
+    )
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.engine import (
+        EnginePrograms,
+        default_prefill_buckets,
+    )
+
+    stats: Dict[str, Any] = {"plan": spec.name}
+    findings: List[Finding] = []
+
+    model = get_model(spec.model, **resolve_model_kwargs(spec.model_kwargs))
+    draft = None
+    if spec.num_draft_tokens > 0:
+        draft = get_model(
+            spec.draft_model, **resolve_model_kwargs(spec.draft_kwargs)
+        )
+    progs = EnginePrograms(
+        model, draft_model=draft, num_draft_tokens=spec.num_draft_tokens
+    )
+    buckets = tuple(spec.prefill_buckets) or default_prefill_buckets(
+        model.cfg.max_len
+    )
+    sigs = progs.program_signatures(spec.num_slots, buckets)
+    findings.extend(
+        check_program_set(
+            spec.name, [s.name for s in sigs], buckets,
+            model.cfg.max_len, spec.num_draft_tokens,
+        )
+    )
+    stats["programs"] = [s.name for s in sigs]
+    stats["buckets"] = list(buckets)
+
+    step_temp_bytes: Optional[int] = None
+    stablehlo_bytes = 0
+    for sig in sigs:
+        traced = sig.fn.trace(*sig.args)
+        closed = traced.jaxpr
+        lowered = traced.lower()
+        txt = lowered.as_text()
+        stablehlo_bytes += len(txt)
+        findings.extend(check_donation(spec.name, sig, txt))
+        findings.extend(
+            check_host_transfer_jaxpr(spec.name, sig.name, closed.jaxpr)
+        )
+        # inert until the engine gains a mesh (no DCN axes on one chip);
+        # the wiring is the point — the sharded-serving rung inherits it
+        findings.extend(
+            check_dcn_collectives(closed.jaxpr, set(), spec.name)
+        )
+        findings.extend(
+            check_cache_dtype(
+                spec.name, sig, traced.out_info, model, draft
+            )
+        )
+        if spec.compile and sig.family == "step":
+            compiled = lowered.compile()
+            try:
+                step_temp_bytes = int(
+                    compiled.memory_analysis().temp_size_in_bytes
+                )
+            except Exception:  # pragma: no cover - backend drift
+                step_temp_bytes = None
+    stats["stablehlo_bytes"] = stablehlo_bytes
+
+    # spmd-replicated-param wiring: the engine has no mesh today, so the
+    # pass runs with no shard-capable axes (inert); when sharded serving
+    # lands, the plan grows a mesh and this starts biting for free
+    params = progs.abstract_params()
+    findings.extend(check_replicated_params(params, {}, {}, spec.name))
+
+    # -- mem-budget: the resident bytes one chip must hold ----------------
+    cache_one = progs.cache_shapes(params, buckets[0])
+    components: Dict[str, int] = {
+        "params": tree_bytes(params),
+        "kv slot cache": tree_bytes(
+            progs.slot_cache_shapes(cache_one, spec.num_slots)
+        ),
+    }
+    if draft is not None:
+        dparams = progs.abstract_params(draft)
+        dcache_one = progs.draft_cache_shapes(dparams, buckets[0])
+        components["draft params"] = tree_bytes(dparams)
+        components["draft kv slot cache"] = tree_bytes(
+            progs.slot_cache_shapes(dcache_one, spec.num_slots)
+        )
+    if step_temp_bytes:
+        components["xla temp (step)"] = step_temp_bytes
+    budget = (
+        hbm_bytes_per_chip(spec.device_kind) if spec.device_kind else None
+    )
+    findings.extend(
+        check_mem_budget(spec.name, components, budget, spec.device_kind)
+    )
+    stats["hbm"] = {
+        "components_bytes": {k: int(v) for k, v in components.items()},
+        "budget_bytes": int(budget) if budget else None,
+        "temp_measured": step_temp_bytes is not None,
+    }
+    return findings, stats
+
+
+def analyze_serving_plan_subprocess(
+    spec: ServingPlanSpec,
+    root: str,
+    timeout_s: float = 900.0,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Run analyze_serving_plan in a child on one virtual CPU device. A
+    crash/timeout becomes a `serve-analysis-error` finding — one broken
+    plan must not hide the others' results."""
+    payload = json.dumps({"spec": spec.to_dict()})
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.analysis.serving"],
+            input=payload.encode(),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            timeout=timeout_s,
+            env=_force_device_env(1),
+            cwd=root,
+        )
+    except subprocess.TimeoutExpired:
+        return (
+            [
+                Finding(
+                    analyzer="serve-analysis-error",
+                    severity=Severity.ERROR,
+                    location=f"plan:{spec.name}",
+                    message=f"plan analysis timed out after {timeout_s:.0f}s",
+                )
+            ],
+            {"plan": spec.name, "timeout": True},
+        )
+    tail = proc.stdout.decode("utf-8", "replace").strip().splitlines()
+    for line in reversed(tail):
+        if line.startswith("{"):
+            try:
+                out = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            return (
+                [Finding.from_dict(d) for d in out.get("findings", [])],
+                out.get("stats", {"plan": spec.name}),
+            )
+    err = proc.stderr.decode("utf-8", "replace").strip().splitlines()
+    detail = err[-1] if err else f"exit code {proc.returncode}, no output"
+    return (
+        [
+            Finding(
+                analyzer="serve-analysis-error",
+                severity=Severity.ERROR,
+                location=f"plan:{spec.name}",
+                message=f"plan analysis failed: {detail}",
+            )
+        ],
+        {"plan": spec.name, "error": detail},
+    )
+
+
+def _main() -> int:
+    """Subprocess entry: JSON {spec} on stdin, one JSON result line on
+    stdout (stderr stays free for XLA noise)."""
+    payload = json.loads(sys.stdin.read())
+    spec = ServingPlanSpec.from_dict(payload["spec"])
+    try:
+        findings, stats = analyze_serving_plan(spec)
+    except Exception as e:  # surface as a finding, not a traceback-exit
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        findings = [
+            Finding(
+                analyzer="serve-analysis-error",
+                severity=Severity.ERROR,
+                location=f"plan:{spec.name}",
+                message=f"{type(e).__name__}: {e}",
+            )
+        ]
+        stats = {"plan": spec.name}
+    print(json.dumps({
+        "findings": [f.to_dict() for f in findings],
+        "stats": stats,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

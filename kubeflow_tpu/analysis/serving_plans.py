@@ -1,0 +1,134 @@
+"""Serving plan registry — the one list of engine geometries the
+platform ships.
+
+A *serving plan* is one (model x num_slots x prefill_buckets x K) tuple a
+DecodeEngine actually runs with. Three consumers share this module so
+they cannot drift (the `analysis/plans.py` pattern, where the dryrun and
+the SPMD lint import one plan list):
+
+- **serving/main.py** — the engine-knob defaults the InferenceService
+  controller's env contract falls back to (`DEFAULT_NUM_SLOTS`,
+  `DEFAULT_MAX_QUEUE`).
+- **bench.py** — `bench_serving_continuous`'s engine geometry and
+  speculative self-draft construction (`BENCH_*`).
+- **kft-analyze's serving lint** (analysis/serving.py) — every spec
+  returned by `shipped_serving_plans()` is abstractly traced/lowered in a
+  subprocess and checked for donation aliasing, program-set bounds,
+  host-transfer freedom, cache dtype discipline and the static HBM
+  budget.
+
+Import rule: this module never imports jax (bench.py's parent process is
+jax-free by contract, and serving/main.py imports it before the heavy
+model imports); model names resolve lazily in the consumers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+# Engine knob defaults: what serving/main.py uses when the controller
+# renders no KFT_SERVING_* override (config/platform.py ServingConfig
+# documents the same numbers; tests/test_analysis.py asserts main.py
+# really reads these names).
+DEFAULT_NUM_SLOTS = 8
+DEFAULT_MAX_QUEUE = 64
+
+# bench_serving_continuous's engine geometry: the ragged three-bucket
+# trace every round's headline engine numbers come from, and the
+# speculative self-draft construction (_spec_pair) riding the same trace.
+BENCH_MAX_LEN = 64           # largest prompt bucket (32) + tokens + slack
+BENCH_PREFILL_BUCKETS: Tuple[int, ...] = (8, 16, 32)
+BENCH_PROMPT_LENS: Tuple[int, ...] = (8, 12, 24)
+BENCH_SPEC_VOCAB = 2048      # small vocab: draft streams ~1/6 the bytes
+BENCH_DRAFT_LAYERS = 2       # early-exit self-draft depth
+BENCH_NUM_DRAFT_TOKENS = 4   # K for the drafted bench phase
+
+
+@dataclasses.dataclass
+class ServingPlanSpec:
+    """One analyzable engine geometry; serializes to JSON for the
+    per-plan analysis subprocess (analysis/serving.py main)."""
+
+    name: str
+    model: str                         # registry model name
+    model_kwargs: Dict[str, Any]       # registry kwargs (dtype as a str)
+    num_slots: int = DEFAULT_NUM_SLOTS
+    prefill_buckets: Tuple[int, ...] = ()  # () = the engine's auto ladder
+    max_queue: int = DEFAULT_MAX_QUEUE
+    num_draft_tokens: int = 0          # K; > 0 adds the draft/verify family
+    draft_model: str = ""              # registry name (required when K > 0)
+    draft_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    device_kind: str = "v5e"           # mem-budget HBM table key ("" skips)
+    compile: bool = False              # also XLA-compile the step program
+    #                                    (adds its temp allocation to the
+    #                                    HBM budget; lower-only otherwise)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServingPlanSpec":
+        d = dict(d)
+        d["prefill_buckets"] = tuple(d.get("prefill_buckets") or ())
+        return cls(**d)
+
+
+def default_serving_plans() -> List[ServingPlanSpec]:
+    """The controller-default engine: what an InferenceService CR gets
+    with no spec.serving overrides — gpt_small at the registry defaults
+    (max_len 1024, bf16, scanned layers, the serving path's
+    scan_layers=True from ServedLm.from_registry), DEFAULT_NUM_SLOTS
+    slots, the auto power-of-two bucket ladder, no draft. The one plan
+    that compiles its step program, so the HBM budget includes XLA's
+    temp allocation for the shipped default."""
+    return [
+        ServingPlanSpec(
+            name="serving:gpt_small-default",
+            model="gpt_small",
+            model_kwargs={"scan_layers": True},
+            compile=True,
+        )
+    ]
+
+
+def bench_serving_plans() -> List[ServingPlanSpec]:
+    """bench_serving_continuous's three engines: the headline gpt_small
+    engine, and the speculative-phase target at K=0 and K=4 (the drafted
+    engine adds the draft_prefill/draft_insert/draft/verify program
+    family over the early-exit self-draft)."""
+    target = {
+        "dtype": "bfloat16",
+        "scan_layers": True,
+        "max_len": BENCH_MAX_LEN,
+    }
+    spec_target = dict(target, vocab_size=BENCH_SPEC_VOCAB)
+    return [
+        ServingPlanSpec(
+            name="bench:gpt_engine",
+            model="gpt_small",
+            model_kwargs=dict(target),
+            prefill_buckets=BENCH_PREFILL_BUCKETS,
+        ),
+        ServingPlanSpec(
+            name="bench:gpt_spec_k0",
+            model="gpt_small",
+            model_kwargs=dict(spec_target),
+            prefill_buckets=BENCH_PREFILL_BUCKETS,
+        ),
+        ServingPlanSpec(
+            name="bench:gpt_spec_kd",
+            model="gpt_small",
+            model_kwargs=dict(spec_target),
+            prefill_buckets=BENCH_PREFILL_BUCKETS,
+            num_draft_tokens=BENCH_NUM_DRAFT_TOKENS,
+            draft_model="gpt_small",
+            draft_kwargs=dict(spec_target, num_layers=BENCH_DRAFT_LAYERS),
+        ),
+    ]
+
+
+def shipped_serving_plans() -> List[ServingPlanSpec]:
+    """Every serving plan the repo ships: the lint sweep's input, and the
+    all-plans-clean merge gate in tests/test_analysis.py."""
+    return default_serving_plans() + bench_serving_plans()

@@ -12,8 +12,10 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import os
 import re
+import tokenize
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 _SKIP_DIRS = {
@@ -34,12 +36,29 @@ class SourceFile:
 
 
 def _scan_suppressions(text: str) -> Dict[int, Set[str]]:
+    """Real COMMENT tokens only: a docstring QUOTING the ignore syntax
+    (sources.py's own docs, the catalog in findings.py) is not a
+    suppression. Tokenize decides what is a comment; unparseable files
+    fall back to the line scan (their parse error is reported anyway)."""
     out: Dict[int, Set[str]] = {}
-    for i, line in enumerate(text.splitlines(), start=1):
-        m = _SUPPRESS_RE.search(line)
-        if m:
-            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
-            out[i] = rules
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                rules = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+                out[tok.start[0]] = rules
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, line in enumerate(text.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+                out[i] = rules
     return out
 
 
@@ -90,6 +109,19 @@ class SourceSet:
             return False
         rules = sf.suppressions.get(line, set())
         return rule in rules or "all" in rules
+
+    def suppression_inventory(self) -> List[Tuple[str, int, str]]:
+        """Every inline ignore in the tree as (path, line, rule) — the
+        `--list-ignores` CLI inventory. The repo's clean-pass discipline
+        says this ships EMPTY (tests/test_analysis.py enforces it); the
+        inventory exists so a reviewed exception is one command away
+        from an audit, never a silent baseline."""
+        rows: List[Tuple[str, int, str]] = []
+        for sf in self:
+            for line, rules in sorted(sf.suppressions.items()):
+                for rule in sorted(rules):
+                    rows.append((sf.path, line, rule))
+        return sorted(rows)
 
 
 # ---------------------------------------------------------------------------

@@ -282,9 +282,10 @@ def analyze_plan(
         stats["stablehlo_bytes"] = -1
         stats["stablehlo_error"] = str(e)
 
+    compiled = None
     if spec.compile:
         with capture_compiler_diagnostics() as diag:
-            lowered.compile()
+            compiled = lowered.compile()
             text = diag.text()
         lines = remat_warnings(text)
         stats["compiled"] = True
@@ -302,6 +303,45 @@ def analyze_plan(
                     ),
                 )
             )
+
+    # -- mem-budget: per-chip train state vs the declared topology's HBM.
+    # Sharded leaves count at nbytes / shard count from their spec; a
+    # replicated leaf counts whole on every chip. Compiled plans add
+    # XLA's own temp allocation; lower-only plans record that temps are
+    # unmeasured (analysis/memory.py headroom covers the gap).
+    if spec.device_kind:
+        from kubeflow_tpu.analysis.memory import (
+            check_mem_budget,
+            hbm_bytes_per_chip,
+            sharded_tree_bytes,
+        )
+
+        budget = hbm_bytes_per_chip(spec.device_kind)
+        if budget:
+            components = {
+                "train state (params+opt, per chip)": sharded_tree_bytes(
+                    state_shapes, shardings, dict(mesh.shape)
+                ),
+            }
+            if compiled is not None:
+                try:
+                    components["xla temp (per device)"] = int(
+                        compiled.memory_analysis().temp_size_in_bytes
+                    )
+                except Exception:  # pragma: no cover - backend drift
+                    pass
+            findings.extend(
+                check_mem_budget(
+                    spec.name, components, budget, spec.device_kind
+                )
+            )
+            stats["hbm"] = {
+                "components_bytes": {
+                    k: int(v) for k, v in components.items()
+                },
+                "budget_bytes": int(budget),
+                "temp_measured": "xla temp (per device)" in components,
+            }
     return findings, stats
 
 

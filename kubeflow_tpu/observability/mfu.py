@@ -31,16 +31,18 @@ from typing import Any, Optional, Tuple
 
 ENV_PEAK_FLOPS = "KFT_PEAK_FLOPS_PER_CHIP"
 
-# bf16 peak TFLOP/s and HBM GB/s per chip, by device_kind substring.
-# (Public TPU spec sheets; used only for utilization denominators.)
+# bf16 peak TFLOP/s, HBM GB/s and HBM capacity bytes per chip, by
+# device_kind substring. (Public TPU spec sheets; the rates are
+# utilization denominators, the capacity is kft-analyze's static
+# mem-budget ceiling — analysis/memory.py.)
 CHIP_SPECS = (
-    ("v6", 918e12, 1640e9),        # Trillium / v6e
-    ("v5p", 459e12, 2765e9),
-    ("v5 lite", 197e12, 819e9),    # v5e reports "TPU v5 lite"
-    ("v5e", 197e12, 819e9),
-    ("v4", 275e12, 1228e9),
-    ("v3", 123e12, 900e9),
-    ("v2", 45e12, 700e9),
+    ("v6", 918e12, 1640e9, 32 << 30),        # Trillium / v6e
+    ("v5p", 459e12, 2765e9, 95 << 30),
+    ("v5 lite", 197e12, 819e9, 16 << 30),    # v5e reports "TPU v5 lite"
+    ("v5e", 197e12, 819e9, 16 << 30),
+    ("v4", 275e12, 1228e9, 32 << 30),
+    ("v3", 123e12, 900e9, 32 << 30),
+    ("v2", 45e12, 700e9, 16 << 30),
 )
 
 _measured_peak_cache: Optional[float] = None
@@ -50,10 +52,23 @@ def chip_peaks(device) -> Tuple[Optional[float], Optional[float]]:
     """(peak bf16 FLOP/s, peak HBM bytes/s) for a jax device, or
     (None, None) when the device kind is not in the table."""
     kind = getattr(device, "device_kind", "").lower()
-    for key, flops, bw in CHIP_SPECS:
+    for key, flops, bw, _ in CHIP_SPECS:
         if key in kind:
             return flops, bw
     return None, None
+
+
+def chip_hbm_bytes(device_kind: str) -> Optional[int]:
+    """Per-chip HBM capacity in bytes for a device-kind (or topology)
+    string like "v5e", "TPU v5 lite" or "v5e-16"; None when unknown.
+    Static-analysis-friendly: takes the STRING, not a live device — the
+    mem-budget pass runs on virtual CPU devices against a declared
+    topology."""
+    kind = (device_kind or "").lower()
+    for key, _, _, hbm in CHIP_SPECS:
+        if key in kind:
+            return hbm
+    return None
 
 
 def _measured_matmul_peak() -> float:

@@ -59,7 +59,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import List, Optional, Sequence, Tuple
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -127,93 +127,74 @@ def default_prefill_buckets(max_len: int, smallest: int = 8) -> Tuple[int, ...]:
     return tuple(out)
 
 
+def bucket_for(prompt_len: int, buckets: Sequence[int]) -> int:
+    """Smallest prefill bucket admitting a prompt of `prompt_len` tokens.
+    Module-level because the engine AND kft-analyze's serve-program-count
+    check share it: the analyzer enumerates every shape this function can
+    route to a prefill program, so a rounding regression that would mint
+    an off-bucket XLA program is caught statically."""
+    for b in buckets:
+        if prompt_len <= b:
+            return b
+    raise EngineCapacityError(
+        f"prompt length {prompt_len} exceeds the largest prefill "
+        f"bucket {buckets[-1]}"
+    )
+
+
 # the per-slot dynamic sampling kernel — shared with the verify step's
 # acceptance math through serving/sampling.py (one definition point; the
 # historical private name stays importable for callers and tests)
 _sample_slots = _sample_slots_shared
 
 
-class _Request:
-    """One admitted-or-queued generation request."""
+class ProgramSignature(NamedTuple):
+    """One enumerable jitted engine program: the callable plus the exact
+    abstract argument shapes the scheduler can ever pass it, and the
+    argnums whose buffers the jit donates. `cache_io` names which inputs
+    and outputs are resident KV caches ((in_argnum, out_index, is_draft)
+    triples; None = the program has no cache on that side, out_index=-1 =
+    the output IS the cache pytree itself, is_draft picks which model's
+    dtype governs that cache — the verify program carries BOTH) so the
+    dtype-discipline check can pair them without re-deriving engine
+    internals."""
 
-    __slots__ = (
-        "prompt", "max_new", "temperature", "top_k", "top_p", "eos_id",
-        "seed", "t_submit", "future", "trace_id", "queue_span",
-    )
-
-    def __init__(self, prompt, max_new, temperature, top_k, top_p, eos_id,
-                 seed, trace_id=None):
-        self.prompt = prompt  # np.int32 [P], real tokens only
-        self.max_new = max_new
-        self.temperature = temperature
-        self.top_k = top_k
-        self.top_p = top_p
-        self.eos_id = eos_id
-        self.seed = seed
-        self.t_submit = time.monotonic()
-        # completes with {"tokens": [...], "ttft_s": float}
-        self.future = Completion()
-        # request-scoped trace id (X-Request-Id on the REST path): every
-        # span kft-trace records for this request carries it
-        self.trace_id = trace_id
-        self.queue_span = None  # started at enqueue, ended at admission
+    name: str                     # "prefill@8", "step", "verify", ...
+    family: str                   # "prefill" | "insert" | "step" | ...
+    fn: Any                       # the jitted callable
+    args: Tuple[Any, ...]         # ShapeDtypeStruct pytrees
+    donate_argnums: Tuple[int, ...]
+    cache_io: Tuple[Tuple[Optional[int], Optional[int], bool], ...] = ()
 
 
-class _Slot:
-    """Host bookkeeping for one occupied decode slot."""
+class EnginePrograms:
+    """The decode engine's complete jitted program family, separated from
+    the engine's device state.
 
-    __slots__ = (
-        "req", "tokens", "ttft_s", "queue_s", "t_admitted", "decode_span",
-    )
+    ONE definition point serves two consumers: the live DecodeEngine jits
+    its scheduler programs from here, and kft-analyze's serving lint
+    (analysis/serving.py) lowers the SAME jits — donation flags included —
+    against abstract inputs in a subprocess, so the donation/dtype/
+    program-set discipline is checked against the programs the engine
+    actually runs, not a parallel description of them. `donate_argnums`
+    in `program_signatures` is the engine's declared HBM contract; the
+    lint verifies the lowered HLO really aliases those buffers (a
+    declaration the partitioner could not honor silently drops the
+    aliasing attribute, which is exactly the 2x-cache-HBM regression
+    class). Adding a jit to the engine without enumerating it here fails
+    the serve-program-count check (tests/test_analysis.py asserts every
+    jax.jit call site in this module lives in this class)."""
 
-    def __init__(self, req: _Request):
-        self.req = req
-        self.tokens: List[int] = []
-        self.ttft_s = 0.0
-        self.queue_s = 0.0  # admission-queue wait (ttft_s minus prefill)
-        self.t_admitted = 0.0
-        self.decode_span = None
+    def __init__(self, model, draft_model=None, num_draft_tokens: int = 0):
+        from kubeflow_tpu.models.gpt import insert_cache_slot
 
-
-class DecodeEngine:
-    """The persistent slot-batch decode engine for one causal LM.
-
-    Thread model: `submit()` (any thread) only touches the admission queue
-    under the condition lock; the scheduler thread owns ALL device state
-    (resident cache, per-slot arrays) and the slot table, so the hot loop
-    never takes a lock around device work. Aggregate counters live behind
-    their own lock (`stats()`).
-    """
-
-    def __init__(
-        self,
-        name: str,
-        model,
-        params,
-        *,
-        num_slots: int = 8,
-        prefill_buckets: Optional[Sequence[int]] = None,
-        max_queue: int = 64,
-        autostart: bool = True,
-        draft_model=None,
-        draft_params=None,
-        num_draft_tokens: int = 0,
-    ):
-        if num_slots < 1:
-            raise ValueError("num_slots must be >= 1")
-        if max_queue < 1:
-            raise ValueError("max_queue must be >= 1")
-        self.name = name
-        self.model = model
-        self.params = params
-        self.num_slots = num_slots
-        self.max_queue = max_queue
         cfg = model.cfg
+        self.model = model
         self.num_draft_tokens = int(num_draft_tokens)
         if self.num_draft_tokens < 0:
             raise ValueError("num_draft_tokens must be >= 0")
         if self.num_draft_tokens > 0:
-            if draft_model is None or draft_params is None:
+            if draft_model is None:
                 raise ValueError(
                     "num_draft_tokens > 0 needs draft_model and "
                     "draft_params (speculative decoding drafts from a "
@@ -233,122 +214,26 @@ class DecodeEngine:
                     "token positions as the target's"
                 )
         self.draft_model = draft_model
-        self.draft_params = draft_params
-        buckets = tuple(
-            sorted(prefill_buckets)
-            if prefill_buckets
-            else default_prefill_buckets(cfg.max_len)
-        )
-        for b in buckets:
-            if b < 1 or b > cfg.max_len:
-                raise ValueError(
-                    f"prefill bucket {b} outside [1, max_len={cfg.max_len}]"
-                )
-            if b & (b - 1):
-                raise ValueError(f"prefill bucket {b} not a power of two")
-        self.prefill_buckets = buckets
 
-        # -- device state (scheduler-thread-owned after start) ----------
-        from kubeflow_tpu.models.gpt import insert_cache_slot, make_slot_cache
-
-        dummy = jax.ShapeDtypeStruct((1, buckets[0]), jnp.int32)
-        dummy_mask = jax.ShapeDtypeStruct((1, buckets[0]), jnp.bool_)
-        _, shapes = jax.eval_shape(
-            lambda p, ids, m: model.apply(
-                {"params": p}, ids, attention_mask=m, prefill=True,
-                mutable=["cache"],
-            ),
-            params, dummy, dummy_mask,
-        )
-        self._cache_shapes = shapes["cache"]
-        self._make_slot_cache = make_slot_cache
-        self._cache = make_slot_cache(self._cache_shapes, num_slots)
-        # the resident cache is always consumed-and-replaced: donate it so
-        # XLA aliases input→output instead of copying the engine's
-        # dominant buffer on every admission and every one-token step
-        # (undonated = 2× cache HBM + one full cache copy per token)
-        self._insert = jax.jit(insert_cache_slot, donate_argnums=(0,))
-        self._step = jax.jit(self._step_fn, donate_argnums=(1,))
-        # one wrapper serves every bucket: jit caches one executable per
-        # input shape, so the bucket set bounds the program set by itself
-        self._prefill = jax.jit(self._prefill_fn)
+        # the resident caches are always consumed-and-replaced: donate
+        # them so XLA aliases input→output instead of copying the
+        # engine's dominant buffer on every admission and every one-token
+        # step (undonated = 2× cache HBM + one full cache copy per token)
+        self.prefill = jax.jit(self._prefill_fn)
+        self.insert = jax.jit(insert_cache_slot, donate_argnums=(0,))
+        self.step = jax.jit(self._step_fn, donate_argnums=(1,))
         if self.num_draft_tokens > 0:
-            # the draft's resident slot cache mirrors the target's slot
-            # table position-for-position; its cursors advance and rewind
-            # in lockstep with the target's inside the verify program
-            _, dshapes = jax.eval_shape(
-                lambda p, ids, m: draft_model.apply(
-                    {"params": p}, ids, attention_mask=m, prefill=True,
-                    mutable=["cache"],
-                ),
-                draft_params, dummy, dummy_mask,
-            )
-            self._draft_cache_shapes = dshapes["cache"]
-            self._draft_cache = make_slot_cache(
-                self._draft_cache_shapes, num_slots
-            )
-            self._draft_insert = jax.jit(
-                insert_cache_slot, donate_argnums=(0,)
-            )
-            self._draft_prefill = jax.jit(self._draft_prefill_fn)
-            self._draft = jax.jit(self._draft_fn, donate_argnums=(1,))
-            self._verify = jax.jit(self._verify_fn, donate_argnums=(1, 2))
+            self.draft_prefill = jax.jit(self._draft_prefill_fn)
+            self.draft_insert = jax.jit(insert_cache_slot, donate_argnums=(0,))
+            self.draft = jax.jit(self._draft_fn, donate_argnums=(1,))
+            self.verify = jax.jit(self._verify_fn, donate_argnums=(1, 2))
         else:
-            self._draft_cache = None
-        # per-slot host mirrors, scheduler-thread-owned
-        self._slots: List[Optional[_Slot]] = [None] * num_slots
-        self._tok_np = np.zeros((num_slots,), np.int32)
-        self._key_np = np.zeros((num_slots, 2), np.uint32)
-        self._cnt_np = np.zeros((num_slots,), np.int32)
-        # rng-stream position (draws consumed, != tokens emitted once the
-        # verify window starts drawing K+1 positions per iteration)
-        self._draw_np = np.zeros((num_slots,), np.int32)
-        self._temp_np = np.zeros((num_slots,), np.float32)
-        self._topk_np = np.zeros((num_slots,), np.int32)
-        self._topp_np = np.ones((num_slots,), np.float32)
+            self.draft_prefill = None
+            self.draft_insert = None
+            self.draft = None
+            self.verify = None
 
-        # -- shared state (condition-lock-guarded) ----------------------
-        self._cv = threading.Condition()
-        self._queue: deque = deque()
-        self._stop = False
-
-        self._stats_lock = threading.Lock()
-        self._admitted = 0
-        self._steps = 0
-        self._emitted = 0
-        self._occupied_slot_steps = 0
-        self._drafted = 0
-        self._accepted = 0
-        self._verifies = 0
-
-        # kft-trace (observability/): request phases + scheduler iteration
-        # spans ride the process tracer; a disabled tracer makes every
-        # span call a no-op (docs/OBSERVABILITY.md span catalog)
-        self._tracer = default_tracer()
-        # recent finished requests (phase breakdowns) for /statusz —
-        # appended by the scheduler thread, read by HTTP handlers
-        self._recent: deque = deque(maxlen=32)
-
-        self._ttft = serving_ttft_histogram()
-        self._phase = serving_phase_histogram()
-        self._draft_proposed = serving_draft_proposed_counter()
-        self._draft_accepted = serving_draft_accepted_counter()
-        self._accept_rate = serving_accept_rate_histogram()
-        self._verify_steps = serving_verify_steps_counter()
-        self._queue_depth = serving_queue_depth_gauge()
-        self._occupancy = serving_slot_occupancy_gauge()
-        self._decode_steps = serving_decode_steps_counter()
-        self._tokens_total = serving_tokens_counter()
-        self._queue_depth.set(0, model=name)
-        self._occupancy.set(0.0, model=name)
-
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name=f"decode-engine-{name}"
-        )
-        if autostart:
-            self._thread.start()
-
-    # -- jitted programs ---------------------------------------------------
+    # -- jitted program bodies ---------------------------------------------
 
     def _prefill_fn(self, params, ids, mask, key, temp, top_k, top_p):
         out, mutated = self.model.apply(
@@ -374,7 +259,7 @@ class DecodeEngine:
         )
         return mutated["cache"], nxt
 
-    # -- speculative draft-and-verify programs -----------------------------
+    # -- speculative draft-and-verify program bodies -----------------------
 
     def _draft_prefill_fn(self, dparams, ids, mask):
         """Seed the draft's batch-1 cache over the same bucketed prompt
@@ -533,16 +418,333 @@ class DecodeEngine:
             out_len,
         )
 
+    # -- abstract views (kft-analyze's serving lint; no device state) ------
+
+    def cache_shapes(self, params, bucket: int):
+        """The batch-1 prefill cache STRUCTURE (eval_shape — nothing
+        materializes; `params` may be real arrays or ShapeDtypeStructs).
+        The K/V buffers are max_len-sized regardless of bucket, so one
+        call describes every bucket's insert."""
+        dummy = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
+        dmask = jax.ShapeDtypeStruct((1, bucket), jnp.bool_)
+        _, shapes = jax.eval_shape(
+            lambda p, ids, m: self.model.apply(
+                {"params": p}, ids, attention_mask=m, prefill=True,
+                mutable=["cache"],
+            ),
+            params, dummy, dmask,
+        )
+        return shapes["cache"]
+
+    def draft_cache_shapes(self, draft_params, bucket: int):
+        dummy = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
+        dmask = jax.ShapeDtypeStruct((1, bucket), jnp.bool_)
+        _, shapes = jax.eval_shape(
+            lambda p, ids, m: self.draft_model.apply(
+                {"params": p}, ids, attention_mask=m, prefill=True,
+                mutable=["cache"],
+            ),
+            draft_params, dummy, dmask,
+        )
+        return shapes["cache"]
+
+    def abstract_params(self, model=None):
+        """Parameter ShapeDtypeStructs from eval_shape over init — the
+        analyzer's stand-in for real weights (same shapes/dtypes, zero
+        bytes allocated)."""
+        m = self.model if model is None else model
+        probe = min(8, m.cfg.max_len)
+        shapes = jax.eval_shape(
+            lambda: m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, probe), jnp.int32),
+                deterministic=True,
+            )
+        )
+        return shapes["params"]
+
+    def slot_cache_shapes(self, cache_one, num_slots: int):
+        """The resident slot-batch cache structure (eval_shape over
+        make_slot_cache so no zeros materialize)."""
+        from kubeflow_tpu.models.gpt import make_slot_cache
+
+        return jax.eval_shape(
+            lambda c: make_slot_cache(c, num_slots), cache_one
+        )
+
+    def program_signatures(
+        self,
+        num_slots: int,
+        prefill_buckets: Sequence[int],
+        params=None,
+        draft_params=None,
+    ) -> List[ProgramSignature]:
+        """Enumerate EVERY jitted program the engine can dispatch for this
+        (num_slots, bucket set) geometry, with exact abstract argument
+        shapes: one prefill per bucket, one insert, one step — plus the
+        draft_prefill-per-bucket/draft_insert/draft/verify family when
+        K > 0. The jit wrappers cache one executable per input signature,
+        so this list IS the engine's compile-bound program set; the
+        serving lint lowers each entry and checks donation aliasing,
+        cache dtype discipline, and host-transfer freedom against it."""
+        sds = jax.ShapeDtypeStruct
+        i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
+        s = int(num_slots)
+        buckets = tuple(sorted(prefill_buckets))
+        if params is None:
+            params = self.abstract_params()
+        key = sds((2,), u32)
+        keys = sds((s, 2), u32)
+
+        def vec(dt):
+            return sds((s,), dt)
+
+        cache_one = self.cache_shapes(params, buckets[0])
+        slot_cache = self.slot_cache_shapes(cache_one, s)
+        sigs: List[ProgramSignature] = []
+        for b in buckets:
+            sigs.append(ProgramSignature(
+                f"prefill@{b}", "prefill", self.prefill,
+                (params, sds((1, b), i32), sds((1, b), jnp.bool_), key,
+                 sds((), f32), sds((), i32), sds((), f32)),
+                (), cache_io=((None, 0, False),),
+            ))
+        sigs.append(ProgramSignature(
+            "insert", "insert", self.insert,
+            (slot_cache, cache_one, sds((), i32)),
+            (0,), cache_io=((0, -1, False),),
+        ))
+        sigs.append(ProgramSignature(
+            "step", "step", self.step,
+            (params, slot_cache, vec(i32), keys, vec(i32), vec(f32),
+             vec(i32), vec(f32)),
+            (1,), cache_io=((1, 0, False),),
+        ))
+        if self.num_draft_tokens > 0:
+            if draft_params is None:
+                draft_params = self.abstract_params(self.draft_model)
+            dcache_one = self.draft_cache_shapes(draft_params, buckets[0])
+            dslot_cache = self.slot_cache_shapes(dcache_one, s)
+            kk = self.num_draft_tokens
+            vocab = self.model.cfg.vocab_size
+            for b in buckets:
+                sigs.append(ProgramSignature(
+                    f"draft_prefill@{b}", "draft_prefill",
+                    self.draft_prefill,
+                    (draft_params, sds((1, b), i32), sds((1, b), jnp.bool_)),
+                    (), cache_io=((None, -1, True),),
+                ))
+            sigs.append(ProgramSignature(
+                "draft_insert", "draft_insert", self.draft_insert,
+                (dslot_cache, dcache_one, sds((), i32)),
+                (0,), cache_io=((0, -1, True),),
+            ))
+            sigs.append(ProgramSignature(
+                "draft", "draft", self.draft,
+                (draft_params, dslot_cache, vec(i32), keys, vec(i32),
+                 vec(f32), vec(i32), vec(f32)),
+                (1,), cache_io=((1, 0, True),),
+            ))
+            sigs.append(ProgramSignature(
+                "verify", "verify", self.verify,
+                (params, slot_cache, dslot_cache, sds((s, kk + 1), i32),
+                 sds((kk, s, vocab), f32), keys, vec(i32), vec(f32),
+                 vec(i32), vec(f32)),
+                (1, 2), cache_io=((1, 0, False), (2, 1, True)),
+            ))
+        return sigs
+
+
+class _Request:
+    """One admitted-or-queued generation request."""
+
+    __slots__ = (
+        "prompt", "max_new", "temperature", "top_k", "top_p", "eos_id",
+        "seed", "t_submit", "future", "trace_id", "queue_span",
+    )
+
+    def __init__(self, prompt, max_new, temperature, top_k, top_p, eos_id,
+                 seed, trace_id=None):
+        self.prompt = prompt  # np.int32 [P], real tokens only
+        self.max_new = max_new
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self.seed = seed
+        self.t_submit = time.monotonic()
+        # completes with {"tokens": [...], "ttft_s": float}
+        self.future = Completion()
+        # request-scoped trace id (X-Request-Id on the REST path): every
+        # span kft-trace records for this request carries it
+        self.trace_id = trace_id
+        self.queue_span = None  # started at enqueue, ended at admission
+
+
+class _Slot:
+    """Host bookkeeping for one occupied decode slot."""
+
+    __slots__ = (
+        "req", "tokens", "ttft_s", "queue_s", "t_admitted", "decode_span",
+    )
+
+    def __init__(self, req: _Request):
+        self.req = req
+        self.tokens: List[int] = []
+        self.ttft_s = 0.0
+        self.queue_s = 0.0  # admission-queue wait (ttft_s minus prefill)
+        self.t_admitted = 0.0
+        self.decode_span = None
+
+
+class DecodeEngine:
+    """The persistent slot-batch decode engine for one causal LM.
+
+    Thread model: `submit()` (any thread) only touches the admission queue
+    under the condition lock; the scheduler thread owns ALL device state
+    (resident cache, per-slot arrays) and the slot table, so the hot loop
+    never takes a lock around device work. Aggregate counters live behind
+    their own lock (`stats()`).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        model,
+        params,
+        *,
+        num_slots: int = 8,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        max_queue: int = 64,
+        autostart: bool = True,
+        draft_model=None,
+        draft_params=None,
+        num_draft_tokens: int = 0,
+    ):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.name = name
+        self.model = model
+        self.params = params
+        self.num_slots = num_slots
+        self.max_queue = max_queue
+        cfg = model.cfg
+        self.num_draft_tokens = int(num_draft_tokens)
+        if self.num_draft_tokens > 0 and (
+            draft_model is None or draft_params is None
+        ):
+            raise ValueError(
+                "num_draft_tokens > 0 needs draft_model and "
+                "draft_params (speculative decoding drafts from a "
+                "resident second model)"
+            )
+        # the jitted program family (and the draft-compat validation)
+        # lives in EnginePrograms — the same object kft-analyze lowers
+        self.programs = EnginePrograms(
+            model, draft_model=draft_model,
+            num_draft_tokens=self.num_draft_tokens,
+        )
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        buckets = tuple(
+            sorted(prefill_buckets)
+            if prefill_buckets
+            else default_prefill_buckets(cfg.max_len)
+        )
+        for b in buckets:
+            if b < 1 or b > cfg.max_len:
+                raise ValueError(
+                    f"prefill bucket {b} outside [1, max_len={cfg.max_len}]"
+                )
+            if b & (b - 1):
+                raise ValueError(f"prefill bucket {b} not a power of two")
+        self.prefill_buckets = buckets
+
+        # -- device state (scheduler-thread-owned after start) ----------
+        from kubeflow_tpu.models.gpt import make_slot_cache
+
+        self._cache_shapes = self.programs.cache_shapes(params, buckets[0])
+        self._make_slot_cache = make_slot_cache
+        self._cache = make_slot_cache(self._cache_shapes, num_slots)
+        self._insert = self.programs.insert
+        self._step = self.programs.step
+        # one wrapper serves every bucket: jit caches one executable per
+        # input shape, so the bucket set bounds the program set by itself
+        self._prefill = self.programs.prefill
+        if self.num_draft_tokens > 0:
+            # the draft's resident slot cache mirrors the target's slot
+            # table position-for-position; its cursors advance and rewind
+            # in lockstep with the target's inside the verify program
+            self._draft_cache_shapes = self.programs.draft_cache_shapes(
+                draft_params, buckets[0]
+            )
+            self._draft_cache = make_slot_cache(
+                self._draft_cache_shapes, num_slots
+            )
+            self._draft_insert = self.programs.draft_insert
+            self._draft_prefill = self.programs.draft_prefill
+            self._draft = self.programs.draft
+            self._verify = self.programs.verify
+        else:
+            self._draft_cache = None
+        # per-slot host mirrors, scheduler-thread-owned
+        self._slots: List[Optional[_Slot]] = [None] * num_slots
+        self._tok_np = np.zeros((num_slots,), np.int32)
+        self._key_np = np.zeros((num_slots, 2), np.uint32)
+        self._cnt_np = np.zeros((num_slots,), np.int32)
+        # rng-stream position (draws consumed, != tokens emitted once the
+        # verify window starts drawing K+1 positions per iteration)
+        self._draw_np = np.zeros((num_slots,), np.int32)
+        self._temp_np = np.zeros((num_slots,), np.float32)
+        self._topk_np = np.zeros((num_slots,), np.int32)
+        self._topp_np = np.ones((num_slots,), np.float32)
+
+        # -- shared state (condition-lock-guarded) ----------------------
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._stop = False
+
+        self._stats_lock = threading.Lock()
+        self._admitted = 0
+        self._steps = 0
+        self._emitted = 0
+        self._occupied_slot_steps = 0
+        self._drafted = 0
+        self._accepted = 0
+        self._verifies = 0
+
+        # kft-trace (observability/): request phases + scheduler iteration
+        # spans ride the process tracer; a disabled tracer makes every
+        # span call a no-op (docs/OBSERVABILITY.md span catalog)
+        self._tracer = default_tracer()
+        # recent finished requests (phase breakdowns) for /statusz —
+        # appended by the scheduler thread, read by HTTP handlers
+        self._recent: deque = deque(maxlen=32)
+
+        self._ttft = serving_ttft_histogram()
+        self._phase = serving_phase_histogram()
+        self._draft_proposed = serving_draft_proposed_counter()
+        self._draft_accepted = serving_draft_accepted_counter()
+        self._accept_rate = serving_accept_rate_histogram()
+        self._verify_steps = serving_verify_steps_counter()
+        self._queue_depth = serving_queue_depth_gauge()
+        self._occupancy = serving_slot_occupancy_gauge()
+        self._decode_steps = serving_decode_steps_counter()
+        self._tokens_total = serving_tokens_counter()
+        self._queue_depth.set(0, model=name)
+        self._occupancy.set(0.0, model=name)
+
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"decode-engine-{name}"
+        )
+        if autostart:
+            self._thread.start()
+
     # -- public API --------------------------------------------------------
 
     def bucket_for(self, prompt_len: int) -> int:
-        for b in self.prefill_buckets:
-            if prompt_len <= b:
-                return b
-        raise EngineCapacityError(
-            f"prompt length {prompt_len} exceeds the largest prefill "
-            f"bucket {self.prefill_buckets[-1]}"
-        )
+        return bucket_for(prompt_len, self.prefill_buckets)
 
     def _make_request(self, prompt_ids, max_new_tokens, temperature,
                       top_k, top_p, eos_id, seed,

@@ -11,6 +11,15 @@ import argparse
 import os
 import sys
 
+# knob defaults shared with the serving plan registry — the same module
+# kft-analyze's serving lint sweeps, so the analyzed default engine
+# geometry and the served one cannot drift (analysis/serving_plans.py;
+# jax-free import, safe at entrypoint scope)
+from kubeflow_tpu.analysis.serving_plans import (
+    DEFAULT_MAX_QUEUE,
+    DEFAULT_NUM_SLOTS,
+)
+
 
 def _env_int(name: str, default: int) -> int:
     raw = os.environ.get(name, "")
@@ -28,8 +37,8 @@ def engine_knobs_from_env():
     buckets_raw = os.environ.get("KFT_SERVING_PREFILL_BUCKETS", "")
     buckets = [int(b) for b in buckets_raw.split(",") if b.strip()]
     return {
-        "num_slots": _env_int("KFT_SERVING_NUM_SLOTS", 8),
-        "max_queue": _env_int("KFT_SERVING_MAX_QUEUE", 64),
+        "num_slots": _env_int("KFT_SERVING_NUM_SLOTS", DEFAULT_NUM_SLOTS),
+        "max_queue": _env_int("KFT_SERVING_MAX_QUEUE", DEFAULT_MAX_QUEUE),
         "prefill_buckets": buckets or None,
         "draft_model": os.environ.get("KFT_SERVING_DRAFT_MODEL", "").strip(),
         "num_draft_tokens": _env_int("KFT_SERVING_DRAFT_TOKENS", 0),
