@@ -38,6 +38,17 @@ _OBSERVE_METHODS = {
     "time": 0, "value": 0, "count": 0, "sum": 0,
 }
 _CONFIG_MODULE = "kubeflow_tpu/config/platform.py"
+_FLEET_MODULE = "kubeflow_tpu/observability/fleet.py"
+_POLICY_TABLE = "AGGREGATION_POLICY"
+# legal merge policies per metric kind (utils/metrics.py merge_rendered):
+# a "sum" histogram or a "merge" counter is a table bug, not a choice
+_POLICIES_BY_KIND = {
+    "counter": {"sum"},
+    "gauge": {"sum", "max", "min", "mean"},
+    "histogram": {"merge"},
+}
+# series the fleet collector PRODUCES (never scrapes) stay out of the table
+_FLEET_PRODUCED_PREFIX = "fleet_"
 _ENV_RENDER_PREFIX = "kubeflow_tpu/controllers/"
 _ENV_CONSUMER_PREFIXES = (
     "kubeflow_tpu/runtime/",
@@ -86,15 +97,11 @@ def _metric_decl(node: ast.Call, path: str) -> Optional[_Decl]:
     )
 
 
-def check_metrics_consistency(sources: SourceSet) -> List[Finding]:
-    rule = "metrics-consistency"
-    findings: List[Finding] = []
+def _collect_metric_decls(sources: SourceSet) -> Dict[str, List[_Decl]]:
+    """Every statically-known metric registration in the source set —
+    shared by the label-set check and the fleet aggregation-policy check
+    (one AST walk, one collection rule)."""
     decls: Dict[str, List[_Decl]] = {}
-    # helper functions in utils/metrics.py that return one registry call:
-    # {helper_name: declared labels} so `X = host_wait_histogram()` call
-    # sites resolve to the central declaration's label set
-    helper_labels: Dict[str, Optional[Tuple[str, ...]]] = {}
-
     for sf in sources:
         if sf.tree is None:
             continue
@@ -103,6 +110,25 @@ def check_metrics_consistency(sources: SourceSet) -> List[Finding]:
                 d = _metric_decl(node, sf.path)
                 if d is not None:
                     decls.setdefault(d.name, []).append(d)
+    return decls
+
+
+def check_metrics_consistency(
+    sources: SourceSet,
+    decls: Optional[Dict[str, List[_Decl]]] = None,
+) -> List[Finding]:
+    rule = "metrics-consistency"
+    findings: List[Finding] = []
+    if decls is None:
+        decls = _collect_metric_decls(sources)
+    # helper functions in utils/metrics.py that return one registry call:
+    # {helper_name: declared labels} so `X = host_wait_histogram()` call
+    # sites resolve to the central declaration's label set
+    helper_labels: Dict[str, Optional[Tuple[str, ...]]] = {}
+
+    for sf in sources:
+        if sf.tree is None:
+            continue
         if sf.path.endswith("utils/metrics.py"):
             for fn in ast.walk(sf.tree):
                 if not isinstance(fn, ast.FunctionDef):
@@ -244,6 +270,186 @@ def check_metrics_consistency(sources: SourceSet) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# fleet aggregation-policy table (rides the metrics-consistency rule)
+# ---------------------------------------------------------------------------
+
+
+def _policy_table(sf) -> Optional[ast.Dict]:
+    """The module-level AGGREGATION_POLICY dict literal in fleet.py."""
+    for node in sf.tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+            value = node.value
+        else:
+            continue
+        if (
+            isinstance(target, ast.Name)
+            and target.id == _POLICY_TABLE
+            and isinstance(value, ast.Dict)
+        ):
+            return value
+    return None
+
+
+def check_aggregation_policy(
+    sources: SourceSet,
+    decls: Optional[Dict[str, List[_Decl]]] = None,
+) -> List[Finding]:
+    """The fleet collector's merge-policy table must cover every scraped
+    metric name EXACTLY once with a policy legal for its kind: counters
+    sum, histograms merge, gauges sum/max/min/mean. A declared metric
+    missing from the table would ship unaggregatable (the collector
+    skips unlisted names); a stale or duplicate entry is a drifted
+    contract. Collector-produced fleet_* series are never scraped and
+    must stay OUT of the table."""
+    rule = "metrics-consistency"
+    sf = sources.files.get(_FLEET_MODULE)
+    if sf is None or sf.tree is None:
+        return []
+    findings: List[Finding] = []
+    table = _policy_table(sf)
+    if table is None:
+        return [
+            Finding(
+                analyzer=rule,
+                severity=Severity.ERROR,
+                location=f"{_FLEET_MODULE}:1",
+                symbol=_POLICY_TABLE,
+                message=(
+                    f"{_POLICY_TABLE} dict literal not found in "
+                    f"{_FLEET_MODULE} — the fleet collector has no "
+                    f"aggregation contract to merge scraped metrics by"
+                ),
+            )
+        ]
+    # declared metric names -> kinds across the codebase (one shared
+    # collection walk with the label-set check)
+    if decls is None:
+        decls = _collect_metric_decls(sources)
+    kinds: Dict[str, Set[str]] = {}
+    decl_loc: Dict[str, str] = {}
+    for name, dl in decls.items():
+        for d in dl:
+            kinds.setdefault(name, set()).add(d.kind)
+            decl_loc.setdefault(name, d.location)
+    entries: Dict[str, List[int]] = {}
+    policies: Dict[str, Tuple[str, int]] = {}
+    for k, v in zip(table.keys, table.values):
+        if not (
+            isinstance(k, ast.Constant) and isinstance(k.value, str)
+            and isinstance(v, ast.Constant) and isinstance(v.value, str)
+        ):
+            findings.append(
+                Finding(
+                    analyzer=rule,
+                    severity=Severity.ERROR,
+                    location=f"{_FLEET_MODULE}:{getattr(k, 'lineno', table.lineno)}",
+                    symbol=_POLICY_TABLE,
+                    message=(
+                        f"{_POLICY_TABLE} entries must be string-literal "
+                        f"name: policy pairs (the table IS the static "
+                        f"contract the lint verifies)"
+                    ),
+                )
+            )
+            continue
+        entries.setdefault(k.value, []).append(k.lineno)
+        policies[k.value] = (v.value, k.lineno)
+    for name, lines in sorted(entries.items()):
+        if len(lines) > 1:
+            findings.append(
+                Finding(
+                    analyzer=rule,
+                    severity=Severity.ERROR,
+                    location=f"{_FLEET_MODULE}:{lines[1]}",
+                    symbol=name,
+                    message=(
+                        f"metric {name!r} declares an aggregation policy "
+                        f"{len(lines)} times (lines {lines}) — later dict "
+                        f"keys silently override earlier ones"
+                    ),
+                )
+            )
+    for name, (policy, line) in sorted(policies.items()):
+        if sources.suppressed(_FLEET_MODULE, line, rule):
+            continue
+        if name.startswith(_FLEET_PRODUCED_PREFIX):
+            findings.append(
+                Finding(
+                    analyzer=rule,
+                    severity=Severity.ERROR,
+                    location=f"{_FLEET_MODULE}:{line}",
+                    symbol=name,
+                    message=(
+                        f"{name!r} is a collector-PRODUCED fleet series; "
+                        f"it is never scraped and must not declare an "
+                        f"aggregation policy"
+                    ),
+                )
+            )
+            continue
+        declared = kinds.get(name)
+        if not declared:
+            findings.append(
+                Finding(
+                    analyzer=rule,
+                    severity=Severity.ERROR,
+                    location=f"{_FLEET_MODULE}:{line}",
+                    symbol=name,
+                    message=(
+                        f"aggregation policy declared for {name!r} but no "
+                        f"metric of that name is registered anywhere — "
+                        f"stale table entry"
+                    ),
+                )
+            )
+            continue
+        legal = set().union(
+            *(_POLICIES_BY_KIND.get(k, set()) for k in declared)
+        )
+        if policy not in legal:
+            findings.append(
+                Finding(
+                    analyzer=rule,
+                    severity=Severity.ERROR,
+                    location=f"{_FLEET_MODULE}:{line}",
+                    symbol=name,
+                    message=(
+                        f"metric {name!r} is a {'/'.join(sorted(declared))} "
+                        f"but declares aggregation policy {policy!r}; "
+                        f"legal: {sorted(legal)}"
+                    ),
+                )
+            )
+    for name, loc in sorted(decl_loc.items()):
+        if name.startswith(_FLEET_PRODUCED_PREFIX) or name in policies:
+            continue
+        line = int(loc.rsplit(":", 1)[1])
+        path = loc.rsplit(":", 1)[0]
+        if sources.suppressed(path, line, rule):
+            continue
+        findings.append(
+            Finding(
+                analyzer=rule,
+                severity=Severity.ERROR,
+                location=loc,
+                symbol=name,
+                message=(
+                    f"metric {name!r} has no entry in "
+                    f"{_FLEET_MODULE}::{_POLICY_TABLE} — the fleet "
+                    f"collector would silently skip it when merging "
+                    f"scraped replicas (declare sum/max/min/mean/merge)"
+                ),
+            )
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # config-reachability
 # ---------------------------------------------------------------------------
 
@@ -359,7 +565,9 @@ def check_env_reachability(sources: SourceSet) -> List[Finding]:
 
 def run_consistency(sources: SourceSet) -> List[Finding]:
     out: List[Finding] = []
-    out.extend(check_metrics_consistency(sources))
+    decls = _collect_metric_decls(sources)  # one walk, both checks
+    out.extend(check_metrics_consistency(sources, decls))
+    out.extend(check_aggregation_policy(sources, decls))
     out.extend(check_config_reachability(sources))
     out.extend(check_env_reachability(sources))
     return out

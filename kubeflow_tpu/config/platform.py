@@ -209,12 +209,64 @@ class ObservabilityConfig(ConfigNode):
         help="serve /statusz + /debug/trace (+ /metrics on the training "
         "runtime's debug port); off = endpoints not mounted",
     )
+    slo_rules: List[str] = config_field(
+        default_factory=list,
+        help="declarative fleet SLO rules (observability/slo.py), e.g. "
+        "'serving_ttft_p99 < 5s', 'training_goodput > 0.85', "
+        "'serving_queue_depth / num_slots < 0.8'. Evaluated per fleet "
+        "scrape sweep into fleet_slo_compliant{slo} + "
+        "fleet_slo_burn_rate{slo}.",
+    )
+    fleet_scrape_interval_s: float = config_field(
+        default=10.0,
+        help="fleet collector sweep period (observability/fleet.py): "
+        "every replica/host /metrics endpoint is scraped and merged "
+        "this often",
+    )
+    fleet_straggler_zscore: float = config_field(
+        default=3.0,
+        help="gang-host straggler threshold: flag a host whose rolling "
+        "mean step time exceeds its peers' by more than this many "
+        "(leave-one-out, floored) standard deviations",
+    )
+    fleet_burn_window: int = config_field(
+        default=30,
+        help="SLO burn-rate window in scrape sweeps: burn rate = "
+        "breached fraction of the last N evaluations",
+    )
 
     def validate(self) -> None:
         if self.trace_buffer_spans < 1:
             raise ConfigError(
                 "observability.trace_buffer_spans must be >= 1"
             )
+        if self.fleet_scrape_interval_s <= 0:
+            raise ConfigError(
+                "observability.fleet_scrape_interval_s must be > 0"
+            )
+        if self.fleet_straggler_zscore <= 0:
+            raise ConfigError(
+                "observability.fleet_straggler_zscore must be > 0"
+            )
+        if self.fleet_burn_window < 1:
+            raise ConfigError(
+                "observability.fleet_burn_window must be >= 1"
+            )
+        # parse AND kind-check the rule list NOW: an unparseable rule, a
+        # histogram signal missing its quantile, or a quantile of a
+        # scalar must fail the config, not the collector's first sweep
+        # at 3am (such a rule would silently never evaluate)
+        from kubeflow_tpu.observability.fleet import AGGREGATION_POLICY
+        from kubeflow_tpu.observability.slo import (
+            SloParseError,
+            check_signal_kinds,
+            parse_rules,
+        )
+
+        try:
+            check_signal_kinds(parse_rules(self.slo_rules), AGGREGATION_POLICY)
+        except SloParseError as e:
+            raise ConfigError(f"observability.slo_rules: {e}") from e
 
 
 @dataclasses.dataclass
@@ -426,6 +478,77 @@ class TrainingConfig(ConfigNode):
 
 
 @dataclasses.dataclass
+class AutoscaleConfig(ConfigNode):
+    """Signal-driven replica autoscaling for an InferenceService
+    (controllers/inference.py, fed by the fleet collector's aggregated
+    engine signals — observability/fleet.py serving_signals). Pure
+    control-plane knobs: nothing here is rendered into pod env."""
+
+    enabled: bool = config_field(
+        default=False,
+        help="let the controller adjust spec.replicas from the fleet's "
+        "own queue/occupancy/429 signals; off = replicas are operator-"
+        "managed",
+    )
+    min_replicas: int = config_field(
+        default=1, help="never scale below this"
+    )
+    max_replicas: int = config_field(
+        default=1, help="never scale above this"
+    )
+    scale_up_occupancy: float = config_field(
+        default=0.9,
+        help="fleet mean slot occupancy at or above this counts as "
+        "scale-up pressure",
+    )
+    scale_up_queue_per_slot: float = config_field(
+        default=0.5,
+        help="fleet queue depth per fleet slot at or above this counts "
+        "as scale-up pressure (matches the queue/slots SLO shape)",
+    )
+    scale_down_occupancy: float = config_field(
+        default=0.3,
+        help="fleet occupancy at or below this WITH an empty queue and "
+        "no 429s counts as scale-down headroom",
+    )
+    breach_cycles: int = config_field(
+        default=3,
+        help="hysteresis: the pressure (or headroom) signal must hold "
+        "for this many consecutive reconciles before a resize",
+    )
+    cooldown_cycles: int = config_field(
+        default=5,
+        help="reconciles to wait after a resize before considering "
+        "another (lets the new replica's signals land)",
+    )
+
+    def validate(self) -> None:
+        if self.min_replicas < 0:
+            raise ConfigError("autoscale.min_replicas must be >= 0")
+        if self.max_replicas < max(1, self.min_replicas):
+            raise ConfigError(
+                "autoscale.max_replicas must be >= max(1, min_replicas)"
+            )
+        for knob in (
+            "scale_up_occupancy",
+            "scale_up_queue_per_slot",
+            "scale_down_occupancy",
+        ):
+            v = getattr(self, knob)
+            if v < 0:
+                raise ConfigError(f"autoscale.{knob} must be >= 0")
+        if self.scale_down_occupancy >= self.scale_up_occupancy:
+            raise ConfigError(
+                "autoscale.scale_down_occupancy must be below "
+                "scale_up_occupancy (the hysteresis band)"
+            )
+        if self.breach_cycles < 1:
+            raise ConfigError("autoscale.breach_cycles must be >= 1")
+        if self.cooldown_cycles < 0:
+            raise ConfigError("autoscale.cooldown_cycles must be >= 0")
+
+
+@dataclasses.dataclass
 class ServingConfig(ConfigNode):
     """Continuous-batching decode-engine knobs (serving/engine.py;
     docs/SERVING.md). The InferenceService controller renders these as
@@ -476,8 +599,12 @@ class ServingConfig(ConfigNode):
     observability: ObservabilityConfig = config_field(
         default_factory=ObservabilityConfig
     )
+    autoscale: AutoscaleConfig = config_field(
+        default_factory=AutoscaleConfig
+    )
 
     def validate(self) -> None:
+        self.autoscale.validate()
         if self.num_slots < 0:
             raise ConfigError("serving.num_slots must be >= 0")
         if self.max_queue < 1:

@@ -11,7 +11,8 @@ controller (reference: tensorboard_controller.go:54-260).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
 
 from kubeflow_tpu.cluster.objects import new_object, set_condition, set_owner
 from kubeflow_tpu.cluster.reconciler import Controller, Result
@@ -19,6 +20,9 @@ from kubeflow_tpu.cluster.store import StateStore
 from kubeflow_tpu.config.core import from_dict
 from kubeflow_tpu.config.platform import ServingConfig, SliceConfig
 from kubeflow_tpu.controllers.statefulset import new_deployment
+from kubeflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
 
 KIND = "InferenceService"
 DEFAULT_IMAGE = "kubeflow-tpu/model-server:latest"
@@ -53,6 +57,18 @@ def new_inference_service(
     )
 
 
+@dataclasses.dataclass
+class _ScaleState:
+    """Per-service autoscaler hysteresis bookkeeping: how many
+    consecutive FLEET SWEEPS the pressure/headroom signal has held, and
+    the post-resize cooldown countdown (also in sweeps)."""
+
+    up_streak: int = 0
+    down_streak: int = 0
+    cooldown: int = 0
+    last_sweep: int = -1  # collector sweep id last counted
+
+
 class InferenceServiceController(Controller):
     kind = KIND
     name = "inference-controller"
@@ -62,6 +78,7 @@ class InferenceServiceController(Controller):
         use_istio: bool = True,
         istio_gateway: str = "kubeflow/kubeflow-gateway",
         serving_defaults: Optional[ServingConfig] = None,
+        fleet=None,
     ) -> None:
         super().__init__()
         self.use_istio = use_istio
@@ -69,37 +86,24 @@ class InferenceServiceController(Controller):
         # platform-wide engine defaults (PlatformDef.serving); per-CR
         # spec.serving keys override field-by-field
         self.serving_defaults = serving_defaults or ServingConfig()
+        # the fleet collector (observability/fleet.py FleetCollector, or
+        # anything with its serving_signals(ns, name) shape): the
+        # autoscaler's only input. None = autoscaling inert even when a
+        # CR asks for it (no signals, no decisions).
+        self.fleet = fleet
+        self._scale_state: Dict[Tuple[str, str], _ScaleState] = {}
         self.watches = {"Deployment": self.map_owned}
 
-    def _serving_env(self, spec: Dict[str, Any]) -> Dict[str, str]:
+    def _serving_env(
+        self, spec: Dict[str, Any], cfg: Optional[ServingConfig] = None
+    ) -> Dict[str, str]:
         """The engine contract rendered into every serving pod — consumed
         by serving/main.py engine_knobs_from_env. Always rendered (also
         at defaults): the pod's env documents the engine configuration it
         actually runs."""
-        obs_defaults = self.serving_defaults.observability
-        merged = {
-            "num_slots": self.serving_defaults.num_slots,
-            "prefill_buckets": list(self.serving_defaults.prefill_buckets),
-            "max_queue": self.serving_defaults.max_queue,
-            "draft_model": self.serving_defaults.draft_model,
-            "num_draft_tokens": self.serving_defaults.num_draft_tokens,
-            "draft_checkpoint_dir": self.serving_defaults.draft_checkpoint_dir,
-            "observability": {
-                "trace_enabled": obs_defaults.trace_enabled,
-                "trace_buffer_spans": obs_defaults.trace_buffer_spans,
-                "statusz_enabled": obs_defaults.statusz_enabled,
-            },
-        }
-        overrides = dict(spec.get("serving") or {})
-        # the observability subtree merges field-by-field like the
-        # top-level keys (a CR overriding one trace knob must not silently
-        # reset the other two to dataclass defaults)
-        obs_override = overrides.pop("observability", None) or {}
-        merged["observability"].update(obs_override)
-        merged.update(overrides)
-        cfg = from_dict(ServingConfig, merged)
-        cfg.validate()
-        return {
+        if cfg is None:
+            cfg = self._serving_cfg(spec)
+        env = {
             "KFT_SERVING_NUM_SLOTS": str(cfg.num_slots),
             "KFT_SERVING_MAX_QUEUE": str(cfg.max_queue),
             "KFT_SERVING_PREFILL_BUCKETS": ",".join(
@@ -117,12 +121,156 @@ class InferenceServiceController(Controller):
                 "1" if cfg.observability.statusz_enabled else "0"
             ),
         }
+        if cfg.observability.statusz_enabled:
+            # kft-fleet contract (observability/fleet.py): the collector
+            # scrapes every replica's /metrics on the serving port.
+            # Gated on statusz like the TPUJob debug port — a statusz-off
+            # replica mounts no /metrics, and advertising a scrape port
+            # it will 404 on would make it a permanently-failing target.
+            env["KFT_FLEET_METRICS_PORT"] = str(SERVE_PORT)
+        return env
+
+    def _serving_cfg(self, spec: Dict[str, Any]) -> ServingConfig:
+        """Platform defaults merged with the CR's spec.serving overrides
+        (nested observability/autoscale subtrees merge FIELD-BY-FIELD —
+        a CR overriding one knob must not silently reset its siblings to
+        dataclass defaults)."""
+        merged = {
+            "num_slots": self.serving_defaults.num_slots,
+            "prefill_buckets": list(self.serving_defaults.prefill_buckets),
+            "max_queue": self.serving_defaults.max_queue,
+            "draft_model": self.serving_defaults.draft_model,
+            "num_draft_tokens": self.serving_defaults.num_draft_tokens,
+            "draft_checkpoint_dir": self.serving_defaults.draft_checkpoint_dir,
+            "observability": dataclasses.asdict(
+                self.serving_defaults.observability
+            ),
+            "autoscale": dataclasses.asdict(
+                self.serving_defaults.autoscale
+            ),
+        }
+        overrides = dict(spec.get("serving") or {})
+        for subtree in ("observability", "autoscale"):
+            sub_override = overrides.pop(subtree, None) or {}
+            merged[subtree].update(sub_override)
+        merged.update(overrides)
+        cfg = from_dict(ServingConfig, merged)
+        cfg.validate()
+        return cfg
+
+    def _maybe_autoscale(
+        self,
+        store: StateStore,
+        svc_cr: Dict[str, Any],
+        namespace: str,
+        name: str,
+        cfg_serving: ServingConfig,
+    ) -> bool:
+        """Signal-driven replica autoscaling (the ROADMAP's replicated-
+        serving loop): read the fleet collector's aggregated queue/
+        occupancy/429 signals for this service and adjust spec.replicas
+        between min/max with hysteresis — the pressure (or headroom)
+        signal must hold `breach_cycles` consecutive reconciles, and a
+        resize starts a `cooldown_cycles` quiet period so the new
+        replica's signals can land before the next decision. Pure
+        signal-driven logic: tests feed it a fake signals source.
+        Returns True when autoscaling is active (caller keeps requeueing
+        so signals are re-polled)."""
+        spec = svc_cr.get("spec", {})
+        cfg = cfg_serving.autoscale
+        key = (namespace, name)
+        if not cfg.enabled or self.fleet is None:
+            self._scale_state.pop(key, None)
+            return False
+        st = self._scale_state.setdefault(key, _ScaleState())
+        current = int(spec.get("replicas", 1))
+        # the min/max clamp applies even before any signal arrives
+        desired = min(max(current, cfg.min_replicas), cfg.max_replicas)
+        reason = "Clamp"
+        sig = self.fleet.serving_signals(namespace, name)
+        # hysteresis counts fleet SWEEPS, not reconciles: the controller
+        # also reconciles on watch events and its 5s requeue, and
+        # re-reading one sweep's snapshot several times must not fake
+        # "consecutive" observations (sweep < 0 = untracked source,
+        # every read counts — the unit-test fakes)
+        fresh = True
+        if sig is not None and sig.sweep >= 0:
+            fresh = sig.sweep != st.last_sweep
+            st.last_sweep = sig.sweep
+        if not fresh:
+            pass
+        elif st.cooldown > 0:
+            st.cooldown -= 1
+        elif sig is None:
+            # signal outage: reset the streaks rather than freeze them —
+            # hysteresis promises CONSECUTIVE observations, and a stale
+            # pre-outage streak must not let one post-recovery reading
+            # trigger a resize
+            st.up_streak = st.down_streak = 0
+        else:
+            if sig.num_slots > 0:
+                q_per_slot = sig.queue_depth / sig.num_slots
+            else:
+                q_per_slot = 1.0 if sig.queue_depth > 0 else 0.0
+            pressure = (
+                sig.occupancy >= cfg.scale_up_occupancy
+                or q_per_slot >= cfg.scale_up_queue_per_slot
+                or sig.rate_429_per_s > 0
+            )
+            headroom = (
+                sig.occupancy <= cfg.scale_down_occupancy
+                and sig.queue_depth == 0
+                and sig.rate_429_per_s == 0
+            )
+            st.up_streak = st.up_streak + 1 if pressure else 0
+            st.down_streak = st.down_streak + 1 if headroom else 0
+            if st.up_streak >= cfg.breach_cycles and desired < cfg.max_replicas:
+                desired += 1
+                reason = "ScaleUp"
+            elif (
+                st.down_streak >= cfg.breach_cycles
+                and desired > cfg.min_replicas
+            ):
+                desired -= 1
+                reason = "ScaleDown"
+            if reason in ("ScaleUp", "ScaleDown"):
+                st.up_streak = st.down_streak = 0
+                st.cooldown = cfg.cooldown_cycles
+        if desired != current:
+            from kubeflow_tpu.observability.trace import default_tracer
+
+            detail = (
+                f"replicas {current} -> {desired} "
+                f"(occupancy={getattr(sig, 'occupancy', None)}, "
+                f"queue={getattr(sig, 'queue_depth', None)}, "
+                f"429/s={getattr(sig, 'rate_429_per_s', None)})"
+            )
+            default_tracer().event(
+                "autoscale.resize",
+                service=f"{namespace}/{name}",
+                reason=reason,
+                replicas_from=current,
+                replicas_to=desired,
+            )
+            log.info("autoscale %s/%s: %s %s", namespace, name, reason, detail)
+            spec["replicas"] = desired
+            svc_cr["spec"] = spec
+            store.update(svc_cr)
+            store.record_event(svc_cr, reason, detail)
+        return True
 
     def reconcile(self, store: StateStore, namespace: str, name: str) -> Result:
         svc_cr = store.try_get(KIND, name, namespace)
         if svc_cr is None or svc_cr["metadata"].get("deletionTimestamp"):
+            # a deleted service's hysteresis state must not leak into a
+            # later same-name service (stale cooldown/streaks)
+            self._scale_state.pop((namespace, name), None)
             return Result()
         spec = svc_cr.get("spec", {})
+        serving_cfg = self._serving_cfg(spec)
+        autoscaling = self._maybe_autoscale(
+            store, svc_cr, namespace, name, serving_cfg
+        )
 
         container: Dict[str, Any] = {
             "name": "model-server",
@@ -138,7 +286,9 @@ class InferenceServiceController(Controller):
             "ports": [{"containerPort": SERVE_PORT}],
             "env": [
                 {"name": k, "value": v}
-                for k, v in sorted(self._serving_env(spec).items())
+                for k, v in sorted(
+                    self._serving_env(spec, serving_cfg).items()
+                )
             ],
         }
         pod_spec: Dict[str, Any] = {"containers": [container]}
@@ -213,4 +363,7 @@ class InferenceServiceController(Controller):
         )
         if changed:
             store.patch_status(KIND, name, namespace, svc_cr["status"])
-        return Result()
+        # an autoscaling service re-polls its fleet signals periodically
+        # even with no cluster writes pending (each poll is one hysteresis
+        # cycle); everything else stays purely event-driven
+        return Result(requeue_after_s=5.0) if autoscaling else Result()

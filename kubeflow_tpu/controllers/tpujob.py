@@ -404,10 +404,18 @@ class TPUTrainJobController(Controller):
         env["KFT_TRACE_BUFFER_SPANS"] = str(obs.trace_buffer_spans)
         env["KFT_TRACE_STATUSZ"] = "1" if obs.statusz_enabled else "0"
         if obs.statusz_enabled:
-            # the coordinator serves /statusz + /debug/trace + /metrics on
-            # this port (runtime/launcher.py; same one-endpoint-per-gang
-            # rule as the profiler); unset = no debug server
+            # every gang host serves /statusz + /debug/trace + /metrics on
+            # this port (runtime/launcher.py; pods have distinct network
+            # namespaces so one port fits all); unset = no debug server
             env.setdefault("KFT_DEBUG_PORT", str(DEBUG_PORT))
+            # kft-fleet contract (observability/fleet.py): the collector
+            # scrapes each host's debug port; KFT_FLEET_SCRAPE makes the
+            # NON-coordinator hosts serve it too (per-host step-time
+            # series are the straggler detector's input), and the
+            # per-pod instance id keeps aggregated rows attributable
+            env["KFT_FLEET_SCRAPE"] = "1"
+            env["KFT_FLEET_METRICS_PORT"] = env["KFT_DEBUG_PORT"]
+            env["KFT_FLEET_INSTANCE"] = pod_name
         pod = new_object(
             "Pod",
             pod_name,

@@ -26,7 +26,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.api.wsgi import App, Response
 from kubeflow_tpu.observability.trace import Tracer, default_tracer
-from kubeflow_tpu.utils.metrics import default_registry
+from kubeflow_tpu.utils.metrics import default_registry, instance_info_gauge
 
 # a statusz section: (title, lines-callable) — called per request so the
 # snapshot is always current
@@ -37,10 +37,20 @@ def add_debug_routes(
     app: App,
     tracer: Optional[Tracer] = None,
     statusz_sections: Optional[List[StatuszSection]] = None,
+    role: str = "serving",
 ) -> App:
-    """Mount /debug/trace, /statusz and /metrics on `app`."""
+    """Mount /debug/trace, /statusz and /metrics on `app`.
+
+    `role` tags this process's kft_instance_info identity series
+    (serving|training): every /metrics page carries WHO emitted it (the
+    KFT_FLEET_INSTANCE replica/host id), so the fleet collector's
+    aggregated rows stay attributable without relying on scrape order.
+    """
     tr = tracer if tracer is not None else default_tracer()
     sections = list(statusz_sections or [])
+    from kubeflow_tpu.observability.fleet import instance_id
+
+    instance_info_gauge().set(1.0, instance=instance_id(), role=role)
 
     @app.get("/debug/trace")
     def debug_trace(req):
@@ -99,10 +109,50 @@ def build_debug_app(
     name: str = "debug",
     tracer: Optional[Tracer] = None,
     statusz_sections: Optional[List[StatuszSection]] = None,
+    role: str = "training",
+    fleet=None,
 ) -> App:
     """Standalone debug app (the training runtime mounts this next to the
-    profiler endpoint; the model server mounts the routes on its own app)."""
-    return add_debug_routes(App(name), tracer, statusz_sections)
+    profiler endpoint; the model server mounts the routes on its own
+    app). Pass a FleetCollector as `fleet` to also mount the aggregated
+    /fleetz + /debug/fleet-trace surface (the controller/coordinator
+    debug server)."""
+    app = add_debug_routes(App(name), tracer, statusz_sections, role=role)
+    if fleet is not None:
+        add_fleet_routes(app, fleet)
+    return app
+
+
+def add_fleet_routes(app: App, collector) -> App:
+    """Mount the fleet-aggregated surface (observability/fleet.py):
+
+    - GET /fleetz — text snapshot of the whole fleet: scrape targets,
+      per-service condensed serving signals, SLO compliance + burn
+      rates, and the gang straggler table.
+    - GET /debug/fleet-trace — every target's trace ring stitched onto
+      one timeline (per-host Perfetto process tracks, scrape-time
+      clock-offset estimation); save the body and load it in Perfetto
+      exactly like /debug/trace.
+    """
+
+    @app.get("/fleetz")
+    def fleetz(req):
+        lines = [
+            f"{app.name} fleetz @ "
+            f"{time.strftime('%Y-%m-%d %H:%M:%S')}",
+            "",
+        ]
+        lines.extend(collector.fleetz_lines())
+        return Response("\n".join(lines) + "\n", "text/plain; charset=utf-8")
+
+    @app.get("/debug/fleet-trace")
+    def fleet_trace(req):
+        return Response(
+            json.dumps(collector.merged_chrome_trace()),
+            "application/json",
+        )
+
+    return app
 
 
 def format_phase_row(summary: Dict[str, float]) -> str:

@@ -318,6 +318,12 @@ class Tracer:
         thread's track), "i" instants for events, plus thread_name
         metadata events so Perfetto labels each track. Span attrs and the
         trace id land in `args` — Perfetto's query/filter surface.
+
+        The top-level `captureUs` key is this process's monotonic clock
+        at export time (same basis as every `ts`). The fleet collector
+        (observability/fleet.py) uses it for scrape-time clock-offset
+        estimation when stitching several hosts' dumps onto one
+        timeline; Perfetto ignores unknown top-level keys.
         """
         records = self.snapshot()
         pid = os.getpid()
@@ -356,6 +362,7 @@ class Tracer:
         return {
             "traceEvents": meta + sorted(events, key=lambda e: e["ts"]),
             "displayTimeUnit": "ms",
+            "captureUs": round(time.monotonic() * 1e6, 3),
         }
 
     def chrome_trace_json(self) -> str:

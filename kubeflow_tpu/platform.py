@@ -33,6 +33,7 @@ from kubeflow_tpu.controllers.studyjob import StudyJobController
 from kubeflow_tpu.controllers.tensorboard import TensorboardController
 from kubeflow_tpu.controllers.tpujob import TPUTrainJobController
 from kubeflow_tpu.deploy.coordinator import Coordinator
+from kubeflow_tpu.observability.fleet import FleetCollector, discover_targets
 from kubeflow_tpu.runtime.executor import (
     FakePodRunner,
     InProcessTrainerRunner,
@@ -67,6 +68,16 @@ class Platform:
         install_notebook_conversion(self.store)
 
         self.manager = ControllerManager(self.store)
+        # kft-fleet (observability/fleet.py): the control-plane collector
+        # scraping every serving replica / gang host the store knows
+        # about — merged series, SLO gauges, straggler flags, and the
+        # signal source the InferenceService autoscaler reads. Knobs
+        # (slo_rules, sweep interval, straggler z, burn window) come from
+        # the platform serving observability config.
+        self.fleet = FleetCollector.from_config(
+            self.platform_def.serving.observability,
+            targets=lambda: discover_targets(self.store),
+        )
         use_istio = self.platform_def.use_istio
         gw = self.platform_def.istio_gateway
         self.controllers = [
@@ -85,6 +96,7 @@ class Platform:
                 use_istio=use_istio,
                 istio_gateway=gw,
                 serving_defaults=self.platform_def.serving,
+                fleet=self.fleet,
             ),
             ProfileController(
                 user_id_header=self.platform_def.user_id_header,
@@ -130,7 +142,15 @@ class Platform:
         from kubeflow_tpu.ui import build_app as build_ui
 
         self.ui = build_ui()
-        gateway_apps = [self.ui, self.dashboard, self.spawner, self.kfam]
+        # the aggregated fleet surface (/fleetz + /debug/fleet-trace)
+        # rides the platform gateway like every other operator page
+        from kubeflow_tpu.api.wsgi import App as _App
+        from kubeflow_tpu.observability.http import add_fleet_routes
+
+        self.fleetz = add_fleet_routes(_App("fleet"), self.fleet)
+        gateway_apps = [
+            self.ui, self.dashboard, self.spawner, self.kfam, self.fleetz,
+        ]
         # optional: the deploy router behind the same socket, so the UI's
         # click-to-deploy page works in dev mode (production keeps the
         # router on its own public endpoint, reference: router.go)
@@ -188,6 +208,7 @@ class Platform:
     def start(self, metrics_sample_period_s: float = 15.0) -> "Platform":
         self.manager.start()
         self.executor.start()
+        self.fleet.start()
         import threading
 
         stop = threading.Event()
@@ -208,6 +229,7 @@ class Platform:
     def stop(self) -> None:
         if self._sampler_stop is not None:
             self._sampler_stop.set()
+        self.fleet.stop()
         self.executor.stop()
         self.manager.stop()
 

@@ -64,15 +64,22 @@ def maybe_start_profiler_server(environ=None):
 def maybe_start_debug_server(environ=None):
     """Serve the kft-trace debug surface (/statusz, /debug/trace,
     /metrics — observability/http.py) when the controller rendered
-    KFT_DEBUG_PORT. Coordinator-only, same as the profiler endpoint.
-    Best-effort: a taken port degrades to no debug server, never a dead
-    gang pod (the training job does not depend on its own status page).
+    KFT_DEBUG_PORT. Coordinator-only by default (same-host gang members
+    would race for the port); KFT_FLEET_SCRAPE=1 (the kft-fleet
+    contract, observability/fleet.py) opts EVERY host in — each pod owns
+    its network namespace in the cluster, and the fleet collector needs
+    per-host /metrics for straggler detection. Best-effort either way: a
+    taken port degrades to no debug server, never a dead gang pod (the
+    training job does not depend on its own status page).
     Returns the Server (caller owns shutdown) or None."""
     env = os.environ if environ is None else environ
     port_raw = env.get(ENV_DEBUG_PORT, "").strip()
     if not port_raw:
         return None
-    if env.get("KFT_PROCESS_ID", "0") != "0":
+    fleet_scrape = env.get("KFT_FLEET_SCRAPE", "").strip() not in (
+        "", "0", "false", "False", "off",
+    )
+    if env.get("KFT_PROCESS_ID", "0") != "0" and not fleet_scrape:
         return None
     from kubeflow_tpu.api.wsgi import Server
     from kubeflow_tpu.observability.http import build_debug_app

@@ -78,6 +78,7 @@ from kubeflow_tpu.utils.metrics import (
     serving_decode_steps_counter,
     serving_draft_accepted_counter,
     serving_draft_proposed_counter,
+    serving_num_slots_gauge,
     serving_phase_histogram,
     serving_queue_depth_gauge,
     serving_slot_occupancy_gauge,
@@ -732,8 +733,13 @@ class DecodeEngine:
         self._occupancy = serving_slot_occupancy_gauge()
         self._decode_steps = serving_decode_steps_counter()
         self._tokens_total = serving_tokens_counter()
+        self._num_slots_gauge = serving_num_slots_gauge()
         self._queue_depth.set(0, model=name)
         self._occupancy.set(0.0, model=name)
+        # exported capacity: fleet-level ratios (queue/slots SLO rules,
+        # the autoscaler's queue-per-slot pressure) divide by the sum of
+        # this gauge across replicas (observability/fleet.py)
+        self._num_slots_gauge.set(num_slots, model=name)
 
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"decode-engine-{name}"

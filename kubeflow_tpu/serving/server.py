@@ -285,6 +285,10 @@ class ModelServer:
                     ("models", self._statusz_models),
                     ("engines", self._statusz_engines),
                 ],
+                # identity satellite (kft-fleet): /metrics carries
+                # kft_instance_info{instance,role} so the fleet collector
+                # can attribute this replica's series
+                role="serving",
             )
 
     def _statusz_models(self) -> List[str]:

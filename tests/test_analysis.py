@@ -252,6 +252,119 @@ class TestSeededMetrics:
                    for f in findings)
 
 
+class TestSeededAggregationPolicy:
+    """The fleet merge-policy table contract (observability/fleet.py
+    AGGREGATION_POLICY, checked by check_aggregation_policy under the
+    metrics-consistency rule): every scraped metric name declares a
+    kind-legal policy exactly once, no stale or collector-produced
+    entries."""
+
+    FLEET = "kubeflow_tpu/observability/fleet.py"
+
+    def _src(self, tmp_path, table, extra=""):
+        from kubeflow_tpu.analysis.consistency import (
+            check_aggregation_policy,
+        )
+
+        src = _tree(tmp_path, {
+            self.FLEET: f'''
+                """seed"""
+                AGGREGATION_POLICY = {table}
+            ''',
+            "kubeflow_tpu/m.py": f'''
+                """seed"""
+                def a(reg):
+                    return reg.counter("reqs_total", "h", ["model"])
+
+                def b(reg):
+                    return reg.gauge("depth", "h", ["model"])
+
+                def c(reg):
+                    return reg.histogram("lat_seconds", "h", ["model"])
+                {extra}
+            ''',
+        })
+        return check_aggregation_policy(src)
+
+    def test_missing_policy_detected(self, tmp_path):
+        findings = self._src(
+            tmp_path, '{"reqs_total": "sum", "depth": "max"}'
+        )
+        assert any(
+            f.symbol == "lat_seconds" and "no entry" in f.message
+            for f in findings
+        )
+
+    def test_kind_illegal_policy_detected(self, tmp_path):
+        findings = self._src(
+            tmp_path,
+            '{"reqs_total": "max", "depth": "max", "lat_seconds": "merge"}',
+        )
+        (bad,) = [f for f in findings if f.symbol == "reqs_total"]
+        assert "counter" in bad.message and "'max'" in bad.message
+
+    def test_stale_entry_detected(self, tmp_path):
+        findings = self._src(
+            tmp_path,
+            '{"reqs_total": "sum", "depth": "max", "lat_seconds": "merge",'
+            ' "ghost_total": "sum"}',
+        )
+        assert any(
+            f.symbol == "ghost_total" and "stale" in f.message
+            for f in findings
+        )
+
+    def test_duplicate_entry_detected(self, tmp_path):
+        findings = self._src(
+            tmp_path,
+            '{"reqs_total": "sum", "reqs_total": "sum", "depth": "max",'
+            ' "lat_seconds": "merge"}',
+        )
+        assert any(
+            f.symbol == "reqs_total" and "override" in f.message
+            for f in findings
+        )
+
+    def test_collector_produced_series_must_stay_out(self, tmp_path):
+        findings = self._src(
+            tmp_path,
+            '{"reqs_total": "sum", "depth": "max", "lat_seconds": "merge",'
+            ' "fleet_slo_compliant": "max"}',
+            extra=(
+                "\n                def d(reg):\n"
+                "                    return reg.gauge("
+                '"fleet_slo_compliant", "h", ["slo"])\n'
+            ),
+        )
+        assert any(
+            f.symbol == "fleet_slo_compliant" and "PRODUCED" in f.message
+            for f in findings
+        )
+
+    def test_clean_table_passes(self, tmp_path):
+        findings = self._src(
+            tmp_path,
+            '{"reqs_total": "sum", "depth": "max", "lat_seconds": "merge"}',
+        )
+        assert findings == []
+
+    def test_missing_table_is_an_error(self, tmp_path):
+        from kubeflow_tpu.analysis.consistency import (
+            check_aggregation_policy,
+        )
+
+        src = _tree(tmp_path, {self.FLEET: '"""seed: no table"""'})
+        (f,) = check_aggregation_policy(src)
+        assert "not found" in f.message
+
+    def test_repo_table_is_clean(self):
+        from kubeflow_tpu.analysis.consistency import (
+            check_aggregation_policy,
+        )
+
+        assert check_aggregation_policy(SourceSet(REPO)) == []
+
+
 class TestSeededReachability:
     def test_orphan_config_knob_detected(self, tmp_path):
         src = _tree(tmp_path, {

@@ -1,0 +1,1082 @@
+"""kft-fleet (kubeflow_tpu/observability/fleet.py + slo.py).
+
+The load-bearing contracts:
+- exposition text round-trips: parse_rendered(render()) reproduces
+  counter/gauge values and the histogram's CUMULATIVE bucket state, and
+  merge_rendered aggregates per policy (counters sum, gauges
+  sum/max/min/mean, histograms bucket-wise — the merged-ladder quantile
+  matches the pooled ground truth),
+- the SLO engine parses the slo.yaml-style rule grammar, evaluates
+  against fleet signals, and its burn rate flips as breaches accumulate,
+- the collector scrapes N fake replica endpoints (no sockets, injected
+  fetch + clock), exports fleet_* gauges, computes 429 rates from
+  counter deltas, and condenses per-service autoscaler signals,
+- a seeded slow gang host is flagged in fleet_straggler (and /fleetz)
+  by the leave-one-out z-score and CLEARS on recovery,
+- the InferenceService autoscaler adjusts spec.replicas between min/max
+  with hysteresis (breach streaks + cooldown) from a fake signal source,
+  and the whole loop closes end-to-end: rising queue on fake replicas →
+  merged fleet series → SLO breach gauge flips → the controller scales
+  up, receding signals scale back down,
+- the merged cross-host Perfetto export stitches per-host rings onto one
+  timeline with per-host process tracks,
+- the controllers render the KFT_FLEET_* env and discovery finds targets
+  from the cluster store's pods.
+
+Tier-1 budget rule (docs/OBSERVABILITY.md): everything here drives
+scrape_once() with fake clocks/sources — no sleeps; the only real-socket
+multi-endpoint test is @slow.
+"""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.observability.fleet import (
+    AGGREGATION_POLICY,
+    ENV_FLEET_INSTANCE,
+    ENV_FLEET_METRICS_PORT,
+    FleetCollector,
+    FleetSignals,
+    ScrapeTarget,
+    discover_targets,
+    instance_id,
+)
+from kubeflow_tpu.observability.slo import (
+    SloEngine,
+    SloParseError,
+    parse_rule,
+    parse_rules,
+)
+from kubeflow_tpu.utils.metrics import (
+    HistogramState,
+    MetricsRegistry,
+    merge_rendered,
+    parse_rendered,
+)
+
+TTFT_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _replica_registry(
+    queue=0.0, occupancy=0.0, num_slots=4, ttfts=(), n_429=0, tokens=0,
+    model="m",
+):
+    """A fake model-server replica's metric state, rendered through the
+    REAL registry renderer so the whole parse→merge chain is exercised."""
+    r = MetricsRegistry()
+    r.gauge("serving_queue_depth", "", ["model"]).set(queue, model=model)
+    r.gauge("serving_slot_occupancy", "", ["model"]).set(
+        occupancy, model=model
+    )
+    r.gauge("serving_num_slots", "", ["model"]).set(num_slots, model=model)
+    r.gauge("kft_instance_info", "", ["instance", "role"]).set(
+        1, instance="replica", role="serving"
+    )
+    if tokens:
+        r.counter("serving_tokens_total", "", ["model"]).inc(
+            tokens, model=model
+        )
+    if n_429:
+        c = r.counter(
+            "http_requests_total", "", ["app", "method", "status"]
+        )
+        c.inc(n_429, app="model-server", method="POST", status="429")
+    h = r.histogram(
+        "serving_time_to_first_token_seconds", "", ["model"],
+        buckets=TTFT_BUCKETS,
+    )
+    for t in ttfts:
+        h.observe(t, model=model)
+    return r
+
+
+def _host_registry(step_times, model="mlp"):
+    """A fake gang host: training_step_seconds observations."""
+    r = MetricsRegistry()
+    h = r.histogram("training_step_seconds", "", ["model"])
+    for t in step_times:
+        h.observe(t, model=model)
+    r.gauge("training_goodput", "", ["model"]).set(0.95, model=model)
+    return r
+
+
+class _FakeFleet:
+    """Dict-driven fetch + targets for the collector (no sockets)."""
+
+    def __init__(self):
+        self.registries = {}  # instance -> MetricsRegistry
+        self.targets = []
+        self.tracers = {}  # instance -> Tracer (for /debug/trace)
+
+    def add(self, role, owner, instance, registry, namespace="default"):
+        self.registries[instance] = registry
+        self.targets.append(
+            ScrapeTarget(role, namespace, owner, instance,
+                         f"fake://{instance}")
+        )
+
+    def fetch(self, url):
+        _, rest = url.split("://", 1)
+        instance, path = rest.split("/", 1)
+        if path == "metrics":
+            return self.registries[instance].render()
+        if path == "debug/trace":
+            return self.tracers[instance].chrome_trace_json()
+        raise KeyError(url)
+
+    def collector(self, **kw):
+        kw.setdefault("registry", MetricsRegistry())
+        return FleetCollector(
+            lambda: list(self.targets), fetch=self.fetch, **kw
+        )
+
+
+class TestParseAndMerge:
+    def test_render_parse_roundtrip(self):
+        r = _replica_registry(
+            queue=3, occupancy=0.5, ttfts=[0.2, 0.3, 4.0], tokens=17
+        )
+        parsed = parse_rendered(r.render())
+        key = (("model", "m"),)
+        assert parsed["serving_queue_depth"].kind == "gauge"
+        assert parsed["serving_queue_depth"].samples[key] == 3.0
+        assert parsed["serving_tokens_total"].kind == "counter"
+        assert parsed["serving_tokens_total"].samples[key] == 17.0
+        hs = parsed["serving_time_to_first_token_seconds"].samples[key]
+        assert isinstance(hs, HistogramState)
+        assert hs.count == 3
+        assert hs.sum == pytest.approx(4.5)
+        # cumulative per le: 0.2,0.3 <= 0.5; all 3 <= +Inf
+        assert hs.buckets[0.5] == 2
+        assert hs.buckets[float("inf")] == 3
+
+    def test_merge_policies(self):
+        snaps = [
+            parse_rendered(_replica_registry(queue=2, occupancy=0.2).render()),
+            parse_rendered(_replica_registry(queue=5, occupancy=0.8).render()),
+        ]
+        merged = merge_rendered(snaps, AGGREGATION_POLICY)
+        key = (("model", "m"),)
+        # counters/queue sum, occupancy means, num_slots sums
+        assert merged["serving_queue_depth"].samples[key] == 7.0
+        assert merged["serving_slot_occupancy"].samples[key] == pytest.approx(0.5)
+        assert merged["serving_num_slots"].samples[key] == 8.0
+        # unlisted metrics are skipped, not guessed
+        assert "not_declared_anywhere" not in merged
+
+    def test_merged_histogram_quantile_matches_pooled_ground_truth(self):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        pools = [rng.uniform(0.05, 4.5, size=200) for _ in range(3)]
+        snaps = [
+            parse_rendered(
+                _replica_registry(ttfts=list(p)).render()
+            )
+            for p in pools
+        ]
+        merged = merge_rendered(snaps, AGGREGATION_POLICY)
+        hs = merged["serving_time_to_first_token_seconds"].samples[
+            (("model", "m"),)
+        ]
+        assert hs.count == 600
+        pooled = np.concatenate(pools)
+        for q in (0.5, 0.9, 0.99):
+            est = hs.quantile(q)
+            truth = float(np.quantile(pooled, q))
+            # the estimate can only be off by bucket resolution: both
+            # truth and estimate live in the same bucket (or adjacent)
+            bucket_edges = [0.0, *TTFT_BUCKETS]
+            width = max(
+                b - a for a, b in zip(bucket_edges, bucket_edges[1:])
+            )
+            assert abs(est - truth) <= width
+
+    def test_histogram_quantile_edge_cases(self):
+        hs = HistogramState()
+        assert hs.quantile(0.5) is None
+        hs.buckets = {1.0: 5.0, float("inf"): 8.0}
+        hs.count = 8
+        # rank beyond the last finite bucket clamps to it
+        assert hs.quantile(0.99) == 1.0
+        with pytest.raises(ValueError):
+            hs.quantile(1.5)
+
+
+class TestSloRules:
+    def test_parse_forms(self):
+        r = parse_rule("serving_ttft_p99 < 5s")
+        assert r.lhs.metric == "serving_time_to_first_token_seconds"
+        assert r.lhs.quantile == 0.99
+        assert r.threshold == 5.0
+        assert r.name == "serving_ttft_p99"
+
+        r = parse_rule("training_goodput > 0.85")
+        assert r.lhs.metric == "training_goodput"
+        assert r.lhs.quantile is None
+
+        r = parse_rule("queue: serving_queue_depth / num_slots < 0.8")
+        assert r.name == "queue"
+        assert r.divisor.metric == "serving_num_slots"
+
+        r = parse_rule("serving_ttft_p50 <= 250ms")
+        assert r.threshold == pytest.approx(0.25)
+
+    def test_parse_rejects_garbage_and_duplicates(self):
+        for bad in ("", "serving_ttft_p99", "a ~ 5", "a < b"):
+            if bad.strip():
+                with pytest.raises(SloParseError):
+                    parse_rule(bad)
+        with pytest.raises(SloParseError):
+            parse_rules(["x: a < 1", "x: b < 2"])
+
+    def test_burn_rate_flips_as_breaches_accumulate(self):
+        eng = SloEngine(parse_rules(["training_goodput > 0.85"]),
+                        burn_window=4)
+        value = {"v": 0.95}
+
+        def resolve(metric, quantile):
+            assert metric == "training_goodput"
+            return value["v"]
+
+        for _ in range(4):
+            (st,) = eng.evaluate(resolve)
+        assert st.compliant is True
+        assert st.burn_rate == 0.0
+        value["v"] = 0.5  # goodput collapses
+        (st,) = eng.evaluate(resolve)
+        assert st.compliant is False
+        assert st.burn_rate == pytest.approx(0.25)
+        (st,) = eng.evaluate(resolve)
+        (st,) = eng.evaluate(resolve)
+        assert st.burn_rate == pytest.approx(0.75)  # window half-burned+
+        value["v"] = 0.95
+        (st,) = eng.evaluate(resolve)
+        assert st.compliant is True
+        assert st.burn_rate == pytest.approx(0.75)  # history remembers
+
+    def test_missing_signal_skips_evaluation(self):
+        eng = SloEngine(parse_rules(["serving_ttft_p99 < 5s"]))
+        (st,) = eng.evaluate(lambda m, q: None)
+        assert st.compliant is None
+        assert st.evaluations == 0
+
+
+class TestCollector:
+    def test_counter_sum_gauge_policy_histogram_quantile(self):
+        fleet = _FakeFleet()
+        for i in range(3):
+            fleet.add(
+                "serving", "svc1", f"r{i}",
+                _replica_registry(
+                    queue=float(i), occupancy=0.3 * i, tokens=10,
+                    ttfts=[0.2 * (i + 1)] * 5,
+                ),
+            )
+        c = fleet.collector()
+        c.scrape_once()
+        series = c.fleet_series()
+        key = (("model", "m"),)
+        assert series["serving_tokens_total"].samples[key] == 30.0
+        assert series["serving_queue_depth"].samples[key] == 3.0
+        assert series["serving_slot_occupancy"].samples[key] == pytest.approx(0.3)
+        # merged ladder p50 over 0.2/0.4/0.6 observations: rank 7.5 of 15
+        # interpolates inside the (0.25, 0.5] bucket (cum 5 -> 10) at 0.375
+        assert c.resolve_signal(
+            "serving_time_to_first_token_seconds", 0.5
+        ) == pytest.approx(0.375)
+        sig = c.serving_signals("default", "svc1")
+        assert sig == FleetSignals(
+            replicas=3, queue_depth=3.0,
+            occupancy=pytest.approx(0.3), num_slots=12.0,
+            rate_429_per_s=0.0, sweep=1,
+        )
+
+    def test_scrape_error_tolerated_and_reported(self):
+        fleet = _FakeFleet()
+        fleet.add("serving", "svc1", "r0", _replica_registry(queue=2))
+        fleet.targets.append(
+            ScrapeTarget("serving", "default", "svc1", "dead",
+                         "fake://dead")
+        )
+        reg = MetricsRegistry()
+        c = fleet.collector(registry=reg)
+        c.scrape_once()
+        assert c.serving_signals("default", "svc1").replicas == 1
+        assert reg.get("fleet_targets").value(role="serving") == 1
+        text = "\n".join(c.fleetz_lines())
+        assert "ERR" in text and "dead" in text
+
+    def test_429_rate_from_counter_deltas_with_fake_clock(self):
+        fleet = _FakeFleet()
+        now = {"t": 100.0}
+        reg = _replica_registry(n_429=5)
+        fleet.add("serving", "svc1", "r0", reg)
+        c = fleet.collector(clock=lambda: now["t"])
+        c.scrape_once()
+        assert c.serving_signals("default", "svc1").rate_429_per_s == 0.0
+        reg.get("http_requests_total").inc(
+            10, app="model-server", method="POST", status="429"
+        )
+        now["t"] += 10.0
+        c.scrape_once()
+        assert c.serving_signals(
+            "default", "svc1"
+        ).rate_429_per_s == pytest.approx(1.0)
+
+    def test_slo_breach_gauge_flips(self):
+        fleet = _FakeFleet()
+        reg = _replica_registry(queue=1, num_slots=4)
+        fleet.add("serving", "svc1", "r0", reg)
+        out = MetricsRegistry()
+        c = fleet.collector(
+            registry=out,
+            slo_rules=["queue: serving_queue_depth / num_slots < 0.8"],
+        )
+        c.scrape_once()
+        g = out.get("fleet_slo_compliant")
+        assert g.value(slo="queue") == 1.0
+        reg.get("serving_queue_depth").set(40, model="m")
+        c.scrape_once()
+        assert g.value(slo="queue") == 0.0
+        assert out.get("fleet_slo_burn_rate").value(slo="queue") == 0.5
+
+    def test_scrape_loop_thread_runs_and_stops(self):
+        fleet = _FakeFleet()
+        fleet.add("serving", "svc1", "r0", _replica_registry(queue=1))
+        c = fleet.collector(scrape_interval_s=0.01)
+        c.start()
+        import time
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if c.serving_signals("default", "svc1") is not None:
+                break
+            time.sleep(0.005)
+        c.stop()
+        assert c.serving_signals("default", "svc1") is not None
+
+
+class TestStraggler:
+    def _gang(self, slow_host_ms=None, hosts=4, sweeps=3):
+        fleet = _FakeFleet()
+        regs = {}
+        for i in range(hosts):
+            regs[f"h{i}"] = _host_registry([])
+            fleet.add("training", "job1", f"h{i}", regs[f"h{i}"])
+        c = fleet.collector(straggler_zscore=3.0, registry=MetricsRegistry())
+        for sweep in range(sweeps):
+            for i in range(hosts):
+                h = regs[f"h{i}"].get("training_step_seconds")
+                base = 0.100 if f"h{i}" != slow_host_ms else 0.300
+                for _ in range(5):
+                    h.observe(base, model="mlp")
+            c.scrape_once()
+        return c, regs, fleet
+
+    def test_seeded_slow_host_flagged_and_visible_in_fleetz(self):
+        c, regs, fleet = self._gang(slow_host_ms="h2")
+        flags = c.stragglers()
+        assert flags[("default", "job1", "h2")] is True
+        assert all(
+            not v for k, v in flags.items() if k[2] != "h2"
+        )
+        assert c._registry is not None
+        text = "\n".join(c.fleetz_lines())
+        assert "STRAGGLER" in text and "h2" in text
+
+    def test_straggler_clears_on_recovery(self):
+        c, regs, fleet = self._gang(slow_host_ms="h2")
+        assert c.stragglers()[("default", "job1", "h2")] is True
+        # recovery: h2 steps at gang speed long enough to drain its
+        # rolling window
+        from kubeflow_tpu.observability.fleet import STRAGGLER_WINDOW
+
+        for _ in range(STRAGGLER_WINDOW + 1):
+            for i in range(4):
+                h = regs[f"h{i}"].get("training_step_seconds")
+                for _ in range(5):
+                    h.observe(0.100, model="mlp")
+            c.scrape_once()
+        assert c.stragglers()[("default", "job1", "h2")] is False
+
+    def test_uniform_gang_never_flags(self):
+        c, _, _ = self._gang(slow_host_ms=None)
+        assert not any(c.stragglers().values())
+
+    def test_two_host_gang_cannot_flag(self):
+        fleet = _FakeFleet()
+        regs = {}
+        for i in range(2):
+            regs[f"h{i}"] = _host_registry([0.1 * (i + 1)] * 5)
+            fleet.add("training", "job1", f"h{i}", regs[f"h{i}"])
+        c = fleet.collector()
+        c.scrape_once()
+        assert not any(c.stragglers().values())
+
+    def test_straggler_gauge_zeroed_when_host_vanishes(self):
+        out = MetricsRegistry()
+        fleet = _FakeFleet()
+        regs = {}
+        for i in range(3):
+            regs[f"h{i}"] = _host_registry(
+                [0.3 if i == 0 else 0.1] * 5
+            )
+            fleet.add("training", "job1", f"h{i}", regs[f"h{i}"])
+        c = fleet.collector(registry=out)
+        c.scrape_once()
+        g = out.get("fleet_straggler")
+        assert g.value(job="default/job1", host="h0") == 1.0
+        # the flagged host's pod goes away (gang restart): the stuck
+        # series must clear, not alert forever
+        fleet.targets = [t for t in fleet.targets if t.instance != "h0"]
+        c.scrape_once()
+        assert g.value(job="default/job1", host="h0") == 0.0
+
+    def test_straggler_gauge_exported(self):
+        out = MetricsRegistry()
+        fleet = _FakeFleet()
+        regs = {}
+        for i in range(3):
+            regs[f"h{i}"] = _host_registry(
+                [0.3 if i == 0 else 0.1] * 5
+            )
+            fleet.add("training", "job1", f"h{i}", regs[f"h{i}"])
+        c = fleet.collector(registry=out)
+        c.scrape_once()
+        g = out.get("fleet_straggler")
+        assert g.value(job="default/job1", host="h0") == 1.0
+        assert g.value(job="default/job1", host="h1") == 0.0
+
+
+class _ScriptedFleet:
+    """serving_signals scripted per reconcile — the fake scrape source
+    the autoscaler contract promises testability against."""
+
+    def __init__(self, signals):
+        self.signals = list(signals)
+        self.i = 0
+
+    def serving_signals(self, namespace, name):
+        sig = self.signals[min(self.i, len(self.signals) - 1)]
+        self.i += 1
+        return sig
+
+
+def _pressure(replicas=1):
+    return FleetSignals(
+        replicas=replicas, queue_depth=30.0, occupancy=1.0,
+        num_slots=8.0 * replicas, rate_429_per_s=2.0,
+    )
+
+
+def _idle(replicas=1):
+    return FleetSignals(
+        replicas=replicas, queue_depth=0.0, occupancy=0.05,
+        num_slots=8.0 * replicas, rate_429_per_s=0.0,
+    )
+
+
+class TestAutoscaler:
+    def _make(self, fleet, autoscale=None, replicas=1):
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.inference import (
+            InferenceServiceController,
+            new_inference_service,
+        )
+
+        store = StateStore()
+        ctrl = InferenceServiceController(fleet=fleet)
+        cr = new_inference_service(
+            "svc1", model="gpt_tiny", replicas=replicas,
+            serving={"autoscale": autoscale or {}},
+        )
+        store.create(cr)
+        return store, ctrl
+
+    def _replicas(self, store):
+        return store.get("InferenceService", "svc1")["spec"]["replicas"]
+
+    def test_scale_up_needs_breach_streak(self):
+        fleet = _ScriptedFleet([_pressure()] * 10)
+        store, ctrl = self._make(
+            fleet,
+            {"enabled": True, "min_replicas": 1, "max_replicas": 3,
+             "breach_cycles": 3, "cooldown_cycles": 0},
+        )
+        for i in range(2):
+            ctrl.reconcile(store, "default", "svc1")
+            assert self._replicas(store) == 1, f"scaled early at {i}"
+        ctrl.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 2
+
+    def test_scale_up_respects_max_and_cooldown(self):
+        fleet = _ScriptedFleet([_pressure()] * 50)
+        store, ctrl = self._make(
+            fleet,
+            {"enabled": True, "min_replicas": 1, "max_replicas": 2,
+             "breach_cycles": 1, "cooldown_cycles": 3},
+        )
+        ctrl.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 2
+        # cooldown: the next 3 reconciles must not touch replicas (and
+        # max would forbid it anyway); after that max still caps at 2
+        for _ in range(6):
+            ctrl.reconcile(store, "default", "svc1")
+            assert self._replicas(store) == 2
+
+    def test_scale_down_on_receding_signals(self):
+        fleet = _ScriptedFleet([_idle(2)] * 10)
+        store, ctrl = self._make(
+            fleet,
+            {"enabled": True, "min_replicas": 1, "max_replicas": 3,
+             "breach_cycles": 2, "cooldown_cycles": 0},
+            replicas=2,
+        )
+        ctrl.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 2
+        ctrl.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 1
+        # min_replicas floor holds forever after
+        for _ in range(4):
+            ctrl.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 1
+
+    def test_mixed_signals_reset_streaks(self):
+        fleet = _ScriptedFleet(
+            [_pressure(), _idle(), _pressure(), _idle()] * 3
+        )
+        store, ctrl = self._make(
+            fleet,
+            {"enabled": True, "min_replicas": 1, "max_replicas": 3,
+             "breach_cycles": 2, "cooldown_cycles": 0},
+        )
+        for _ in range(12):
+            ctrl.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 1  # never 2 consecutive breaches
+
+    def test_disabled_or_no_fleet_never_scales(self):
+        store, ctrl = self._make(
+            _ScriptedFleet([_pressure()] * 5), {"enabled": False}
+        )
+        for _ in range(5):
+            ctrl.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 1
+
+        from kubeflow_tpu.controllers.inference import (
+            InferenceServiceController,
+        )
+
+        ctrl2 = InferenceServiceController()  # no fleet source
+        for _ in range(5):
+            ctrl2.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 1
+
+    def test_same_sweep_reads_do_not_advance_streaks(self):
+        import dataclasses as dc
+
+        # three reconciles against ONE sweep (watch events + requeue all
+        # re-reading the same snapshot) count as one observation
+        fleet = _ScriptedFleet([
+            dc.replace(_pressure(), sweep=1),
+            dc.replace(_pressure(), sweep=1),
+            dc.replace(_pressure(), sweep=1),
+            dc.replace(_pressure(), sweep=2),
+        ])
+        store, ctrl = self._make(
+            fleet,
+            {"enabled": True, "min_replicas": 1, "max_replicas": 3,
+             "breach_cycles": 2, "cooldown_cycles": 0},
+        )
+        for _ in range(3):
+            ctrl.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 1
+        ctrl.reconcile(store, "default", "svc1")  # sweep advanced
+        assert self._replicas(store) == 2
+
+    def test_signal_outage_resets_streaks(self):
+        # up_streak 2 of 3 → signals vanish → one post-recovery pressure
+        # reading must NOT complete the streak (hysteresis promises
+        # CONSECUTIVE observations)
+        fleet = _ScriptedFleet(
+            [_pressure(), _pressure(), None, None, _pressure()]
+        )
+        store, ctrl = self._make(
+            fleet,
+            {"enabled": True, "min_replicas": 1, "max_replicas": 3,
+             "breach_cycles": 3, "cooldown_cycles": 0},
+        )
+        for _ in range(5):
+            ctrl.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 1
+
+    def test_scale_state_dropped_on_deletion(self):
+        fleet = _ScriptedFleet([_pressure()] * 5)
+        store, ctrl = self._make(
+            fleet,
+            {"enabled": True, "min_replicas": 1, "max_replicas": 2,
+             "breach_cycles": 1, "cooldown_cycles": 99},
+        )
+        ctrl.reconcile(store, "default", "svc1")
+        assert ctrl._scale_state  # cooldown armed
+        store.delete("InferenceService", "svc1")
+        ctrl.reconcile(store, "default", "svc1")
+        # a recreated same-name service must not inherit the cooldown
+        assert ctrl._scale_state == {}
+
+    def test_replica_clamp_into_min_max_band(self):
+        fleet = _ScriptedFleet([_idle(5)] * 3)
+        store, ctrl = self._make(
+            fleet,
+            {"enabled": True, "min_replicas": 1, "max_replicas": 3,
+             "breach_cycles": 99, "cooldown_cycles": 0},
+            replicas=5,
+        )
+        ctrl.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 3
+
+    def test_resize_logged_as_trace_event_and_k8s_event(self):
+        from kubeflow_tpu.observability.trace import default_tracer
+
+        tracer = default_tracer()
+        tracer.clear()
+        fleet = _ScriptedFleet([_pressure()] * 3)
+        store, ctrl = self._make(
+            fleet,
+            {"enabled": True, "min_replicas": 1, "max_replicas": 2,
+             "breach_cycles": 1, "cooldown_cycles": 0},
+        )
+        ctrl.reconcile(store, "default", "svc1")
+        events = [
+            r for r in tracer.snapshot() if r.name == "autoscale.resize"
+        ]
+        assert events and events[0].attrs["replicas_to"] == 2
+        cr = store.get("InferenceService", "svc1")
+        evs = store.events_for(cr)
+        assert any(e["reason"] == "ScaleUp" for e in evs)
+
+    def test_autoscale_config_validates(self):
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import AutoscaleConfig
+
+        with pytest.raises(ConfigError):
+            AutoscaleConfig(min_replicas=3, max_replicas=2).validate()
+        with pytest.raises(ConfigError):
+            AutoscaleConfig(
+                scale_down_occupancy=0.9, scale_up_occupancy=0.9
+            ).validate()
+        with pytest.raises(ConfigError):
+            AutoscaleConfig(breach_cycles=0).validate()
+
+
+class TestEndToEndSignalLoop:
+    """The acceptance loop: three fake replicas with rising queue depth →
+    aggregated fleet series → SLO breach flips → the controller raises
+    spec.replicas (and scales back down when signals recede)."""
+
+    def test_full_loop(self):
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.inference import (
+            InferenceServiceController,
+            new_inference_service,
+        )
+
+        fleet = _FakeFleet()
+        regs = []
+        for i in range(3):
+            r = _replica_registry(queue=0, occupancy=0.2, num_slots=4)
+            regs.append(r)
+            fleet.add("serving", "svc1", f"r{i}", r)
+        out = MetricsRegistry()
+        collector = fleet.collector(
+            registry=out,
+            slo_rules=["queue: serving_queue_depth / num_slots < 0.8"],
+        )
+        store = StateStore()
+        ctrl = InferenceServiceController(fleet=collector)
+        store.create(
+            new_inference_service(
+                "svc1", model="gpt_tiny", replicas=1,
+                serving={"autoscale": {
+                    "enabled": True, "min_replicas": 1, "max_replicas": 3,
+                    "breach_cycles": 2, "cooldown_cycles": 0,
+                }},
+            )
+        )
+        collector.scrape_once()
+        ctrl.reconcile(store, "default", "svc1")
+        cr = store.get("InferenceService", "svc1")
+        assert cr["spec"]["replicas"] == 1
+        assert out.get("fleet_slo_compliant").value(slo="queue") == 1.0
+
+        # queue depth rises across all replicas: SLO breaches, and after
+        # breach_cycles reconciles the controller adds a replica
+        for r in regs:
+            r.get("serving_queue_depth").set(20, model="m")
+            r.get("serving_slot_occupancy").set(1.0, model="m")
+        collector.scrape_once()
+        assert out.get("fleet_slo_compliant").value(slo="queue") == 0.0
+        ctrl.reconcile(store, "default", "svc1")
+        assert store.get("InferenceService", "svc1")["spec"]["replicas"] == 1
+        # hysteresis counts SWEEPS: reconciling again on the same sweep
+        # must not advance the streak...
+        ctrl.reconcile(store, "default", "svc1")
+        assert store.get("InferenceService", "svc1")["spec"]["replicas"] == 1
+        # ...but a fresh breached sweep completes it
+        collector.scrape_once()
+        ctrl.reconcile(store, "default", "svc1")
+        assert store.get("InferenceService", "svc1")["spec"]["replicas"] == 2
+
+        # signals recede: queue drains, occupancy collapses → scale down
+        for r in regs:
+            r.get("serving_queue_depth").set(0, model="m")
+            r.get("serving_slot_occupancy").set(0.05, model="m")
+        collector.scrape_once()
+        assert out.get("fleet_slo_compliant").value(slo="queue") == 1.0
+        ctrl.reconcile(store, "default", "svc1")
+        collector.scrape_once()
+        ctrl.reconcile(store, "default", "svc1")
+        assert store.get("InferenceService", "svc1")["spec"]["replicas"] == 1
+
+
+class TestMergedTrace:
+    def _fleet_with_tracers(self):
+        from kubeflow_tpu.observability.trace import Tracer
+
+        fleet = _FakeFleet()
+        for i in range(2):
+            tr = Tracer(capacity=64)
+            with tr.span(f"work-{i}", step=i):
+                pass
+            fleet.tracers[f"h{i}"] = tr
+            fleet.add("training", "job1", f"h{i}", _host_registry([0.1]))
+        return fleet
+
+    def test_merged_export_has_per_host_process_tracks(self):
+        fleet = self._fleet_with_tracers()
+        c = fleet.collector()
+        doc = c.merged_chrome_trace()
+        assert isinstance(doc["traceEvents"], list)
+        procs = [
+            e for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        ]
+        assert len(procs) == 2
+        assert {p["args"]["name"] for p in procs} == {
+            "training:default/job1 [h0]",
+            "training:default/job1 [h1]",
+        }
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"work-0", "work-1"}
+        # each host's spans live on that host's pid track
+        pid_by_name = {e["name"]: e["pid"] for e in xs}
+        assert pid_by_name["work-0"] != pid_by_name["work-1"]
+        # offsets land both hosts' events on ONE recent timeline: spans
+        # recorded moments ago must sit within a few seconds of each
+        # other after stitching
+        ts = sorted(e["ts"] for e in xs)
+        assert ts[-1] - ts[0] < 5e6
+
+    def test_merged_export_loads_like_chrome_trace(self):
+        fleet = self._fleet_with_tracers()
+        doc = json.loads(json.dumps(fleet.collector().merged_chrome_trace()))
+        for e in doc["traceEvents"]:
+            assert {"name", "ph", "pid"} <= set(e)
+            if e["ph"] == "X":
+                assert isinstance(e["dur"], (int, float))
+
+    def test_fleet_trace_endpoint(self):
+        from kubeflow_tpu.api.wsgi import App
+        from kubeflow_tpu.observability.http import add_fleet_routes
+
+        fleet = self._fleet_with_tracers()
+        app = add_fleet_routes(App("debug"), fleet.collector())
+        status, resp, _ = app.handle_full("GET", "/debug/fleet-trace")
+        assert status == 200
+        doc = json.loads(resp.body)
+        assert "traceEvents" in doc
+
+
+class TestFleetzEndpoint:
+    def test_fleetz_renders_all_sections(self):
+        from kubeflow_tpu.api.wsgi import App
+        from kubeflow_tpu.observability.http import add_fleet_routes
+
+        fleet = _FakeFleet()
+        fleet.add(
+            "serving", "svc1", "r0",
+            _replica_registry(queue=2, occupancy=0.4),
+        )
+        c = fleet.collector(slo_rules=["serving_queue_depth < 100"])
+        c.scrape_once()
+        app = add_fleet_routes(App("debug"), c)
+        status, resp, _ = app.handle_full("GET", "/fleetz")
+        assert status == 200
+        text = resp.body.decode()
+        for section in ("[targets]", "[serving fleets]", "[slo]",
+                        "[stragglers]"):
+            assert section in text
+        assert "svc1" in text and "OK" in text
+
+    def test_build_debug_app_mounts_fleet_surface(self):
+        from kubeflow_tpu.observability.http import build_debug_app
+
+        fleet = _FakeFleet()
+        app = build_debug_app("ctl", fleet=fleet.collector())
+        status, _, _ = app.handle_full("GET", "/fleetz")
+        assert status == 200
+        # and without a collector the route is absent
+        app2 = build_debug_app("ctl2")
+        status, _, _ = app2.handle_full("GET", "/fleetz")
+        assert status == 404
+
+
+class TestIdentityAndDiscovery:
+    def test_instance_id_env_and_fallback(self):
+        assert instance_id({ENV_FLEET_INSTANCE: "pod-3"}) == "pod-3"
+        auto = instance_id({})
+        assert auto and "-" in auto
+
+    def test_metrics_endpoint_carries_instance_line(self, monkeypatch):
+        monkeypatch.setenv(ENV_FLEET_INSTANCE, "replica-7")
+        from kubeflow_tpu.observability.http import build_debug_app
+
+        app = build_debug_app("dbg", role="training")
+        status, resp, _ = app.handle_full("GET", "/metrics")
+        assert status == 200
+        text = resp.body.decode()
+        assert 'kft_instance_info{instance="replica-7",role="training"} 1' in text
+
+    def test_discover_targets_from_store_pods(self):
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.cluster.objects import new_object
+
+        store = StateStore()
+        store.create(new_object(
+            "Pod", "svc1-rep-0", "default", api_version="v1",
+            labels={"inferenceservice": "svc1"},
+            spec={"containers": [{"name": "model-server", "env": [
+                {"name": ENV_FLEET_METRICS_PORT, "value": "8500"},
+            ]}]},
+        ))
+        store.create(new_object(
+            "Pod", "job1-0", "default", api_version="v1",
+            labels={"tpujob.kubeflow-tpu.dev/job-name": "job1"},
+            spec={
+                "hostname": "job1-0", "subdomain": "job1-gang",
+                "containers": [{"name": "trainer", "env": [
+                    {"name": ENV_FLEET_METRICS_PORT, "value": "9432"},
+                    {"name": ENV_FLEET_INSTANCE, "value": "job1-0"},
+                ]}],
+            },
+        ))
+        store.create(new_object(  # no fleet port -> not a target
+            "Pod", "other", "default", api_version="v1",
+            spec={"containers": [{"name": "x", "env": []}]},
+        ))
+        targets = sorted(
+            discover_targets(store), key=lambda t: t.role
+        )
+        assert len(targets) == 2
+        serving, training = targets[0], targets[1]
+        assert serving.role == "serving"
+        assert serving.owner == "svc1"
+        assert serving.base_url.endswith(":8500")
+        assert training.role == "training"
+        assert training.instance == "job1-0"
+        assert training.base_url == "http://job1-0.job1-gang.default:9432"
+
+    def test_inference_controller_renders_fleet_env(self):
+        from kubeflow_tpu.controllers.inference import (
+            InferenceServiceController,
+        )
+
+        env = InferenceServiceController()._serving_env({})
+        assert env["KFT_FLEET_METRICS_PORT"] == "8500"
+        # statusz off = no /metrics mounted: advertising a scrape port
+        # the replica will 404 on would create a permanently-failing
+        # target, so the env must drop with it
+        env = InferenceServiceController()._serving_env(
+            {"serving": {"observability": {"statusz_enabled": False}}}
+        )
+        assert "KFT_FLEET_METRICS_PORT" not in env
+
+    def test_tpujob_controller_renders_fleet_env(self):
+        from kubeflow_tpu.cluster.reconciler import ControllerManager
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.tpujob import (
+            TPUTrainJobController,
+            new_tpu_train_job,
+        )
+        from kubeflow_tpu.runtime.executor import pod_env
+
+        store = StateStore()
+        cm = ControllerManager(store)
+        cm.register(TPUTrainJobController())
+        store.create(
+            new_tpu_train_job(
+                "fleetjob",
+                training={
+                    "model": "mlp", "global_batch_size": 8, "steps": 1,
+                    "mesh": {"data": 4},
+                    "checkpoint": {"enabled": False},
+                },
+                slice_spec={"topology": "v5e-4"},
+            )
+        )
+        cm.run_until_idle(max_seconds=5)
+        (pod,) = store.list("Pod", "default")
+        env = pod_env(pod)
+        assert env["KFT_FLEET_SCRAPE"] == "1"
+        assert env["KFT_FLEET_METRICS_PORT"] == env["KFT_DEBUG_PORT"]
+        assert env["KFT_FLEET_INSTANCE"] == pod["metadata"]["name"]
+
+    def test_tpujob_statusz_off_renders_no_fleet_env(self):
+        from kubeflow_tpu.cluster.reconciler import ControllerManager
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.tpujob import (
+            TPUTrainJobController,
+            new_tpu_train_job,
+        )
+        from kubeflow_tpu.runtime.executor import pod_env
+
+        store = StateStore()
+        cm = ControllerManager(store)
+        cm.register(TPUTrainJobController())
+        store.create(
+            new_tpu_train_job(
+                "fleetjob2",
+                training={
+                    "model": "mlp", "global_batch_size": 8, "steps": 1,
+                    "mesh": {"data": 4},
+                    "checkpoint": {"enabled": False},
+                    "observability": {"statusz_enabled": False},
+                },
+                slice_spec={"topology": "v5e-4"},
+            )
+        )
+        cm.run_until_idle(max_seconds=5)
+        (pod,) = store.list("Pod", "default")
+        env = pod_env(pod)
+        assert "KFT_FLEET_SCRAPE" not in env
+        assert "KFT_FLEET_METRICS_PORT" not in env
+
+    def test_launcher_serves_non_coordinator_when_fleet_scrape(self):
+        from kubeflow_tpu.runtime.launcher import maybe_start_debug_server
+
+        # still coordinator-only without the fleet knob
+        assert maybe_start_debug_server(
+            {"KFT_DEBUG_PORT": "0", "KFT_PROCESS_ID": "1"}
+        ) is None
+        server = maybe_start_debug_server({
+            "KFT_DEBUG_PORT": "0", "KFT_PROCESS_ID": "1",
+            "KFT_FLEET_SCRAPE": "1",
+        })
+        try:
+            assert server is not None
+        finally:
+            if server is not None:
+                server.stop()
+
+    def test_observability_config_validates_fleet_knobs(self):
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import ObservabilityConfig
+
+        ObservabilityConfig(
+            slo_rules=["serving_ttft_p99 < 5s"]
+        ).validate()
+        with pytest.raises(ConfigError):
+            ObservabilityConfig(slo_rules=["nonsense ~~ 4"]).validate()
+        with pytest.raises(ConfigError):
+            ObservabilityConfig(fleet_scrape_interval_s=0).validate()
+        with pytest.raises(ConfigError):
+            ObservabilityConfig(fleet_burn_window=0).validate()
+
+    def test_histogram_signal_without_quantile_rejected(self):
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import ObservabilityConfig
+
+        # 'serving_ttft < 5s' parses but could never evaluate (the
+        # histogram has no scalar value) — rejected at construction
+        with pytest.raises(SloParseError, match="without a quantile"):
+            FleetCollector(
+                lambda: [], registry=MetricsRegistry(),
+                slo_rules=["serving_ttft < 5s"],
+            )
+        with pytest.raises(ConfigError, match="without a quantile"):
+            ObservabilityConfig(slo_rules=["serving_ttft < 5s"]).validate()
+        # ...as is a quantile of a scalar metric
+        with pytest.raises(SloParseError, match="not a histogram"):
+            FleetCollector(
+                lambda: [], registry=MetricsRegistry(),
+                slo_rules=["serving_queue_depth_p99 < 5"],
+            )
+
+    def test_platform_assembly_wires_fleet(self):
+        from kubeflow_tpu.platform import Platform
+
+        p = Platform()
+        assert p.fleet is not None
+        # the InferenceService controller reads THIS collector
+        (infer,) = [
+            c for c in p.controllers
+            if c.__class__.__name__ == "InferenceServiceController"
+        ]
+        assert infer.fleet is p.fleet
+        # /fleetz rides the platform gateway
+        status, resp = p.gateway.handle("GET", "/fleetz")
+        assert status == 200
+
+    def test_collector_from_config(self):
+        from kubeflow_tpu.config.platform import ObservabilityConfig
+
+        cfg = ObservabilityConfig(
+            slo_rules=["training_goodput > 0.5"],
+            fleet_scrape_interval_s=1.0,
+            fleet_straggler_zscore=2.5,
+            fleet_burn_window=4,
+        )
+        c = FleetCollector.from_config(
+            cfg, lambda: [], registry=MetricsRegistry()
+        )
+        assert c.scrape_interval_s == 1.0
+        assert c.straggler_zscore == 2.5
+        assert c._slo.rules[0].name == "training_goodput"
+
+
+@pytest.mark.slow
+class TestRealSocketScrape:
+    """Multi-endpoint real-socket sweep (CI-only: two HTTP servers)."""
+
+    def test_collector_scrapes_live_debug_servers(self):
+        from kubeflow_tpu.api.wsgi import Server
+        from kubeflow_tpu.observability.http import build_debug_app
+
+        servers = [
+            Server(build_debug_app(f"dbg{i}", role="training"))
+            for i in range(2)
+        ]
+        for s in servers:
+            s.start()
+        try:
+            targets = [
+                ScrapeTarget(
+                    "training", "default", "job1", f"h{i}",
+                    f"http://127.0.0.1:{s.port}",
+                )
+                for i, s in enumerate(servers)
+            ]
+            reg = MetricsRegistry()
+            c = FleetCollector(
+                lambda: list(targets), registry=reg
+            )
+            c.scrape_once()
+            assert reg.get("fleet_targets").value(role="training") == 2
+            series = c.fleet_series()
+            assert "kft_instance_info" in series
+            doc = c.merged_chrome_trace()
+            assert isinstance(doc["traceEvents"], list)
+        finally:
+            for s in servers:
+                s.stop()

@@ -319,7 +319,12 @@ class TestEngineTracing:
             assert "queue=" in text and "prefill=" in text
             status, resp, _ = server.app.handle_full("GET", "/metrics")
             assert status == 200
-            assert "serving_request_phase_seconds" in resp.body.decode()
+            metrics_text = resp.body.decode()
+            assert "serving_request_phase_seconds" in metrics_text
+            # kft-fleet inputs ride the same page: the engine's exported
+            # slot capacity and this replica's identity line
+            assert 'serving_num_slots{model="g"} 2' in metrics_text
+            assert "kft_instance_info{" in metrics_text
         finally:
             engine.close()
 

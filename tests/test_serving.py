@@ -222,6 +222,10 @@ class TestInferenceServiceController:
             "KFT_TRACE_ENABLED": "1",
             "KFT_TRACE_BUFFER_SPANS": "4096",
             "KFT_TRACE_STATUSZ": "1",
+            # kft-fleet contract: the fleet collector scrapes every
+            # replica's /metrics on the serving port
+            # (observability/fleet.py; tests/test_fleet.py)
+            "KFT_FLEET_METRICS_PORT": "8500",
         }
 
     def test_invalid_spec_serving_rejected(self):
