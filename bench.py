@@ -63,7 +63,12 @@ from kubeflow_tpu.analysis.serving_plans import (  # noqa: E402
     BENCH_MAX_LEN,
     BENCH_NUM_DRAFT_TOKENS,
     BENCH_PREFILL_BUCKETS,
+    BENCH_PREFIX_BUCKETS,
+    BENCH_PREFIX_MAX_LEN,
+    BENCH_PREFIX_PAGE_SIZE,
+    BENCH_PREFIX_PROMPT_LEN,
     BENCH_PROMPT_LENS,
+    BENCH_SHARED_PREFIX_LEN,
     BENCH_SPEC_VOCAB,
     DEFAULT_NUM_SLOTS,
     bench_serving_plans as _bench_serving_plans,
@@ -893,6 +898,7 @@ def bench_serving_continuous(
     import time
     import urllib.request
 
+    import jax
     import numpy as np
 
     from kubeflow_tpu.api.wsgi import Server
@@ -944,6 +950,28 @@ def bench_serving_continuous(
     model_server.add_engine(spec_k0)
     model_server.add_engine(spec_kd)
 
+    # the shared-prefix comparison rides one arrival trace through two
+    # geometry-identical paged engines — radix prefix cache on vs off —
+    # so the delta is the cache, not the trace (the off engine is the
+    # slot-row engine's TTFT behavior: every prompt prefills in full).
+    # Longer context than the headline engines: the cache's TTFT win is
+    # the prefill compute it skips, which a 64-token prompt doesn't have
+    px_model, px_params = _gpt_small_with_params(BENCH_PREFIX_MAX_LEN)
+    prefix_on = DecodeEngine(
+        "gpt_prefix", px_model, px_params, num_slots=num_slots,
+        prefill_buckets=list(BENCH_PREFIX_BUCKETS),
+        max_queue=max(64, num_requests),
+        page_size=BENCH_PREFIX_PAGE_SIZE, prefix_cache=True,
+    )
+    prefix_off = DecodeEngine(
+        "gpt_noprefix", px_model, px_params, num_slots=num_slots,
+        prefill_buckets=list(BENCH_PREFIX_BUCKETS),
+        max_queue=max(64, num_requests),
+        page_size=BENCH_PREFIX_PAGE_SIZE, prefix_cache=False,
+    )
+    model_server.add_engine(prefix_on)
+    model_server.add_engine(prefix_off)
+
     rng = np.random.default_rng(0)
     offsets = np.cumsum(
         rng.exponential(mean_interarrival_ms / 1e3, num_requests)
@@ -965,6 +993,39 @@ def bench_serving_continuous(
     # engines must decode the same work
     payloads_spec = make_payloads(spec_vocab)
 
+    # the 80%-shared-prefix trace: 4 of 5 requests share a
+    # BENCH_SHARED_PREFIX_LEN-token system-prompt-style prefix and differ
+    # only in an 8-token tail (the production shape: shared templates,
+    # multi-turn continuations); 1 of 5 is fully random
+    prefix_prompt_len = BENCH_PREFIX_PROMPT_LEN
+    # 2 tokens/request: the phase measures ADMISSION (TTFT is what the
+    # prefix cache buys); a long decode tail would just re-measure the
+    # step loop the headline engine phase already covers
+    prefix_new_tokens = 2
+    shared_prefix = np.random.default_rng(2).integers(
+        0, 50257, (BENCH_SHARED_PREFIX_LEN,)
+    )
+
+    def make_prefix_payloads():
+        prng = np.random.default_rng(4)
+        out = []
+        for i in range(num_requests):
+            if i % 5 == 4:
+                prompt = prng.integers(0, 50257, (prefix_prompt_len,))
+            else:
+                tail = prng.integers(
+                    0, 50257,
+                    (prefix_prompt_len - BENCH_SHARED_PREFIX_LEN,),
+                )
+                prompt = np.concatenate([shared_prefix, tail])
+            out.append(_json.dumps({
+                "prompt_ids": [prompt.tolist()],
+                "max_new_tokens": prefix_new_tokens,
+            }).encode())
+        return out
+
+    payloads_prefix = make_prefix_payloads()
+
     def post(url, payload):
         req = urllib.request.Request(
             url, data=payload, headers={"Content-Type": "application/json"}
@@ -972,21 +1033,30 @@ def bench_serving_continuous(
         with urllib.request.urlopen(req, timeout=600) as resp:
             return _json.loads(resp.read()), resp.headers
 
-    def run_phase(name: str, payloads, on_warm=None, vocab=50257) -> dict:
+    def run_phase(name: str, payloads, on_warm=None, vocab=50257,
+                  offs=None, warm_extra=None, warm_lens=None,
+                  toks_per_req=None) -> dict:
         url = f"http://127.0.0.1:{server.port}/v1/models/{name}:generate"
         # warm every program this phase can reach (one request per
         # distinct prompt length covers the static shape keys AND the
-        # engine's buckets + step/draft/verify + insert)
-        for p in prompt_lens:
+        # engine's buckets + step/draft/verify + insert; a phase whose
+        # trace hits one bucket passes its own warm_lens)
+        for p in (prompt_lens if warm_lens is None else warm_lens):
             post(url, _json.dumps({
                 "prompt_ids": rng.integers(0, vocab, (1, p)).tolist(),
                 "max_new_tokens": new_tokens,
             }).encode())
+        for wp in warm_extra or ():
+            # phase-specific warm traffic (the prefix phase commits the
+            # shared system prompt here — production's steady state,
+            # where the template predates the measured requests)
+            post(url, wp)
         if on_warm is not None:
             # snapshot engine counters AFTER warm-up: the serial warm
             # requests run at 1/num_slots occupancy and must not dilute
             # the measured trace's occupancy
             on_warm()
+        arrivals = offsets if offs is None else offs
         lat = [None] * num_requests
         ttft = [None] * num_requests
         done_at = [None] * num_requests
@@ -995,7 +1065,7 @@ def bench_serving_continuous(
         t0 = time.monotonic() + 0.05
 
         def fire(i):
-            time.sleep(max(0.0, t0 + offsets[i] - time.monotonic()))
+            time.sleep(max(0.0, t0 + arrivals[i] - time.monotonic()))
             t_send = time.monotonic()
             try:
                 body, hdr = post(url, payloads[i])
@@ -1034,7 +1104,9 @@ def bench_serving_continuous(
         pct = lambda xs, q: xs[min(len(xs) - 1, int(len(xs) * q))]  # noqa: E731
         return {
             "failed_requests": len(errors),
-            "tokens_per_sec": round(len(ok) * new_tokens / wall, 1),
+            "tokens_per_sec": round(
+                len(ok) * (toks_per_req or new_tokens) / wall, 1
+            ),
             "ttft_p50_ms": round(pct(tfs, 0.5) * 1e3, 2),
             "ttft_p99_ms": round(pct(tfs, 0.99) * 1e3, 2),
             "latency_p50_ms": round(pct(lats, 0.5) * 1e3, 2),
@@ -1135,6 +1207,96 @@ def bench_serving_continuous(
             spec_stats["draft_accepted"] - pre_spec["draft_accepted"]
         )
         accept_rate = round(accepted / proposed, 3) if proposed else 0.0
+        # -- paged-KV prefix-cache phase: the 80%-shared trace ------------
+        # TTFT through the engine is queue wait + prefill; the cache cuts
+        # the PREFILL term, so the phase is arrival-limited (spaced
+        # arrivals keep slots free — TTFT measures admission, not queue
+        # depth) and the shared prefix is committed during warm-up
+        # (production steady state: the system prompt predates the
+        # measured traffic). Same trace through the cache-off twin — its
+        # every-request-full-prefill admission IS the slot-row engine's.
+        offsets_prefix = np.cumsum(
+            np.random.default_rng(3).exponential(0.5, num_requests)
+        )
+        wrng = np.random.default_rng(5)
+        warm_px = [
+            # one miss-shaped prompt (compiles prefill@256 + insert), the
+            # shared system prompt itself (commits its pages), and one
+            # hit-shaped prompt (compiles the chunk/COW path) — the
+            # steady state a production replica reaches before traffic
+            _json.dumps({
+                "prompt_ids": [
+                    wrng.integers(0, 50257, (prefix_prompt_len,)).tolist()
+                ],
+                "max_new_tokens": prefix_new_tokens,
+            }).encode(),
+            _json.dumps({
+                "prompt_ids": [shared_prefix.tolist()],
+                "max_new_tokens": 2,
+            }).encode(),
+            _json.dumps({
+                "prompt_ids": [np.concatenate([
+                    shared_prefix,
+                    wrng.integers(
+                        0, 50257,
+                        (prefix_prompt_len - BENCH_SHARED_PREFIX_LEN,),
+                    ),
+                ]).tolist()],
+                "max_new_tokens": prefix_new_tokens,
+            }).encode(),
+        ]
+        pre_px = {}
+        px_on = run_phase(
+            "gpt_prefix", payloads_prefix,
+            on_warm=lambda: pre_px.update(prefix_on.stats()),
+            offs=offsets_prefix, warm_extra=warm_px, warm_lens=(),
+            toks_per_req=prefix_new_tokens,
+        )
+        px_stats = prefix_on.stats()
+        px_off = run_phase(
+            "gpt_noprefix", payloads_prefix, offs=offsets_prefix,
+            warm_extra=warm_px, warm_lens=(),
+            toks_per_req=prefix_new_tokens,
+        )
+        hit_tokens = (
+            px_stats["prefix_hit_tokens"] - pre_px["prefix_hit_tokens"]
+        )
+        prompt_tokens = prefix_prompt_len * num_requests
+        prefix_hit_rate = (
+            round(hit_tokens / prompt_tokens, 3) if prompt_tokens else 0.0
+        )
+        pages_per_request = round(
+            (px_stats["pages_allocated"] - pre_px["pages_allocated"])
+            / num_requests, 2,
+        )
+        # resident-HBM accounting: the pool's bytes vs what the slot-row
+        # cache (one max_len row per slot) held at the same geometry —
+        # the mem-budget lint reports the same pool term statically
+        pool_bytes = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(prefix_on._pool)
+        )
+        slot_row_bytes = int(
+            pool_bytes
+            * (num_slots * BENCH_PREFIX_MAX_LEN)
+            / (prefix_on.num_pages * prefix_on.page_size)
+        )
+        prefix = {
+            "page_size": prefix_on.page_size,
+            "num_pages": prefix_on.num_pages,
+            "max_len": BENCH_PREFIX_MAX_LEN,
+            "shared_prefix_len": BENCH_SHARED_PREFIX_LEN,
+            "prompt_len": prefix_prompt_len,
+            "with_cache": px_on,
+            "without_cache": px_off,
+            "prefix_hit_rate": prefix_hit_rate,
+            "kv_pages_per_request": pages_per_request,
+            "ttft_p50_speedup": round(
+                px_off["ttft_p50_ms"] / px_on["ttft_p50_ms"], 2
+            ) if px_on["ttft_p50_ms"] else 0.0,
+            "hbm_per_request_pool_bytes": pool_bytes // num_slots,
+            "hbm_per_request_slot_row_bytes": slot_row_bytes // num_slots,
+        }
     finally:
         server.stop()
         model_server.close()
@@ -1168,6 +1330,10 @@ def bench_serving_continuous(
         },
         "engine_accept_rate": accept_rate,
         "drafted_tokens_per_sec": kd["tokens_per_sec"],
+        # paged KV + radix prefix cache: same trace, cache on vs off
+        "prefix": prefix,
+        "prefix_hit_rate": prefix_hit_rate,
+        "kv_pages_per_request": pages_per_request,
     }
 
 
@@ -2095,6 +2261,9 @@ _EXTRA_FINAL_KEYS = (
     "drafted_tokens_per_sec",
     "training_model_flops_utilization",
     "trace_overhead_pct",
+    # paged-KV + prefix cache (serving_continuous prefix phase)
+    "prefix_hit_rate",
+    "kv_pages_per_request",
 )
 
 

@@ -1,10 +1,12 @@
 """Serving-program lint: abstract-lower the decode engine's program set.
 
 The DecodeEngine (serving/engine.py) is the platform's perf centerpiece —
-six jitted programs, donation-dependent HBM accounting, a bucketed
-executable set — and none of its invariants were machine-checked before
-this pass: an undonated resident cache (2x cache HBM, caught by hand in
-the PR 4 review) or an unbounded prefill-bucket set would ship silently.
+a paged-KV program family (bucketed prefill, page insert, chunk-prefill
+window, COW page copy, step, and the K>0 draft/verify mirror), donation-
+dependent HBM accounting, a bounded executable set — and none of its
+invariants were machine-checked before this pass: an undonated resident
+pool (2x cache HBM, caught by hand in the PR 4 review) or an unbounded
+prefill-bucket set would ship silently.
 Every shipped serving plan (analysis/serving_plans.py — the same registry
 serving/main.py and bench.py consume) is traced/lowered in a subprocess
 on virtual CPU devices via the ENGINE'S OWN `EnginePrograms` object, so
@@ -29,9 +31,10 @@ the lint checks the programs the scheduler actually dispatches:
   program with the dtype they entered (no silent bf16->f32 upcast
   across a step), and are never wider than the model's weight dtype.
   The gate the int8-KV roadmap item will extend.
-- **mem-budget** (analysis/memory.py): params + resident slot cache(s)
-  (+ XLA temp allocation when the plan compiles) vs the declared chip's
-  HBM.
+- **mem-budget** (analysis/memory.py): params + the resident KV page
+  pool(s) — num_pages x page_size of K/V per layer, the paged layout's
+  decoupling of resident HBM from num_slots x max_len — (+ XLA temp
+  allocation when the plan compiles) vs the declared chip's HBM.
 
 The existing SPMD passes (`spmd-dcn-collective`, `spmd-replicated-param`)
 run over the same jaxprs/params: inert while the engine is single-chip,
@@ -337,10 +340,17 @@ def check_cache_dtype(
 def expected_program_names(
     buckets: Sequence[int], num_draft_tokens: int
 ) -> set:
-    names = {f"prefill@{b}" for b in buckets} | {"insert", "step"}
+    """The paged engine's fixed family: one prefill per bucket, one
+    page insert, one page-sized chunk-prefill window, one COW page copy,
+    one step — doubled (minus step/verify asymmetries) at K > 0."""
+    names = {f"prefill@{b}" for b in buckets} | {
+        "insert", "chunk", "cow", "step",
+    }
     if num_draft_tokens > 0:
         names |= {f"draft_prefill@{b}" for b in buckets}
-        names |= {"draft_insert", "draft", "verify"}
+        names |= {
+            "draft_insert", "draft_chunk", "draft_cow", "draft", "verify",
+        }
     return names
 
 
@@ -455,8 +465,15 @@ def analyze_serving_plan(
         draft = get_model(
             spec.draft_model, **resolve_model_kwargs(spec.draft_kwargs)
         )
+    from kubeflow_tpu.serving.engine import auto_num_pages
+
+    page_size = spec.page_size
+    num_pages = spec.num_pages or auto_num_pages(
+        spec.num_slots, model.cfg.max_len, page_size
+    )
     progs = EnginePrograms(
-        model, draft_model=draft, num_draft_tokens=spec.num_draft_tokens
+        model, draft_model=draft, num_draft_tokens=spec.num_draft_tokens,
+        page_size=page_size, num_pages=num_pages,
     )
     buckets = tuple(spec.prefill_buckets) or default_prefill_buckets(
         model.cfg.max_len
@@ -470,6 +487,8 @@ def analyze_serving_plan(
     )
     stats["programs"] = [s.name for s in sigs]
     stats["buckets"] = list(buckets)
+    stats["page_size"] = page_size
+    stats["num_pages"] = num_pages
 
     step_temp_bytes: Optional[int] = None
     stablehlo_bytes = 0
@@ -510,19 +529,19 @@ def analyze_serving_plan(
     findings.extend(check_replicated_params(params, {}, {}, spec.name))
 
     # -- mem-budget: the resident bytes one chip must hold ----------------
+    # (the KV term is POOL-sized — num_pages x page_size per layer — the
+    # paged representation's whole point vs num_slots x max_len rows)
     cache_one = progs.cache_shapes(params, buckets[0])
     components: Dict[str, int] = {
         "params": tree_bytes(params),
-        "kv slot cache": tree_bytes(
-            progs.slot_cache_shapes(cache_one, spec.num_slots)
-        ),
+        "kv page pool": tree_bytes(progs.pool_shapes(cache_one)),
     }
     if draft is not None:
         dparams = progs.abstract_params(draft)
         dcache_one = progs.draft_cache_shapes(dparams, buckets[0])
         components["draft params"] = tree_bytes(dparams)
-        components["draft kv slot cache"] = tree_bytes(
-            progs.slot_cache_shapes(dcache_one, spec.num_slots)
+        components["draft kv page pool"] = tree_bytes(
+            progs.pool_shapes(dcache_one)
         )
     if step_temp_bytes:
         components["xla temp (step)"] = step_temp_bytes

@@ -33,6 +33,11 @@ from typing import Any, Dict, List, Tuple
 # really reads these names).
 DEFAULT_NUM_SLOTS = 8
 DEFAULT_MAX_QUEUE = 64
+# Paged-KV pool geometry: tokens per KV block, and the pool size in
+# pages (0 = the engine's auto sizing — 3/4 of the slot-row footprint,
+# floored at one full-length request; serving/engine.py auto_num_pages).
+DEFAULT_PAGE_SIZE = 16
+DEFAULT_NUM_PAGES = 0
 
 # bench_serving_continuous's engine geometry: the ragged three-bucket
 # trace every round's headline engine numbers come from, and the
@@ -43,6 +48,16 @@ BENCH_PROMPT_LENS: Tuple[int, ...] = (8, 12, 24)
 BENCH_SPEC_VOCAB = 2048      # small vocab: draft streams ~1/6 the bytes
 BENCH_DRAFT_LAYERS = 2       # early-exit self-draft depth
 BENCH_NUM_DRAFT_TOKENS = 4   # K for the drafted bench phase
+# The shared-prefix trace: 80% of requests carry a system-prompt-style
+# shared prefix. Its engines run a LONGER context than the headline
+# trace (256 vs 64) because the prefix cache's TTFT win is proportional
+# to the prefill compute it skips — at 64-token prompts, admission is
+# dispatch-bound and the cache cannot show (measured; docs/PERF.md).
+BENCH_PREFIX_MAX_LEN = 256
+BENCH_PREFIX_PAGE_SIZE = 16
+BENCH_PREFIX_BUCKETS: Tuple[int, ...] = (32, 256)
+BENCH_SHARED_PREFIX_LEN = 160
+BENCH_PREFIX_PROMPT_LEN = 192
 
 
 @dataclasses.dataclass
@@ -59,6 +74,12 @@ class ServingPlanSpec:
     num_draft_tokens: int = 0          # K; > 0 adds the draft/verify family
     draft_model: str = ""              # registry name (required when K > 0)
     draft_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    page_size: int = DEFAULT_PAGE_SIZE  # tokens per KV pool block
+    num_pages: int = DEFAULT_NUM_PAGES  # pool pages (0 = auto sizing)
+    prefix_cache: bool = True          # radix prefix index (host-side; no
+    #                                    program-set impact — listed so the
+    #                                    registry documents the full knob
+    #                                    surface the pod runs)
     device_kind: str = "v5e"           # mem-budget HBM table key ("" skips)
     compile: bool = False              # also XLA-compile the step program
     #                                    (adds its temp allocation to the
@@ -109,6 +130,18 @@ def bench_serving_plans() -> List[ServingPlanSpec]:
             model="gpt_small",
             model_kwargs=dict(target),
             prefill_buckets=BENCH_PREFILL_BUCKETS,
+        ),
+        ServingPlanSpec(
+            # the shared-prefix trace's engine (256-token context, a
+            # 160-token shared system prompt maps 10 copy-free pages);
+            # the prefix_cache=off twin in the bench is
+            # geometry-identical, so one plan covers both program
+            # families
+            name="bench:gpt_prefix",
+            model="gpt_small",
+            model_kwargs=dict(target, max_len=BENCH_PREFIX_MAX_LEN),
+            prefill_buckets=BENCH_PREFIX_BUCKETS,
+            page_size=BENCH_PREFIX_PAGE_SIZE,
         ),
         ServingPlanSpec(
             name="bench:gpt_spec_k0",

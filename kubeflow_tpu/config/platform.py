@@ -559,9 +559,32 @@ class ServingConfig(ConfigNode):
     num_slots: int = config_field(
         default=8,
         help="resident KV-cache decode slots — the engine's fixed batch "
-        "capacity. More slots = more throughput under load, more HBM "
-        "(num_slots x max_len KV) and marginally slower steps; 0 "
-        "disables the engine (per-request fused-scan :generate).",
+        "capacity. More slots = more throughput under load and more KV "
+        "pool pressure (resident HBM is num_pages x page_size, NOT "
+        "slots x max_len); 0 disables the engine (per-request "
+        "fused-scan :generate).",
+    )
+    page_size: int = config_field(
+        default=16,
+        help="tokens per KV pool block (power of two dividing the "
+        "model's max_len). Smaller pages share prefixes at finer grain "
+        "and waste less tail space; larger pages shrink page-table and "
+        "scatter overhead.",
+    )
+    num_pages: int = config_field(
+        default=0,
+        help="KV pool capacity in pages. 0 = auto: 3/4 of the slot-row "
+        "footprint (num_slots x max_len / page_size), floored at one "
+        "full-length request. The admission gate converts pool pressure "
+        "into queue wait, never into a failed decode.",
+    )
+    prefix_cache: bool = config_field(
+        default=True,
+        help="radix-tree prefix index over committed requests: prompts "
+        "sharing a committed prefix map its pages copy-free and prefill "
+        "only the tail. Turn off for traffic with no shared prefixes "
+        "(pure random prompts) to skip the host-side bookkeeping and "
+        "keep retired pages returning to the pool immediately.",
     )
     prefill_buckets: List[int] = config_field(
         default_factory=list,
@@ -631,6 +654,13 @@ class ServingConfig(ConfigNode):
                 )
         if self.prefill_buckets != sorted(self.prefill_buckets):
             raise ConfigError("serving.prefill_buckets must be ascending")
+        if self.page_size < 1 or self.page_size & (self.page_size - 1):
+            raise ConfigError(
+                f"serving.page_size must be a positive power of two, "
+                f"got {self.page_size}"
+            )
+        if self.num_pages < 0:
+            raise ConfigError("serving.num_pages must be >= 0 (0 = auto)")
 
 
 @dataclasses.dataclass

@@ -109,6 +109,10 @@ class InferenceServiceController(Controller):
             "KFT_SERVING_PREFILL_BUCKETS": ",".join(
                 str(b) for b in cfg.prefill_buckets
             ),
+            # paged-KV pool + radix prefix cache (serving/engine.py)
+            "KFT_SERVING_PAGE_SIZE": str(cfg.page_size),
+            "KFT_SERVING_NUM_PAGES": str(cfg.num_pages),
+            "KFT_SERVING_PREFIX_CACHE": "1" if cfg.prefix_cache else "0",
             "KFT_SERVING_DRAFT_MODEL": cfg.draft_model,
             "KFT_SERVING_DRAFT_TOKENS": str(cfg.num_draft_tokens),
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": cfg.draft_checkpoint_dir,

@@ -22,6 +22,7 @@ import dataclasses
 from typing import Any
 
 import flax.linen as nn
+import flax.struct
 import jax
 import jax.numpy as jnp
 
@@ -70,6 +71,33 @@ class GptConfig:
     scan_layers: bool = False
 
 
+@flax.struct.dataclass
+class PagedState:
+    """Per-call view of the engine's block-paged KV cache (serving/
+    engine.py). The K/V pools themselves ride the flax cache collection
+    ([num_pages, page_size, H, D] per attention layer); everything that
+    used to be per-slot device bookkeeping — page table, cursor — is
+    host-owned by the engine scheduler and passed per dispatch:
+
+    - `page_table` [B, max_pages] int32: row b's logical cache position t
+      lives at pool page page_table[b, t // page_size], offset t %
+      page_size. max_pages * page_size is the per-slot logical window
+      (== the target model's max_len).
+    - `cache_index` [B] int32: tokens resident per row. The paged layout
+      has NO pad holes (real token i sits at logical position i — the
+      invariant the prefix cache's token→page mapping needs), so cursor
+      masking alone gives visibility: no valid_mask, and position
+      embeddings index straight off the cursor.
+
+    `page_size`/`num_pages` are static (they shape the pool): one jitted
+    program per pool geometry, exactly like max_len."""
+
+    page_table: Any
+    cache_index: Any
+    page_size: int = flax.struct.field(pytree_node=False)
+    num_pages: int = flax.struct.field(pytree_node=False)
+
+
 class CausalSelfAttention(nn.Module):
     cfg: GptConfig
 
@@ -103,6 +131,7 @@ class CausalSelfAttention(nn.Module):
         deterministic: bool,
         decode: bool = False,
         prefill: bool = False,
+        paged=None,
     ):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
@@ -115,6 +144,61 @@ class CausalSelfAttention(nn.Module):
         q = shard_constraint(q, ("batch", "seq", "act_heads", None))
         k = shard_constraint(k, ("batch", "seq", "act_heads", None))
         v = shard_constraint(v, ("batch", "seq", "act_heads", None))
+
+        if decode and paged is not None:
+            # block-paged decode (the continuous-batching engine's cache
+            # representation): the cache collection holds ONLY the K/V
+            # pools; page table and cursor are scheduler-owned host state
+            # riding `paged`. Writes scatter the s new vectors through
+            # the page table (exact indexed store, ops/attention.py);
+            # the read gathers a per-slot contiguous view and runs the SAME
+            # dense_attention the slot-row cache did — masked positions
+            # contribute exactly zero, so the math is bitwise the
+            # contiguous path's.
+            from kubeflow_tpu.ops.attention import (
+                dense_attention,
+                paged_kv_update,
+                paged_kv_view,
+            )
+
+            pool_shape = (
+                paged.num_pages, paged.page_size, cfg.num_heads, head_dim
+            )
+            cached_k = self.variable(
+                "cache", "cached_key", jnp.zeros, pool_shape, cfg.dtype
+            )
+            cached_v = self.variable(
+                "cache", "cached_value", jnp.zeros, pool_shape, cfg.dtype
+            )
+            s = x.shape[1]
+            idx = paged.cache_index
+            cached_k.value, cached_v.value = paged_kv_update(
+                cached_k.value, cached_v.value,
+                k.astype(cfg.dtype), v.astype(cfg.dtype),
+                paged.page_table, idx,
+            )
+            k_view = paged_kv_view(cached_k.value, paged.page_table)
+            v_view = paged_kv_view(cached_v.value, paged.page_table)
+            view_len = k_view.shape[1]
+            if s == 1:
+                # no pad holes in the paged layout: everything at or
+                # before the cursor is a real token — cursor masking IS
+                # the visibility rule
+                visible = jnp.arange(view_len)[None, :] <= idx[:, None]
+            else:
+                # per-query causal visibility inside the verify window:
+                # query j (at logical position idx+j) sees <= idx+j
+                q_pos = idx[:, None] + jnp.arange(s)[None, :]
+                visible = (
+                    jnp.arange(view_len)[None, None, :] <= q_pos[:, :, None]
+                )
+            out = dense_attention(
+                q, k_view, v_view, mask=visible, dtype=cfg.dtype,
+                causal=False,
+            )
+            return nn.DenseGeneral(
+                cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
+            )(out)
 
         if prefill:
             # one causal pass over the whole prompt that ALSO seeds the KV
@@ -281,12 +365,13 @@ class DecoderBlock(nn.Module):
         deterministic: bool,
         decode: bool = False,
         prefill: bool = False,
+        paged=None,
     ):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x)
         x = x + CausalSelfAttention(cfg, name="attention")(
             h.astype(cfg.dtype), mask, deterministic, decode=decode,
-            prefill=prefill,
+            prefill=prefill, paged=paged,
         )
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
         if cfg.num_experts > 0:
@@ -325,12 +410,12 @@ class ScanDecoderBlock(nn.Module):
     cfg: GptConfig
 
     @nn.compact
-    def __call__(self, x, mask, deterministic, decode, prefill):
+    def __call__(self, x, mask, deterministic, decode, prefill, paged=None):
         block_cls = DecoderBlock
         if self.cfg.remat:
             block_cls = nn.remat(DecoderBlock, static_argnums=(3, 4, 5))
         x = block_cls(self.cfg, name="block")(
-            x, mask, deterministic, decode, prefill
+            x, mask, deterministic, decode, prefill, paged
         )
         return x, None
 
@@ -358,20 +443,18 @@ def unstack_layer_params(params, num_layers: int):
 
 
 # ---------------------------------------------------------------------------
-# Cache-as-value slot helpers (the continuous-batching engine's view of the
-# KV cache, serving/engine.py). The cache collection is a pytree whose
-# leaves are identified by NAME, not position, because the batch axis sits
-# at a different depth per leaf — and scan_layers prepends a layer axis to
-# all of them. Counting axes from the RIGHT makes one rule cover both the
-# named-layer and scanned layouts:
-#   cached_key / cached_value  [..., B, max_len, heads, head_dim]  -> -4
-#   valid_mask                 [..., B, max_len]                   -> -2
-#   position                   [B]                                 -> -1
-#   cache_index                model form has NO batch axis (a shared
-#                              scalar cursor, [] or [L]); the engine form
-#                              appends a trailing per-slot axis [..., S]
-#                              which the decode path reads as a per-row
-#                              cursor (see CausalSelfAttention).
+# Block-paged KV pool helpers (the continuous-batching engine's cache
+# representation, serving/engine.py). The engine-form cache is a pytree
+# holding ONLY the per-layer K/V pools [..., num_pages, page_size, H, D]
+# (scan_layers prepends a layer axis); page tables, cursors and refcounts
+# are host-owned by the scheduler and ride each dispatch as arguments
+# (PagedState). Leaves are identified by NAME because the pool axes sit at
+# a different depth per layout — counting from the RIGHT covers both:
+#   cached_key / cached_value  [..., num_pages, page_size, heads, head_dim]
+# The slot-row cache helpers this section replaces (`make_slot_cache`/
+# `insert_cache_slot`/`rewind_slot_cache`) resided one max_len row per
+# slot regardless of actual length; the pool decouples resident HBM from
+# num_slots × max_len and gives the prefix cache page-granular sharing.
 # ---------------------------------------------------------------------------
 
 
@@ -380,85 +463,107 @@ def _cache_leaf_name(path) -> str:
     return getattr(last, "key", str(last))
 
 
-def _slot_axis(name: str, ndim: int) -> int:
-    if name in ("cached_key", "cached_value"):
-        return ndim - 4
-    if name == "valid_mask":
-        return ndim - 2
-    if name in ("position", "cache_index"):
-        return ndim - 1
-    raise ValueError(f"unknown cache leaf {name!r}")
+def _prune_non_kv(tree):
+    """Drop every cache leaf except cached_key/cached_value, removing
+    emptied subtrees (the paged engine keeps cursor/validity bookkeeping
+    on the host, so the device cache is pools only)."""
+    if isinstance(tree, dict):
+        out = {}
+        for key, sub in tree.items():
+            pruned = _prune_non_kv(sub)
+            if pruned is None or (isinstance(pruned, dict) and not pruned):
+                continue
+            out[key] = pruned
+        return out
+    return tree
 
 
-def make_slot_cache(cache_one, num_slots: int):
-    """Zeroed slot-batch cache shaped like `cache_one` (a batch-1 prefill
-    cache or its eval_shape) with batch axes widened to `num_slots` and
-    cache_index converted to the engine's per-slot cursor form."""
+def make_paged_pool(cache_one, num_pages: int, page_size: int):
+    """Zeroed paged K/V pool shaped from a batch-1 prefill cache (or its
+    eval_shape): each cached_key/cached_value leaf's trailing
+    [1, max_len, H, D] becomes [num_pages, page_size, H, D] (leading
+    layer axes preserved); every other cache leaf is dropped — the
+    engine owns that bookkeeping host-side."""
     import jax.tree_util as jtu
 
-    def widen(path, leaf):
+    def conv(path, leaf):
         name = _cache_leaf_name(path)
-        if name == "cache_index":
-            return jnp.zeros(tuple(leaf.shape) + (num_slots,), leaf.dtype)
-        shape = list(leaf.shape)
-        shape[_slot_axis(name, len(shape))] = num_slots
-        return jnp.zeros(shape, leaf.dtype)
+        if name not in ("cached_key", "cached_value"):
+            return None
+        lead = tuple(leaf.shape[:-4])
+        h, d = leaf.shape[-2], leaf.shape[-1]
+        return jnp.zeros(lead + (num_pages, page_size, h, d), leaf.dtype)
 
-    return jtu.tree_map_with_path(widen, cache_one)
+    # unfreeze defensively: flax may hand a FrozenDict, and pruning needs
+    # plain dicts
+    try:
+        from flax.core import unfreeze
+
+        cache_one = unfreeze(cache_one)
+    except Exception:  # pragma: no cover - plain dicts already
+        pass
+    return _prune_non_kv(jtu.tree_map_with_path(conv, dict(cache_one)))
 
 
-def insert_cache_slot(cache, cache_one, slot):
-    """Write a batch-1 prefill cache into slot `slot` of a slot-batch
-    cache, along each leaf's batch axis. `slot` may be a traced int32 —
-    one compiled program serves every slot."""
+def _leaf_by_path(tree, path):
+    node = tree
+    for entry in path:
+        node = node[getattr(entry, "key", str(entry))]
+    return node
+
+
+def insert_pages(pool, cache_one, page_ids, real_len):
+    """Scatter a batch-1 prefill cache's K/V rows [0, real_len) into the
+    pool pages listed in `page_ids` [max_pages]: cache rows
+    [c*page_size, (c+1)*page_size) land on page page_ids[c], and a chunk
+    is written iff it holds at least one real row (c*page_size <
+    real_len). Pad-garbage rows inside the last written chunk land past
+    the cursor, stay invisible to the masked read, and are overwritten
+    by decode. `page_ids`/`real_len` may be traced — one compiled insert
+    serves every slot and prompt length. The indexed scatter stores the
+    prefill's bits directly, so inserted bits equal the computed bits."""
     import jax.tree_util as jtu
 
-    def ins(path, dst, src):
-        name = _cache_leaf_name(path)
-        if name == "cache_index":
-            src = src[..., None]  # model form (no batch axis) -> engine form
-        ax = _slot_axis(name, dst.ndim)
-        return jax.lax.dynamic_update_slice_in_dim(
-            dst, src.astype(dst.dtype), slot, axis=ax
+    mp = page_ids.shape[0]
+
+    def ins(path, pool_leaf):
+        one = _leaf_by_path(cache_one, path)
+        num_pages, ps = pool_leaf.shape[-4], pool_leaf.shape[-3]
+        row = jnp.squeeze(one, axis=-4)           # [..., max_len, H, D]
+        row = row[..., : mp * ps, :, :].astype(pool_leaf.dtype)
+        lead = row.shape[:-3]
+        chunks = row.reshape(lead + (mp, ps) + row.shape[-2:])
+        # indexed scatter: stores the prefill's bits directly (no
+        # arithmetic) and touches only the written pages; chunks past
+        # real_len route to index P, which mode="drop" skips
+        valid = (jnp.arange(mp) * ps) < real_len  # [MP]
+        idx = jnp.where(valid, page_ids, num_pages)
+        if pool_leaf.ndim == 4:      # named-layer leaf [P, ps, H, D]
+            return pool_leaf.at[idx].set(
+                chunks, mode="drop"
+            )
+        # scanned-layer leaf [L, P, ps, H, D]: the leading slice keeps
+        # the page axis in place under advanced indexing
+        return pool_leaf.at[:, idx].set(
+            chunks, mode="drop"
         )
 
-    return jtu.tree_map_with_path(ins, cache, cache_one)
+    return jtu.tree_map_with_path(ins, pool)
 
 
-def extract_cache_slot(cache, slot):
-    """One slot of a slot-batch cache as a batch-1 cache (the inverse of
-    `insert_cache_slot`; introspection/debugging and tests)."""
-    import jax.tree_util as jtu
+def copy_pool_page(pool, src, dst):
+    """Copy page `src` onto page `dst` across every pool leaf — the
+    prefix cache's copy-on-write: an admission that reuses a partially
+    matched page gets its OWN copy to extend, leaving the shared
+    original (and every other slot referencing it) untouched. `src`/
+    `dst` may be traced int32 — one compiled program serves every copy."""
 
-    def ext(path, leaf):
-        name = _cache_leaf_name(path)
-        ax = _slot_axis(name, leaf.ndim)
-        out = jax.lax.dynamic_slice_in_dim(leaf, slot, 1, axis=ax)
-        if name == "cache_index":
-            out = jnp.squeeze(out, axis=-1)  # engine form -> model form
-        return out
+    def cp(leaf):
+        ax = leaf.ndim - 4
+        page = jax.lax.dynamic_slice_in_dim(leaf, src, 1, axis=ax)
+        return jax.lax.dynamic_update_slice_in_dim(leaf, page, dst, axis=ax)
 
-    return jtu.tree_map_with_path(ext, cache)
-
-
-def rewind_slot_cache(cache, rollback):
-    """Rewind an engine-form slot cache's per-slot cursors by
-    `rollback[S]` positions — the speculative-decoding rollback: a decode
-    window wrote s tokens' K/V and advanced cache_index AND position by
-    s; subtracting the rejected tail makes those cache entries invisible
-    (the decode read masks positions past the cursor) without touching
-    the K/V buffers, and the next accepted token simply overwrites them.
-    `rollback` may be a traced int32 array — one compiled program serves
-    every acceptance pattern."""
-    import jax.tree_util as jtu
-
-    def fix(path, leaf):
-        name = _cache_leaf_name(path)
-        if name in ("cache_index", "position"):
-            return leaf - rollback.astype(leaf.dtype)
-        return leaf
-
-    return jtu.tree_map_with_path(fix, cache)
+    return jax.tree.map(cp, pool)
 
 
 class DecoderStage(nn.Module):
@@ -534,6 +639,7 @@ class Gpt(nn.Module):
         deterministic: bool = True,
         decode: bool = False,
         prefill: bool = False,
+        paged=None,
         return_hidden: bool = False,
     ):
         cfg = self.cfg
@@ -560,7 +666,16 @@ class Gpt(nn.Module):
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok_emb"
         )(input_ids)
         tok = shard_constraint(tok, ("batch", "seq", "act_embed"))
-        if decode or prefill:
+        if decode and paged is not None:
+            # block-paged decode: the cursor is scheduler-owned host
+            # state riding `paged`, and the layout has no pad holes, so
+            # a row's logical cache position IS its real-token position —
+            # position embeddings index straight off the cursor.
+            positions = jnp.minimum(
+                paged.cache_index[:, None] + jnp.arange(s)[None, :],
+                cfg.max_len - 1,
+            )  # overrun window tails clamp; their writes/outputs are masked
+        elif decode or prefill:
             # the decode cursor lives IN the cache (one source of truth —
             # a restored cache cannot disagree with a caller-passed
             # position). It is PER ROW: padded prompts give each row its
@@ -597,17 +712,17 @@ class Gpt(nn.Module):
                 ScanDecoderBlock,
                 variable_axes={"params": 0, "cache": 0, "losses": 0},
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast,) * 4,
+                in_axes=(nn.broadcast,) * 5,
                 length=cfg.num_layers,
             )(cfg, name="layers")
-            x, _ = scan(x, mask, deterministic, decode, prefill)
+            x, _ = scan(x, mask, deterministic, decode, prefill, paged)
         else:
             block_cls = DecoderBlock
             if cfg.remat:
                 block_cls = nn.remat(DecoderBlock, static_argnums=(3, 4, 5))
             for i in range(cfg.num_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(
-                    x, mask, deterministic, decode, prefill
+                    x, mask, deterministic, decode, prefill, paged
                 )
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)

@@ -128,6 +128,8 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "serving_decode_steps_total": "sum",
     "serving_draft_accepted_total": "sum",
     "serving_draft_proposed_total": "sum",
+    "serving_prefix_cache_hit_tokens_total": "sum",
+    "serving_prefix_cache_lookups_total": "sum",
     "serving_requests_total": "sum",
     "serving_tokens_total": "sum",
     "serving_verify_steps_total": "sum",
@@ -156,6 +158,8 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "kft_instance_info": "max",
     "kubeflow_availability": "max",
     "notebook_running": "sum",
+    "serving_kv_pages_in_use": "sum",
+    "serving_kv_pages_total": "sum",
     "serving_num_slots": "sum",
     "serving_queue_depth": "sum",
     "serving_slot_occupancy": "mean",

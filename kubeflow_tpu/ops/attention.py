@@ -52,6 +52,70 @@ def auto_attention_impl(
     return "flash" if score_bytes > 2 << 30 else "dense"
 
 
+# ---------------------------------------------------------------------------
+# Paged-KV primitives (the continuous-batching engine's block-pool cache,
+# serving/engine.py). The pool stores K/V as [num_pages, page_size, H, D]
+# blocks; a per-slot page table [B, max_pages] maps each slot's logical
+# cache positions onto pool pages. The decode read GATHERS a per-slot
+# contiguous view through the page table and runs the exact same
+# dense_attention as the contiguous cache did — gathers copy bits, the
+# indexed scatter stores computed bits directly, so paging is a
+# storage-layout change with bitwise-identical math (the parity contract).
+# ---------------------------------------------------------------------------
+
+
+def paged_kv_view(pool: jax.Array, page_table: jax.Array) -> jax.Array:
+    """Gather a per-slot contiguous K/V view through the page table:
+    pool [P, page_size, H, D] + page_table [B, max_pages] int32 →
+    [B, max_pages * page_size, H, D]. Row b position t of the view is
+    pool[page_table[b, t // page_size], t % page_size] — exactly the
+    slot-row cache layout the attention math always saw."""
+    b, mp = page_table.shape
+    ps = pool.shape[1]
+    pages = jnp.take(pool, page_table.reshape(-1), axis=0)
+    return pages.reshape((b, mp * ps) + pool.shape[2:])
+
+
+def paged_kv_update(
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    page_table: jax.Array,
+    cursors: jax.Array,
+) -> tuple:
+    """Scatter row b's s new K/V vectors ([B, s, H, D]) into the pool at
+    logical positions cursors[b] + j, routed through the page table. An
+    indexed scatter stores the computed projection BITS directly (no
+    arithmetic — trivially exact) and touches only the B*s written rows,
+    not the whole pool; positions at/past the view length map to an
+    out-of-range index and are dropped — retired slots park their cursor
+    there and idle safely. The page allocator keeps IN-BOUNDS indices
+    distinct (each row's offsets land on its own pages), but several
+    parked rows share the one drop sentinel, so the scatter must NOT
+    promise unique indices."""
+    num_pages, ps = pool_k.shape[:2]
+    b, s = k_new.shape[:2]
+    mp = page_table.shape[1]
+    pos = cursors[:, None] + jnp.arange(s)[None, :]            # [B, s]
+    page_idx = jnp.clip(pos // ps, 0, mp - 1)
+    page = jnp.take_along_axis(page_table, page_idx, axis=1)   # [B, s]
+    flat = page * ps + pos % ps
+    # out-of-window writes route to index P*ps, which mode="drop" skips
+    flat = jnp.where(pos < mp * ps, flat, num_pages * ps).reshape(-1)
+    fk = pool_k.reshape((num_pages * ps,) + pool_k.shape[2:])
+    fv = pool_v.reshape((num_pages * ps,) + pool_v.shape[2:])
+    fk = fk.at[flat].set(
+        k_new.reshape((b * s,) + k_new.shape[2:]),
+        mode="drop",
+    )
+    fv = fv.at[flat].set(
+        v_new.reshape((b * s,) + v_new.shape[2:]),
+        mode="drop",
+    )
+    return fk.reshape(pool_k.shape), fv.reshape(pool_v.shape)
+
+
 def dense_attention(
     q: jax.Array,
     k: jax.Array,

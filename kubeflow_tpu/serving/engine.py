@@ -1,5 +1,5 @@
-"""Continuous-batching decode engine — token-level scheduling over one
-resident slot-batch KV cache.
+"""Continuous-batching decode engine — token-level scheduling over a
+block-paged KV pool with a radix prefix cache.
 
 The static `:generate` path (serving/generate.py ServedLm) is
 request-granular: every request runs its own fused prefill+scan program,
@@ -10,25 +10,44 @@ throughput is a function of KEEPING THE BATCH FULL (4.3k tok/s at batch 8
 do. This engine is the Orca/vLLM iteration-level-scheduling insight
 transplanted to the JAX static-shape world:
 
-- ONE resident KV cache of fixed capacity `num_slots` lives on device for
-  the engine's lifetime (models/gpt.py `make_slot_cache`); its batch axis
-  is the slot table.
-- Admission is a bucketed, jitted batch-1 prefill (`prompt_len` rounded up
-  to a power-of-two bucket so prompt-length jitter mints a bounded set of
-  XLA programs) whose KV is `dynamic_update_slice`d into the request's
-  slot (`insert_cache_slot` — one compiled insert serves every slot).
-- Decode is ONE jitted single-token step over ALL slots, forever. Each
-  slot carries its own cursor (`cache_index` in the per-row engine form),
-  `position` and `valid_mask`, so ragged prompts and staggered admission
-  ages coexist in one program.
+- The resident KV cache is a fixed POOL of `num_pages × page_size` K/V
+  blocks per attention layer (models/gpt.py `make_paged_pool` — the
+  vLLM/PagedAttention representation), not one max_len row per slot.
+  Each slot maps its logical cache positions onto pool pages through a
+  host-owned page table; the decode read gathers a per-slot contiguous
+  view through it (ops/attention.py `paged_kv_view`) and runs the exact
+  same attention the slot-row cache did. Resident HBM is pool-sized —
+  decoupled from num_slots × max_len — and tracks ACTUAL lengths.
+- A reference-counted RADIX PREFIX INDEX (à la SGLang's RadixAttention,
+  host-side) remembers committed token sequences page-by-page: a new
+  request whose prompt shares a committed prefix maps those pages
+  copy-free (refcount++), COW-copies the one partially-matched boundary
+  page, and prefills only the tail — shared system prompts / few-shot
+  templates / multi-turn continuations stop paying prefill at all.
+- CHUNKED PREFILL feeds prefix tails and prompts past the largest bucket
+  through page-sized multi-token decode windows over the same paged
+  cache, so the largest-bucket admission ceiling is gone: anything with
+  `prompt + max_new_tokens ≤ max_len` rides the engine.
+- Admission is RESERVATION-GATED: a request is only admitted when the
+  pool can cover its worst-case page demand (free pages + evictable
+  prefix-cache pages − other slots' outstanding reservations), so
+  decode can never hit pool exhaustion mid-request; overload waits in
+  the bounded queue and surfaces as 429, never as a poisoned pool.
+- Decode is ONE jitted single-token step over ALL slots, forever. Page
+  tables and per-slot cursors are host numpy (tiny int32 arrays shipped
+  per dispatch); ragged prompts and staggered admission ages coexist in
+  one program exactly as before.
 - A scheduler thread runs the iteration loop: retire EOS/length-exhausted
-  slots, refill free slots FIFO from a bounded admission queue, run the
-  fused step, stream each slot's token to its waiting request future.
+  slots (committing their full pages to the prefix index), refill free
+  slots FIFO from a bounded admission queue, run the fused step, stream
+  each slot's token to its waiting request future.
 
 Greedy engine output is bitwise-identical to `generate()`'s fused scan
-(enforced by tests/test_engine.py): the decode step runs the same
-attention over the same max_len cache buffer — masked positions contribute
-exactly zero — and greedy sampling is the same f32 argmax.
+(enforced by tests/test_engine.py + tests/test_paged_kv.py for any page
+size, with and without prefix hits): the paged read gathers the same K/V
+bits the contiguous cache held, the one-hot page scatter writes x·1+0
+(exact), masked positions contribute exactly zero, and greedy sampling is
+the same f32 argmax.
 
 Sampling is per-request and DYNAMIC (temperature / top-k / top-p ride the
 step as per-slot arrays, not compile-time constants), so mixed sampling
@@ -42,16 +61,16 @@ every emitted token in the K=0 loop costs one full target forward — the
 memory-bound regime of Leviathan et al. 2023 / Chen et al. 2023. With a
 draft attached, each scheduler iteration runs K+1 cheap draft steps that
 propose K tokens per slot, then ONE jitted verify step drives the target
-over all slots x (K+1) window positions at once (the multi-token per-row
+over all slots x (K+1) window positions at once (the multi-token paged
 decode path in models/gpt.py), accepts each slot's longest valid prefix —
 greedy: exact match against the target argmax, which makes the output
 BITWISE identical to the K=0 engine; sampled: the rejection-sampling rule
 in serving/sampling.py, which makes the output distribution exactly the
-target's — and rewinds both caches' per-slot cursors past the rejected
-tail (models/gpt.py rewind_slot_cache). Each iteration emits between 1
-token (all drafts rejected: the verify step IS the ordinary decode step
-plus a correction) and K+1 tokens (all accepted plus the bonus token), so
-the target's weight traffic is amortized over up to K+1 tokens per slot.
+target's. Rollback is host arithmetic now: cursors live on the host, so
+rewinding past the rejected tail subtracts integers and RETURNS the pages
+the rejected window had claimed to the pool — no device rewind program.
+The draft shares the target's page tables (same page ids, its own pool),
+so prefix hits warm BOTH models' caches.
 """
 
 from __future__ import annotations
@@ -59,12 +78,16 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_tpu.analysis.serving_plans import (
+    DEFAULT_NUM_SLOTS,
+    DEFAULT_PAGE_SIZE,
+)
 from kubeflow_tpu.observability.trace import default_tracer
 from kubeflow_tpu.serving.batching import Completion
 from kubeflow_tpu.serving.sampling import (
@@ -78,8 +101,12 @@ from kubeflow_tpu.utils.metrics import (
     serving_decode_steps_counter,
     serving_draft_accepted_counter,
     serving_draft_proposed_counter,
+    serving_kv_pages_in_use_gauge,
+    serving_kv_pages_total_gauge,
     serving_num_slots_gauge,
     serving_phase_histogram,
+    serving_prefix_hit_tokens_counter,
+    serving_prefix_lookups_counter,
     serving_queue_depth_gauge,
     serving_slot_occupancy_gauge,
     serving_tokens_counter,
@@ -105,13 +132,10 @@ class QueueFullError(RuntimeError):
 
 
 class EngineCapacityError(ValueError):
-    """The request is valid for the MODEL but not for the engine's bucketed
-    slot layout: its prompt exceeds the largest prefill bucket, or the
-    bucket-rounded prompt plus max_new_tokens overruns max_len (prefill
-    leaves the slot cursor at the BUCKET boundary, so decode really does
-    need bucket + n <= max_len). The server falls back to the static
-    per-request fused scan for these instead of 400ing traffic the
-    platform served before the engine existed."""
+    """The request exceeds the MODEL's window: prompt + max_new_tokens >
+    max_len. With chunked prefill there is no bucket ceiling anymore —
+    any prompt the model can hold rides the engine — so this is a hard
+    400 (the static fused scan has exactly the same max_len limit)."""
 
 
 def default_prefill_buckets(max_len: int, smallest: int = 8) -> Tuple[int, ...]:
@@ -133,7 +157,10 @@ def bucket_for(prompt_len: int, buckets: Sequence[int]) -> int:
     Module-level because the engine AND kft-analyze's serve-program-count
     check share it: the analyzer enumerates every shape this function can
     route to a prefill program, so a rounding regression that would mint
-    an off-bucket XLA program is caught statically."""
+    an off-bucket XLA program is caught statically. Prompts past the
+    largest bucket no longer fall off the engine — admission seeds the
+    head with the largest bucket and chunk-prefills the rest — so this
+    raising is an internal contract, not an admission ceiling."""
     for b in buckets:
         if prompt_len <= b:
             return b
@@ -143,22 +170,266 @@ def bucket_for(prompt_len: int, buckets: Sequence[int]) -> int:
     )
 
 
+# Chunk-prefill window floor: windows are page-aligned but never smaller
+# than this many tokens — a 16-token forward runs at a fraction of the
+# matmul efficiency of a 64-token one (measured ~0.9 s vs ~1.7 s for a
+# FULL 256-token prefill on the CPU mesh), so page-sized windows would
+# make hit admissions nearly as slow as the full prefill they replace.
+# Garbage positions past the real tail are write-masked and overwritten.
+CHUNK_MIN_TOKENS = 64
+
+
+def auto_num_pages(num_slots: int, max_len: int, page_size: int) -> int:
+    """Default pool sizing: 3/4 of the slot-row footprint (num_slots ×
+    max_len), floored at one full-length request. Real traffic rarely
+    runs every slot to max_len, and the prefix cache recovers more — the
+    admission gate converts the residual risk into queue wait, never
+    into a failed decode."""
+    per_slot = max_len // page_size
+    return max(per_slot, (num_slots * per_slot * 3) // 4)
+
+
 # the per-slot dynamic sampling kernel — shared with the verify step's
 # acceptance math through serving/sampling.py (one definition point; the
 # historical private name stays importable for callers and tests)
 _sample_slots = _sample_slots_shared
 
 
+# ---------------------------------------------------------------------------
+# Host-side page accounting: the pool allocator and the radix prefix index.
+# Both are scheduler-thread-owned (no locks) — every mutation happens
+# between device dispatches, exactly like the slot table.
+# ---------------------------------------------------------------------------
+
+
+class PagePool:
+    """Free-list page allocator with reference counts. A page is held by
+    each slot that maps it plus (at most once) the radix prefix index;
+    it returns to the free list when the last reference drops.
+
+    Tree-evictability is tracked INCREMENTALLY (a tree flag per page, a
+    counter of tree pages whose only reference is the tree): the
+    admission gate reads it on every scheduler iteration with a queued
+    request, and a full-tree walk there would put O(nodes) of host work
+    under the condition lock exactly when the engine is under pool
+    pressure."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = int(num_pages)
+        self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
+        self._ref = np.zeros((self.num_pages,), np.int32)
+        self._tree = np.zeros((self.num_pages,), bool)
+        self._tree_pages = 0
+        self._tree_shared = 0  # tree pages a slot ALSO maps (unevictable)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def tree_evictable(self) -> int:
+        """Pages whose ONLY reference is the prefix index — what
+        eviction can eventually hand back (leaves first, cascading)."""
+        return self._tree_pages - self._tree_shared
+
+    def refcount(self, page: int) -> int:
+        return int(self._ref[page])
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh pages at refcount 1, or None if the free list is
+        short (the caller evicts from the prefix index and retries)."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._ref[p] = 1
+        return out
+
+    def retain(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            self._ref[p] += 1
+            if self._tree[p] and self._ref[p] == 2:
+                self._tree_shared += 1
+
+    def release(self, pages: Sequence[int]) -> int:
+        """Drop one reference per page; returns how many pages freed."""
+        freed = 0
+        for p in pages:
+            self._ref[p] -= 1
+            if self._tree[p] and self._ref[p] == 1:
+                self._tree_shared -= 1
+            if self._ref[p] <= 0:
+                self._ref[p] = 0
+                self._free.append(p)
+                freed += 1
+        return freed
+
+    def mark_tree(self, page: int) -> None:
+        """The prefix index adopted this page (call AFTER its retain)."""
+        self._tree[page] = True
+        self._tree_pages += 1
+        if self._ref[page] > 1:
+            self._tree_shared += 1
+
+    def unmark_tree(self, page: int) -> None:
+        """The prefix index is dropping this page (call BEFORE its
+        release)."""
+        self._tree[page] = False
+        self._tree_pages -= 1
+        if self._ref[page] > 1:
+            self._tree_shared -= 1
+
+    def reset(self) -> None:
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._ref[:] = 0
+        self._tree[:] = False
+        self._tree_pages = 0
+        self._tree_shared = 0
+
+
+class _RadixNode:
+    __slots__ = ("chunk", "page", "children", "parent", "last_used")
+
+    def __init__(self, chunk, page, parent):
+        self.chunk = chunk          # tuple of page_size token ids
+        self.page = page            # pool page holding this chunk's K/V
+        self.parent = parent
+        self.children: Dict[tuple, "_RadixNode"] = {}
+        self.last_used = 0
+
+
+class RadixPrefixIndex:
+    """Reference-counted radix tree over committed token sequences, with
+    PAGE-ALIGNED edges: each node is one full page (page_size tokens →
+    one pool page), children keyed by their chunk's token tuple so a
+    full-page match is a dict hit. Token-level reuse happens at the
+    frontier: the longest common prefix with any child's chunk names the
+    COW candidate — admission copies that page and extends its own copy,
+    which is exactly copy-on-divergence (the donor's page, and every
+    other slot referencing it, stays untouched).
+
+    Lifecycle: slots commit their FULL pages at retire (`insert` adopts
+    new chunks with a tree reference; chunks already present keep the
+    existing page and the slot's duplicate is simply released by the
+    caller). Eviction removes least-recently-matched LEAVES, releasing
+    the tree's reference — the page frees once no resident slot maps it.
+    Everything here is host data touched only by the scheduler thread."""
+
+    def __init__(self, page_size: int, pool: PagePool):
+        self.page_size = int(page_size)
+        self.pool = pool
+        self.root = _RadixNode(None, -1, None)
+        self.nodes = 0
+        self._clock = 0
+        # leaves maintained incrementally: eviction scans only these,
+        # never the whole tree
+        self._leaves: Dict[_RadixNode, None] = {}
+
+    def reset(self) -> None:
+        self.root = _RadixNode(None, -1, None)
+        self.nodes = 0
+        self._leaves = {}
+
+    def match(self, tokens) -> Tuple[List[int], int, Optional[Tuple[int, int]]]:
+        """Longest committed prefix of `tokens`: (full-page chain,
+        matched token count, partial) where partial = (page, r) names a
+        frontier page whose first r tokens continue the prompt (the COW
+        candidate), or None."""
+        ps = self.page_size
+        self._clock += 1
+        node = self.root
+        pages: List[int] = []
+        i, n = 0, len(tokens)
+        while n - i >= ps:
+            chunk = tuple(int(t) for t in tokens[i : i + ps])
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.last_used = self._clock
+            pages.append(child.page)
+            node = child
+            i += ps
+        partial = None
+        rest = [int(t) for t in tokens[i:]]
+        if rest:
+            best, best_child = 0, None
+            for chunk, child in node.children.items():
+                r = 0
+                for a, c in zip(rest, chunk):
+                    if a != c:
+                        break
+                    r += 1
+                if r > best:
+                    best, best_child = r, child
+            if best_child is not None:
+                best_child.last_used = self._clock
+                partial = (best_child.page, best)
+        return pages, i, partial
+
+    def insert(self, tokens, pages: Sequence[int]) -> None:
+        """Commit `len(pages)` full pages of `tokens` (page-aligned).
+        New chunks adopt the slot's page with a tree reference; chunks
+        already committed keep the existing page — the slot's duplicate
+        reference is dropped by the caller's blanket release, so
+        identical prefixes never hold two copies."""
+        ps = self.page_size
+        self._clock += 1
+        node = self.root
+        i = 0
+        for pg in pages:
+            chunk = tuple(int(t) for t in tokens[i : i + ps])
+            i += ps
+            child = node.children.get(chunk)
+            if child is None:
+                child = _RadixNode(chunk, int(pg), node)
+                if not node.children and node is not self.root:
+                    del self._leaves[node]  # gained a child: not a leaf
+                node.children[chunk] = child
+                self._leaves[child] = None
+                self.pool.retain([int(pg)])
+                self.pool.mark_tree(int(pg))
+                self.nodes += 1
+            child.last_used = self._clock
+            node = child
+
+    def evictable_pages(self) -> int:
+        """Pages whose ONLY reference is the tree — what eviction can
+        eventually hand back (leaves first, cascading upward). O(1):
+        the pool tracks tree flags against refcount transitions."""
+        return self.pool.tree_evictable
+
+    def evict(self, need: int) -> int:
+        """Remove least-recently-matched leaves until `need` pages have
+        actually freed (a leaf still mapped by a resident slot releases
+        the tree ref but frees nothing). Scans only the maintained leaf
+        set; terminates: every round removes a node."""
+        freed = 0
+        while freed < need and self._leaves:
+            victim = min(self._leaves, key=lambda n: n.last_used)
+            del self._leaves[victim]
+            del victim.parent.children[victim.chunk]
+            parent = victim.parent
+            if not parent.children and parent is not self.root:
+                self._leaves[parent] = None
+            self.nodes -= 1
+            self.pool.unmark_tree(victim.page)
+            freed += self.pool.release([victim.page])
+        return freed
+
+
 class ProgramSignature(NamedTuple):
     """One enumerable jitted engine program: the callable plus the exact
     abstract argument shapes the scheduler can ever pass it, and the
     argnums whose buffers the jit donates. `cache_io` names which inputs
-    and outputs are resident KV caches ((in_argnum, out_index, is_draft)
+    and outputs are resident KV pools ((in_argnum, out_index, is_draft)
     triples; None = the program has no cache on that side, out_index=-1 =
     the output IS the cache pytree itself, is_draft picks which model's
-    dtype governs that cache — the verify program carries BOTH) so the
-    dtype-discipline check can pair them without re-deriving engine
-    internals."""
+    dtype governs that cache) so the dtype-discipline check can pair
+    them without re-deriving engine internals."""
 
     name: str                     # "prefill@8", "step", "verify", ...
     family: str                   # "prefill" | "insert" | "step" | ...
@@ -184,16 +455,61 @@ class EnginePrograms:
     aliasing attribute, which is exactly the 2x-cache-HBM regression
     class). Adding a jit to the engine without enumerating it here fails
     the serve-program-count check (tests/test_analysis.py asserts every
-    jax.jit call site in this module lives in this class)."""
+    jax.jit call site in this module lives in this class).
 
-    def __init__(self, model, draft_model=None, num_draft_tokens: int = 0):
-        from kubeflow_tpu.models.gpt import insert_cache_slot
+    Paged geometry (`page_size`, `num_pages`) is construction state: it
+    shapes the K/V pools and is baked static into every paged program,
+    exactly like max_len."""
+
+    def __init__(
+        self,
+        model,
+        draft_model=None,
+        num_draft_tokens: int = 0,
+        page_size: int = DEFAULT_PAGE_SIZE,
+        num_pages: Optional[int] = None,
+    ):
+        from kubeflow_tpu.models.gpt import copy_pool_page
 
         cfg = model.cfg
         self.model = model
         self.num_draft_tokens = int(num_draft_tokens)
         if self.num_draft_tokens < 0:
             raise ValueError("num_draft_tokens must be >= 0")
+        self.page_size = int(page_size)
+        if self.page_size < 1 or self.page_size & (self.page_size - 1):
+            raise ValueError(
+                f"page_size {self.page_size} must be a positive power of two"
+            )
+        if cfg.max_len % self.page_size:
+            raise ValueError(
+                f"page_size {self.page_size} must divide the model's "
+                f"max_len {cfg.max_len} (the page table tiles the logical "
+                f"window exactly)"
+            )
+        self.max_pages_per_slot = cfg.max_len // self.page_size
+        # chunk windows are a whole number of pages, floored for matmul
+        # efficiency (CHUNK_MIN_TOKENS) and capped by the logical window
+        self.chunk_len = min(
+            max(self.page_size, CHUNK_MIN_TOKENS), cfg.max_len
+        )
+        self.chunk_len -= self.chunk_len % self.page_size
+        self.num_pages = (
+            int(num_pages)
+            if num_pages
+            # callers (DecodeEngine, the serving lint) always pass the
+            # resolved pool size; this fallback only covers a direct
+            # construction, so it assumes the registry's default slots
+            else auto_num_pages(
+                DEFAULT_NUM_SLOTS, cfg.max_len, self.page_size
+            )
+        )
+        if self.num_pages < self.max_pages_per_slot:
+            raise ValueError(
+                f"num_pages {self.num_pages} cannot hold one full-length "
+                f"request ({self.max_pages_per_slot} pages of "
+                f"{self.page_size})"
+            )
         if self.num_draft_tokens > 0:
             if draft_model is None:
                 raise ValueError(
@@ -216,23 +532,38 @@ class EnginePrograms:
                 )
         self.draft_model = draft_model
 
-        # the resident caches are always consumed-and-replaced: donate
+        # the resident pools are always consumed-and-replaced: donate
         # them so XLA aliases input→output instead of copying the
         # engine's dominant buffer on every admission and every one-token
-        # step (undonated = 2× cache HBM + one full cache copy per token)
+        # step (undonated = 2× pool HBM + one full pool copy per token)
         self.prefill = jax.jit(self._prefill_fn)
-        self.insert = jax.jit(insert_cache_slot, donate_argnums=(0,))
+        self.insert = jax.jit(self._insert_fn, donate_argnums=(0,))
+        self.chunk = jax.jit(self._chunk_fn, donate_argnums=(1,))
+        self.cow = jax.jit(copy_pool_page, donate_argnums=(0,))
         self.step = jax.jit(self._step_fn, donate_argnums=(1,))
         if self.num_draft_tokens > 0:
             self.draft_prefill = jax.jit(self._draft_prefill_fn)
-            self.draft_insert = jax.jit(insert_cache_slot, donate_argnums=(0,))
+            self.draft_insert = jax.jit(self._insert_fn, donate_argnums=(0,))
+            self.draft_chunk = jax.jit(
+                self._draft_chunk_fn, donate_argnums=(1,)
+            )
+            self.draft_cow = jax.jit(copy_pool_page, donate_argnums=(0,))
             self.draft = jax.jit(self._draft_fn, donate_argnums=(1,))
-            self.verify = jax.jit(self._verify_fn, donate_argnums=(1, 2))
+            self.verify = jax.jit(self._verify_fn, donate_argnums=(1,))
         else:
             self.draft_prefill = None
             self.draft_insert = None
+            self.draft_chunk = None
+            self.draft_cow = None
             self.draft = None
             self.verify = None
+
+    def _paged(self, page_table, cursors):
+        from kubeflow_tpu.models.gpt import PagedState
+
+        return PagedState(
+            page_table, cursors, self.page_size, self.num_pages
+        )
 
     # -- jitted program bodies ---------------------------------------------
 
@@ -249,11 +580,39 @@ class EnginePrograms:
         )
         return mutated["cache"], tok[0]
 
-    def _step_fn(self, params, cache, tokens, keys, counters, temps,
-                 top_ks, top_ps):
+    def _insert_fn(self, pool, cache_one, page_ids, real_len):
+        from kubeflow_tpu.models.gpt import insert_pages
+
+        return insert_pages(pool, cache_one, page_ids, real_len)
+
+    def _chunk_fn(self, params, pool, ids, page_table, cursor, sample_idx,
+                  key, temp, top_k, top_p):
+        """One page-sized prefill chunk through the paged decode path:
+        writes the window's K/V into the slot's pages and samples the
+        token after window position `sample_idx` (the request's last
+        real prompt token — only the chunk containing it returns a
+        meaningful token; the scheduler ignores the rest). This is what
+        kills both the largest-bucket admission ceiling and the
+        recompute on prefix hits: a tail of any length is a sequence of
+        these windows over already-resident context."""
+        paged = self._paged(page_table, cursor)
         out, mutated = self.model.apply(
-            {"params": params, "cache": cache}, tokens[:, None],
-            decode=True, mutable=["cache"],
+            {"params": params, "cache": pool}, ids,
+            decode=True, paged=paged, mutable=["cache"],
+        )
+        logits = out["logits"][0, sample_idx]
+        tok = _sample_slots(
+            logits[None], key[None], jnp.zeros((1,), jnp.int32),
+            temp[None], top_k[None], top_p[None],
+        )
+        return mutated["cache"], tok[0]
+
+    def _step_fn(self, params, pool, tokens, page_table, cursors, keys,
+                 counters, temps, top_ks, top_ps):
+        paged = self._paged(page_table, cursors)
+        out, mutated = self.model.apply(
+            {"params": params, "cache": pool}, tokens[:, None],
+            decode=True, paged=paged, mutable=["cache"],
         )
         nxt = _sample_slots(
             out["logits"][:, 0], keys, counters, temps, top_ks, top_ps
@@ -273,22 +632,34 @@ class EnginePrograms:
         )
         return mutated["cache"]
 
-    def _draft_fn(self, dparams, dcache, tokens, keys, draws, temps,
-                  top_ks, top_ps):
+    def _draft_chunk_fn(self, dparams, dpool, ids, page_table, cursor):
+        """The draft-side prefill chunk: same window, same pages, its own
+        pool — the draft's cache stays position-for-position in lockstep
+        with the target's through chunked admission."""
+        paged = self._paged(page_table, cursor)
+        _, mutated = self.draft_model.apply(
+            {"params": dparams, "cache": dpool}, ids,
+            decode=True, paged=paged, mutable=["cache"],
+        )
+        return mutated["cache"]
+
+    def _draft_fn(self, dparams, dpool, tokens, page_table, cursors, keys,
+                  draws, temps, top_ks, top_ps):
         """K+1 sequential one-token draft steps over all slots: proposals
         d_1..d_K plus their per-step sampling distributions q (what the
         verify step's rejection rule needs). The (K+1)-th step's output
         is discarded — it runs only to WRITE d_K's K/V, so the draft
-        cache ends the iteration having written exactly the same K+1
-        window positions as the target's verify forward and the two
-        caches rewind identically."""
+        pool ends the iteration having written exactly the same K+1
+        window positions as the target's verify forward. Cursors are
+        host-owned: step j writes at cursors + j."""
         kk = self.num_draft_tokens
 
         def body(carry, j):
-            cache, tok = carry
+            dcache, tok = carry
+            paged = self._paged(page_table, cursors + j)
             out, mutated = self.draft_model.apply(
-                {"params": dparams, "cache": cache}, tok[:, None],
-                decode=True, mutable=["cache"],
+                {"params": dparams, "cache": dcache}, tok[:, None],
+                decode=True, paged=paged, mutable=["cache"],
             )
             logits = out["logits"][:, 0].astype(jnp.float32)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -314,18 +685,18 @@ class EnginePrograms:
             )
             return (mutated["cache"], nxt), (nxt, q)
 
-        (dcache, _), (proposals, qs) = jax.lax.scan(
-            body, (dcache, tokens), jnp.arange(kk + 1)
+        (dpool, _), (proposals, qs) = jax.lax.scan(
+            body, (dpool, tokens), jnp.arange(kk + 1)
         )
         # [K+1, S] / [K+1, S, V] scan stacks -> the K proposals
-        return dcache, proposals[:kk].T, qs[:kk]
+        return dpool, proposals[:kk].T, qs[:kk]
 
-    def _verify_fn(self, params, cache, dcache, window, qs, keys, draws,
-                   temps, top_ks, top_ps):
+    def _verify_fn(self, params, pool, window, qs, keys, draws, temps,
+                   top_ks, top_ps, page_table, cursors):
         """ONE target forward over all slots x (K+1) window positions
         (window[:, 0] is each slot's last emitted token, window[:, 1:]
         the draft's proposals), then per-slot longest-valid-prefix
-        acceptance and cursor rollback for BOTH resident caches.
+        acceptance.
 
         Greedy slots accept while the proposal equals the target argmax;
         the first mismatch position emits the argmax itself (the target's
@@ -335,13 +706,15 @@ class EnginePrograms:
         rejected position resamples from the residual distribution and a
         fully-accepted window appends the bonus token from the (K+1)-th
         target distribution. Every iteration emits acc+1 tokens per slot
-        (1..K+1)."""
-        from kubeflow_tpu.models.gpt import rewind_slot_cache
-
+        (1..K+1). Rollback happens on the HOST: cursors are scheduler
+        state, so the rejected tail's K/V simply stays past the rewound
+        cursor — invisible to the masked read, overwritten next window —
+        and the pages it claimed go back to the pool."""
         kk = self.num_draft_tokens
+        paged = self._paged(page_table, cursors)
         out, mutated = self.model.apply(
-            {"params": params, "cache": cache}, window,
-            decode=True, mutable=["cache"],
+            {"params": params, "cache": pool}, window,
+            decode=True, paged=paged, mutable=["cache"],
         )
         logits = out["logits"].astype(jnp.float32)  # [S, K+1, V]
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -408,16 +781,7 @@ class EnginePrograms:
         out_tokens = jnp.where(
             jnp.arange(kk + 1)[None, :] < acc[:, None], padded, replacement
         )
-        # both caches consumed K+1 window positions; keep out_len of them
-        # (the replacement token's K/V is NOT resident — it is the next
-        # iteration's window[:, 0], exactly like the K=0 step's output)
-        rollback = (kk + 1) - out_len
-        return (
-            rewind_slot_cache(mutated["cache"], rollback),
-            rewind_slot_cache(dcache, rollback),
-            out_tokens,
-            out_len,
-        )
+        return mutated["cache"], out_tokens, out_len
 
     # -- abstract views (kft-analyze's serving lint; no device state) ------
 
@@ -463,13 +827,17 @@ class EnginePrograms:
         )
         return shapes["params"]
 
-    def slot_cache_shapes(self, cache_one, num_slots: int):
-        """The resident slot-batch cache structure (eval_shape over
-        make_slot_cache so no zeros materialize)."""
-        from kubeflow_tpu.models.gpt import make_slot_cache
+    def pool_shapes(self, cache_one):
+        """The paged K/V pool structure (eval_shape over make_paged_pool
+        so no zeros materialize) — the resident-HBM term mem-budget
+        charges: num_pages x page_size tokens of K/V per layer, NOT
+        num_slots x max_len. Works for the target's cache_one and the
+        draft's alike (the draft pool shares the page geometry)."""
+        from kubeflow_tpu.models.gpt import make_paged_pool
 
         return jax.eval_shape(
-            lambda c: make_slot_cache(c, num_slots), cache_one
+            lambda c: make_paged_pool(c, self.num_pages, self.page_size),
+            cache_one,
         )
 
     def program_signatures(
@@ -480,16 +848,18 @@ class EnginePrograms:
         draft_params=None,
     ) -> List[ProgramSignature]:
         """Enumerate EVERY jitted program the engine can dispatch for this
-        (num_slots, bucket set) geometry, with exact abstract argument
-        shapes: one prefill per bucket, one insert, one step — plus the
-        draft_prefill-per-bucket/draft_insert/draft/verify family when
-        K > 0. The jit wrappers cache one executable per input signature,
-        so this list IS the engine's compile-bound program set; the
-        serving lint lowers each entry and checks donation aliasing,
-        cache dtype discipline, and host-transfer freedom against it."""
+        (num_slots, bucket set, page geometry): one prefill per bucket,
+        one insert, one page-sized chunk, one COW page copy, one step —
+        plus the draft_prefill-per-bucket/draft_insert/draft_chunk/
+        draft_cow/draft/verify family when K > 0. The jit wrappers cache
+        one executable per input signature, so this list IS the engine's
+        compile-bound program set; the serving lint lowers each entry and
+        checks donation aliasing, cache dtype discipline, and
+        host-transfer freedom against it."""
         sds = jax.ShapeDtypeStruct
         i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
         s = int(num_slots)
+        mp = self.max_pages_per_slot
         buckets = tuple(sorted(prefill_buckets))
         if params is None:
             params = self.abstract_params()
@@ -500,7 +870,9 @@ class EnginePrograms:
             return sds((s,), dt)
 
         cache_one = self.cache_shapes(params, buckets[0])
-        slot_cache = self.slot_cache_shapes(cache_one, s)
+        pool = self.pool_shapes(cache_one)
+        pt = sds((s, mp), i32)
+        pt1 = sds((1, mp), i32)
         sigs: List[ProgramSignature] = []
         for b in buckets:
             sigs.append(ProgramSignature(
@@ -511,20 +883,32 @@ class EnginePrograms:
             ))
         sigs.append(ProgramSignature(
             "insert", "insert", self.insert,
-            (slot_cache, cache_one, sds((), i32)),
+            (pool, cache_one, sds((mp,), i32), sds((), i32)),
+            (0,), cache_io=((0, -1, False),),
+        ))
+        sigs.append(ProgramSignature(
+            "chunk", "chunk", self.chunk,
+            (params, pool, sds((1, self.chunk_len), i32), pt1,
+             sds((1,), i32), sds((), i32), key, sds((), f32),
+             sds((), i32), sds((), f32)),
+            (1,), cache_io=((1, 0, False),),
+        ))
+        sigs.append(ProgramSignature(
+            "cow", "cow", self.cow,
+            (pool, sds((), i32), sds((), i32)),
             (0,), cache_io=((0, -1, False),),
         ))
         sigs.append(ProgramSignature(
             "step", "step", self.step,
-            (params, slot_cache, vec(i32), keys, vec(i32), vec(f32),
-             vec(i32), vec(f32)),
+            (params, pool, vec(i32), pt, vec(i32), keys, vec(i32),
+             vec(f32), vec(i32), vec(f32)),
             (1,), cache_io=((1, 0, False),),
         ))
         if self.num_draft_tokens > 0:
             if draft_params is None:
                 draft_params = self.abstract_params(self.draft_model)
             dcache_one = self.draft_cache_shapes(draft_params, buckets[0])
-            dslot_cache = self.slot_cache_shapes(dcache_one, s)
+            dpool = self.pool_shapes(dcache_one)
             kk = self.num_draft_tokens
             vocab = self.model.cfg.vocab_size
             for b in buckets:
@@ -536,21 +920,32 @@ class EnginePrograms:
                 ))
             sigs.append(ProgramSignature(
                 "draft_insert", "draft_insert", self.draft_insert,
-                (dslot_cache, dcache_one, sds((), i32)),
+                (dpool, dcache_one, sds((mp,), i32), sds((), i32)),
+                (0,), cache_io=((0, -1, True),),
+            ))
+            sigs.append(ProgramSignature(
+                "draft_chunk", "draft_chunk", self.draft_chunk,
+                (draft_params, dpool, sds((1, self.chunk_len), i32), pt1,
+                 sds((1,), i32)),
+                (1,), cache_io=((1, -1, True),),
+            ))
+            sigs.append(ProgramSignature(
+                "draft_cow", "draft_cow", self.draft_cow,
+                (dpool, sds((), i32), sds((), i32)),
                 (0,), cache_io=((0, -1, True),),
             ))
             sigs.append(ProgramSignature(
                 "draft", "draft", self.draft,
-                (draft_params, dslot_cache, vec(i32), keys, vec(i32),
-                 vec(f32), vec(i32), vec(f32)),
+                (draft_params, dpool, vec(i32), pt, vec(i32), keys,
+                 vec(i32), vec(f32), vec(i32), vec(f32)),
                 (1,), cache_io=((1, 0, True),),
             ))
             sigs.append(ProgramSignature(
                 "verify", "verify", self.verify,
-                (params, slot_cache, dslot_cache, sds((s, kk + 1), i32),
+                (params, pool, sds((s, kk + 1), i32),
                  sds((kk, s, vocab), f32), keys, vec(i32), vec(f32),
-                 vec(i32), vec(f32)),
-                (1, 2), cache_io=((1, 0, False), (2, 1, True)),
+                 vec(i32), vec(f32), pt, vec(i32)),
+                (1,), cache_io=((1, 0, False),),
             ))
         return sigs
 
@@ -598,13 +993,14 @@ class _Slot:
 
 
 class DecodeEngine:
-    """The persistent slot-batch decode engine for one causal LM.
+    """The persistent paged-KV decode engine for one causal LM.
 
     Thread model: `submit()` (any thread) only touches the admission queue
     under the condition lock; the scheduler thread owns ALL device state
-    (resident cache, per-slot arrays) and the slot table, so the hot loop
-    never takes a lock around device work. Aggregate counters live behind
-    their own lock (`stats()`).
+    (the K/V pools) AND all page accounting (page tables, cursors, the
+    allocator, the radix prefix index) and the slot table, so the hot
+    loop never takes a lock around device work. Aggregate counters live
+    behind their own lock (`stats()`).
     """
 
     def __init__(
@@ -620,6 +1016,9 @@ class DecodeEngine:
         draft_model=None,
         draft_params=None,
         num_draft_tokens: int = 0,
+        page_size: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        prefix_cache: bool = True,
     ):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -640,12 +1039,24 @@ class DecodeEngine:
                 "draft_params (speculative decoding drafts from a "
                 "resident second model)"
             )
-        # the jitted program family (and the draft-compat validation)
-        # lives in EnginePrograms — the same object kft-analyze lowers
+        ps = int(page_size) if page_size else DEFAULT_PAGE_SIZE
+        pool_pages = (
+            int(num_pages)
+            if num_pages
+            else auto_num_pages(num_slots, cfg.max_len, ps)
+        )
+        # the jitted program family (and the draft-compat + page-geometry
+        # validation) lives in EnginePrograms — the same object
+        # kft-analyze lowers
         self.programs = EnginePrograms(
             model, draft_model=draft_model,
             num_draft_tokens=self.num_draft_tokens,
+            page_size=ps, num_pages=pool_pages,
         )
+        self.page_size = ps
+        self.num_pages = pool_pages
+        self._max_pages = self.programs.max_pages_per_slot
+        self.prefix_cache_enabled = bool(prefix_cache)
         self.draft_model = draft_model
         self.draft_params = draft_params
         buckets = tuple(
@@ -663,32 +1074,52 @@ class DecodeEngine:
         self.prefill_buckets = buckets
 
         # -- device state (scheduler-thread-owned after start) ----------
-        from kubeflow_tpu.models.gpt import make_slot_cache
+        from kubeflow_tpu.models.gpt import make_paged_pool
 
         self._cache_shapes = self.programs.cache_shapes(params, buckets[0])
-        self._make_slot_cache = make_slot_cache
-        self._cache = make_slot_cache(self._cache_shapes, num_slots)
+        self._make_paged_pool = make_paged_pool
+        self._pool = make_paged_pool(
+            self._cache_shapes, self.num_pages, self.page_size
+        )
         self._insert = self.programs.insert
         self._step = self.programs.step
+        self._chunk = self.programs.chunk
+        self._cow = self.programs.cow
         # one wrapper serves every bucket: jit caches one executable per
         # input shape, so the bucket set bounds the program set by itself
         self._prefill = self.programs.prefill
         if self.num_draft_tokens > 0:
-            # the draft's resident slot cache mirrors the target's slot
-            # table position-for-position; its cursors advance and rewind
-            # in lockstep with the target's inside the verify program
+            # the draft's pool mirrors the target's page ids page-for-
+            # page (one allocator serves both), so prefix hits and COW
+            # copies warm both models' caches in lockstep
             self._draft_cache_shapes = self.programs.draft_cache_shapes(
                 draft_params, buckets[0]
             )
-            self._draft_cache = make_slot_cache(
-                self._draft_cache_shapes, num_slots
+            self._draft_pool = make_paged_pool(
+                self._draft_cache_shapes, self.num_pages, self.page_size
             )
             self._draft_insert = self.programs.draft_insert
             self._draft_prefill = self.programs.draft_prefill
+            self._draft_chunk = self.programs.draft_chunk
+            self._draft_cow = self.programs.draft_cow
             self._draft = self.programs.draft
             self._verify = self.programs.verify
         else:
-            self._draft_cache = None
+            self._draft_pool = None
+        # -- host page accounting (scheduler-thread-owned) --------------
+        self._pagepool = PagePool(self.num_pages)
+        self._radix = (
+            RadixPrefixIndex(self.page_size, self._pagepool)
+            if self.prefix_cache_enabled
+            else None
+        )
+        self._pt_np = np.zeros((num_slots, self._max_pages), np.int32)
+        # parked cursor = max_len: the paged write masks positions past
+        # the logical window, so idle/retired rows write nothing
+        self._cur_np = np.full((num_slots,), cfg.max_len, np.int32)
+        self._slot_pages: List[List[int]] = [[] for _ in range(num_slots)]
+        self._slot_shared = np.zeros((num_slots,), np.int32)
+        self._slot_reserve = np.zeros((num_slots,), np.int32)
         # per-slot host mirrors, scheduler-thread-owned
         self._slots: List[Optional[_Slot]] = [None] * num_slots
         self._tok_np = np.zeros((num_slots,), np.int32)
@@ -714,6 +1145,12 @@ class DecodeEngine:
         self._drafted = 0
         self._accepted = 0
         self._verifies = 0
+        self._prefix_hit_tokens = 0
+        self._prefix_lookups = 0
+        self._cow_copies = 0
+        self._prefill_compute_tokens = 0
+        self._pages_allocated = 0
+        self._rewind_pages_returned = 0
 
         # kft-trace (observability/): request phases + scheduler iteration
         # spans ride the process tracer; a disabled tracer makes every
@@ -734,12 +1171,18 @@ class DecodeEngine:
         self._decode_steps = serving_decode_steps_counter()
         self._tokens_total = serving_tokens_counter()
         self._num_slots_gauge = serving_num_slots_gauge()
+        self._prefix_hits_m = serving_prefix_hit_tokens_counter()
+        self._prefix_lookups_m = serving_prefix_lookups_counter()
+        self._pages_in_use_g = serving_kv_pages_in_use_gauge()
+        self._pages_total_g = serving_kv_pages_total_gauge()
         self._queue_depth.set(0, model=name)
         self._occupancy.set(0.0, model=name)
         # exported capacity: fleet-level ratios (queue/slots SLO rules,
         # the autoscaler's queue-per-slot pressure) divide by the sum of
         # this gauge across replicas (observability/fleet.py)
         self._num_slots_gauge.set(num_slots, model=name)
+        self._pages_total_g.set(self.num_pages, model=name)
+        self._pages_in_use_g.set(0, model=name)
 
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"decode-engine-{name}"
@@ -764,10 +1207,11 @@ class DecodeEngine:
         n = int(max_new_tokens)
         if n < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        bucket = self.bucket_for(prompt.size)
-        if bucket + n > self.model.cfg.max_len:
+        # the paged layout holds prompts at their REAL length (no bucket
+        # rounding in the cache), so capacity is the model's own window
+        if prompt.size + n > self.model.cfg.max_len:
             raise EngineCapacityError(
-                f"prompt bucket {bucket} + {n} new tokens exceeds "
+                f"prompt {prompt.size} + {n} new tokens exceeds "
                 f"max_len {self.model.cfg.max_len}"
             )
         temperature = float(temperature)
@@ -889,14 +1333,23 @@ class DecodeEngine:
                 "accept_rate": (
                     self._accepted / self._drafted if self._drafted else 0.0
                 ),
+                "prefix_lookups": self._prefix_lookups,
+                "prefix_hit_tokens": self._prefix_hit_tokens,
+                "cow_copies": self._cow_copies,
+                "prefill_compute_tokens": self._prefill_compute_tokens,
+                "pages_allocated": self._pages_allocated,
+                "rewind_pages_returned": self._rewind_pages_returned,
+                "pages_in_use": self._pagepool.in_use,
+                "pages_total": self.num_pages,
             }
 
     def debug_state(self) -> dict:
-        """The /statusz snapshot: slot map, queue depth, recent finished
-        requests with phase breakdowns, aggregate stats. Slot reads are
-        lock-free snapshots of scheduler-owned state (a torn view across
-        slots is acceptable for a human-readable status page; no device
-        state is touched)."""
+        """The /statusz snapshot: slot map (with page footprints), pool
+        + prefix-cache occupancy, queue depth, recent finished requests
+        with phase breakdowns, aggregate stats. Slot reads are lock-free
+        snapshots of scheduler-owned state (a torn view across slots is
+        acceptable for a human-readable status page; no device state is
+        touched)."""
         slots = []
         for i, slot in enumerate(self._slots):
             if slot is None:
@@ -909,6 +1362,8 @@ class DecodeEngine:
                     "prompt_len": int(slot.req.prompt.size),
                     "tokens": len(slot.tokens),
                     "max_new": slot.req.max_new,
+                    "pages": len(self._slot_pages[i]),
+                    "shared_pages": int(self._slot_shared[i]),
                 }
             )
         with self._cv:
@@ -919,6 +1374,11 @@ class DecodeEngine:
             "name": self.name,
             "num_slots": self.num_slots,
             "queue_depth": depth,
+            "page_size": self.page_size,
+            "pages_total": self.num_pages,
+            "pages_in_use": self._pagepool.in_use,
+            "prefix_cache": self.prefix_cache_enabled,
+            "prefix_nodes": self._radix.nodes if self._radix else 0,
             "slots": slots,
             "recent": recent,
             "stats": self.stats(),
@@ -955,6 +1415,101 @@ class DecodeEngine:
                 slot.req.future.fail(err)
         self._occupancy.set(0.0, model=self.name)
 
+    # -- page accounting (scheduler thread only) ---------------------------
+
+    def _reserve_pages(self, prompt_len: int, max_new: int) -> int:
+        """Worst-case pages one request can ever hold: its full prompt
+        (plus the final chunk window's pad spill) and every token it may
+        decode, including the verify window's transient K overhang,
+        capped at the logical window. The admission gate holds this many
+        in reserve so lazy per-iteration allocation can NEVER fail mid-
+        decode — pool pressure becomes queue wait, not a dead slot."""
+        tokens = min(
+            prompt_len + max(max_new + self.num_draft_tokens,
+                             self.programs.chunk_len),
+            self.model.cfg.max_len,
+        )
+        return -(-tokens // self.page_size)
+
+    def _outstanding_pages(self) -> int:
+        out = 0
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                out += max(
+                    0,
+                    int(self._slot_reserve[i]) - len(self._slot_pages[i]),
+                )
+        return out
+
+    def _can_admit(self, req: _Request) -> bool:
+        """The reservation gate (conservative: assumes no prefix hit —
+        a hit only ever needs fewer fresh pages). Free pages plus what
+        prefix-cache eviction could reclaim, minus what already-resident
+        slots may still claim, must cover this request's worst case."""
+        need = self._reserve_pages(int(req.prompt.size), req.max_new)
+        avail = self._pagepool.free_count - self._outstanding_pages()
+        if self._radix is not None:
+            avail += self._radix.evictable_pages()
+        return avail >= need
+
+    def _alloc_pages(self, n: int) -> List[int]:
+        short = n - self._pagepool.free_count
+        if short > 0 and self._radix is not None:
+            self._radix.evict(short)
+        pages = self._pagepool.alloc(n)
+        if pages is None:
+            # unreachable behind the admission gate; if it ever trips,
+            # the scheduler's recovery path rebuilds a clean pool
+            raise RuntimeError(
+                f"engine {self.name}: KV page pool exhausted "
+                f"({self._pagepool.free_count} free of {self.num_pages})"
+            )
+        with self._stats_lock:
+            self._pages_allocated += n
+        return pages
+
+    def _ensure_pages(self, i: int, upto_tokens: int) -> None:
+        """Map enough pages onto slot i's table to cover logical
+        positions [0, upto_tokens); writes past the logical window are
+        masked on device, so the need is capped at max_pages."""
+        need = min(
+            -(-upto_tokens // self.page_size), self._max_pages
+        )
+        pages = self._slot_pages[i]
+        if len(pages) >= need:
+            return
+        got = self._alloc_pages(need - len(pages))
+        for pg in got:
+            self._pt_np[i, len(pages)] = pg
+            pages.append(pg)
+
+    def _free_tail_pages(self, i: int) -> int:
+        """Return pages past the resident ceiling to the pool — the K>0
+        rewind's page give-back: a rejected verify tail may have claimed
+        a page the rewound cursor no longer reaches."""
+        keep = max(
+            -(-int(self._cur_np[i]) // self.page_size),
+            int(self._slot_shared[i]),
+        )
+        pages = self._slot_pages[i]
+        freed = 0
+        while len(pages) > keep:
+            freed += self._pagepool.release([pages.pop()])
+        return freed
+
+    def _release_slot_pages(self, i: int) -> None:
+        pages = self._slot_pages[i]
+        if pages:
+            self._pagepool.release(pages)
+        self._slot_pages[i] = []
+        self._slot_shared[i] = 0
+        self._slot_reserve[i] = 0
+        self._cur_np[i] = self.model.cfg.max_len
+        self._pt_np[i, :] = 0
+
+    def _update_page_gauges(self) -> None:
+        self._pages_in_use_g.set(self._pagepool.in_use, model=self.name)
+
     # -- scheduler loop ----------------------------------------------------
 
     def _admit(self, slot_idx: int, req: _Request) -> None:
@@ -963,36 +1518,175 @@ class DecodeEngine:
         if req.queue_span is not None:
             req.queue_span.end(slot=slot_idx)
             req.queue_span = None
-        bucket = self.bucket_for(req.prompt.size)
+        prompt = req.prompt
+        p = int(prompt.size)
+        ps = self.page_size
         prefill_span = self._tracer.start_span(
             "request.prefill", trace_id=req.trace_id, model=self.name,
-            slot=slot_idx, bucket=bucket, prompt_len=int(req.prompt.size),
+            slot=slot_idx, prompt_len=p,
         )
-        fn = self._prefill
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, : req.prompt.size] = req.prompt
-        mask = np.zeros((1, bucket), bool)
-        mask[0, : req.prompt.size] = True
+        self._slot_reserve[slot_idx] = self._reserve_pages(p, req.max_new)
+        # -- prefix-cache lookup: map shared full pages copy-free, COW
+        # the partially-matched boundary page ---------------------------
+        matched = 0
+        pages: List[int] = []
+        shared = 0
+        if self._radix is not None:
+            with self._stats_lock:
+                self._prefix_lookups += 1
+            self._prefix_lookups_m.inc(model=self.name)
+            chain, full_m, partial = self._radix.match(prompt)
+            # never map the WHOLE prompt: the last real token must run
+            # through a chunk window to produce the first-token logits
+            m = min(
+                full_m + (partial[1] if partial is not None else 0), p - 1
+            )
+            if not (
+                m * 2 >= p
+                or (p > self.prefill_buckets[-1]
+                    and m >= self.prefill_buckets[-1])
+            ):
+                # a SMALL hit is faster as a miss: taking it routes the
+                # whole tail through chunk windows, which run at roughly
+                # half the bucketed prefill's per-token FLOP rate
+                # (CHUNK_MIN_TOKENS header), so a sliver of a match
+                # makes admission SLOWER than no match. Keep the hit
+                # only when it covers at least half the prompt — the
+                # tail is then no bigger than the skipped work even at
+                # the chunk's worse rate — or, past the largest bucket,
+                # when it covers at least the head prefill (the tail
+                # rides chunk windows on the miss path too, so the hit
+                # strictly removes windows).
+                m = 0
+            q, r = divmod(m, ps)
+            for pg in chain[:q]:
+                self._pagepool.retain([pg])
+                self._pt_np[slot_idx, len(pages)] = pg
+                pages.append(pg)
+            shared = q
+            matched = q * ps
+            if r > 0:
+                # copy-on-write at the divergence/extension boundary:
+                # this slot will WRITE into the page's tail, so it gets
+                # its own copy; the donor page (and every other slot or
+                # tree reference) stays untouched
+                src = chain[q] if q < len(chain) else partial[0]
+                self._slot_pages[slot_idx] = pages  # alloc accounting
+                dst = self._alloc_pages(1)[0]
+                self._pool = self._cow(
+                    self._pool, jnp.int32(src), jnp.int32(dst)
+                )
+                if self.num_draft_tokens > 0:
+                    self._draft_pool = self._draft_cow(
+                        self._draft_pool, jnp.int32(src), jnp.int32(dst)
+                    )
+                self._pt_np[slot_idx, len(pages)] = dst
+                pages.append(dst)
+                matched = q * ps + r
+                with self._stats_lock:
+                    self._cow_copies += 1
+            if matched:
+                self._prefix_hits_m.inc(matched, model=self.name)
+                with self._stats_lock:
+                    self._prefix_hit_tokens += matched
+        self._slot_pages[slot_idx] = pages
+        self._slot_shared[slot_idx] = shared
+        self._cur_np[slot_idx] = matched
+
         base = jax.random.PRNGKey(req.seed)
-        cache_one, tok = fn(
-            self.params, jnp.asarray(ids), jnp.asarray(mask), base,
-            jnp.float32(req.temperature), jnp.int32(req.top_k),
-            jnp.float32(req.top_p),
-        )
-        self._cache = self._insert(
-            self._cache, cache_one, jnp.int32(slot_idx)
-        )
-        if self.num_draft_tokens > 0:
-            # the draft tracks the same context from the same bucketed
-            # prompt; its cursors now sit at the same bucket boundary as
-            # the target's and stay in lockstep through verify rollbacks
-            draft_one = self._draft_prefill(
-                self.draft_params, jnp.asarray(ids), jnp.asarray(mask)
+        temp = jnp.float32(req.temperature)
+        tk = jnp.int32(req.top_k)
+        tp = jnp.float32(req.top_p)
+        largest = self.prefill_buckets[-1]
+        first_tok = None
+        computed = 0
+        if matched == 0 and p <= largest:
+            # fresh short prompt: one bucketed batch-1 prefill, scattered
+            # into this slot's pages at the prompt's REAL length (bucket
+            # padding never reaches the pool)
+            bucket = self.bucket_for(p)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :p] = prompt
+            mask = np.zeros((1, bucket), bool)
+            mask[0, :p] = True
+            cache_one, tok = self._prefill(
+                self.params, jnp.asarray(ids), jnp.asarray(mask), base,
+                temp, tk, tp,
             )
-            self._draft_cache = self._draft_insert(
-                self._draft_cache, draft_one, jnp.int32(slot_idx)
+            self._ensure_pages(slot_idx, p)
+            prow = jnp.asarray(self._pt_np[slot_idx])
+            self._pool = self._insert(
+                self._pool, cache_one, prow, jnp.int32(p)
             )
-        first = int(jax.device_get(tok))
+            if self.num_draft_tokens > 0:
+                draft_one = self._draft_prefill(
+                    self.draft_params, jnp.asarray(ids), jnp.asarray(mask)
+                )
+                self._draft_pool = self._draft_insert(
+                    self._draft_pool, draft_one, prow, jnp.int32(p)
+                )
+            first_tok = tok
+            self._cur_np[slot_idx] = p
+            computed = p
+        else:
+            pos = matched
+            if matched == 0 and p > largest:
+                # long fresh prompt: the head rides ONE largest-bucket
+                # prefill (no padding — the prompt overflows it), the
+                # rest chunk-prefills below. This is the admission that
+                # used to 400 / fall to the 8.55x-slower static path.
+                ids = np.asarray(prompt[:largest])[None]
+                mask = np.ones((1, largest), bool)
+                cache_one, _ = self._prefill(
+                    self.params, jnp.asarray(ids), jnp.asarray(mask),
+                    base, temp, tk, tp,
+                )
+                self._ensure_pages(slot_idx, largest)
+                prow = jnp.asarray(self._pt_np[slot_idx])
+                self._pool = self._insert(
+                    self._pool, cache_one, prow, jnp.int32(largest)
+                )
+                if self.num_draft_tokens > 0:
+                    draft_one = self._draft_prefill(
+                        self.draft_params, jnp.asarray(ids),
+                        jnp.asarray(mask),
+                    )
+                    self._draft_pool = self._draft_insert(
+                        self._draft_pool, draft_one, prow,
+                        jnp.int32(largest),
+                    )
+                pos = largest
+                computed = largest
+            # chunked prefill: page-aligned decode windows over the
+            # paged cache — the tail attends to everything already
+            # resident (mapped prefix pages included), so only UNCACHED
+            # tokens cost compute; window pads past the real tail are
+            # write-masked and overwritten by decode
+            clen = self.programs.chunk_len
+            while pos < p:
+                nreal = min(clen, p - pos)
+                chunk = np.zeros((1, clen), np.int32)
+                chunk[0, :nreal] = prompt[pos : pos + nreal]
+                self._ensure_pages(slot_idx, pos + clen)
+                prow = jnp.asarray(self._pt_np[slot_idx])[None]
+                cur = jnp.asarray([pos], jnp.int32)
+                final = pos + nreal >= p
+                sample_idx = jnp.int32((p - 1) - pos if final else 0)
+                self._pool, tok = self._chunk(
+                    self.params, self._pool, jnp.asarray(chunk), prow,
+                    cur, sample_idx, base, temp, tk, tp,
+                )
+                if self.num_draft_tokens > 0:
+                    self._draft_pool = self._draft_chunk(
+                        self.draft_params, self._draft_pool,
+                        jnp.asarray(chunk), prow, cur,
+                    )
+                if final:
+                    first_tok = tok
+                computed += nreal
+                pos += clen
+            self._cur_np[slot_idx] = p
+        first = int(jax.device_get(first_tok))
         prefill_span.end()
         slot = _Slot(req)
         slot.ttft_s = time.monotonic() - req.t_submit
@@ -1010,18 +1704,39 @@ class DecodeEngine:
         self._tok_np[slot_idx] = first
         self._key_np[slot_idx] = np.asarray(jax.device_get(base))
         self._cnt_np[slot_idx] = 1
-        self._draw_np[slot_idx] = 1  # the prefill drew fold_in(key, 0)
+        self._draw_np[slot_idx] = 1  # the admission sample drew fold_in(key, 0)
         self._temp_np[slot_idx] = req.temperature
         self._topk_np[slot_idx] = req.top_k
         self._topp_np[slot_idx] = req.top_p
         self._slots[slot_idx] = slot
         with self._stats_lock:
             self._admitted += 1
+            self._prefill_compute_tokens += computed
+        self._update_page_gauges()
 
     def _finish(self, slot_idx: int) -> None:
         slot = self._slots[slot_idx]
         self._slots[slot_idx] = None
         self._temp_np[slot_idx] = 0.0  # freed slots cost only the argmax
+        # commit the retired request's FULL pages to the prefix index
+        # (prompt + emitted tokens whose K/V are resident), then drop
+        # this slot's references — pages the tree adopted live on for
+        # future prefix hits, the rest return to the pool
+        req = slot.req
+        pages = self._slot_pages[slot_idx]
+        if self._radix is not None and pages:
+            resident = int(self._cur_np[slot_idx])
+            fullp = min(resident // self.page_size, len(pages))
+            if fullp > 0:
+                seq = np.concatenate(
+                    [req.prompt,
+                     np.asarray(slot.tokens[:-1], np.int32)]
+                )
+                self._radix.insert(
+                    seq[: fullp * self.page_size], pages[:fullp]
+                )
+        self._release_slot_pages(slot_idx)
+        self._update_page_gauges()
         # the exact phase decomposition: queue + prefill == TTFT, and
         # queue + prefill + decode == full request wall time
         prefill_s = slot.ttft_s - slot.queue_s
@@ -1060,17 +1775,18 @@ class DecodeEngine:
 
     def _recover(self, exc: BaseException) -> None:
         """A device call escaped the per-request handling (step failure, or
-        an admit that invalidated the DONATED resident cache before
+        an admit that invalidated the DONATED resident pool before
         raising). Without this the scheduler thread dies and every resident
         and queued request blocks until its caller's wait() timeout. Fail
         the resident futures (their slot state is gone), rebuild BOTH
-        zeroed resident caches — the draft/verify programs donate the
-        target AND draft buffers, so either may be a donated tombstone —
-        and keep scheduling: queued requests were never admitted and
-        remain servable."""
+        zeroed K/V pools — every paged program donates them, so either may
+        be a donated tombstone — reset the page allocator and the prefix
+        index (their page ids described the dead pools), and keep
+        scheduling: queued requests were never admitted and remain
+        servable."""
         log.exception(
             "engine %s decode iteration failed; failing %d resident "
-            "request(s) and rebuilding the slot cache(s)",
+            "request(s) and rebuilding the KV pool(s)",
             self.name, sum(s is not None for s in self._slots),
         )
         self._tracer.event(
@@ -1085,14 +1801,24 @@ class DecodeEngine:
                 self._slots[i] = None
                 slot.req.future.fail(err)
         self._temp_np[:] = 0.0
-        self._cache = self._make_slot_cache(
-            self._cache_shapes, self.num_slots
+        self._pool = self._make_paged_pool(
+            self._cache_shapes, self.num_pages, self.page_size
         )
         if self.num_draft_tokens > 0:
-            self._draft_cache = self._make_slot_cache(
-                self._draft_cache_shapes, self.num_slots
+            self._draft_pool = self._make_paged_pool(
+                self._draft_cache_shapes, self.num_pages, self.page_size
             )
+        self._pagepool.reset()
+        if self._radix is not None:
+            self._radix.reset()
+        for i in range(self.num_slots):
+            self._slot_pages[i] = []
+        self._slot_shared[:] = 0
+        self._slot_reserve[:] = 0
+        self._pt_np[:] = 0
+        self._cur_np[:] = self.model.cfg.max_len
         self._occupancy.set(0.0, model=self.name)
+        self._update_page_gauges()
 
     def _loop(self) -> None:
         while True:
@@ -1111,7 +1837,10 @@ class DecodeEngine:
                 self._recover(e)
 
     def _iterate(self) -> None:
-        # retire finished slots, then refill FIFO from the queue
+        # retire finished slots, then refill FIFO from the queue — each
+        # admission passes the page-reservation gate, so pool pressure
+        # holds the queue's HEAD (FIFO order preserved) instead of
+        # admitting work the pool cannot finish
         for i, slot in enumerate(self._slots):
             if slot is not None and self._done(slot):
                 self._finish(i)
@@ -1121,21 +1850,25 @@ class DecodeEngine:
             with self._cv:
                 if not self._queue:
                     break
+                if not self._can_admit(self._queue[0]):
+                    break
                 req = self._queue.popleft()
                 self._queue_depth.set(len(self._queue), model=self.name)
             try:
                 self._admit(i, req)
             except BaseException as e:  # noqa: BLE001 - per-request
                 req.future.fail(e)
-                # the inserts donate the resident caches: a failure past
-                # dispatch leaves self._cache (or the draft's) a deleted
-                # tombstone. With active slots the next step raises into
-                # _recover, but an IDLE engine never steps — every later
-                # admit would hit the tombstone and fail, poisoning the
-                # engine forever.
-                leaves = list(jax.tree_util.tree_leaves(self._cache))
+                self._release_slot_pages(i)
+                self._update_page_gauges()
+                # the admission programs donate the resident pools: a
+                # failure past dispatch leaves self._pool (or the
+                # draft's) a deleted tombstone. With active slots the
+                # next step raises into _recover, but an IDLE engine
+                # never steps — every later admit would hit the
+                # tombstone and fail, poisoning the engine forever.
+                leaves = list(jax.tree_util.tree_leaves(self._pool))
                 if self.num_draft_tokens > 0:
-                    leaves += jax.tree_util.tree_leaves(self._draft_cache)
+                    leaves += jax.tree_util.tree_leaves(self._draft_pool)
                 if any(
                     getattr(leaf, "is_deleted", lambda: False)()
                     for leaf in leaves
@@ -1156,12 +1889,15 @@ class DecodeEngine:
         if self.num_draft_tokens > 0:
             self._iterate_spec(active)
             return
+        for i in active:  # host-only page mapping; no device sync here
+            self._ensure_pages(i, int(self._cur_np[i]) + 1)
         with self._tracer.span(
             "engine.step", model=self.name, active=len(active)
         ):
-            self._cache, tok = self._step(
-                self.params, self._cache,
-                jnp.asarray(self._tok_np), jnp.asarray(self._key_np),
+            self._pool, tok = self._step(
+                self.params, self._pool,
+                jnp.asarray(self._tok_np), jnp.asarray(self._pt_np),
+                jnp.asarray(self._cur_np), jnp.asarray(self._key_np),
                 jnp.asarray(self._cnt_np), jnp.asarray(self._temp_np),
                 jnp.asarray(self._topk_np), jnp.asarray(self._topp_np),
             )
@@ -1177,29 +1913,34 @@ class DecodeEngine:
             slot.tokens.append(int(toks[i]))
             self._tok_np[i] = toks[i]
             self._cnt_np[i] += 1
+            self._cur_np[i] += 1
 
     def _iterate_spec(self, active: List[int]) -> None:
         """One draft-and-verify iteration: K+1 draft steps propose K
         tokens per slot, one target verify forward over all slots x (K+1)
-        positions accepts each slot's longest valid prefix and rewinds
-        both caches past the rejected tail. Emits 1..K+1 tokens per
+        positions accepts each slot's longest valid prefix. Cursors are
+        host state, so the rejected tail's rollback is integer arithmetic
+        here — and the pages the rejected overhang claimed go straight
+        back to the pool (`_free_tail_pages`). Emits 1..K+1 tokens per
         active slot; slots that hit max_new_tokens or EOS inside the
-        window keep only the prefix they asked for (their device cursors
-        are off-by-a-few but the slot retires and admission resets every
-        cursor it reuses)."""
+        window keep only the prefix they asked for."""
         kk = self.num_draft_tokens
+        for i in active:  # host-only page mapping; no device sync here
+            self._ensure_pages(i, int(self._cur_np[i]) + kk + 1)
         keys = jnp.asarray(self._key_np)
         draws = jnp.asarray(self._draw_np)
         temps = jnp.asarray(self._temp_np)
         top_ks = jnp.asarray(self._topk_np)
         top_ps = jnp.asarray(self._topp_np)
+        pt = jnp.asarray(self._pt_np)
+        curs = jnp.asarray(self._cur_np)
         with self._tracer.span(
             "engine.draft", model=self.name, active=len(active), k=kk
         ):
-            self._draft_cache, proposals, qs = self._draft(
-                self.draft_params, self._draft_cache,
-                jnp.asarray(self._tok_np), keys, draws, temps, top_ks,
-                top_ps,
+            self._draft_pool, proposals, qs = self._draft(
+                self.draft_params, self._draft_pool,
+                jnp.asarray(self._tok_np), pt, curs, keys, draws, temps,
+                top_ks, top_ps,
             )
         window = jnp.concatenate(
             [jnp.asarray(self._tok_np)[:, None], proposals], axis=1
@@ -1207,23 +1948,24 @@ class DecodeEngine:
         with self._tracer.span(
             "engine.verify", model=self.name, active=len(active), k=kk
         ):
-            self._cache, self._draft_cache, out_tok, out_len = self._verify(
-                self.params, self._cache, self._draft_cache, window, qs,
-                keys, draws, temps, top_ks, top_ps,
+            self._pool, out_tok, out_len = self._verify(
+                self.params, self._pool, window, qs, keys, draws, temps,
+                top_ks, top_ps, pt, curs,
             )
             out_tok = np.asarray(jax.device_get(out_tok))
             out_len = np.asarray(jax.device_get(out_len))
         rolled = int(sum((kk + 1) - int(out_len[i]) for i in active))
         if rolled:
-            # the verify program rewound both caches past the rejected
-            # tails — recorded as an instant (the device work is inside
-            # the verify span; this is the acceptance outcome)
+            # the host cursors rewind past the rejected tails below —
+            # recorded as an instant (the acceptance outcome; device work
+            # is inside the verify span)
             self._tracer.event(
                 "engine.rewind", model=self.name, tokens=rolled,
             )
         self._draw_np += kk + 1  # the window consumed K+1 rng positions
         emitted = 0
         accepted = 0
+        freed = 0
         for i in active:
             slot = self._slots[i]
             req = slot.req
@@ -1234,11 +1976,21 @@ class DecodeEngine:
                 toks = toks[: toks.index(req.eos_id) + 1]
             slot.tokens.extend(toks)
             self._tok_np[i] = toks[-1]
+            # host-side rollback: resident K/V = prompt + emitted - 1;
+            # the window wrote K+1 entries but only the kept prefix
+            # advances the cursor — the rest is invisible and will be
+            # overwritten by the next window at the same positions
+            self._cur_np[i] += len(toks)
+            freed += self._free_tail_pages(i)
             # _cnt_np (the K=0 step's rng counter) stays untouched: the
             # spec path's rng position is _draw_np, and a drafted engine
             # never runs _step
             emitted += len(toks)
             accepted += int(out_len[i]) - 1
+        if freed:
+            with self._stats_lock:
+                self._rewind_pages_returned += freed
+            self._update_page_gauges()
         proposed = kk * len(active)
         self._decode_steps.inc(model=self.name)
         self._verify_steps.inc(model=self.name)

@@ -18,6 +18,8 @@ import sys
 from kubeflow_tpu.analysis.serving_plans import (
     DEFAULT_MAX_QUEUE,
     DEFAULT_NUM_SLOTS,
+    DEFAULT_NUM_PAGES,
+    DEFAULT_PAGE_SIZE,
 )
 
 
@@ -31,15 +33,22 @@ def engine_knobs_from_env():
     renders (controllers/inference.py ← config/platform.py ServingConfig):
     KFT_SERVING_NUM_SLOTS (0 disables the engine), KFT_SERVING_MAX_QUEUE,
     KFT_SERVING_PREFILL_BUCKETS (comma-separated powers of two; empty =
-    auto power-of-two ladder), KFT_SERVING_DRAFT_MODEL +
-    KFT_SERVING_DRAFT_TOKENS (speculative decoding: registry draft model
-    and tokens drafted per verify step; 0 disables)."""
+    auto power-of-two ladder), KFT_SERVING_PAGE_SIZE +
+    KFT_SERVING_NUM_PAGES (paged-KV pool geometry; 0 pages = auto) +
+    KFT_SERVING_PREFIX_CACHE (radix prefix index on/off),
+    KFT_SERVING_DRAFT_MODEL + KFT_SERVING_DRAFT_TOKENS (speculative
+    decoding: registry draft model and tokens drafted per verify step; 0
+    disables)."""
     buckets_raw = os.environ.get("KFT_SERVING_PREFILL_BUCKETS", "")
     buckets = [int(b) for b in buckets_raw.split(",") if b.strip()]
+    prefix_raw = os.environ.get("KFT_SERVING_PREFIX_CACHE", "").strip()
     return {
         "num_slots": _env_int("KFT_SERVING_NUM_SLOTS", DEFAULT_NUM_SLOTS),
         "max_queue": _env_int("KFT_SERVING_MAX_QUEUE", DEFAULT_MAX_QUEUE),
         "prefill_buckets": buckets or None,
+        "page_size": _env_int("KFT_SERVING_PAGE_SIZE", DEFAULT_PAGE_SIZE),
+        "num_pages": _env_int("KFT_SERVING_NUM_PAGES", DEFAULT_NUM_PAGES),
+        "prefix_cache": prefix_raw != "0",
         "draft_model": os.environ.get("KFT_SERVING_DRAFT_MODEL", "").strip(),
         "num_draft_tokens": _env_int("KFT_SERVING_DRAFT_TOKENS", 0),
         "draft_checkpoint_dir": os.environ.get(
@@ -67,6 +76,9 @@ def build_server(
     num_slots: int = None,
     max_queue: int = None,
     prefill_buckets=None,
+    page_size: int = None,
+    num_pages: int = None,
+    prefix_cache: bool = None,
     draft_model: str = None,
     num_draft_tokens: int = None,
     draft_params=None,
@@ -128,6 +140,12 @@ def build_server(
             max_queue = env["max_queue"]
         if prefill_buckets is None:
             prefill_buckets = env["prefill_buckets"]
+        if page_size is None:
+            page_size = env["page_size"]
+        if num_pages is None:
+            num_pages = env["num_pages"]
+        if prefix_cache is None:
+            prefix_cache = env["prefix_cache"]
         if draft_model is None:
             draft_model = env["draft_model"]
         if num_draft_tokens is None:
@@ -192,6 +210,9 @@ def build_server(
                     num_slots=num_slots,
                     max_queue=max_queue,
                     prefill_buckets=prefill_buckets,
+                    page_size=page_size or None,
+                    num_pages=num_pages or None,
+                    prefix_cache=prefix_cache,
                     draft_model=draft,
                     draft_params=draft_params,
                     num_draft_tokens=num_draft_tokens,
@@ -230,6 +251,21 @@ def main(argv=None) -> int:
         "KFT_SERVING_MAX_QUEUE, else 64)",
     )
     ap.add_argument(
+        "--page-size", type=int, default=None,
+        help="tokens per KV pool block (power of two dividing max_len; "
+        "default from KFT_SERVING_PAGE_SIZE, else 16)",
+    )
+    ap.add_argument(
+        "--num-pages", type=int, default=None,
+        help="KV pool capacity in pages (0 = auto sizing; default from "
+        "KFT_SERVING_NUM_PAGES)",
+    )
+    ap.add_argument(
+        "--prefix-cache", type=int, choices=(0, 1), default=None,
+        help="radix prefix cache on/off (default from "
+        "KFT_SERVING_PREFIX_CACHE, else on)",
+    )
+    ap.add_argument(
         "--draft-model", default=None,
         help="registry model drafting speculative tokens beside the "
         "target (default from KFT_SERVING_DRAFT_MODEL; empty disables)",
@@ -252,6 +288,10 @@ def main(argv=None) -> int:
     server = build_server(
         args.model, args.checkpoint_dir, args.batch_window_ms,
         num_slots=args.num_slots, max_queue=args.max_queue,
+        page_size=args.page_size, num_pages=args.num_pages,
+        prefix_cache=(
+            None if args.prefix_cache is None else bool(args.prefix_cache)
+        ),
         draft_model=args.draft_model,
         num_draft_tokens=args.num_draft_tokens,
         draft_checkpoint_dir=args.draft_checkpoint_dir,

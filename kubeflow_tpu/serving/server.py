@@ -322,12 +322,24 @@ class ModelServer:
                 f"tokens={st['tokens']} "
                 f"occupancy={st['mean_occupancy']:.3f}"
             )
+            lines.append(
+                f"    kv pool: {state['pages_in_use']}"
+                f"/{state['pages_total']} pages of {state['page_size']} | "
+                f"prefix cache: "
+                f"{'on' if state['prefix_cache'] else 'off'} "
+                f"nodes={state['prefix_nodes']} "
+                f"hit_tokens={st['prefix_hit_tokens']} "
+                f"lookups={st['prefix_lookups']} "
+                f"cow={st['cow_copies']}"
+            )
             for s in state["slots"]:
                 if s is not None:
                     lines.append(
                         f"    slot {s['slot']}: {s['trace_id']} "
                         f"prompt={s['prompt_len']} "
-                        f"tokens={s['tokens']}/{s['max_new']}"
+                        f"tokens={s['tokens']}/{s['max_new']} "
+                        f"pages={s['pages']} "
+                        f"(shared {s['shared_pages']})"
                     )
             if state["recent"]:
                 lines.append("    recent requests (newest last):")
@@ -377,13 +389,13 @@ class ModelServer:
         path's rectangular wire shape: rows that hit EOS early are padded
         with eos_id, exactly the fused scan's freeze-at-EOS behavior.
 
-        Raises EngineCapacityError untouched: a request the MODEL could
-        serve but the engine's bucketed slots cannot (long prompt) belongs
-        on the static path, and the caller decides whether one exists."""
-        from kubeflow_tpu.serving.engine import (
-            EngineCapacityError,
-            QueueFullError,
-        )
+        Capacity: chunked prefill killed the bucket ceiling, so the only
+        limit left is the MODEL's own window (prompt + max_new_tokens >
+        max_len → EngineCapacityError → 400, exactly what the static
+        fused scan would have rejected). The old fall-back-to-ServedLm
+        branch is gone because no engine-refusable-but-model-servable
+        request exists anymore."""
+        from kubeflow_tpu.serving.engine import QueueFullError
 
         try:
             x = np.asarray(body["prompt_ids"], dtype=np.int32)
@@ -428,9 +440,9 @@ class ModelServer:
             )
         except QueueFullError as e:
             raise HttpError(429, str(e))
-        except EngineCapacityError:
-            raise  # a ValueError, but NOT a 400: caller may have a fallback
         except (ValueError, TypeError) as e:
+            # includes EngineCapacityError: prompt + n > max_len is a
+            # model limit, a 400 on the static path too
             raise BadRequest(f"bad generate request: {e}")
         # one deadline for the whole request: sequential per-row waits
         # against a hung engine would hold the socket rows × ENGINE_WAIT_S
@@ -594,17 +606,10 @@ class ModelServer:
             except (ValueError, TypeError) as e:
                 raise BadRequest(f"bad generate request: {e}")
             if engine is not None:
-                from kubeflow_tpu.serving.engine import EngineCapacityError
-
-                try:
-                    return self._generate_via_engine(engine, req, body, n)
-                except EngineCapacityError as e:
-                    # valid for the model, too big for the engine's
-                    # bucketed slots (prompt > largest bucket, or bucket +
-                    # n > max_len): serve it the pre-engine way instead of
-                    # 400ing traffic the static path always handled
-                    if lm is None:
-                        raise BadRequest(f"bad generate request: {e}")
+                # chunked prefill admits every prompt the model can hold
+                # (the old largest-bucket fallback to the static scan is
+                # dead); capacity overruns 400 inside, queue-full 429s
+                return self._generate_via_engine(engine, req, body, n)
             try:
                 sequences = lm.generate(
                     prompt,

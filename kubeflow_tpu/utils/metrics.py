@@ -689,6 +689,54 @@ def serving_verify_steps_counter() -> Counter:
     )
 
 
+# Paged-KV + radix prefix cache (serving/engine.py): hit tokens over
+# lookups is the TTFT lever — every hit token is prefill compute (and
+# pool HBM) the admission skipped; pages_in_use over pages_total is the
+# pool-pressure signal the admission gate throttles on.
+
+
+def serving_prefix_hit_tokens_counter() -> Counter:
+    """Prompt tokens served from the radix prefix cache instead of being
+    prefilled (shared full pages plus the COW'd partial page) — each one
+    is admission compute skipped, i.e. TTFT not paid."""
+    return default_registry().counter(
+        "serving_prefix_cache_hit_tokens_total",
+        "prompt tokens mapped copy-free from the prefix cache",
+        ["model"],
+    )
+
+
+def serving_prefix_lookups_counter() -> Counter:
+    """Admissions that consulted the radix prefix index (hit or miss) —
+    the denominator for the fleet-level hit-rate ratio."""
+    return default_registry().counter(
+        "serving_prefix_cache_lookups_total",
+        "prefix-cache lookups at admission",
+        ["model"],
+    )
+
+
+def serving_kv_pages_in_use_gauge() -> Gauge:
+    """KV pool pages currently referenced (resident slots plus the
+    prefix index); pages_total minus this is the admission gate's free
+    budget."""
+    return default_registry().gauge(
+        "serving_kv_pages_in_use",
+        "KV pool pages held by slots or the prefix cache",
+        ["model"],
+    )
+
+
+def serving_kv_pages_total_gauge() -> Gauge:
+    """Configured KV pool capacity (serving.num_pages) — the resident
+    cache HBM ceiling, decoupled from num_slots x max_len."""
+    return default_registry().gauge(
+        "serving_kv_pages_total",
+        "configured KV pool page capacity",
+        ["model"],
+    )
+
+
 # ---------------------------------------------------------------------------
 # Observability-derived metrics (kubeflow_tpu/observability/; docs/
 # OBSERVABILITY.md): per-phase request accounting on the serving path and

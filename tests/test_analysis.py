@@ -1006,10 +1006,17 @@ class TestServingPlansClean:
         bad = [f for f in findings if f.severity >= Severity.ERROR]
         assert bad == [], "\n".join(f.render() for f in bad)
         assert stats["programs"] == [
-            "prefill@8", "prefill@16", "insert", "step",
+            "prefill@8", "prefill@16", "insert", "chunk", "cow", "step",
         ]
         assert stats["hbm"]["budget_bytes"] == 16 << 30
-        assert stats["hbm"]["components_bytes"]["kv slot cache"] > 0
+        assert stats["hbm"]["components_bytes"]["kv page pool"] > 0
+        # the pool term is smaller than the slot-row cache it replaced
+        # (auto sizing: 3/4 of num_slots x max_len)
+        from kubeflow_tpu.serving.engine import auto_num_pages
+
+        assert stats["num_pages"] == auto_num_pages(
+            4, 128, stats["page_size"]
+        )
 
     def test_tiny_drafted_plan_lowers_clean(self):
         from kubeflow_tpu.analysis.serving import analyze_serving_plan
@@ -1022,7 +1029,8 @@ class TestServingPlansClean:
         bad = [f for f in findings if f.severity >= Severity.ERROR]
         assert bad == [], "\n".join(f.render() for f in bad)
         assert "verify" in stats["programs"]
-        assert "draft kv slot cache" in stats["hbm"]["components_bytes"]
+        assert "draft_chunk" in stats["programs"]
+        assert "draft kv page pool" in stats["hbm"]["components_bytes"]
 
     @pytest.mark.slow
     def test_shipped_serving_plans_clean(self):
@@ -1037,7 +1045,7 @@ class TestServingPlansClean:
         )
 
         specs = shipped_serving_plans()
-        assert len(specs) == 4
+        assert len(specs) == 5
         for spec in specs:
             findings, stats = analyze_serving_plan_subprocess(
                 spec, REPO, timeout_s=600.0
@@ -1054,21 +1062,30 @@ class TestServingPlansClean:
         import kubeflow_tpu.serving.main as sm
         from kubeflow_tpu.analysis.serving_plans import (
             DEFAULT_MAX_QUEUE,
+            DEFAULT_NUM_PAGES,
             DEFAULT_NUM_SLOTS,
+            DEFAULT_PAGE_SIZE,
         )
         from kubeflow_tpu.config.platform import ServingConfig
 
         for var in (
             "KFT_SERVING_NUM_SLOTS", "KFT_SERVING_MAX_QUEUE",
-            "KFT_SERVING_PREFILL_BUCKETS",
+            "KFT_SERVING_PREFILL_BUCKETS", "KFT_SERVING_PAGE_SIZE",
+            "KFT_SERVING_NUM_PAGES", "KFT_SERVING_PREFIX_CACHE",
         ):
             monkeypatch.delenv(var, raising=False)
         knobs = sm.engine_knobs_from_env()
         assert knobs["num_slots"] == DEFAULT_NUM_SLOTS
         assert knobs["max_queue"] == DEFAULT_MAX_QUEUE
+        assert knobs["page_size"] == DEFAULT_PAGE_SIZE
+        assert knobs["num_pages"] == DEFAULT_NUM_PAGES
+        assert knobs["prefix_cache"] is True
         cfg = ServingConfig()
         assert cfg.num_slots == DEFAULT_NUM_SLOTS
         assert cfg.max_queue == DEFAULT_MAX_QUEUE
+        assert cfg.page_size == DEFAULT_PAGE_SIZE
+        assert cfg.num_pages == DEFAULT_NUM_PAGES
+        assert cfg.prefix_cache is True
 
     def test_registry_shared_with_bench(self):
         """bench.py imports the registry's plan list and geometry (the
@@ -1132,7 +1149,8 @@ class TestServingPlansClean:
             ):
                 (in_programs if lo <= sub.lineno <= hi
                  else elsewhere).append(sub.lineno)
-        assert len(in_programs) == 7  # prefill/insert/step + 4 draft-family
+        # prefill/insert/chunk/cow/step + the 6-member draft family
+        assert len(in_programs) == 11
         assert elsewhere == [], (
             f"jax.jit outside EnginePrograms at lines {elsewhere}"
         )

@@ -108,15 +108,24 @@ class TestSlotScheduling:
             assert out["tokens"] == _ref_tokens(model, params, row, n)
         assert eng.stats()["admitted"] == 5
 
-    def test_prompt_longer_than_largest_bucket_rejected(self, gpt_and_params):
+    def test_prompt_longer_than_largest_bucket_chunk_prefills(
+        self, gpt_and_params
+    ):
+        """The old admission ceiling: a prompt past the largest bucket
+        used to 400 off the engine. Chunked prefill seeds the head with
+        the largest bucket and feeds the rest through page-sized decode
+        windows — output must still be bitwise the fused scan's."""
         model, params = gpt_and_params
         eng = DecodeEngine(
             "g", model, params, num_slots=1, prefill_buckets=[8],
-            autostart=False,
+            max_queue=4, page_size=8,
         )
-        with pytest.raises(ValueError, match="bucket"):
-            eng.submit(list(range(9)), 2)
-        eng.close()
+        try:
+            row = _rows(21)[0]  # 8-token head prefill + one chunk window
+            out = eng.generate_row(row, 5, timeout=120)
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, row, 5)
 
     def test_capacity_exceeding_max_len_rejected(self, gpt_and_params):
         model, params = gpt_and_params  # gpt_tiny max_len=128
@@ -166,10 +175,10 @@ class TestSlotScheduling:
         )
         orig_insert = eng._insert
 
-        def broken_insert(cache, cache_one, slot):
+        def broken_insert(pool, cache_one, page_ids, real_len):
             # simulate a post-dispatch failure: donation already consumed
-            # the resident cache when the error surfaces
-            jax.tree_util.tree_map(lambda a: a.delete(), cache)
+            # the resident pool when the error surfaces
+            jax.tree_util.tree_map(lambda a: a.delete(), pool)
             raise RuntimeError("injected insert failure")
 
         eng._insert = broken_insert
@@ -367,19 +376,22 @@ class TestServerIntegration:
         finally:
             eng.close()
 
-    def test_long_prompt_falls_back_to_static_path(self, gpt_and_params):
-        """A prompt the MODEL serves but the engine's buckets cannot
-        (len 12 > largest bucket 8) must ride the static fused scan, not
-        400 — the engine may not shrink the platform's servable range."""
+    def test_long_prompt_rides_the_engine_not_the_static_path(
+        self, gpt_and_params
+    ):
+        """A prompt past the largest bucket (len 12 > bucket 8) used to
+        fall back to the 8.55x-slower static fused scan; chunked prefill
+        routes it through the engine — same wire contract, same bits,
+        and the response now carries the engine's TTFT header."""
         model, params = gpt_and_params
         eng = DecodeEngine(
             "gpt", model, params, num_slots=1, prefill_buckets=[8],
-            max_queue=4,
+            max_queue=4, page_size=8,
         )
         server = self._server(gpt_and_params, eng)
         try:
             prompt = [list(range(1, 13))]
-            status, body = server.app.handle(
+            status, body, headers = server.app.handle_full(
                 "POST",
                 "/v1/models/gpt:generate",
                 body={"prompt_ids": prompt, "max_new_tokens": 3},
@@ -389,16 +401,20 @@ class TestServerIntegration:
         assert status == 200, body
         want = generate(model, params, jnp.asarray(prompt, jnp.int32), 3)
         assert body["sequences"] == np.asarray(want).tolist()
+        # the engine served it (the static path has no first-token moment)
+        assert "X-TTFT-Ms" in dict(headers)
+        assert eng.stats()["admitted"] == 1
 
-    def test_engine_only_capacity_error_is_400(self, gpt_and_params):
-        """Same oversize prompt with NO static fallback registered: a 400
-        naming the bucket limit, not a 500 or a hang."""
+    def test_capacity_error_is_400(self, gpt_and_params):
+        """prompt + max_new_tokens past the MODEL's window is the one
+        capacity limit left (no bucket ceiling anymore): a 400 naming
+        max_len — exactly what the static scan rejects — not a 500."""
         from kubeflow_tpu.serving.server import ModelServer
 
-        model, params = gpt_and_params
+        model, params = gpt_and_params  # gpt_tiny max_len=128
         eng = DecodeEngine(
-            "gpt", model, params, num_slots=1, prefill_buckets=[8],
-            max_queue=4, autostart=False,
+            "gpt", model, params, num_slots=1, max_queue=4,
+            autostart=False,
         )
         server = ModelServer()
         server.add_engine(eng)
@@ -408,13 +424,13 @@ class TestServerIntegration:
                 "/v1/models/gpt:generate",
                 body={
                     "prompt_ids": [list(range(1, 13))],
-                    "max_new_tokens": 3,
+                    "max_new_tokens": 120,  # 12 + 120 > 128
                 },
             )
         finally:
             server.close()
         assert status == 400
-        assert "bucket" in body["log"]
+        assert "max_len" in body["log"]
 
     def test_list_models_includes_engine_only_models(self, gpt_and_params):
         """Discovery must agree with serving: a model registered only via
@@ -470,15 +486,14 @@ class TestServerIntegration:
             server.close()
 
 
-class TestCacheSlotHelpers:
-    def test_insert_extract_roundtrip(self, gpt_and_params):
-        from kubeflow_tpu.models.gpt import (
-            extract_cache_slot,
-            insert_cache_slot,
-            make_slot_cache,
-        )
+class TestPagedPoolHelpers:
+    def test_insert_pages_scatters_prefill_rows_exactly(
+        self, gpt_and_params
+    ):
+        from kubeflow_tpu.models.gpt import insert_pages, make_paged_pool
 
         model, params = gpt_and_params
+        p = 4
         ids = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
         mask = jnp.ones_like(ids, bool)
         _, mutated = model.apply(
@@ -486,25 +501,53 @@ class TestCacheSlotHelpers:
             mutable=["cache"],
         )
         one = jax.tree.map(jnp.asarray, dict(mutated["cache"]))
-        slots = make_slot_cache(one, 3)
-        slots = insert_cache_slot(slots, one, jnp.int32(1))
-        back = extract_cache_slot(slots, jnp.int32(1))
-        for (pa, a), (pb, b) in zip(
-            sorted(
-                jax.tree_util.tree_leaves_with_path(one),
-                key=lambda kv: jax.tree_util.keystr(kv[0]),
-            ),
-            sorted(
-                jax.tree_util.tree_leaves_with_path(back),
-                key=lambda kv: jax.tree_util.keystr(kv[0]),
-            ),
-        ):
-            assert jax.tree_util.keystr(pa) == jax.tree_util.keystr(pb)
-            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-        # untouched slots stay zero
-        other = extract_cache_slot(slots, jnp.int32(0))
-        for leaf in jax.tree.leaves(other):
-            assert not np.asarray(leaf).any()
+        ps, num_pages = 4, 6
+        pool = make_paged_pool(one, num_pages, ps)
+        # the pool keeps ONLY K/V leaves (bookkeeping is host-owned)
+        names = {
+            path[-1].key
+            for path, _ in jax.tree_util.tree_leaves_with_path(pool)
+        }
+        assert names == {"cached_key", "cached_value"}
+        page_ids = jnp.asarray([5, 0, 0, 0], jnp.int32)  # 4 tokens -> 1 page
+        pool = insert_pages(pool, one, page_ids, jnp.int32(p))
+        for path, pool_leaf in jax.tree_util.tree_leaves_with_path(pool):
+            key = jax.tree_util.keystr(path)
+
+            def find(tree, path=path):
+                node = tree
+                for entry in path:
+                    node = node[entry.key]
+                return node
+
+            src = np.asarray(find(one))[0]  # [max_len, H, D]
+            got = np.asarray(pool_leaf)
+            # page 5 holds the prompt's first ps rows bitwise
+            np.testing.assert_array_equal(got[5], src[:ps])
+            # every unwritten page is untouched zeros, key included
+            for pg in range(got.shape[0]):
+                if pg != 5:
+                    assert not got[pg].any(), key
+
+    def test_copy_pool_page_is_isolated(self, gpt_and_params):
+        from kubeflow_tpu.models.gpt import copy_pool_page, make_paged_pool
+
+        model, params = gpt_and_params
+        ids = jnp.asarray([[7, 8]], jnp.int32)
+        _, mutated = model.apply(
+            {"params": params}, ids, attention_mask=jnp.ones_like(ids, bool),
+            prefill=True, mutable=["cache"],
+        )
+        one = jax.tree.map(jnp.asarray, dict(mutated["cache"]))
+        pool = make_paged_pool(one, 4, 8)
+        pool = jax.tree.map(
+            lambda leaf: leaf.at[1].set(1.0), pool
+        )  # page 1 = ones
+        copied = copy_pool_page(pool, jnp.int32(1), jnp.int32(3))
+        for leaf in jax.tree.leaves(copied):
+            arr = np.asarray(leaf)
+            np.testing.assert_array_equal(arr[3], arr[1])  # dst == src
+            assert not arr[0].any() and not arr[2].any()   # others untouched
 
 
 class TestMetricsSurface:

@@ -1,0 +1,400 @@
+"""Paged KV pool + radix prefix cache + chunked prefill
+(serving/engine.py; models/gpt.py paged helpers; ops/attention.py paged
+primitives).
+
+The load-bearing contract is unchanged from the slot-row engine: greedy
+output BITWISE-identical to the fused-scan `generate()` — paging changes
+where bytes LIVE, never what is computed — and it must hold for any page
+size, with and without prefix hits, through COW divergence, and under
+K>0 speculation. On top of that, this file pins the paged machinery
+itself: prefix hits actually skip prefill compute, partial-page reuse
+copies (never mutates) the donor page, pool exhaustion backpressures as
+queue-wait → clean 429 (no tombstoned pool), and the K>0 rewind returns
+the rejected window's pages to the pool.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import get_model
+from kubeflow_tpu.serving.engine import DecodeEngine, QueueFullError
+from kubeflow_tpu.serving.generate import generate
+
+
+@pytest.fixture(scope="module")
+def gpt_and_params():
+    model = get_model("gpt_tiny", dtype=jnp.float32)
+    prompt = jnp.arange(6)[None, :].astype(jnp.int32) % 512
+    params = model.init(jax.random.PRNGKey(0), prompt, deterministic=True)[
+        "params"
+    ]
+    return model, params
+
+
+def _rows(*lens):
+    return [
+        (np.arange(n) * (3 + 2 * i) + i + 1).astype(np.int32) % 512
+        for i, n in enumerate(lens)
+    ]
+
+
+def _ref_tokens(model, params, row, n):
+    out = generate(model, params, jnp.asarray(row, jnp.int32)[None, :], n)
+    return np.asarray(out)[0, len(row):].tolist()
+
+
+class TestParityAcrossPageSizes:
+    @pytest.mark.parametrize("page_size", [8, 64])
+    def test_bitwise_vs_generate(self, gpt_and_params, page_size):
+        """Page geometry is a storage-layout knob: any power-of-two page
+        size that divides max_len yields bitwise the fused scan's greedy
+        stream (8 = many pages per request, 64 = two)."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "pg", model, params, num_slots=2, max_queue=8,
+            page_size=page_size,
+        )
+        try:
+            rows = _rows(4, 7)
+            futs = [eng.submit(r, 6) for r in rows]
+            outs = [f.wait(120) for f in futs]
+        finally:
+            eng.close()
+        for row, out in zip(rows, outs):
+            assert out["tokens"] == _ref_tokens(model, params, row, 6)
+
+    @pytest.mark.slow
+    def test_bitwise_staggered_admission_page8(self, gpt_and_params):
+        """4 ragged requests through 2 slots at page_size=8: staggered
+        admission by construction, pages recycled across retires."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "pg8", model, params, num_slots=2, max_queue=16, page_size=8,
+        )
+        try:
+            rows = _rows(4, 6, 7, 3)
+            n_new = [6, 7, 5, 8]
+            futs = [eng.submit(r, n) for r, n in zip(rows, n_new)]
+            outs = [f.wait(120) for f in futs]
+        finally:
+            eng.close()
+        for row, n, out in zip(rows, n_new, outs):
+            assert out["tokens"] == _ref_tokens(model, params, row, n)
+
+
+class TestPrefixCache:
+    def test_shared_prefix_skips_prefill_compute(self, gpt_and_params):
+        """Second request with the same prompt maps the committed pages
+        copy-free and computes only the tail — prefill compute tokens
+        must drop, output must stay bitwise the oracle's."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "px", model, params, num_slots=1, max_queue=8, page_size=8,
+            prefix_cache=True,
+        )
+        try:
+            row = _rows(20)[0]
+            a = eng.generate_row(row, 6, timeout=120)
+            stats_a = eng.stats()
+            b = eng.generate_row(row, 6, timeout=120)
+            stats_b = eng.stats()
+        finally:
+            eng.close()
+        ref = _ref_tokens(model, params, row, 6)
+        assert a["tokens"] == ref
+        assert b["tokens"] == ref  # bitwise THROUGH the prefix hit
+        first_cost = stats_a["prefill_compute_tokens"]
+        second_cost = (
+            stats_b["prefill_compute_tokens"] - first_cost
+        )
+        assert first_cost == 20
+        # request A committed floor((20+5)/8)=3 full pages => B matches
+        # 19 tokens (capped at p-1: the last token recomputes for its
+        # logits) via 2 full pages + a COW'd partial, computing 1 token
+        assert second_cost < first_cost
+        assert second_cost <= 4
+        assert stats_b["prefix_hit_tokens"] >= 16
+        assert stats_b["prefix_lookups"] == 2
+
+    def test_cow_divergence_mid_prefix(self, gpt_and_params):
+        """A prompt diverging MID-PAGE from a committed prefix reuses
+        the full pages, COW-copies the boundary page, and extends its
+        own copy — bitwise-correct output for the diverged prompt AND
+        for a re-run of the original (the donor page is untouched)."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "cow", model, params, num_slots=1, max_queue=8, page_size=8,
+            prefix_cache=True,
+        )
+        try:
+            base = _rows(20)[0]
+            a = eng.generate_row(base, 6, timeout=120)
+            # diverge at token 18 — inside the committed chain's third
+            # page (positions 16..23)
+            div = base.copy()
+            div[18:] = (div[18:] + 101) % 512
+            c = eng.generate_row(div, 6, timeout=120)
+            stats = eng.stats()
+            # the donor chain must be intact: the ORIGINAL prompt still
+            # decodes bitwise through its (shared) pages
+            a2 = eng.generate_row(base, 6, timeout=120)
+        finally:
+            eng.close()
+        assert a["tokens"] == _ref_tokens(model, params, base, 6)
+        assert c["tokens"] == _ref_tokens(model, params, div, 6)
+        assert a2["tokens"] == a["tokens"]
+        assert stats["cow_copies"] >= 1
+
+    def test_small_hit_on_long_prompt_prefers_head_prefill(
+        self, gpt_and_params
+    ):
+        """A long prompt whose match covers less than the largest bucket
+        admits as a MISS: chunk windows run at a worse FLOP rate than
+        the bucketed head prefill, so a tiny hit would make admission
+        slower than no hit at all. The guard drops the match; output
+        stays the oracle's and the whole prompt is computed."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "smallhit", model, params, num_slots=1, max_queue=8,
+            page_size=8, prefill_buckets=[32], prefix_cache=True,
+        )
+        try:
+            short = _rows(12)[0]
+            eng.generate_row(short, 4, timeout=120)  # commits ~1 page
+            pre = eng.stats()["prefill_compute_tokens"]
+            # long prompt extending the committed 12-token prefix: the
+            # raw match (8 full-page tokens) is below bucket 32
+            long_row = np.concatenate(
+                [short, (np.arange(30, dtype=np.int32) * 5 + 7) % 512]
+            )
+            out = eng.generate_row(long_row, 4, timeout=120)
+            post = eng.stats()
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, long_row, 4)
+        # the match was ignored: the full 42 tokens were computed
+        assert post["prefill_compute_tokens"] - pre == long_row.size
+
+    def test_small_hit_on_short_prompt_prefers_bucketed_prefill(
+        self, gpt_and_params
+    ):
+        """Same guard below the largest bucket: a hit covering less than
+        half the prompt is dropped — one bucketed prefill beats chunking
+        the whole tail at the chunk window's worse FLOP rate."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "sliver", model, params, num_slots=1, max_queue=8,
+            page_size=8, prefill_buckets=[32], prefix_cache=True,
+        )
+        try:
+            short = _rows(8)[0]
+            eng.generate_row(short, 2, timeout=120)  # commits one page
+            pre = eng.stats()["prefill_compute_tokens"]
+            long_row = np.concatenate(
+                [short, (np.arange(12, dtype=np.int32) * 5 + 7) % 512]
+            )  # 20 tokens, raw match 8 < 20/2
+            out = eng.generate_row(long_row, 4, timeout=120)
+            post = eng.stats()
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, long_row, 4)
+        assert post["prefill_compute_tokens"] - pre == long_row.size
+
+    def test_tree_eviction_under_pool_pressure(self, gpt_and_params):
+        """A minimum-size pool with the prefix index holding committed
+        pages: a new admission that needs them evicts LRU leaves (the
+        incremental evictable accounting must agree), and everything
+        stays bitwise-correct — including re-serving the evicted prompt
+        afterwards (as a miss)."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "evict", model, params, num_slots=1, max_queue=4,
+            page_size=16, num_pages=8, prefill_buckets=[32],
+            prefix_cache=True,
+        )
+        try:
+            a_row = _rows(32)[0]
+            a1 = eng.generate_row(a_row, 4, timeout=120)
+            held = eng.stats()["pages_in_use"]
+            assert held > 0  # the tree kept A's full pages
+            assert eng._radix.evictable_pages() == held
+            # 80-token prompt: head prefill + chunk windows whose spill
+            # reaches the whole 8-page pool — forces tree eviction
+            b_row = _rows(80)[0]
+            b = eng.generate_row(b_row, 4, timeout=120)
+            a2 = eng.generate_row(a_row, 4, timeout=120)
+        finally:
+            eng.close()
+        assert a1["tokens"] == _ref_tokens(model, params, a_row, 4)
+        assert b["tokens"] == _ref_tokens(model, params, b_row, 4)
+        assert a2["tokens"] == a1["tokens"]
+
+    def test_prefix_cache_off_commits_nothing(self, gpt_and_params):
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "nopx", model, params, num_slots=1, max_queue=4, page_size=8,
+            prefix_cache=False,
+        )
+        try:
+            row = _rows(16)[0]
+            eng.generate_row(row, 4, timeout=120)
+            eng.generate_row(row, 4, timeout=120)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        assert stats["prefix_lookups"] == 0
+        assert stats["prefix_hit_tokens"] == 0
+        # with no index holding pages, everything returns to the pool
+        assert stats["pages_in_use"] == 0
+        assert stats["prefill_compute_tokens"] == 32  # both paid in full
+
+
+class TestPoolExhaustion:
+    def test_pool_pressure_queues_then_429s_cleanly(self, gpt_and_params):
+        """A minimum-size pool (one full-length request) forces the
+        admission gate to serialize long requests: followers wait in the
+        queue, the queue bound converts overflow into a clean 429, and
+        every admitted request still completes bitwise-correct — no
+        tombstoned pool, no dead scheduler."""
+        model, params = gpt_and_params  # max_len 128
+        eng = DecodeEngine(
+            "pool", model, params, num_slots=2, max_queue=2,
+            page_size=16, num_pages=8,  # 8 = max_len/page_size (minimum)
+            prefix_cache=False,
+        )
+        try:
+            row = _rows(4)[0]
+            # reserve = ceil(min(4+max(100,16),128)/16) = 7 of 8 pages:
+            # the second long request cannot co-reside
+            f_a = eng.submit(row, 100)
+            deadline = time.monotonic() + 60
+            while (
+                eng.stats()["admitted"] < 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert eng.stats()["admitted"] == 1
+            f_b = eng.submit(row, 10)
+            f_c = eng.submit(row, 10)
+            with pytest.raises(QueueFullError):
+                eng.submit(row, 10)  # queue holds b+c: clean 429
+            out_a = f_a.wait(300)
+            out_b = f_b.wait(300)
+            out_c = f_c.wait(300)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        assert out_a["tokens"] == _ref_tokens(model, params, row, 100)
+        assert out_b["tokens"] == _ref_tokens(model, params, row, 10)
+        assert out_c["tokens"] == _ref_tokens(model, params, row, 10)
+        assert stats["pages_in_use"] == 0  # everything returned
+
+    def test_capacity_validation_is_model_window(self, gpt_and_params):
+        from kubeflow_tpu.serving.engine import EngineCapacityError
+
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "cap", model, params, num_slots=1, autostart=False,
+        )
+        with pytest.raises(EngineCapacityError, match="max_len"):
+            eng.submit(list(range(1, 30)), 100)  # 29 + 100 > 128
+        eng.close()
+
+
+class TestSpeculativeRewind:
+    def test_rewind_returns_pages_under_k_gt_0(self, gpt_and_params):
+        """A hostile draft (rolled head: acceptance provably 0) makes
+        every verify window claim its K-token overhang and reject it:
+        the host-side rewind must hand those pages back (the pool's
+        free count recovers every iteration), and the stream stays
+        bitwise the oracle's."""
+        model, params = gpt_and_params
+        dparams = jax.device_get(params)
+        dparams["head"]["kernel"] = np.roll(
+            np.asarray(dparams["head"]["kernel"]), 1, axis=-1
+        )
+        eng = DecodeEngine(
+            "rw", model, params, num_slots=1, max_queue=4, page_size=8,
+            prefix_cache=False, draft_model=model, draft_params=dparams,
+            num_draft_tokens=2,
+        )
+        try:
+            row = _rows(7)[0]
+            out = eng.generate_row(row, 6, timeout=120)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, row, 6)
+        assert stats["rewind_pages_returned"] > 0
+        assert stats["pages_in_use"] == 0
+
+    @pytest.mark.slow
+    def test_spec_parity_with_prefix_hits(self, gpt_and_params):
+        """Speculation (perfect draft) composed with prefix hits at
+        page_size=8: the second identical request maps shared pages for
+        BOTH the target and draft pools (same page ids) and still emits
+        bitwise the oracle's stream."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "spx", model, params, num_slots=1, max_queue=8, page_size=8,
+            prefix_cache=True, draft_model=model, draft_params=params,
+            num_draft_tokens=3,
+        )
+        try:
+            row = _rows(20)[0]
+            a = eng.generate_row(row, 8, timeout=120)
+            b = eng.generate_row(row, 8, timeout=120)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        ref = _ref_tokens(model, params, row, 8)
+        assert a["tokens"] == ref
+        assert b["tokens"] == ref
+        assert stats["prefix_hit_tokens"] > 0
+
+
+class TestMetricsSurface:
+    def test_paged_metrics_registered_and_move(self, gpt_and_params):
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "pgm", model, params, num_slots=1, max_queue=4, page_size=8,
+            prefix_cache=True,
+        )
+        try:
+            row = _rows(20)[0]
+            eng.generate_row(row, 4, timeout=120)
+            eng.generate_row(row, 4, timeout=120)
+        finally:
+            eng.close()
+        reg = default_registry()
+        m = dict(model="pgm")
+        assert reg.get(
+            "serving_prefix_cache_lookups_total"
+        ).value(**m) == 2
+        assert reg.get(
+            "serving_prefix_cache_hit_tokens_total"
+        ).value(**m) > 0
+        assert reg.get("serving_kv_pages_total").value(**m) == eng.num_pages
+        # the prefix index is still holding the committed pages
+        assert reg.get("serving_kv_pages_in_use").value(**m) > 0
+
+    def test_debug_state_carries_page_map(self, gpt_and_params):
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "dbg", model, params, num_slots=1, autostart=False,
+            page_size=16,
+        )
+        try:
+            state = eng.debug_state()
+        finally:
+            eng.close()
+        assert state["page_size"] == 16
+        assert state["pages_total"] == eng.num_pages
+        assert state["pages_in_use"] == 0
+        assert state["prefix_cache"] is True

@@ -213,6 +213,10 @@ class TestInferenceServiceController:
             "KFT_SERVING_NUM_SLOTS": "4",  # platform default (override)
             "KFT_SERVING_MAX_QUEUE": "16",  # per-CR spec.serving
             "KFT_SERVING_PREFILL_BUCKETS": "8,32",
+            # paged-KV pool + radix prefix cache defaults
+            "KFT_SERVING_PAGE_SIZE": "16",
+            "KFT_SERVING_NUM_PAGES": "0",  # 0 = auto pool sizing
+            "KFT_SERVING_PREFIX_CACHE": "1",
             "KFT_SERVING_DRAFT_MODEL": "",  # speculation off by default
             "KFT_SERVING_DRAFT_TOKENS": "0",
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": "",
@@ -242,19 +246,29 @@ class TestInferenceServiceController:
         monkeypatch.setenv("KFT_SERVING_NUM_SLOTS", "4")
         monkeypatch.setenv("KFT_SERVING_MAX_QUEUE", "16")
         monkeypatch.setenv("KFT_SERVING_PREFILL_BUCKETS", "8,32")
+        monkeypatch.setenv("KFT_SERVING_PAGE_SIZE", "8")
+        monkeypatch.setenv("KFT_SERVING_NUM_PAGES", "24")
+        monkeypatch.setenv("KFT_SERVING_PREFIX_CACHE", "0")
         assert engine_knobs_from_env() == {
             "num_slots": 4,
             "max_queue": 16,
             "prefill_buckets": [8, 32],
+            "page_size": 8,
+            "num_pages": 24,
+            "prefix_cache": False,
             "draft_model": "",
             "num_draft_tokens": 0,
             "draft_checkpoint_dir": "",
         }
         monkeypatch.setenv("KFT_SERVING_PREFILL_BUCKETS", "")
         monkeypatch.setenv("KFT_SERVING_NUM_SLOTS", "")
+        monkeypatch.setenv("KFT_SERVING_PAGE_SIZE", "")
+        monkeypatch.setenv("KFT_SERVING_PREFIX_CACHE", "")
         knobs = engine_knobs_from_env()
         assert knobs["num_slots"] == 8  # default
         assert knobs["prefill_buckets"] is None  # auto ladder
+        assert knobs["page_size"] == 16  # default
+        assert knobs["prefix_cache"] is True  # empty = default on
 
 
 class TestNpyFastPath:

@@ -146,7 +146,7 @@ class TestGreedyParity:
                            autostart=False)
         try:
             assert eng.num_draft_tokens == 0
-            assert eng._draft_cache is None
+            assert eng._draft_pool is None
             assert not hasattr(eng, "_verify")
         finally:
             eng.close()
@@ -236,11 +236,12 @@ class TestRecovery:
         )
         orig_verify = eng._verify
 
-        def broken_verify(params_, cache, dcache, *a, **kw):
+        def broken_verify(params_, pool, *a, **kw):
             # simulate a post-dispatch failure: donation already consumed
-            # both resident caches when the error surfaces
-            jax.tree_util.tree_map(lambda x: x.delete(), cache)
-            jax.tree_util.tree_map(lambda x: x.delete(), dcache)
+            # the target pool; the draft pool (donated by the preceding
+            # draft program) is tombstoned alongside it
+            jax.tree_util.tree_map(lambda x: x.delete(), pool)
+            jax.tree_util.tree_map(lambda x: x.delete(), eng._draft_pool)
             raise RuntimeError("injected verify failure")
 
         eng._verify = broken_verify
