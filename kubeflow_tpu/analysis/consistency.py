@@ -57,6 +57,7 @@ _ENV_CONSUMER_PREFIXES = (
     "kubeflow_tpu/checkpointing/",
     "kubeflow_tpu/serving/",
     "kubeflow_tpu/observability/",
+    "kubeflow_tpu/chaos/",
     "kubeflow_tpu/images.py",
 )
 _ENV_RE = re.compile(r"^KFT_[A-Z0-9_]+$")

@@ -38,6 +38,11 @@ DEFAULT_MAX_QUEUE = 64
 # floored at one full-length request; serving/engine.py auto_num_pages).
 DEFAULT_PAGE_SIZE = 16
 DEFAULT_NUM_PAGES = 0
+# Draining-shutdown budget (serving/engine.py drain; docs/ROBUSTNESS.md):
+# the ONE definition point — serving/main.py's env fallback and
+# ModelServer's close(drain=True) default import it, and the registry-
+# defaults test pins ServingConfig.drain_deadline_s to the same number.
+DEFAULT_DRAIN_DEADLINE_S = 30.0
 
 # bench_serving_continuous's engine geometry: the ragged three-bucket
 # trace every round's headline engine numbers come from, and the

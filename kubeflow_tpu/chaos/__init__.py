@@ -1,0 +1,31 @@
+"""kft-chaos — deterministic fault injection (docs/ROBUSTNESS.md)."""
+
+from kubeflow_tpu.chaos.core import (
+    CATALOG,
+    ENV_CHAOS_ATTEMPT,
+    ENV_CHAOS_POINTS,
+    ENV_CHAOS_SEED,
+    ChaosController,
+    ChaosError,
+    ChaosSpecError,
+    PointSpec,
+    configure_from_env,
+    default_chaos,
+    parse_point,
+    parse_points,
+)
+
+__all__ = [
+    "CATALOG",
+    "ENV_CHAOS_ATTEMPT",
+    "ENV_CHAOS_POINTS",
+    "ENV_CHAOS_SEED",
+    "ChaosController",
+    "ChaosError",
+    "ChaosSpecError",
+    "PointSpec",
+    "configure_from_env",
+    "default_chaos",
+    "parse_point",
+    "parse_points",
+]

@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from kubeflow_tpu.chaos import ChaosError, default_chaos
 from kubeflow_tpu.checkpointing import layout
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import (
@@ -50,10 +51,39 @@ from kubeflow_tpu.utils.metrics import (
     checkpoint_save_histogram,
     default_registry,
 )
+from kubeflow_tpu.utils.retry import backoff_retry
 
 log = get_logger(__name__)
 
 _CLOSE = object()  # writer-queue sentinel
+
+# Transient-I/O retry policy for the shard-write / commit / restore
+# paths: network checkpoint volumes hiccup (and kft-chaos injects
+# exactly that class of fault — docs/ROBUSTNESS.md), and one flaky
+# write must not fail a whole save. Bounded exponential backoff WITH
+# jitter: every host of a gang retries against the same volume, and
+# lockstep retries would re-collide. A fault that survives all
+# attempts propagates — a persistent failure leaves the step
+# uncommitted (invisible to readers), never torn.
+_IO_RETRY_ATTEMPTS = 3
+_IO_RETRY_DELAY_S = 0.05
+_IO_RETRY_MULTIPLIER = 2.0
+_IO_RETRY_JITTER = 0.5
+_IO_RETRY_ON = (OSError, ChaosError)
+
+
+def _io_retry(fn, what: str):
+    return backoff_retry(
+        fn,
+        attempts=_IO_RETRY_ATTEMPTS,
+        delay_s=_IO_RETRY_DELAY_S,
+        multiplier=_IO_RETRY_MULTIPLIER,
+        jitter=_IO_RETRY_JITTER,
+        retry_on=_IO_RETRY_ON,
+        on_retry=lambda i, e: log.warning(
+            "checkpoint %s failed (attempt %d): %s; retrying", what, i, e
+        ),
+    )
 
 
 class _LeafSnapshot:
@@ -170,6 +200,9 @@ class CheckpointManager:
         # simulates a kill mid-save (the torn state the commit protocol
         # must tolerate)
         self._crash_after_shards = False
+        # kft-chaos injection points checkpoint.{shard_write,commit}
+        # ride the transient-I/O retry path above (docs/ROBUSTNESS.md)
+        self._chaos = default_chaos()
         reg = default_registry()
         self._save_total = reg.counter(
             "checkpoint_save_total", "checkpoints saved"
@@ -276,9 +309,14 @@ class CheckpointManager:
                 # dtypes too — bf16's buffer format is rejected outright
                 # ("cannot include dtype 'E'"), and 0-d arrays can't view
                 buf = np.ascontiguousarray(arr)
-                layout.atomic_write_bytes(
-                    path, buf.reshape(-1).view(np.uint8).data
-                )
+
+                def _write_shard(path=path, buf=buf):
+                    self._chaos.maybe_fail("checkpoint.shard_write")
+                    layout.atomic_write_bytes(
+                        path, buf.reshape(-1).view(np.uint8).data
+                    )
+
+                _io_retry(_write_shard, "shard write")
                 written += buf.nbytes
         if written:
             self._bytes_total.inc(written)
@@ -316,7 +354,11 @@ class CheckpointManager:
                 for leaf_id, leaf in enumerate(snapshot)
             ],
         }
-        layout.write_manifest(dirpath, manifest)
+        def _commit():
+            self._chaos.maybe_fail("checkpoint.commit")
+            layout.write_manifest(dirpath, manifest)
+
+        _io_retry(_commit, "commit")
         self._save_total.inc()
         self._save_seconds.observe(time.monotonic() - t0)
         self._sweep_retention()
@@ -523,9 +565,20 @@ def _materialize(reader: _ShardReader, target) -> Any:
 
 
 def restore_pytree(dirpath: str, target: Any) -> Any:
-    """Restore a committed step directory into `target`'s structure."""
+    """Restore a committed step directory into `target`'s structure.
+
+    Retried with bounded backoff: a transient I/O fault (or the
+    checkpoint.restore chaos point) mid-assembly must not fail a gang
+    resume that a second read would satisfy."""
+    return _io_retry(
+        lambda: _restore_pytree_once(dirpath, target), "restore"
+    )
+
+
+def _restore_pytree_once(dirpath: str, target: Any) -> Any:
     import jax
 
+    default_chaos().maybe_fail("checkpoint.restore")
     entries = _manifest_entries(dirpath)
     paths, treedef = jax.tree_util.tree_flatten_with_path(target)
     leaves = []
@@ -579,6 +632,13 @@ def restore_params(
     checkpoint as a nested dict of host numpy arrays — no target pytree or
     mesh required (shapes/dtypes come from the manifest)."""
     dirpath = _resolve_committed_dir(directory, step)
+    return _io_retry(
+        lambda: _restore_params_once(dirpath, prefix), "params restore"
+    )
+
+
+def _restore_params_once(dirpath: str, prefix: str) -> Dict[str, Any]:
+    default_chaos().maybe_fail("checkpoint.restore")
     entries = _manifest_entries(dirpath)
     want = prefix + "/"
     out: Dict[str, Any] = {}

@@ -270,6 +270,46 @@ class ObservabilityConfig(ConfigNode):
 
 
 @dataclasses.dataclass
+class ChaosConfig(ConfigNode):
+    """kft-chaos fault-injection knobs (kubeflow_tpu/chaos/;
+    docs/ROBUSTNESS.md). Rendered as KFT_CHAOS_* env into gang pods
+    (TPUJob controller) and serving pods (InferenceService controller);
+    consumed via chaos.configure_from_env in runtime/train_run.py and
+    serving/main.py. Disabled (the default) the injection points compile
+    to a shared no-op — production pays one bool check per seam."""
+
+    enabled: bool = config_field(
+        default=False,
+        help="arm the fault plan below in this job's/service's pods; "
+        "off = every injection point is a no-op",
+    )
+    points: List[str] = config_field(
+        default_factory=list,
+        help="armed injection points, one '<point>[:qualifiers]' entry "
+        "each (qualifiers p=<prob>, after=<n>, once, attempt=<n>); "
+        "point names come from the chaos.CATALOG registry, e.g. "
+        "'trainer.device_step:after=3,once,attempt=0'",
+    )
+    seed: int = config_field(
+        default=0,
+        help="fault-plan RNG seed: the same plan + seed + call sequence "
+        "injects bitwise the same faults (replayable chaos tests)",
+    )
+
+    def validate(self) -> None:
+        if self.seed < 0:
+            raise ConfigError("chaos.seed must be >= 0")
+        # parse NOW: an unknown point or bad qualifier must fail the
+        # config, not silently arm nothing (the slo_rules discipline)
+        from kubeflow_tpu.chaos import ChaosSpecError, parse_points
+
+        try:
+            parse_points(self.points)
+        except ChaosSpecError as e:
+            raise ConfigError(f"chaos.points: {e}") from e
+
+
+@dataclasses.dataclass
 class DataConfig(ConfigNode):
     """Input-pipeline selection: synthetic (the tf-cnn default, reference
     launcher.py:81-88 passes no data flags) or a real dataset, plus the eval
@@ -363,6 +403,7 @@ class TrainingConfig(ConfigNode):
     observability: ObservabilityConfig = config_field(
         default_factory=ObservabilityConfig
     )
+    chaos: ChaosConfig = config_field(default_factory=ChaosConfig)
     remat: bool = config_field(default=False, help="jax.checkpoint rematerialisation")
     loss_chunk: int = config_field(
         default=0,
@@ -619,15 +660,45 @@ class ServingConfig(ConfigNode):
         "but the accept rate is noise, so drafted serving is SLOWER than "
         "K=0 until real params are supplied.",
     )
+    drain_deadline_s: float = config_field(
+        default=30.0,
+        help="draining-shutdown budget (serving/engine.py drain): on "
+        "SIGTERM/scale-down the admission gate flips to 429 + "
+        "Retry-After and resident requests run to completion for at "
+        "most this many seconds before the remainder is failed fast. "
+        "Rendered as KFT_SERVING_DRAIN_DEADLINE_S; the serving pod's "
+        "terminationGracePeriodSeconds is derived from it.",
+    )
     observability: ObservabilityConfig = config_field(
         default_factory=ObservabilityConfig
     )
     autoscale: AutoscaleConfig = config_field(
         default_factory=AutoscaleConfig
     )
+    chaos: ChaosConfig = config_field(default_factory=ChaosConfig)
 
     def validate(self) -> None:
         self.autoscale.validate()
+        # from_dict only validates the chaos subtree when the key is
+        # present; a programmatically built config (replace(), CR merge)
+        # must hit the same parse rejection here, not crash-loop the pod
+        # at configure_from_env time
+        self.chaos.validate()
+        # serving replicas have no gang-incarnation counter (the
+        # controller renders no KFT_CHAOS_ATTEMPT): an attempt-qualified
+        # spec would arm as silently inert — fail it at config time
+        from kubeflow_tpu.chaos import parse_points
+
+        for spec in parse_points(self.chaos.points):
+            if spec.attempt is not None:
+                raise ConfigError(
+                    f"serving.chaos.points: {spec.spec_str()!r} uses "
+                    f"attempt=, which only gang pods support (the "
+                    f"TPUJob controller renders the incarnation "
+                    f"counter; serving replicas have none)"
+                )
+        if self.drain_deadline_s < 0:
+            raise ConfigError("serving.drain_deadline_s must be >= 0")
         if self.num_slots < 0:
             raise ConfigError("serving.num_slots must be >= 0")
         if self.max_queue < 1:

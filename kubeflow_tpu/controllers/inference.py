@@ -116,6 +116,10 @@ class InferenceServiceController(Controller):
             "KFT_SERVING_DRAFT_MODEL": cfg.draft_model,
             "KFT_SERVING_DRAFT_TOKENS": str(cfg.num_draft_tokens),
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": cfg.draft_checkpoint_dir,
+            # draining shutdown (serving/main.py SIGTERM path → engine
+            # drain: finish resident requests, 429 + Retry-After for new
+            # admissions — docs/ROBUSTNESS.md drain contract)
+            "KFT_SERVING_DRAIN_DEADLINE_S": f"{cfg.drain_deadline_s:g}",
             # kft-trace contract (observability/trace.py knobs_from_env)
             "KFT_TRACE_ENABLED": "1" if cfg.observability.trace_enabled else "0",
             "KFT_TRACE_BUFFER_SPANS": str(
@@ -132,6 +136,11 @@ class InferenceServiceController(Controller):
             # replica mounts no /metrics, and advertising a scrape port
             # it will 404 on would make it a permanently-failing target.
             env["KFT_FLEET_METRICS_PORT"] = str(SERVE_PORT)
+        if cfg.chaos.enabled and cfg.chaos.points:
+            # kft-chaos plan (kubeflow_tpu/chaos/): rendered only when
+            # armed — a chaos-off service's pods carry no plan at all
+            env["KFT_CHAOS_POINTS"] = ";".join(cfg.chaos.points)
+            env["KFT_CHAOS_SEED"] = str(cfg.chaos.seed)
         return env
 
     def _serving_cfg(self, spec: Dict[str, Any]) -> ServingConfig:
@@ -143,6 +152,10 @@ class InferenceServiceController(Controller):
             "num_slots": self.serving_defaults.num_slots,
             "prefill_buckets": list(self.serving_defaults.prefill_buckets),
             "max_queue": self.serving_defaults.max_queue,
+            "page_size": self.serving_defaults.page_size,
+            "num_pages": self.serving_defaults.num_pages,
+            "prefix_cache": self.serving_defaults.prefix_cache,
+            "drain_deadline_s": self.serving_defaults.drain_deadline_s,
             "draft_model": self.serving_defaults.draft_model,
             "num_draft_tokens": self.serving_defaults.num_draft_tokens,
             "draft_checkpoint_dir": self.serving_defaults.draft_checkpoint_dir,
@@ -152,9 +165,10 @@ class InferenceServiceController(Controller):
             "autoscale": dataclasses.asdict(
                 self.serving_defaults.autoscale
             ),
+            "chaos": dataclasses.asdict(self.serving_defaults.chaos),
         }
         overrides = dict(spec.get("serving") or {})
-        for subtree in ("observability", "autoscale"):
+        for subtree in ("observability", "autoscale", "chaos"):
             sub_override = overrides.pop(subtree, None) or {}
             merged[subtree].update(sub_override)
         merged.update(overrides)
@@ -249,6 +263,14 @@ class InferenceServiceController(Controller):
                 f"queue={getattr(sig, 'queue_depth', None)}, "
                 f"429/s={getattr(sig, 'rate_429_per_s', None)})"
             )
+            if reason == "ScaleDown":
+                # the condemned replica drains before it dies: SIGTERM →
+                # ModelServer.close(drain=True) inside the grace period
+                # (serving/main.py; docs/ROBUSTNESS.md drain contract)
+                detail += (
+                    f"; replica drains in-flight requests for up to "
+                    f"{cfg_serving.drain_deadline_s:g}s before exit"
+                )
             default_tracer().event(
                 "autoscale.resize",
                 service=f"{namespace}/{name}",
@@ -295,7 +317,21 @@ class InferenceServiceController(Controller):
                 )
             ],
         }
-        pod_spec: Dict[str, Any] = {"containers": [container]}
+        # draining shutdown: the grace period must COVER the WORST-CASE
+        # shutdown, or the kubelet's SIGKILL lands mid-cleanup and drops
+        # the very requests the drain exists to finish. Budget: the
+        # entrypoint's SIGTERM poll notices up to 1s late
+        # (serving/main.py stop.wait(1.0)), and a deadline-expired drain
+        # still pays engine.close()'s 10s scheduler-join before failing
+        # leftovers fast — so deadline + ~11s of machinery + slack.
+        # Generous grace is free (deletion waits only as long as the
+        # process actually takes).
+        pod_spec: Dict[str, Any] = {
+            "containers": [container],
+            "terminationGracePeriodSeconds": int(
+                serving_cfg.drain_deadline_s
+            ) + 30,
+        }
         topology = (spec.get("tpu") or {}).get("topology", "")
         if topology:
             slice_cfg = from_dict(SliceConfig, {"topology": topology})

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.cluster.objects import (
     new_object,
@@ -42,6 +42,8 @@ from kubeflow_tpu.cluster.reconciler import Controller, Result
 from kubeflow_tpu.cluster.store import AlreadyExists, StateStore
 from kubeflow_tpu.config.core import ConfigError, from_dict
 from kubeflow_tpu.config.platform import (
+    TPU_TOPOLOGIES,
+    ChaosConfig,
     ObservabilityConfig,
     SliceConfig,
     TrainingConfig,
@@ -76,10 +78,93 @@ DEFAULT_IMAGE = "kubeflow-tpu/trainer:latest"
 COND_CREATED = "Created"
 COND_RUNNING = "Running"
 COND_RESTARTING = "Restarting"
+COND_DEGRADED = "Degraded"
 COND_SUCCEEDED = "Succeeded"
 COND_FAILED = "Failed"
 
 TERMINAL_CONDITIONS = (COND_SUCCEEDED, COND_FAILED)
+
+# How many consecutive fleet sweeps a host must stay flagged by the
+# straggler detector (observability/fleet.py fleet_straggler) before the
+# controller treats it as conclusively sick and reshapes the gang off it.
+# Counted in SWEEPS, not reconciles — watch-event reconciles re-reading
+# one sweep's snapshot cannot fake persistence (the autoscaler's
+# hysteresis discipline).
+STRAGGLER_TRIP_SWEEPS = 3
+
+# Degraded-reshape axis policy: only the pure data-parallel axes shrink.
+# Halving data (or fsdp) changes WHERE batch rows land, never the model's
+# parameter structure — so the checkpoint subsystem's resharding restore
+# stays bitwise. tensor/pipeline/sequence/expert stay untouched: shrinking
+# them would change the model partitioning itself (pipeline_stages is a
+# model-construction knob), which is a migration, not a degradation.
+_SHRINK_AXES = ("data", "fsdp")
+
+
+def shrink_mesh(
+    axes: Dict[str, int], factor: int
+) -> Optional[Dict[str, int]]:
+    """Shrink the mesh's chip product by `factor` (a power of two) by
+    repeatedly halving the data-parallel axes (data first, then fsdp).
+    Returns the new axis map, or None when those axes cannot absorb the
+    reduction. global_batch_size divisibility survives by construction:
+    a batch divisible by data*fsdp is divisible by any halving of it."""
+    if factor < 1 or factor & (factor - 1):
+        return None
+    out = dict(axes)
+    remaining = factor
+    while remaining > 1:
+        for a in _SHRINK_AXES:
+            if out.get(a, 1) % 2 == 0:
+                out[a] //= 2
+                remaining //= 2
+                break
+        else:
+            return None
+    return out
+
+
+def plan_degraded_reshape(
+    slice_cfg: SliceConfig, training: TrainingConfig
+) -> Optional[Tuple[Dict[str, Any], Dict[str, int]]]:
+    """The largest valid smaller gang shape for a job that lost a host:
+    multislice jobs try dropping one slice first (the lost host's slice
+    — same topology, one fewer DCN member), but that candidate is valid
+    only when the remaining chips divide the old count by a power of
+    two (`shrink_mesh` halves axes), i.e. 2 -> 1 slices; other slice
+    counts fall through to the same path single-slice jobs take — the
+    largest same-generation topology with fewer chips, keeping the
+    slice count. The mesh shrinks data-first by the chip ratio
+    (`shrink_mesh`). Returns
+    ({"topology", "num_slices"}, mesh_axes) or None when no smaller
+    shape can hold the job (data axes exhausted, or no smaller
+    topology exists in the generation)."""
+    candidates: List[Tuple[str, int]] = []
+    if slice_cfg.num_slices > 1:
+        candidates.append((slice_cfg.topology, slice_cfg.num_slices - 1))
+    gen = slice_cfg.topology.split("-")[0]
+    same_gen = sorted(
+        (
+            (name, info["chips"])
+            for name, info in TPU_TOPOLOGIES.items()
+            if name.split("-")[0] == gen
+            and info["chips"] < slice_cfg.chips_per_slice
+        ),
+        key=lambda kv: kv[1],
+        reverse=True,
+    )
+    candidates.extend((name, slice_cfg.num_slices) for name, _ in same_gen)
+    old_chips = slice_cfg.total_chips
+    axes = training.mesh.axis_sizes()
+    for topology, num_slices in candidates:
+        new_chips = TPU_TOPOLOGIES[topology]["chips"] * num_slices
+        if new_chips >= old_chips or old_chips % new_chips:
+            continue
+        mesh = shrink_mesh(axes, old_chips // new_chips)
+        if mesh is None:
+            continue
+        return {"topology": topology, "num_slices": num_slices}, mesh
+    return None
 
 # Pod phases (mirrors k8s).
 PENDING, RUNNING, SUCCEEDED, FAILED = "Pending", "Running", "Succeeded", "Failed"
@@ -94,8 +179,16 @@ def new_tpu_train_job(
     image: str = DEFAULT_IMAGE,
     active_deadline_seconds: Optional[float] = None,
     clean_pod_policy: str = "None",
+    elastic_resume: bool = True,
 ) -> Dict[str, Any]:
-    """Spec constructor (the create_job_specs.py equivalent, mesh-first)."""
+    """Spec constructor (the create_job_specs.py equivalent, mesh-first).
+
+    `elastic_resume` (runPolicy.elasticResume, default on): a gang that
+    conclusively lost a host reshapes to the largest valid smaller
+    topology and resumes from the last committed checkpoint instead of
+    failing terminally (docs/ROBUSTNESS.md). Off restores strict
+    fail-fast: budget exhaustion is always BackoffLimitExceeded —
+    the contract for operators whose automation resubmits on Failed."""
     return new_object(
         KIND,
         name,
@@ -108,6 +201,7 @@ def new_tpu_train_job(
                 "maxRestarts": max_restarts,
                 "activeDeadlineSeconds": active_deadline_seconds,
                 "cleanPodPolicy": clean_pod_policy,
+                "elasticResume": elastic_resume,
             },
         },
     )
@@ -145,15 +239,29 @@ class TPUTrainJobController(Controller):
     kind = KIND
     name = "tpujob-controller"
 
-    def __init__(self) -> None:
+    def __init__(self, fleet=None) -> None:
         super().__init__()
         self.watches = {"Pod": self.map_owned}
+        # the fleet collector (observability/fleet.py FleetCollector, or
+        # anything with its stragglers()/sweeps() shape): the straggler-
+        # trip → degraded-reshape relay's only input. None = reshape
+        # still triggers on restart-budget exhaustion, never proactively.
+        self.fleet = fleet
+        # (ns, job, host) → consecutive flagged sweeps; (ns, job) → last
+        # counted sweep id (re-reading one sweep must not double-count)
+        self._straggler_strikes: Dict[Tuple[str, str, str], int] = {}
+        self._straggler_sweep: Dict[Tuple[str, str], int] = {}
         reg = default_registry()
         self._jobs_total = reg.counter(
             "tpujob_total", "job terminal outcomes", ["outcome"]
         )
         self._restarts_total = reg.counter(
             "tpujob_gang_restarts_total", "whole-gang restarts", []
+        )
+        self._reshapes_total = reg.counter(
+            "tpujob_gang_reshapes_total",
+            "degraded-mesh gang reshapes (elastic resume on fewer chips)",
+            [],
         )
         self._running = reg.gauge("tpujob_running", "jobs currently running", [])
 
@@ -179,7 +287,7 @@ class TPUTrainJobController(Controller):
             return Result()
 
         try:
-            slice_cfg, training = parse_job_spec(job.get("spec", {}))
+            slice_cfg, training, training_spec = self._effective_config(job)
         except ConfigError as e:
             self._finish(store, job, COND_FAILED, "InvalidSpec", str(e))
             return Result()
@@ -200,7 +308,7 @@ class TPUTrainJobController(Controller):
 
         if missing:
             created = self._create_gang(
-                store, job, slice_cfg, training, desired, pods
+                store, job, slice_cfg, training, desired, pods, training_spec
             )
             if created:
                 changed |= set_condition(
@@ -273,7 +381,9 @@ class TPUTrainJobController(Controller):
                 return Result()
 
         if any(p == FAILED for p in phases):
-            return self._handle_gang_failure(store, job, desired, pods)
+            return self._handle_gang_failure(
+                store, job, desired, pods, slice_cfg, training
+            )
 
         if all(p == SUCCEEDED for p in phases):
             # surface the coordinator's final metrics on the job (trial
@@ -300,6 +410,11 @@ class TPUTrainJobController(Controller):
             return Result()
 
         if all(p == RUNNING for p in phases):
+            # a persistently-straggling host (fleet_straggler relay) is
+            # treated as conclusively gone: reshape proactively instead
+            # of letting the slow host throttle the whole gang
+            if self._check_stragglers(store, job, slice_cfg, training):
+                return Result(requeue=True)
             changed |= set_condition(
                 job, COND_RUNNING, "True", "GangRunning", "all workers running"
             )
@@ -307,6 +422,33 @@ class TPUTrainJobController(Controller):
             self._write_status(store, job)
         # periodic deadline check while non-terminal
         return Result(requeue_after_s=1.0 if deadline else 5.0)
+
+    # -- effective shape (degraded-mesh overrides) -------------------------
+
+    def _effective_config(self, job: Dict[str, Any]):
+        """The job's EFFECTIVE (slice, training) shape: the spec as
+        written, overridden by status.degraded after an elastic reshape.
+        The spec itself stays immutable — what the operator asked for —
+        while the status records what the job actually runs on, exactly
+        like replicaStatuses records what exists vs what was requested.
+        Returns (slice_cfg, training_cfg, training_spec_dict); the spec
+        dict is what _build_pod renders into KFT_TRAINING_SPEC so the
+        in-pod Trainer builds the degraded mesh."""
+        spec = job.get("spec", {})
+        degraded = (job.get("status") or {}).get("degraded") or {}
+        slice_spec = dict(spec.get("slice") or {})
+        # shallow copy: the degraded override replaces the top-level
+        # "mesh" key, never mutates nested spec state — and this runs
+        # on every reconcile, so no deepcopy on the hot path
+        training_spec = dict(spec.get("training") or {})
+        if degraded:
+            slice_spec["topology"] = degraded["topology"]
+            slice_spec["num_slices"] = degraded["numSlices"]
+            training_spec["mesh"] = dict(degraded["mesh"])
+        slice_cfg, training = parse_job_spec(
+            {"slice": slice_spec, "training": training_spec}
+        )
+        return slice_cfg, training, training_spec
 
     # -- gang creation ----------------------------------------------------
 
@@ -359,13 +501,19 @@ class TPUTrainJobController(Controller):
         pod_name: str,
         index: int,
         env: Dict[str, str],
+        training_spec: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         m = job["metadata"]
         spec = job["spec"]
+        # the EFFECTIVE training spec (degraded mesh applied) — what the
+        # in-pod Trainer actually builds; defaults to the raw spec for
+        # direct callers
+        if training_spec is None:
+            training_spec = spec.get("training") or {}
         restarts = job.get("status", {}).get("restarts", 0)
         env = dict(env)
-        env["KFT_TRAINING_SPEC"] = json.dumps(spec.get("training") or {})
-        ckpt = (spec.get("training") or {}).get("checkpoint") or {}
+        env["KFT_TRAINING_SPEC"] = json.dumps(training_spec)
+        ckpt = training_spec.get("checkpoint") or {}
         ckpt_dir = ckpt.get("directory")
         if ckpt_dir and ckpt.get("enabled", True):
             # the platform checkpoint knob (checkpointing subsystem,
@@ -379,13 +527,13 @@ class TPUTrainJobController(Controller):
             # invisible to the manifest scan, so a preemption mid-save can
             # never resume from a torn checkpoint)
             env["KFT_RESTORE_DIR"] = ckpt_dir
-        profiler_logdir = (spec.get("training") or {}).get("profiler_logdir")
+        profiler_logdir = training_spec.get("profiler_logdir")
         if profiler_logdir:
             # coordinator serves the jax.profiler capture endpoint
             # (runtime/profiler.py); a Tensorboard CR fronts the logdir
             env["KFT_PROFILER_LOGDIR"] = profiler_logdir
             env.setdefault("KFT_PROFILER_PORT", "9431")
-        compile_cache = (spec.get("training") or {}).get("compile_cache_dir")
+        compile_cache = training_spec.get("compile_cache_dir")
         if compile_cache:
             # persistent XLA compile cache (runtime/train_run.py): every
             # gang member caches its own compiled programs there, so gang
@@ -397,7 +545,7 @@ class TPUTrainJobController(Controller):
         # the tracing configuration it actually runs, defaults included.
         obs = from_dict(
             ObservabilityConfig,
-            (spec.get("training") or {}).get("observability") or {},
+            training_spec.get("observability") or {},
         )
         obs.validate()
         env["KFT_TRACE_ENABLED"] = "1" if obs.trace_enabled else "0"
@@ -416,6 +564,19 @@ class TPUTrainJobController(Controller):
             env["KFT_FLEET_SCRAPE"] = "1"
             env["KFT_FLEET_METRICS_PORT"] = env["KFT_DEBUG_PORT"]
             env["KFT_FLEET_INSTANCE"] = pod_name
+        # kft-chaos contract (kubeflow_tpu/chaos/; docs/ROBUSTNESS.md):
+        # the fault plan rides the pod env only when armed — a chaos-off
+        # job's pods carry no plan at all (and run_training actively
+        # disarms on an empty env). KFT_CHAOS_ATTEMPT is the gang
+        # generation (restarts counter, reshapes included), so a spec
+        # qualified `attempt=N` targets exactly one incarnation — the
+        # restarted/reshaped gang re-renders the same plan, but the
+        # fault stays behind with the generation it was aimed at.
+        chaos_cfg = from_dict(ChaosConfig, training_spec.get("chaos") or {})
+        if chaos_cfg.enabled and chaos_cfg.points:
+            env["KFT_CHAOS_POINTS"] = ";".join(chaos_cfg.points)
+            env["KFT_CHAOS_SEED"] = str(chaos_cfg.seed)
+            env["KFT_CHAOS_ATTEMPT"] = str(restarts)
         pod = new_object(
             "Pod",
             pod_name,
@@ -490,6 +651,7 @@ class TPUTrainJobController(Controller):
         training: TrainingConfig,
         desired: List[str],
         existing: Dict[str, Dict[str, Any]],
+        training_spec: Optional[Dict[str, Any]] = None,
     ) -> bool:
         """All-or-nothing creation of the missing gang pods.
 
@@ -507,7 +669,9 @@ class TPUTrainJobController(Controller):
             for i, pod_name in enumerate(desired):
                 if pod_name in existing:
                     continue
-                pod = self._build_pod(job, slice_cfg, pod_name, i, envs[i])
+                pod = self._build_pod(
+                    job, slice_cfg, pod_name, i, envs[i], training_spec
+                )
                 try:
                     store.create(pod)
                 except AlreadyExists:
@@ -547,9 +711,17 @@ class TPUTrainJobController(Controller):
         job: Dict[str, Any],
         desired: List[str],
         pods: Dict[str, Dict[str, Any]],
+        slice_cfg: SliceConfig,
+        training: TrainingConfig,
     ) -> Result:
         status = job["status"]
         restarts = status.get("restarts", 0)
+        # same-SHAPE restart budget: restarts is the monotonic gang-
+        # generation counter (reshapes bump it too — a reshape IS a gang
+        # restart), so the budget measures attempts since the last
+        # reshape. Each degraded shape gets a fresh budget; the topology
+        # ladder is finite, so degradation always terminates.
+        shape_restarts = restarts - status.get("restartsAtReshape", 0)
         max_restarts = (job["spec"].get("runPolicy") or {}).get("maxRestarts", 0)
         # tolerate pods deleted out-of-band (e.g. cascade GC racing a
         # failure) — a missing gang member must not crash the reconcile
@@ -557,18 +729,34 @@ class TPUTrainJobController(Controller):
             n for n in desired
             if pods.get(n, {}).get("status", {}).get("phase") == FAILED
         ]
-        if restarts >= max_restarts:
+        if shape_restarts >= max_restarts:
+            # same-topology restarts exhausted: the host is conclusively
+            # gone (retrying the same dead topology would burn forever) —
+            # resume on the largest valid smaller mesh instead of dying
+            if self._try_degrade(
+                store, job, slice_cfg, training,
+                f"workers {failed} failed with the same-shape restart "
+                f"budget exhausted ({shape_restarts}/{max_restarts})",
+            ):
+                return Result(requeue=True)
             self._finish(
                 store,
                 job,
                 COND_FAILED,
                 "BackoffLimitExceeded",
-                f"workers {failed} failed; {restarts} restarts exhausted",
+                f"workers {failed} failed; {restarts} restarts exhausted "
+                f"and no resumable smaller shape is available (elastic "
+                f"resume needs elasticResume on, a committed checkpoint, "
+                f"and a smaller topology that holds the mesh)",
             )
             self._maybe_clean_pods(store, job)
             return Result()
         # whole-gang restart: delete every pod, bump the counter; the next
-        # reconcile recreates the gang with KFT_RESTORE_DIR set.
+        # reconcile recreates the gang with KFT_RESTORE_DIR set. The new
+        # generation's pods may land on different nodes, so any straggler
+        # strikes accumulated against the old placement are stale.
+        m = job["metadata"]
+        self._drop_straggler_state((m["namespace"], m["name"]))
         for n in desired:
             try:
                 store.delete("Pod", n, job["metadata"]["namespace"])
@@ -594,6 +782,176 @@ class TPUTrainJobController(Controller):
         self._write_status(store, job)
         return Result(requeue=True)
 
+    # -- elastic degradation ----------------------------------------------
+
+    def _try_degrade(
+        self,
+        store: StateStore,
+        job: Dict[str, Any],
+        slice_cfg: SliceConfig,
+        training: TrainingConfig,
+        reason: str,
+    ) -> bool:
+        """Reshape the gang to the largest valid smaller shape and
+        restart it there, resuming from the last committed checkpoint
+        (KFT_RESTORE_DIR is gated on restarts > 0, and a reshape bumps
+        the generation counter). Records the new shape in
+        status.degraded — the spec stays what the operator wrote — sets
+        the Degraded condition, and gives the new shape a fresh restart
+        budget. Returns False when no smaller shape can hold the job."""
+        if not (job["spec"].get("runPolicy") or {}).get(
+            "elasticResume", True
+        ):
+            # strict fail-fast opted in: the operator's automation
+            # watches for Failed, not a silently-smaller gang
+            return False
+        if not self._has_committed_checkpoint(job, training):
+            # nothing to resume FROM: a reshape would rerun the whole
+            # job from step 0 on fewer chips — and a persistent failure
+            # would cascade down the topology ladder, each shape with a
+            # fresh budget, burning chip time on doomed from-scratch
+            # runs. Without a committed step, exhaustion stays terminal.
+            return False
+        plan = plan_degraded_reshape(slice_cfg, training)
+        if plan is None:
+            return False
+        new_slice, new_mesh = plan
+        status = job["status"]
+        restarts = status.get("restarts", 0)
+        old = f"{slice_cfg.topology} x{slice_cfg.num_slices}"
+        new = f"{new_slice['topology']} x{new_slice['num_slices']}"
+        m = job["metadata"]
+        # tear down the WHOLE old gang (list_owned, not the desired
+        # names: the new shape may have fewer hosts, and a stale
+        # worker-3 from the bigger gang must not linger)
+        for p in list_owned(store, job, "Pod"):
+            try:
+                store.delete("Pod", p["metadata"]["name"], m["namespace"])
+            except KeyError:
+                pass
+        status["degraded"] = {
+            "topology": new_slice["topology"],
+            "numSlices": new_slice["num_slices"],
+            "mesh": new_mesh,
+            "from": old,
+        }
+        status["restarts"] = restarts + 1
+        status["reshapes"] = status.get("reshapes", 0) + 1
+        status["restartsAtReshape"] = restarts + 1
+        msg = f"gang reshaped {old} -> {new} (mesh {new_mesh}): {reason}"
+        set_condition(job, COND_DEGRADED, "True", "MeshReshaped", msg)
+        set_condition(job, COND_RESTARTING, "True", "GangDegraded", msg)
+        set_condition(job, COND_RUNNING, "False", "GangDegraded", "")
+        self._reshapes_total.inc()
+        self._restarts_total.inc()
+        # the reshaped gang is a new placement: straggler strikes
+        # accumulated against the old pods are stale evidence, whichever
+        # trigger (budget exhaustion or straggler trip) got us here
+        self._drop_straggler_state((m["namespace"], m["name"]))
+        store.record_event(job, "GangDegraded", msg, type="Warning")
+        log.warning(
+            "job %s/%s: %s", m["namespace"], m["name"], msg
+        )
+        self._write_status(store, job)
+        return True
+
+    def _check_stragglers(
+        self,
+        store: StateStore,
+        job: Dict[str, Any],
+        slice_cfg: SliceConfig,
+        training: TrainingConfig,
+    ) -> bool:
+        """The fleet_straggler → reshape relay (ROADMAP: the PR 9
+        detector as the elastic-resume trigger signal). A host flagged
+        for STRAGGLER_TRIP_SWEEPS consecutive fleet sweeps is treated as
+        conclusively sick — a same-topology restart could land right
+        back on the bad node, so the gang reshapes off it proactively.
+        Strikes advance only when the collector has actually swept again
+        (fakes without sweeps() count every reconcile)."""
+        if self.fleet is None:
+            return False
+        m = job["metadata"]
+        jkey = (m["namespace"], m["name"])
+        sweeps_fn = getattr(self.fleet, "sweeps", None)
+        sweep = sweeps_fn() if callable(sweeps_fn) else -1
+        if sweep >= 0 and sweep == self._straggler_sweep.get(jkey):
+            return False  # no fresh fleet data since the last count
+        self._straggler_sweep[jkey] = sweep
+        tripped = None
+        seen = set()
+        for (ns, owner, host), flagged in self.fleet.stragglers().items():
+            if (ns, owner) != jkey:
+                continue
+            key = (ns, owner, host)
+            seen.add(key)
+            strikes = self._straggler_strikes.get(key, 0) + 1 if flagged else 0
+            self._straggler_strikes[key] = strikes
+            if strikes >= STRAGGLER_TRIP_SWEEPS and tripped is None:
+                tripped = host
+        # hosts with NO row this sweep (scrape outage, target gone) are
+        # missing evidence, not flagged evidence: their streak is broken —
+        # a stale pre-outage strike count must never complete later on
+        # one fresh flag (the autoscaler's signal-outage discipline)
+        for key in [
+            k for k in self._straggler_strikes
+            if (k[0], k[1]) == jkey and k not in seen
+        ]:
+            self._straggler_strikes[key] = 0
+        if tripped is None:
+            return False
+        reason = (
+            f"host {tripped} flagged fleet_straggler for "
+            f"{STRAGGLER_TRIP_SWEEPS} consecutive sweeps"
+        )
+        if not self._has_committed_checkpoint(job, training):
+            # a PROACTIVE reshape of a running-but-slow gang is only a
+            # win when the job can resume where it left off; without a
+            # committed checkpoint it would trade a slow gang for a
+            # from-scratch restart on fewer chips — strictly worse.
+            # (Budget-exhaustion reshape is different: that gang is
+            # already dead.) Reset the streak so the warning rate-limits
+            # itself to once per TRIP_SWEEPS flagged sweeps.
+            self._drop_straggler_state(jkey)
+            log.warning(
+                "job %s/%s: %s, but no committed checkpoint to resume "
+                "from — leaving the slow gang running (enable "
+                "checkpointing to opt into proactive reshape)",
+                jkey[0], jkey[1], reason,
+            )
+            store.record_event(
+                job, "StragglerNotReshaped",
+                f"{reason}; no committed checkpoint to resume from",
+                type="Warning",
+            )
+            return False
+        # _try_degrade drops the straggler state itself on success (the
+        # reshaped gang is a new placement)
+        return self._try_degrade(store, job, slice_cfg, training, reason)
+
+    @staticmethod
+    def _has_committed_checkpoint(
+        job: Dict[str, Any], training: TrainingConfig
+    ) -> bool:
+        """Can this job actually RESUME after a reshape? Checkpointing
+        must be on and at least one step committed in its directory."""
+        ckpt = training.checkpoint
+        if not (ckpt.enabled and ckpt.directory):
+            return False
+        from kubeflow_tpu.checkpointing import latest_committed_step
+
+        try:
+            return latest_committed_step(ckpt.directory) is not None
+        except OSError:
+            return False
+
+    def _drop_straggler_state(self, jkey: Tuple[str, str]) -> None:
+        for key in [
+            k for k in self._straggler_strikes if (k[0], k[1]) == jkey
+        ]:
+            del self._straggler_strikes[key]
+        self._straggler_sweep.pop(jkey, None)
+
     # -- terminal / cleanup -----------------------------------------------
 
     def _finish(
@@ -607,6 +965,8 @@ class TPUTrainJobController(Controller):
         set_condition(job, cond, "True", reason, message)
         set_condition(job, COND_RUNNING, "False", reason, "")
         job["status"]["completionTime"] = now_iso()
+        m = job["metadata"]
+        self._drop_straggler_state((m["namespace"], m["name"]))
         self._jobs_total.inc(outcome=cond.lower())
         store.record_event(
             job, reason, message, type="Normal" if cond == COND_SUCCEEDED else "Warning"
@@ -632,6 +992,8 @@ class TPUTrainJobController(Controller):
                         pass
 
     def _handle_deletion(self, store: StateStore, job: Dict[str, Any]) -> Result:
+        m = job["metadata"]
+        self._drop_straggler_state((m["namespace"], m["name"]))
         for kind in ("Pod", "Service"):
             for obj in list_owned(store, job, kind):
                 try:

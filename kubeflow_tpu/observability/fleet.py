@@ -61,6 +61,7 @@ from typing import (
     Tuple,
 )
 
+from kubeflow_tpu.chaos import ChaosError, default_chaos
 from kubeflow_tpu.observability.slo import (
     SloEngine,
     SloStatus,
@@ -120,6 +121,7 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "deploy_servers_gc_total": "sum",
     "deployments_total": "sum",
     "http_requests_total": "sum",
+    "kft_faults_injected_total": "sum",
     "notebook_create_total": "sum",
     "notebook_culling_total": "sum",
     "profile_namespaces_created_total": "sum",
@@ -128,6 +130,7 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "serving_decode_steps_total": "sum",
     "serving_draft_accepted_total": "sum",
     "serving_draft_proposed_total": "sum",
+    "serving_engine_recoveries_total": "sum",
     "serving_prefix_cache_hit_tokens_total": "sum",
     "serving_prefix_cache_lookups_total": "sum",
     "serving_requests_total": "sum",
@@ -136,6 +139,7 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "statestore_writes_total": "sum",
     "study_total": "sum",
     "study_trials_total": "sum",
+    "tpujob_gang_reshapes_total": "sum",
     "tpujob_gang_restarts_total": "sum",
     "tpujob_total": "sum",
     "training_compile_cache_hits_total": "sum",
@@ -146,6 +150,7 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "http_request_seconds": "merge",
     "reconcile_seconds": "merge",
     "serving_accept_rate": "merge",
+    "serving_drain_seconds": "merge",
     "serving_fused_batch_rows": "merge",
     "serving_predict_seconds": "merge",
     "serving_request_phase_seconds": "merge",
@@ -204,8 +209,12 @@ def _container_env(pod: Dict[str, Any]) -> Dict[str, str]:
 
 
 # the TPUJob gang label (controllers/tpujob.py JOB_NAME_LABEL); duplicated
-# as a string so this module never imports the controller layer
-_JOB_NAME_LABEL = "tpujob.kubeflow-tpu.dev/job-name"
+# as a string so this module never imports the controller layer. MUST
+# match the controller's constant: discovery keyed on a different label
+# would silently never find real gang pods (the straggler → elastic-
+# reshape relay rides this), which is exactly what the stale
+# "tpujob."-prefixed value here used to do.
+_JOB_NAME_LABEL = "kubeflow-tpu.dev/job-name"
 _SERVING_LABEL = "inferenceservice"
 
 
@@ -374,6 +383,10 @@ class FleetCollector:
         self._g_burn = fleet_slo_burn_rate_gauge(self._registry)
         self._g_straggler = fleet_straggler_gauge(self._registry)
         self._g_targets = fleet_targets_gauge(self._registry)
+        # kft-chaos: fleet.scrape_fetch models an unreachable pod /
+        # partition — the injected fault rides the same best-effort
+        # per-target error path a real timeout does
+        self._chaos = default_chaos()
 
     @classmethod
     def from_config(
@@ -430,9 +443,22 @@ class FleetCollector:
         evaluate under the lock."""
         targets = list(self._targets_fn())
         now = self._clock()
+        # chaos decided SERIALLY, in target order, BEFORE the pool runs:
+        # the executor's threads would otherwise consume the injection
+        # point's call counter/RNG in scheduling order, making WHICH
+        # target fails run-dependent — breaking the bitwise-replay
+        # guarantee the chaos layer documents
+        chaos_down = set()
+        for t in targets:
+            try:
+                self._chaos.maybe_fail("fleet.scrape_fetch")
+            except ChaosError:
+                chaos_down.add(t)
 
         def _grab(t: ScrapeTarget) -> Tuple[Optional[Dict], str]:
             try:
+                if t in chaos_down:
+                    raise ChaosError("fleet.scrape_fetch")
                 return parse_rendered(self._fetch(t.base_url + "/metrics")), ""
             except Exception as e:  # noqa: BLE001 - scrape is best-effort
                 return None, f"{type(e).__name__}: {e}"
@@ -626,6 +652,15 @@ class FleetCollector:
     def stragglers(self) -> Dict[Tuple[str, str, str], bool]:
         with self._lock:
             return dict(self._stragglers)
+
+    def sweeps(self) -> int:
+        """Monotonic scrape-sweep count — the freshness token consumers
+        with hysteresis (the autoscaler via FleetSignals.sweep, the
+        TPUJob controller's straggler-trip counter) key their
+        consecutive-observation streaks on, so re-reading one sweep's
+        snapshot can never fake repeated observations."""
+        with self._lock:
+            return self._sweeps
 
     def serving_signals(
         self, namespace: str, name: str

@@ -83,7 +83,9 @@ class Platform:
         self.controllers = [
             StatefulSetController(),
             DeploymentController(),
-            TPUTrainJobController(),
+            # fleet-wired: the PR 9 straggler detector's flags relay into
+            # the controller's degraded-mesh reshape (elastic resume)
+            TPUTrainJobController(fleet=self.fleet),
             StudyJobController(),
             NotebookController(
                 use_istio=use_istio,

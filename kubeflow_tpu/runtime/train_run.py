@@ -149,11 +149,42 @@ def run_training(
     the controller's env-wins contract holds there too and nothing leaks
     in from the host process).
     """
+    from kubeflow_tpu.chaos import (
+        configure_from_env as configure_chaos,
+        default_chaos,
+    )
+
+    env = os.environ if environ is None else environ
+    # kft-chaos (docs/ROBUSTNESS.md): the controller-rendered KFT_CHAOS_*
+    # plan arms the process's injection points for THIS run only — the
+    # in-process pod runner shares one interpreter across simulated jobs,
+    # so the plan is disarmed again on every exit path below, and a pod
+    # env without chaos actively disarms (env is the whole truth).
+    chaos_armed = configure_chaos(environ=env)
+    try:
+        # the host-exit seam: a fault here is the pod dying before the
+        # gang ever trains (slice_agent crash, node preemption at start)
+        default_chaos().maybe_fail("gang.host_exit")
+        return _run_training_armed(
+            cfg, restore, steps_override, mesh, stop_event, env
+        )
+    finally:
+        if chaos_armed:
+            default_chaos().disarm()
+
+
+def _run_training_armed(
+    cfg: TrainingConfig,
+    restore: bool,
+    steps_override: Optional[int],
+    mesh,
+    stop_event: Optional[threading.Event],
+    env,
+) -> Dict[str, Any]:
     import jax
 
     from kubeflow_tpu.training.trainer import Trainer
 
-    env = os.environ if environ is None else environ
     cache_dir = configure_compile_cache(cfg, environ=env)
     entries_before = _cache_entries(cache_dir)
     trainer = Trainer(cfg, mesh=mesh)

@@ -88,6 +88,7 @@ from kubeflow_tpu.analysis.serving_plans import (
     DEFAULT_NUM_SLOTS,
     DEFAULT_PAGE_SIZE,
 )
+from kubeflow_tpu.chaos import default_chaos
 from kubeflow_tpu.observability.trace import default_tracer
 from kubeflow_tpu.serving.batching import Completion
 from kubeflow_tpu.serving.sampling import (
@@ -101,6 +102,8 @@ from kubeflow_tpu.utils.metrics import (
     serving_decode_steps_counter,
     serving_draft_accepted_counter,
     serving_draft_proposed_counter,
+    serving_drain_histogram,
+    serving_engine_recoveries_counter,
     serving_kv_pages_in_use_gauge,
     serving_kv_pages_total_gauge,
     serving_num_slots_gauge,
@@ -129,6 +132,19 @@ _SALT_CORRECT = 3
 
 class QueueFullError(RuntimeError):
     """Admission queue at capacity — the server maps this to HTTP 429."""
+
+
+class EngineDrainingError(QueueFullError):
+    """Admission rejected because the engine is draining for shutdown
+    (scale-down / SIGTERM). A QueueFullError subclass so every existing
+    429 mapping applies; the server additionally attaches Retry-After
+    from `retry_after_s` — the correct client action is to retry
+    elsewhere (through the Service VIP the retry lands on a replica
+    that is not going away)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
 
 
 class EngineCapacityError(ValueError):
@@ -1136,6 +1152,16 @@ class DecodeEngine:
         self._cv = threading.Condition()
         self._queue: deque = deque()
         self._stop = False
+        # draining shutdown (docs/ROBUSTNESS.md drain contract): once
+        # set, NEW submits are rejected with EngineDrainingError (429 +
+        # Retry-After at the server) while everything already accepted —
+        # queued and resident — runs to completion under drain()'s
+        # deadline. _admitting covers the popped-but-not-yet-resident
+        # window: a request leaves the queue BEFORE its slot is assigned
+        # (admission runs outside the lock), and drain's idle check must
+        # not mistake that in-between moment for an empty engine.
+        self._draining = False
+        self._admitting = 0
 
         self._stats_lock = threading.Lock()
         self._admitted = 0
@@ -1156,12 +1182,18 @@ class DecodeEngine:
         # spans ride the process tracer; a disabled tracer makes every
         # span call a no-op (docs/OBSERVABILITY.md span catalog)
         self._tracer = default_tracer()
+        # kft-chaos: engine.{prefill,step} injection points model device
+        # failures in admission and the decode iteration — exactly the
+        # faults _recover exists for (docs/ROBUSTNESS.md)
+        self._chaos = default_chaos()
         # recent finished requests (phase breakdowns) for /statusz —
         # appended by the scheduler thread, read by HTTP handlers
         self._recent: deque = deque(maxlen=32)
 
         self._ttft = serving_ttft_histogram()
         self._phase = serving_phase_histogram()
+        self._recoveries = serving_engine_recoveries_counter()
+        self._drain_hist = serving_drain_histogram()
         self._draft_proposed = serving_draft_proposed_counter()
         self._draft_accepted = serving_draft_accepted_counter()
         self._accept_rate = serving_accept_rate_histogram()
@@ -1234,6 +1266,15 @@ class DecodeEngine:
 
     def _enqueue(self, reqs: List[_Request]) -> None:
         with self._cv:
+            # draining outranks closed: drain() ends in close(), and an
+            # engine that finished draining while a sibling still drains
+            # must keep answering 429 + Retry-After (the retry-another-
+            # replica signal), not 500, until the server socket stops
+            if self._draining:
+                raise EngineDrainingError(
+                    f"engine {self.name} is draining for shutdown; "
+                    f"retry against another replica"
+                )
             if self._stop:
                 raise RuntimeError("engine is closed")
             if len(self._queue) + len(reqs) > self.max_queue:
@@ -1384,6 +1425,51 @@ class DecodeEngine:
             "stats": self.stats(),
         }
 
+    def drain(self, deadline_s: float = 30.0) -> bool:
+        """Draining shutdown: flip the admission gate (new submits get
+        EngineDrainingError → 429 + Retry-After), let every ALREADY
+        accepted request — queued and resident — run to completion, then
+        close. Bounded by `deadline_s`: requests still live when it
+        expires are failed FAST by close() (the zero-hung-futures
+        contract — a drain can time out, it can never strand a caller).
+        Returns True when everything finished inside the deadline.
+
+        Idempotent-ish: callable once per engine lifetime (close() is
+        terminal); a second call just observes the already-stopped
+        engine."""
+        t0 = time.monotonic()
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        deadline = t0 + max(0.0, float(deadline_s))
+        drained = False
+        while True:
+            with self._cv:
+                idle = (
+                    not self._queue
+                    and self._admitting == 0
+                    and all(s is None for s in self._slots)
+                )
+            if idle:
+                drained = True
+                break
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        self._drain_hist.observe(time.monotonic() - t0, model=self.name)
+        self._tracer.event(
+            "engine.drain", model=self.name, drained=drained,
+            seconds=round(time.monotonic() - t0, 4),
+        )
+        if not drained:
+            log.warning(
+                "engine %s drain deadline (%.1fs) expired; failing the "
+                "remaining resident/queued requests fast", self.name,
+                deadline_s,
+            )
+        self.close()
+        return drained
+
     def close(self) -> None:
         with self._cv:
             self._stop = True
@@ -1518,6 +1604,11 @@ class DecodeEngine:
         if req.queue_span is not None:
             req.queue_span.end(slot=slot_idx)
             req.queue_span = None
+        # chaos seam: a device failure during THIS request's admission
+        # (prefill/insert) — handled per-request by _iterate's admit
+        # try. AFTER the queue-span end so an injected failure never
+        # leaks the request's queue phase from the trace
+        self._chaos.maybe_fail("engine.prefill")
         prompt = req.prompt
         p = int(prompt.size)
         ps = self.page_size
@@ -1794,6 +1885,7 @@ class DecodeEngine:
             residents=sum(s is not None for s in self._slots),
             error=type(exc).__name__,
         )
+        self._recoveries.inc(model=self.name)
         err = RuntimeError(f"engine {self.name} decode step failed: {exc!r}")
         err.__cause__ = exc
         for i, slot in enumerate(self._slots):
@@ -1853,6 +1945,7 @@ class DecodeEngine:
                 if not self._can_admit(self._queue[0]):
                     break
                 req = self._queue.popleft()
+                self._admitting += 1
                 self._queue_depth.set(len(self._queue), model=self.name)
             try:
                 self._admit(i, req)
@@ -1875,6 +1968,12 @@ class DecodeEngine:
                 ):
                     self._recover(e)
                 continue
+            finally:
+                # the request is now either resident (slot set) or
+                # failed — either way the admission window is over and
+                # drain's idle check sees the truth again
+                with self._cv:
+                    self._admitting -= 1
             if self._done(self._slots[i]):
                 # one-token request (or instant EOS): never steps
                 self._finish(i)
@@ -1886,6 +1985,9 @@ class DecodeEngine:
         )
         if not active:
             return
+        # chaos seam: a device failure in the decode iteration — raises
+        # into _loop's recovery path exactly like a real XLA abort
+        self._chaos.maybe_fail("engine.step")
         if self.num_draft_tokens > 0:
             self._iterate_spec(active)
             return

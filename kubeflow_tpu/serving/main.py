@@ -16,6 +16,7 @@ import sys
 # geometry and the served one cannot drift (analysis/serving_plans.py;
 # jax-free import, safe at entrypoint scope)
 from kubeflow_tpu.analysis.serving_plans import (
+    DEFAULT_DRAIN_DEADLINE_S,
     DEFAULT_MAX_QUEUE,
     DEFAULT_NUM_SLOTS,
     DEFAULT_NUM_PAGES,
@@ -28,6 +29,11 @@ def _env_int(name: str, default: int) -> int:
     return int(raw) if raw.strip() else default
 
 
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    return float(raw) if raw.strip() else default
+
+
 def engine_knobs_from_env():
     """The serving-pod engine contract the InferenceService controller
     renders (controllers/inference.py ← config/platform.py ServingConfig):
@@ -38,7 +44,8 @@ def engine_knobs_from_env():
     KFT_SERVING_PREFIX_CACHE (radix prefix index on/off),
     KFT_SERVING_DRAFT_MODEL + KFT_SERVING_DRAFT_TOKENS (speculative
     decoding: registry draft model and tokens drafted per verify step; 0
-    disables)."""
+    disables), KFT_SERVING_DRAIN_DEADLINE_S (SIGTERM/scale-down draining
+    budget — docs/ROBUSTNESS.md drain contract)."""
     buckets_raw = os.environ.get("KFT_SERVING_PREFILL_BUCKETS", "")
     buckets = [int(b) for b in buckets_raw.split(",") if b.strip()]
     prefix_raw = os.environ.get("KFT_SERVING_PREFIX_CACHE", "").strip()
@@ -54,6 +61,9 @@ def engine_knobs_from_env():
         "draft_checkpoint_dir": os.environ.get(
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR", ""
         ).strip(),
+        "drain_deadline_s": _env_float(
+            "KFT_SERVING_DRAIN_DEADLINE_S", DEFAULT_DRAIN_DEADLINE_S
+        ),
     }
 
 
@@ -86,6 +96,7 @@ def build_server(
     trace_enabled: bool = None,
     trace_buffer_spans: int = None,
     statusz_enabled: bool = None,
+    drain_deadline_s: float = None,
 ):
     """Assemble the ModelServer for one registry model (testable core of
     the entrypoint): causal families serve :generate via the
@@ -100,12 +111,17 @@ def build_server(
     deterministic seed-0 init (correct output regardless — verify
     rejects bad drafts — just a useless accept rate until real params
     arrive)."""
+    from kubeflow_tpu.chaos import configure_from_env as configure_chaos
     from kubeflow_tpu.observability.trace import (
         default_tracer,
         knobs_from_env,
     )
     from kubeflow_tpu.serving.server import ModelServer, ServedModel
 
+    # kft-chaos: the controller-rendered KFT_CHAOS_* plan (ServingConfig
+    # chaos subtree) arms the engine's injection points; absent = the
+    # shared no-op (docs/ROBUSTNESS.md)
+    configure_chaos()
     # kft-trace knobs: explicit args win, else the controller-rendered
     # KFT_TRACE_* env (ObservabilityConfig → controllers/inference.py)
     obs = knobs_from_env()
@@ -120,6 +136,13 @@ def build_server(
     )
 
     server = ModelServer(statusz_enabled=statusz_enabled)
+    # the SIGTERM/scale-down draining budget (server.close(drain=True));
+    # explicit arg wins, else the controller-rendered env
+    if drain_deadline_s is None:
+        drain_deadline_s = _env_float(
+            "KFT_SERVING_DRAIN_DEADLINE_S", DEFAULT_DRAIN_DEADLINE_S
+        )
+    server.drain_deadline_s = float(drain_deadline_s)
     if is_causal_family(model):
         from kubeflow_tpu.serving.generate import ServedLm
 
@@ -299,14 +322,32 @@ def main(argv=None) -> int:
     httpd = Server(server.app, host=args.host, port=args.port)
     print(f"serving {args.model} on :{httpd.port}", flush=True)
     httpd.start()
-    try:
-        import time
+    # graceful scale-down (docs/ROBUSTNESS.md drain contract): the
+    # autoscaler's replica delete lands here as SIGTERM inside the pod's
+    # terminationGracePeriodSeconds — drain every engine (finish
+    # resident/queued requests, 429 + Retry-After for new ones) before
+    # the process exits, so a scale-down never drops an accepted request
+    import signal
+    import threading
 
-        while True:
-            time.sleep(3600)
+    stop = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda signum, frame: stop.set())
+    except ValueError:
+        pass  # no signal support in this context (not the main thread)
+    try:
+        while not stop.wait(1.0):
+            pass
+        print(
+            f"SIGTERM: draining engines "
+            f"(deadline {server.drain_deadline_s:g}s)", flush=True,
+        )
+        drained = server.close(drain=True)
+        print(f"drain {'complete' if drained else 'TIMED OUT'}", flush=True)
     except KeyboardInterrupt:
-        httpd.stop()
         server.close()
+    finally:
+        httpd.stop()
     return 0
 
 

@@ -275,6 +275,15 @@ class ModelServer:
         self._models: Dict[str, ServedModel] = {}
         self._lms: Dict[str, Any] = {}  # ServedLm (serving/generate.py)
         self._engines: Dict[str, Any] = {}  # DecodeEngine (serving/engine.py)
+        # draining-shutdown budget used when close(drain=True) is called
+        # without an explicit deadline; build_server overrides it from
+        # the controller-rendered KFT_SERVING_DRAIN_DEADLINE_S (one
+        # definition point: the serving-plan registry)
+        from kubeflow_tpu.analysis.serving_plans import (
+            DEFAULT_DRAIN_DEADLINE_S,
+        )
+
+        self.drain_deadline_s = DEFAULT_DRAIN_DEADLINE_S
         self.app = self._build()
         if statusz_enabled:
             from kubeflow_tpu.observability.http import add_debug_routes
@@ -369,13 +378,62 @@ class ModelServer:
         if engine is not None:
             engine.close()
 
-    def close(self) -> None:
+    def close(
+        self, drain: bool = False, drain_deadline_s: Optional[float] = None
+    ) -> bool:
         """Stop background machinery (engines' scheduler threads, the
-        micro-batchers) — the server-process shutdown hook."""
-        for engine in self._engines.values():
-            engine.close()
+        micro-batchers) — the server-process shutdown hook.
+
+        `drain=True` is the scale-down/SIGTERM path (docs/ROBUSTNESS.md
+        drain contract): each engine stops ADMITTING (new :generate
+        requests get 429 + Retry-After) while everything already
+        accepted — queued and resident — runs to completion under the
+        deadline; requests still live at the deadline are failed fast,
+        never left hanging. Engines drain CONCURRENTLY, so total
+        shutdown is bounded by ONE deadline (plus close's join) — the
+        budget the controller's terminationGracePeriodSeconds is sized
+        for — not deadline x engines. Returns True when every engine
+        drained clean (always True for drain=False)."""
+        if drain_deadline_s is None:
+            drain_deadline_s = self.drain_deadline_s
+        drained = True
+        if drain and self._engines:
+            results: Dict[str, bool] = {}
+
+            def _drain_one(n: str, e) -> None:
+                try:
+                    results[n] = e.drain(drain_deadline_s)
+                except Exception:
+                    # drain() raising before its internal close() would
+                    # leave the scheduler running and every accepted
+                    # future hung — close() unconditionally so the
+                    # zero-hung-futures contract survives; the missing
+                    # results entry reports drained=False
+                    log.exception("engine %s drain failed; closing", n)
+                    e.close()
+
+            workers = [
+                # joined below; daemon=True so even an interpreter
+                # teardown racing a wedged drain cannot hang exit
+                threading.Thread(
+                    target=_drain_one,
+                    args=(name, engine),
+                    name=f"drain-{name}",
+                    daemon=True,
+                )
+                for name, engine in self._engines.items()
+            ]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join()
+            drained = all(results.get(n, False) for n in self._engines)
+        else:
+            for engine in self._engines.values():
+                engine.close()
         for model in self._models.values():
             model.close()
+        return drained
 
     # generous bound: an engine request waits behind at most max_queue
     # admissions; a hung engine must surface as a 500, not a stuck socket
@@ -395,7 +453,10 @@ class ModelServer:
         fused scan would have rejected). The old fall-back-to-ServedLm
         branch is gone because no engine-refusable-but-model-servable
         request exists anymore."""
-        from kubeflow_tpu.serving.engine import QueueFullError
+        from kubeflow_tpu.serving.engine import (
+            EngineDrainingError,
+            QueueFullError,
+        )
 
         try:
             x = np.asarray(body["prompt_ids"], dtype=np.int32)
@@ -438,6 +499,16 @@ class ModelServer:
                 seed=body.get("seed", 0),
                 trace_id=trace_id,
             )
+        except EngineDrainingError as e:
+            # draining shutdown: same 429 wire status as queue-full, plus
+            # Retry-After so well-behaved clients back off — through the
+            # Service VIP the retry lands on a replica that stays up
+            import math
+
+            req.response_headers.append(
+                ("Retry-After", str(max(1, math.ceil(e.retry_after_s))))
+            )
+            raise HttpError(429, str(e))
         except QueueFullError as e:
             raise HttpError(429, str(e))
         except (ValueError, TypeError) as e:

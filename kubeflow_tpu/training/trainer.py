@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kubeflow_tpu.chaos import default_chaos
 from kubeflow_tpu.config.platform import TrainingConfig
 from kubeflow_tpu.models.registry import get_model
 from kubeflow_tpu.parallel.mesh import mesh_from_config, set_mesh
@@ -150,6 +151,10 @@ class Trainer:
         # the lowered program; observability/mfu.py) — memoized per
         # trainer, the numerator of training_model_flops_utilization
         self._step_flops: Optional[float] = None
+        # kft-chaos: the trainer.device_step injection point models a
+        # host losing its chips mid-run (docs/ROBUSTNESS.md); disarmed
+        # it costs one bool check per step
+        self._chaos = default_chaos()
 
     # ---- state init ----------------------------------------------------
 
@@ -617,6 +622,7 @@ class Trainer:
             # steady state this IS the device step wall time (and on the
             # first step it is the XLA compile — see train.compile_fence)
             with tracer.span("train.device_step", model=cfg.model, step=i):
+                self._chaos.maybe_fail("trainer.device_step")
                 state, metrics = self.train_step(state, batch, rng)
             steps_since_log += 1
             if i == start_step and steps > 1:

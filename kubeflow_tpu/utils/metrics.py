@@ -759,6 +759,51 @@ def serving_phase_histogram() -> Histogram:
     )
 
 
+def serving_engine_recoveries_counter() -> Counter:
+    """Decode-engine scheduler recoveries: a device call escaped the
+    per-request handling, the resident requests were failed fast and the
+    KV pool(s) rebuilt (engine._recover). Today this recovers silently
+    except for a log line; a climbing rate is a sick device or a real
+    engine bug, and the fleet should see it."""
+    return default_registry().counter(
+        "serving_engine_recoveries_total",
+        "decode-engine scheduler recoveries (residents failed, pool rebuilt)",
+        ["model"],
+    )
+
+
+# Drain spans a near-idle engine (ms: nothing resident) to a full slot
+# batch decoding its longest tails under the shutdown deadline.
+SERVING_DRAIN_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120,
+)
+
+
+def serving_drain_histogram() -> Histogram:
+    """Wall seconds one engine spent draining at shutdown: admission
+    closed (429 + Retry-After) through the last resident request
+    retiring (or the drain deadline failing the stragglers). The
+    scale-down latency the autoscaler's replica deletes pay."""
+    return default_registry().histogram(
+        "serving_drain_seconds",
+        "seconds from drain start to the engine going idle (or deadline)",
+        ["model"],
+        buckets=SERVING_DRAIN_BUCKETS,
+    )
+
+
+def faults_injected_counter() -> Counter:
+    """kft-chaos faults actually injected, per named injection point
+    (kubeflow_tpu/chaos/; docs/ROBUSTNESS.md). Zero in production unless
+    an operator armed a plan — a nonzero rate with no armed plan is a
+    bug in the plan rendering, not in the seams."""
+    return default_registry().counter(
+        "kft_faults_injected_total",
+        "chaos faults injected at named platform injection points",
+        ["point"],
+    )
+
+
 def training_mfu_gauge() -> Gauge:
     """Model-FLOPs utilization of the train step: XLA-cost-model FLOPs of
     the compiled per-device step over step wall time over the per-chip

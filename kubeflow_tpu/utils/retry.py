@@ -11,6 +11,7 @@ and a generic `run_with_retry.py`. This module is the one shared primitive.
 from __future__ import annotations
 
 import functools
+import random
 import time
 from typing import Callable, Optional, Tuple, Type, TypeVar
 
@@ -26,13 +27,21 @@ def backoff_retry(
     retry_on: Tuple[Type[BaseException], ...] = (Exception,),
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
     sleep: Callable[[float], None] = time.sleep,
+    jitter: float = 0.0,
+    rng: Optional[random.Random] = None,
 ) -> T:
     """Call `fn` up to `attempts` times with (constant or exponential) backoff.
 
     multiplier=1.0 gives the reference's constant-backoff behavior.
+    `jitter` adds a uniform [0, jitter·delay) slice on top of each sleep
+    so retrying peers (every host of a gang hitting the same flaky
+    volume) decorrelate instead of re-colliding in lockstep; pass `rng`
+    for a deterministic jitter stream in tests.
     """
     if attempts < 1:
         raise ValueError("attempts must be >= 1")
+    if jitter < 0:
+        raise ValueError("jitter must be >= 0")
     current = delay_s
     last: BaseException
     for i in range(attempts):
@@ -44,7 +53,10 @@ def backoff_retry(
                 break
             if on_retry is not None:
                 on_retry(i + 1, e)
-            sleep(min(current, max_delay_s))
+            base = min(current, max_delay_s)
+            if jitter:
+                base += (rng or random).random() * jitter * base
+            sleep(base)
             current *= multiplier
     raise last
 

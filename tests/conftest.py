@@ -123,6 +123,29 @@ def devices8():
     return devs[:8]
 
 
+@pytest.fixture(scope="session")
+def gpt_and_params():
+    """ONE shared tiny-gpt (model, params) for every engine-family suite
+    (test_engine / test_paged_kv / test_spec_decode / test_observability /
+    test_serving's drain tests) — the tier-1 time-budget tranche from the
+    ROADMAP: four module-scoped copies each paid their own init and
+    minted their own jit cache keys; session scope pays once and keeps
+    every suite's engine programs keyed identically, so the persistent
+    compile cache serves them all. Tests must treat it as IMMUTABLE
+    (engines already never mutate params)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import get_model
+
+    model = get_model("gpt_tiny", dtype=jnp.float32)
+    prompt = jnp.arange(6)[None, :].astype(jnp.int32) % 512
+    params = model.init(jax.random.PRNGKey(0), prompt, deterministic=True)[
+        "params"
+    ]
+    return model, params
+
+
 @pytest.fixture(autouse=True)
 def _no_leaked_nondaemon_threads():
     """Fail any test that leaves a live non-daemon thread behind.

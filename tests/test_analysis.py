@@ -1061,6 +1061,7 @@ class TestServingPlansClean:
         drift."""
         import kubeflow_tpu.serving.main as sm
         from kubeflow_tpu.analysis.serving_plans import (
+            DEFAULT_DRAIN_DEADLINE_S,
             DEFAULT_MAX_QUEUE,
             DEFAULT_NUM_PAGES,
             DEFAULT_NUM_SLOTS,
@@ -1072,6 +1073,7 @@ class TestServingPlansClean:
             "KFT_SERVING_NUM_SLOTS", "KFT_SERVING_MAX_QUEUE",
             "KFT_SERVING_PREFILL_BUCKETS", "KFT_SERVING_PAGE_SIZE",
             "KFT_SERVING_NUM_PAGES", "KFT_SERVING_PREFIX_CACHE",
+            "KFT_SERVING_DRAIN_DEADLINE_S",
         ):
             monkeypatch.delenv(var, raising=False)
         knobs = sm.engine_knobs_from_env()
@@ -1080,12 +1082,14 @@ class TestServingPlansClean:
         assert knobs["page_size"] == DEFAULT_PAGE_SIZE
         assert knobs["num_pages"] == DEFAULT_NUM_PAGES
         assert knobs["prefix_cache"] is True
+        assert knobs["drain_deadline_s"] == DEFAULT_DRAIN_DEADLINE_S
         cfg = ServingConfig()
         assert cfg.num_slots == DEFAULT_NUM_SLOTS
         assert cfg.max_queue == DEFAULT_MAX_QUEUE
         assert cfg.page_size == DEFAULT_PAGE_SIZE
         assert cfg.num_pages == DEFAULT_NUM_PAGES
         assert cfg.prefix_cache is True
+        assert cfg.drain_deadline_s == DEFAULT_DRAIN_DEADLINE_S
 
     def test_registry_shared_with_bench(self):
         """bench.py imports the registry's plan list and geometry (the

@@ -1,0 +1,424 @@
+"""kft-chaos (kubeflow_tpu/chaos/; docs/ROBUSTNESS.md).
+
+Three contracts pinned here:
+- **disabled is free**: a disarmed controller's maybe_fail is a shared
+  no-op (microbench-asserted, the disabled-tracer discipline) and armed
+  state never leaks across runs (run_training disarms on every exit).
+- **deterministic**: the same plan + seed against the same call sequence
+  injects bitwise the same faults — a chaos test that flakes is a real
+  bug, not injection noise.
+- **the seams hold**: each injection point's fault rides the seam's
+  GENERIC failure path — checkpoint I/O faults are absorbed by the
+  bounded-backoff retries, engine faults fail fast into _recover, fleet
+  scrape faults degrade one target, and the env/config chain renders and
+  parses like every other knob family.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.chaos import (
+    CATALOG,
+    ChaosController,
+    ChaosError,
+    ChaosSpecError,
+    PointSpec,
+    configure_from_env,
+    default_chaos,
+    parse_point,
+    parse_points,
+)
+from kubeflow_tpu.utils.metrics import default_registry
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """No chaos plan may leak out of a test: the controller is process-
+    global (like the tracer), and a leaked plan would fault unrelated
+    suites."""
+    yield
+    default_chaos().disarm()
+
+
+def _fires(ctrl: ChaosController, point: str, calls: int):
+    out = []
+    for i in range(calls):
+        try:
+            ctrl.maybe_fail(point)
+        except ChaosError:
+            out.append(i)
+    return out
+
+
+class TestSpecGrammar:
+    def test_bare_point_fires_every_call(self):
+        spec = parse_point("engine.step")
+        assert spec == PointSpec("engine.step")
+        ctrl = ChaosController()
+        ctrl.arm([spec])
+        assert _fires(ctrl, "engine.step", 5) == [0, 1, 2, 3, 4]
+
+    def test_qualifiers_parse(self):
+        spec = parse_point(
+            " trainer.device_step : p=0.25 , after=3 , once , attempt=2 "
+        )
+        assert spec.point == "trainer.device_step"
+        assert spec.probability == 0.25
+        assert spec.after == 3
+        assert spec.once is True
+        assert spec.attempt == 2
+        # round-trips through the string form the controllers render
+        assert parse_point(spec.spec_str()) == spec
+
+    @pytest.mark.parametrize("bad", [
+        "nope.unknown",                   # not in the CATALOG
+        "engine.step:p=1.5",              # probability out of range
+        "engine.step:p=0",                # p=0 would arm a dead point
+        "engine.step:after=-1",
+        "engine.step:once=yes",           # once takes no value
+        "engine.step:frobnicate=1",       # unknown qualifier
+        "",
+    ])
+    def test_bad_specs_rejected(self, bad):
+        with pytest.raises(ChaosSpecError):
+            parse_point(bad)
+
+    def test_duplicate_points_rejected(self):
+        with pytest.raises(ChaosSpecError, match="duplicate"):
+            parse_points(["engine.step", "engine.step:once"])
+
+    def test_config_validation_rejects_bad_plan(self):
+        from kubeflow_tpu.config.core import ConfigError, from_dict
+        from kubeflow_tpu.config.platform import ChaosConfig
+
+        with pytest.raises(ConfigError, match="unknown chaos point"):
+            from_dict(ChaosConfig, {"points": ["typo.point"]})
+        with pytest.raises(ConfigError, match="qualifier"):
+            from_dict(ChaosConfig, {"points": ["engine.step:p=2"]})
+
+    def test_serving_config_validates_chaos_without_from_dict(self):
+        """ServingConfig.validate() must reject a bad chaos plan even
+        when the config is built PROGRAMMATICALLY (replace(), CR merge):
+        from_dict only validates the chaos subtree when the key is
+        present, so validate() owns the fail-at-config-time discipline —
+        a swallowed parse error here would crash-loop the serving pod at
+        configure_from_env time instead."""
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import ChaosConfig, ServingConfig
+
+        with pytest.raises(ConfigError, match="unknown chaos point"):
+            ServingConfig(
+                chaos=ChaosConfig(points=["typo.point"])
+            ).validate()
+        # attempt= needs the gang-incarnation counter only the TPUJob
+        # controller renders; a serving plan carrying it is inert — fail
+        with pytest.raises(ConfigError, match="attempt="):
+            ServingConfig(
+                chaos=ChaosConfig(points=["engine.step:attempt=0"])
+            ).validate()
+
+    def test_catalog_names_the_five_seams(self):
+        for seam in (
+            "checkpoint.shard_write", "checkpoint.commit",
+            "trainer.device_step", "gang.host_exit", "engine.step",
+            "fleet.scrape_fetch",
+        ):
+            assert seam in CATALOG
+
+
+class TestDeterminism:
+    def test_probability_pattern_replays_bitwise(self):
+        spec = parse_point("engine.step:p=0.3")
+        a = ChaosController()
+        a.arm([spec], seed=42)
+        first = _fires(a, "engine.step", 200)
+        assert 20 < len(first) < 110  # sanity: roughly p * calls
+        b = ChaosController()
+        b.arm([spec], seed=42)
+        assert _fires(b, "engine.step", 200) == first
+        c = ChaosController()
+        c.arm([spec], seed=43)
+        assert _fires(c, "engine.step", 200) != first
+
+    def test_after_once_fires_exactly_once_at_the_named_call(self):
+        ctrl = ChaosController()
+        ctrl.arm([parse_point("engine.step:after=3,once")])
+        # skips calls 1..3, fires on call 4, then inert forever
+        assert _fires(ctrl, "engine.step", 50) == [3]
+
+    def test_per_point_rng_streams_independent(self):
+        """Adding a second armed point must not shift the first point's
+        fault pattern (per-point RNGs seeded from (seed, name))."""
+        solo = ChaosController()
+        solo.arm([parse_point("engine.step:p=0.3")], seed=9)
+        pattern = _fires(solo, "engine.step", 100)
+        both = ChaosController()
+        both.arm(
+            parse_points(["engine.step:p=0.3", "engine.prefill:p=0.5"]),
+            seed=9,
+        )
+        interleaved = []
+        for i in range(100):
+            try:
+                both.maybe_fail("engine.prefill")
+            except ChaosError:
+                pass
+            try:
+                both.maybe_fail("engine.step")
+            except ChaosError:
+                interleaved.append(i)
+        assert interleaved == pattern
+
+    def test_attempt_gating(self):
+        """attempt=N pins a fault to one gang incarnation: armed under a
+        different KFT_CHAOS_ATTEMPT the point is inert — and a plan with
+        NO active points leaves the controller disabled entirely."""
+        spec = parse_point("engine.step:attempt=0")
+        hit = ChaosController()
+        hit.arm([spec], attempt=0)
+        assert hit.enabled and _fires(hit, "engine.step", 1) == [0]
+        miss = ChaosController()
+        miss.arm([spec], attempt=1)
+        assert miss.enabled is False
+        assert _fires(miss, "engine.step", 5) == []
+
+    def test_faults_counter_increments_per_point(self):
+        reg = default_registry()
+        counter = reg.get("kft_faults_injected_total")
+        ctrl = ChaosController()
+        ctrl.arm([parse_point("engine.step:after=1")])
+        before = counter.value(point="engine.step") if counter else 0.0
+        _fires(ctrl, "engine.step", 4)  # skips 1, fires 3x
+        counter = reg.get("kft_faults_injected_total")
+        assert counter.value(point="engine.step") - before == 3
+
+
+class TestDisabledIsFree:
+    def test_disarmed_maybe_fail_is_a_shared_noop(self):
+        """The production cost of carrying the seams: one attribute read
+        + one branch per call on a disarmed controller. Budgeted like
+        the disabled tracer (PR 7: disabled span ~0.6µs): well under 2µs
+        per call even on a loaded CI host."""
+        ctrl = ChaosController()
+        assert ctrl.enabled is False
+        n = 100_000
+        point = "engine.step"
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ctrl.maybe_fail(point)
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 2e-6, f"disarmed maybe_fail {per_call * 1e6:.2f}µs/call"
+
+    def test_armed_plan_does_not_touch_unarmed_points(self):
+        ctrl = ChaosController()
+        ctrl.arm([parse_point("engine.step")])
+        # an armed controller is still a no-op for every OTHER point
+        assert _fires(ctrl, "checkpoint.commit", 10) == []
+
+
+class TestEnvChain:
+    def test_configure_from_env_arms_and_empty_disarms(self):
+        armed = configure_from_env(environ={
+            "KFT_CHAOS_POINTS": "engine.step:after=1;engine.prefill:once",
+            "KFT_CHAOS_SEED": "5",
+        })
+        assert armed is True
+        assert default_chaos().armed_points() == [
+            "engine.prefill", "engine.step",
+        ]
+        # the env is the whole truth: no env = actively disarmed
+        assert configure_from_env(environ={}) is False
+        assert default_chaos().enabled is False
+
+    def test_attempt_env_drops_other_incarnations(self):
+        armed = configure_from_env(environ={
+            "KFT_CHAOS_POINTS": "engine.step:attempt=0",
+            "KFT_CHAOS_ATTEMPT": "1",
+        })
+        assert armed is False  # the plan exists but is inert here
+
+    def test_inference_controller_renders_chaos_env(self):
+        from kubeflow_tpu.cluster.reconciler import ControllerManager
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.inference import (
+            InferenceServiceController,
+            new_inference_service,
+        )
+        from kubeflow_tpu.controllers.statefulset import DeploymentController
+
+        store = StateStore()
+        cm = ControllerManager(store)
+        cm.register(DeploymentController())
+        cm.register(InferenceServiceController())
+        store.create(new_inference_service(
+            "svc", model="gpt_tiny",
+            serving={"chaos": {
+                "enabled": True, "seed": 3,
+                "points": ["engine.step:p=0.5"],
+            }},
+        ))
+        cm.run_until_idle(max_seconds=5)
+        dep = store.get("Deployment", "svc", "default")
+        env = {
+            e["name"]: e["value"]
+            for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert env["KFT_CHAOS_POINTS"] == "engine.step:p=0.5"
+        assert env["KFT_CHAOS_SEED"] == "3"
+        # chaos-off services carry NO plan keys at all
+        store.create(new_inference_service("plain", model="gpt_tiny"))
+        cm.run_until_idle(max_seconds=5)
+        dep = store.get("Deployment", "plain", "default")
+        env = {
+            e["name"]: e["value"]
+            for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert "KFT_CHAOS_POINTS" not in env
+
+    def test_run_training_arms_from_pod_env_and_disarms_after(self):
+        """The gang.host_exit seam end-to-end through run_training's own
+        arming: the pod env's plan fires before training starts, the
+        error propagates as a pod failure would, and the process-global
+        controller is DISARMED again on the way out (the in-process
+        runner shares one interpreter across simulated jobs)."""
+        from kubeflow_tpu.config.core import from_dict
+        from kubeflow_tpu.config.platform import TrainingConfig
+        from kubeflow_tpu.runtime.train_run import run_training
+
+        cfg = from_dict(TrainingConfig, {
+            "model": "mlp", "global_batch_size": 8, "steps": 1,
+            "checkpoint": {"enabled": False},
+        })
+        with pytest.raises(ChaosError, match="gang.host_exit"):
+            run_training(cfg, environ={
+                "KFT_CHAOS_POINTS": "gang.host_exit",
+            })
+        assert default_chaos().enabled is False
+
+
+class TestCheckpointSeams:
+    def _state(self):
+        return {"params": {"w": np.arange(8, dtype=np.float32)}}
+
+    def _manager(self, tmp_path):
+        from kubeflow_tpu.checkpointing import CheckpointManager
+
+        return CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+
+    def test_transient_shard_write_fault_absorbed_by_retry(self, tmp_path):
+        from kubeflow_tpu.checkpointing import latest_committed_step
+
+        default_chaos().arm([parse_point("checkpoint.shard_write:once")])
+        with self._manager(tmp_path) as mgr:
+            assert mgr.save(2, self._state(), force=True)
+        assert latest_committed_step(str(tmp_path / "ckpt")) == 2
+
+    def test_transient_commit_fault_absorbed_by_retry(self, tmp_path):
+        from kubeflow_tpu.checkpointing import latest_committed_step
+
+        default_chaos().arm([parse_point("checkpoint.commit:once")])
+        with self._manager(tmp_path) as mgr:
+            assert mgr.save(4, self._state(), force=True)
+        assert latest_committed_step(str(tmp_path / "ckpt")) == 4
+
+    def test_persistent_commit_fault_leaves_step_uncommitted(self, tmp_path):
+        """A fault that survives every retry must fail the save loudly
+        AND leave nothing torn: the step directory exists but readers
+        (latest_committed_step) never see it."""
+        from kubeflow_tpu.checkpointing import latest_committed_step
+
+        default_chaos().arm([parse_point("checkpoint.commit")])  # always
+        with self._manager(tmp_path) as mgr:
+            with pytest.raises(ChaosError):
+                mgr.save(6, self._state(), force=True)
+        assert latest_committed_step(str(tmp_path / "ckpt")) is None
+
+    def test_transient_restore_fault_absorbed_by_retry(self, tmp_path):
+        from kubeflow_tpu.checkpointing import restore_latest
+
+        with self._manager(tmp_path) as mgr:
+            mgr.save(2, self._state(), force=True)
+        default_chaos().arm([parse_point("checkpoint.restore:once")])
+        out = restore_latest(str(tmp_path / "ckpt"), self._state())
+        np.testing.assert_array_equal(
+            out["params"]["w"], self._state()["params"]["w"]
+        )
+
+
+class TestEngineSeams:
+    def test_engine_step_fault_recovers_and_counts(self, gpt_and_params):
+        """engine.step rides the scheduler's generic recovery: resident
+        futures fail FAST, serving_engine_recoveries_total climbs, an
+        engine.recover trace event lands, and the engine keeps serving."""
+        from kubeflow_tpu.observability.trace import default_tracer
+        from kubeflow_tpu.serving.engine import DecodeEngine
+        from kubeflow_tpu.serving.generate import generate
+
+        model, params = gpt_and_params
+        reg = default_registry()
+        tracer = default_tracer()
+        tracer.configure(enabled=True)
+        eng = DecodeEngine("cz", model, params, num_slots=1, max_queue=4)
+        try:
+            counter = reg.get("serving_engine_recoveries_total")
+            before = counter.value(model="cz")
+            default_chaos().arm([parse_point("engine.step:once")])
+            row = (np.arange(4) * 3 + 1).astype(np.int32) % 512
+            with pytest.raises(RuntimeError, match="decode step failed"):
+                eng.submit(row, 5).wait(60)
+            assert counter.value(model="cz") - before == 1
+            assert any(
+                r.name == "engine.recover"
+                for r in tracer.snapshot()
+            )
+            # disarmed again: the engine serves correctly afterward
+            default_chaos().disarm()
+            out = eng.generate_row(row, 5, timeout=120)
+        finally:
+            eng.close()
+        ref = generate(model, params, np.asarray(row)[None, :], 5)
+        assert out["tokens"] == np.asarray(ref)[0, len(row):].tolist()
+
+    def test_engine_prefill_fault_fails_one_request_only(self, gpt_and_params):
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        model, params = gpt_and_params
+        eng = DecodeEngine("cz2", model, params, num_slots=1, max_queue=4)
+        try:
+            default_chaos().arm([parse_point("engine.prefill:once")])
+            row = (np.arange(4) * 3 + 1).astype(np.int32) % 512
+            with pytest.raises(ChaosError):
+                eng.submit(row, 3).wait(60)
+            # the fault consumed itself; the engine was never poisoned
+            out = eng.generate_row(row, 3, timeout=120)
+            assert len(out["tokens"]) == 3
+        finally:
+            eng.close()
+
+
+class TestFleetSeam:
+    def test_scrape_fetch_fault_degrades_one_sweep_not_the_collector(self):
+        from kubeflow_tpu.observability.fleet import (
+            FleetCollector,
+            ScrapeTarget,
+        )
+
+        target = ScrapeTarget(
+            role="serving", namespace="ns", owner="svc",
+            instance="r0", base_url="http://fake:1",
+        )
+        collector = FleetCollector(
+            targets=lambda: [target],
+            fetch=lambda url: (
+                "# TYPE serving_queue_depth gauge\n"
+                'serving_queue_depth{model="m"} 2\n'
+            ),
+        )
+        default_chaos().arm([parse_point("fleet.scrape_fetch:once")])
+        collector.scrape_once()  # injected fetch failure
+        assert collector.serving_signals("ns", "svc") is None
+        collector.scrape_once()  # fault consumed: sweep recovers
+        sig = collector.serving_signals("ns", "svc")
+        assert sig is not None and sig.queue_depth == 2

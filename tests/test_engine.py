@@ -10,25 +10,19 @@ determinism, the cache slot helpers, and the TTFT surface.
 """
 
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from kubeflow_tpu.models import get_model
 from kubeflow_tpu.serving.engine import DecodeEngine, QueueFullError
 from kubeflow_tpu.serving.generate import generate
 
 
-@pytest.fixture(scope="module")
-def gpt_and_params():
-    model = get_model("gpt_tiny", dtype=jnp.float32)
-    prompt = jnp.arange(6)[None, :].astype(jnp.int32) % 512
-    params = model.init(jax.random.PRNGKey(0), prompt, deterministic=True)[
-        "params"
-    ]
-    return model, params
+# gpt_and_params comes from conftest.py: ONE session-scoped tiny-gpt
+# shared by every engine-family suite (the tier-1 time-budget tranche)
 
 
 def _rows(*lens):
@@ -594,3 +588,114 @@ class TestMetricsSurface:
         for row, out in zip(rows, outs):
             assert out is not None
             assert out["tokens"] == _ref_tokens(model, params, row, 4)
+
+
+class TestDraining:
+    """Draining shutdown (docs/ROBUSTNESS.md drain contract): admission
+    flips to EngineDrainingError (429 + Retry-After at the server) while
+    everything already accepted — queued AND resident — runs to
+    completion under the deadline. Zero dropped or hung futures, ever."""
+
+    def test_drain_completes_in_flight_and_rejects_new(self, gpt_and_params):
+        from kubeflow_tpu.serving.engine import EngineDrainingError
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        model, params = gpt_and_params
+        eng = DecodeEngine("dr", model, params, num_slots=2, max_queue=8)
+        rows = _rows(4, 5, 6)  # 3 requests through 2 slots: one queues
+        n_new = [8, 9, 7]
+        futs = [eng.submit(r, n) for r, n in zip(rows, n_new)]
+        done = threading.Event()
+        drained = []
+
+        def _drain():
+            drained.append(eng.drain(deadline_s=60))
+            done.set()
+
+        t = threading.Thread(target=_drain)
+        t.start()
+        try:
+            # the admission gate flips as soon as drain starts
+            deadline = time.monotonic() + 10
+            while not eng._draining:
+                assert time.monotonic() < deadline
+            with pytest.raises(EngineDrainingError):
+                eng.submit(rows[0], 2)
+        finally:
+            t.join(timeout=120)
+        assert done.is_set() and drained == [True]
+        # every accepted request completed with the oracle's tokens —
+        # including the one that was still QUEUED when drain began
+        for row, n, f in zip(rows, n_new, futs):
+            out = f.wait(5)  # already completed; tiny timeout proves it
+            assert out["tokens"] == _ref_tokens(model, params, row, n)
+        # the drain latency landed in the fleet-aggregatable histogram
+        assert default_registry().get(
+            "serving_drain_seconds"
+        ).count(model="dr") == 1
+
+    def test_drained_closed_engine_still_answers_draining(
+        self, gpt_and_params
+    ):
+        """drain() ends in close(); an engine that FINISHED draining
+        (e.g. while a sibling engine still drains the full deadline)
+        must keep answering EngineDrainingError → 429 + Retry-After,
+        not a bare 500 — the retry-another-replica signal holds until
+        the server socket stops."""
+        from kubeflow_tpu.serving.engine import EngineDrainingError
+
+        model, params = gpt_and_params
+        eng = DecodeEngine("drc", model, params, num_slots=1, max_queue=4)
+        assert eng.drain(deadline_s=5) is True  # idle: drains, then closes
+        with pytest.raises(EngineDrainingError):
+            eng.submit(_rows(4)[0], 2)
+
+    def test_drain_deadline_fails_stragglers_fast(self, gpt_and_params):
+        """deadline_s=0: the drain cannot wait — close() must fail the
+        resident futures immediately (failed fast beats hung forever)."""
+        model, params = gpt_and_params
+        eng = DecodeEngine("dr0", model, params, num_slots=1, max_queue=4)
+        fut = eng.submit(_rows(4)[0], 100)  # long enough to still be live
+        drained = eng.drain(deadline_s=0.0)
+        assert drained is False
+        with pytest.raises(RuntimeError, match="closed|failed"):
+            fut.wait(10)
+
+    def test_server_close_drain_idle_engine(self, gpt_and_params):
+        """close(drain=True) on an idle server returns True immediately
+        (nothing resident: the drain is one occupancy check)."""
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model, params = gpt_and_params
+        server = ModelServer(statusz_enabled=False)
+        eng = DecodeEngine("dri", model, params, num_slots=1, max_queue=4)
+        server.add_engine(eng)
+        assert server.close(drain=True, drain_deadline_s=5.0) is True
+
+    def test_server_drains_multiple_engines_concurrently(self, gpt_and_params):
+        """Multi-engine servers drain in PARALLEL (total shutdown is one
+        deadline, the budget terminationGracePeriodSeconds is sized for
+        — not deadline x engines), and every engine's accepted work
+        still completes."""
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model, params = gpt_and_params
+        server = ModelServer(statusz_enabled=False)
+        engines = [
+            DecodeEngine(f"me{i}", model, params, num_slots=1, max_queue=4)
+            for i in range(2)
+        ]
+        for eng in engines:
+            server.add_engine(eng)
+        futs = [
+            eng.submit(_rows(4)[0], 10) for eng in engines
+        ]
+        t0 = time.monotonic()
+        assert server.close(drain=True, drain_deadline_s=120.0) is True
+        wall = time.monotonic() - t0
+        for f in futs:
+            assert len(f.wait(5)["tokens"]) == 10
+        # both engines' drains overlapped: the wall time is far under
+        # what two sequential full-deadline waits could reach (loose
+        # bound — this asserts the concurrency plumbing, not perf)
+        assert wall < 120.0

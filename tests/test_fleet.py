@@ -862,7 +862,10 @@ class TestIdentityAndDiscovery:
         ))
         store.create(new_object(
             "Pod", "job1-0", "default", api_version="v1",
-            labels={"tpujob.kubeflow-tpu.dev/job-name": "job1"},
+            # the REAL controller label (controllers/tpujob.py
+            # JOB_NAME_LABEL) — discovery keyed on anything else would
+            # never find actual gang pods
+            labels={"kubeflow-tpu.dev/job-name": "job1"},
             spec={
                 "hostname": "job1-0", "subdomain": "job1-gang",
                 "containers": [{"name": "trainer", "env": [

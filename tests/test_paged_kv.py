@@ -20,19 +20,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from kubeflow_tpu.models import get_model
 from kubeflow_tpu.serving.engine import DecodeEngine, QueueFullError
 from kubeflow_tpu.serving.generate import generate
 
 
-@pytest.fixture(scope="module")
-def gpt_and_params():
-    model = get_model("gpt_tiny", dtype=jnp.float32)
-    prompt = jnp.arange(6)[None, :].astype(jnp.int32) % 512
-    params = model.init(jax.random.PRNGKey(0), prompt, deterministic=True)[
-        "params"
-    ]
-    return model, params
+# gpt_and_params comes from conftest.py: ONE session-scoped tiny-gpt
+# shared by every engine-family suite (the tier-1 time-budget tranche)
 
 
 def _rows(*lens):

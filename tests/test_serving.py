@@ -207,7 +207,12 @@ class TestInferenceServiceController:
         )
         cm.run_until_idle(max_seconds=5)
         dep = store.get("Deployment", "lm-serve", "team-a")
-        c = dep["spec"]["template"]["spec"]["containers"][0]
+        pod_spec = dep["spec"]["template"]["spec"]
+        # the pod's kill grace covers the drain deadline PLUS the
+        # shutdown machinery (SIGTERM poll + engine close join), so
+        # SIGKILL can never land mid-drain
+        assert pod_spec["terminationGracePeriodSeconds"] == 30 + 30
+        c = pod_spec["containers"][0]
         env = {e["name"]: e["value"] for e in c["env"]}
         assert env == {
             "KFT_SERVING_NUM_SLOTS": "4",  # platform default (override)
@@ -220,6 +225,9 @@ class TestInferenceServiceController:
             "KFT_SERVING_DRAFT_MODEL": "",  # speculation off by default
             "KFT_SERVING_DRAFT_TOKENS": "0",
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": "",
+            # draining-shutdown budget (docs/ROBUSTNESS.md drain
+            # contract; consumed by serving/main.py's SIGTERM path)
+            "KFT_SERVING_DRAIN_DEADLINE_S": "30",
             # kft-trace contract (observability defaults: tracing on,
             # docs/OBSERVABILITY.md; knob-flow coverage lives in
             # tests/test_observability.py)
@@ -249,6 +257,7 @@ class TestInferenceServiceController:
         monkeypatch.setenv("KFT_SERVING_PAGE_SIZE", "8")
         monkeypatch.setenv("KFT_SERVING_NUM_PAGES", "24")
         monkeypatch.setenv("KFT_SERVING_PREFIX_CACHE", "0")
+        monkeypatch.setenv("KFT_SERVING_DRAIN_DEADLINE_S", "12")
         assert engine_knobs_from_env() == {
             "num_slots": 4,
             "max_queue": 16,
@@ -259,16 +268,19 @@ class TestInferenceServiceController:
             "draft_model": "",
             "num_draft_tokens": 0,
             "draft_checkpoint_dir": "",
+            "drain_deadline_s": 12.0,
         }
         monkeypatch.setenv("KFT_SERVING_PREFILL_BUCKETS", "")
         monkeypatch.setenv("KFT_SERVING_NUM_SLOTS", "")
         monkeypatch.setenv("KFT_SERVING_PAGE_SIZE", "")
         monkeypatch.setenv("KFT_SERVING_PREFIX_CACHE", "")
+        monkeypatch.setenv("KFT_SERVING_DRAIN_DEADLINE_S", "")
         knobs = engine_knobs_from_env()
         assert knobs["num_slots"] == 8  # default
         assert knobs["prefill_buckets"] is None  # auto ladder
         assert knobs["page_size"] == 16  # default
         assert knobs["prefix_cache"] is True  # empty = default on
+        assert knobs["drain_deadline_s"] == 30.0  # default budget
 
 
 class TestNpyFastPath:
@@ -598,3 +610,64 @@ class TestThreadedWire:
             assert stats["fused_rows_mean"] > 2.0
         finally:
             served.close()
+
+
+class TestDraining:
+    """The scale-down drain contract at the REST surface
+    (docs/ROBUSTNESS.md): while a replica drains, in-flight :generate
+    requests complete normally and NEW ones get 429 + Retry-After —
+    the signal a well-behaved client (or the Service VIP retry) acts on.
+    Engine-level drain mechanics live in tests/test_engine.py."""
+
+    def test_rest_429_with_retry_after_while_draining(self, gpt_and_params):
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        model, params = gpt_and_params
+        server = ModelServer(statusz_enabled=False)
+        eng = DecodeEngine("lm", model, params, num_slots=1, max_queue=4)
+        server.add_engine(eng)
+        prompt = (np.arange(5) % 512).astype(int).tolist()
+        # an in-flight request occupies the slot while the gate flips
+        resident = eng.submit(np.asarray(prompt, np.int32), 40)
+        # flip the admission gate exactly as drain() does (flipping it
+        # here instead of racing a background close() keeps the 429
+        # window deterministic; drain-to-completion mechanics are pinned
+        # in tests/test_engine.py::TestDraining)
+        with eng._cv:
+            eng._draining = True
+        status, body, headers = server.app.handle_full(
+            "POST",
+            "/v1/models/lm:generate",
+            body={"prompt_ids": [prompt], "max_new_tokens": 4},
+        )
+        assert status == 429
+        assert "draining" in body["log"]
+        hdrs = dict(headers)
+        assert int(hdrs["Retry-After"]) >= 1
+        # the full drain completes the resident request — zero dropped
+        assert server.close(drain=True, drain_deadline_s=60) is True
+        assert len(resident.wait(5)["tokens"]) == 40
+
+    def test_drain_exception_still_closes_engine(self, gpt_and_params):
+        """An engine whose drain() raises must still be close()d by the
+        server's drain worker: drained=False is reported and the
+        resident future fails FAST instead of hanging on a scheduler
+        that nobody stopped (the zero-hung-futures contract survives a
+        drain-path bug)."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        model, params = gpt_and_params
+        server = ModelServer(statusz_enabled=False)
+        eng = DecodeEngine("boom", model, params, num_slots=1, max_queue=4)
+        server.add_engine(eng)
+        prompt = np.asarray((np.arange(4) % 512), np.int32)
+        fut = eng.submit(prompt, 100)  # long enough to still be live
+
+        def _broken_drain(deadline_s):
+            raise RuntimeError("drain bug")
+
+        eng.drain = _broken_drain
+        assert server.close(drain=True, drain_deadline_s=60) is False
+        assert not eng._thread.is_alive()
+        with pytest.raises(RuntimeError, match="closed|failed"):
+            fut.wait(10)
