@@ -1337,6 +1337,287 @@ def bench_serving_continuous(
     }
 
 
+def bench_serving_router(
+    num_requests: int = 20,
+    num_replicas: int = 3,
+    num_templates: int = 4,
+    mean_interarrival_ms: float = 60.0,
+) -> dict:
+    """The kft-router fleet phase (docs/SERVING.md "Fleet routing"): the
+    PR-10 80%-shared-prefix Poisson trace driven through `num_replicas`
+    in-process replicas — each a full ModelServer + DecodeEngine on its
+    own socket — behind the FleetRouter, prefix-affinity routing vs
+    round-robin spray on the SAME trace. The fleet-wide question the
+    router exists to answer: with N independent radix caches, does
+    affinity turn them into ONE logical cache? Reported per arm:
+    fleet-wide prefix hit rate (summed engine stats deltas over prompt
+    tokens — the `prefix_cache_hit_rate`/`first_page_hashes` stats
+    surface, not raw counter scraping), TTFT p50/p99 through the router,
+    and per-replica first-page-hash cardinality (affinity: near-disjoint
+    key slices; spray: every replica sees most keys). Plus the parity
+    gate: greedy output THROUGH the router is bitwise-identical to
+    direct single-replica serving.
+
+    The trace is the production shape scaled down: `num_templates`
+    system-prompt-style shared prefixes (4 of 5 requests extend one;
+    1 of 5 is fully random), committed through the router during warm-up
+    — steady state, where the templates predate the measured traffic.
+    Under affinity every template lives on exactly its rendezvous
+    replica and every measured extension hits; under spray the warm
+    commits scatter round-robin and a request only hits when the spray
+    happens to land it on (or a prior miss re-committed it to) the right
+    replica."""
+    import json as _json
+    import threading
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from kubeflow_tpu.api.wsgi import Server
+    from kubeflow_tpu.routing import FleetRouter, Replica
+    from kubeflow_tpu.serving.engine import DecodeEngine
+    from kubeflow_tpu.serving.server import ModelServer
+
+    num_requests = _budget_scaled(num_requests, sized_for_s=480, floor=10)
+    prompt_len = BENCH_PREFIX_PROMPT_LEN
+    shared_len = BENCH_SHARED_PREFIX_LEN
+    new_tokens = 2  # TTFT is what affinity buys; decode is measured elsewhere
+    model, params = _gpt_small_with_params(BENCH_PREFIX_MAX_LEN)
+
+    # the trace: index -> payload; 1 of 5 random, else one of the shared
+    # templates (fixed seeds: both arms decode the identical trace)
+    trng = np.random.default_rng(2)
+    templates = [
+        trng.integers(0, 50257, (shared_len,)) for _ in range(num_templates)
+    ]
+    prng = np.random.default_rng(4)
+    prompts = []
+    for i in range(num_requests):
+        if i % 5 == 4:
+            prompts.append(prng.integers(0, 50257, (prompt_len,)))
+        else:
+            tail = prng.integers(0, 50257, (prompt_len - shared_len,))
+            prompts.append(np.concatenate([templates[i % num_templates], tail]))
+    payloads = [
+        _json.dumps({
+            "prompt_ids": [p.tolist()],
+            "max_new_tokens": new_tokens,
+        }).encode()
+        for p in prompts
+    ]
+    offsets = np.cumsum(
+        np.random.default_rng(3).exponential(
+            mean_interarrival_ms / 1e3, num_requests
+        )
+    )
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url, data=payload, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return _json.loads(resp.read()), resp.headers
+
+    def run_arm(affinity: bool) -> dict:
+        """One full fleet (fresh engines — cold caches) + router arm."""
+        engines, servers = [], []
+        replicas = []
+        wrng = np.random.default_rng(5)
+        try:
+            for r in range(num_replicas):
+                eng = DecodeEngine(
+                    "gpt_fleet", model, params,
+                    num_slots=DEFAULT_NUM_SLOTS,
+                    prefill_buckets=list(BENCH_PREFIX_BUCKETS),
+                    max_queue=max(64, num_requests),
+                    page_size=BENCH_PREFIX_PAGE_SIZE, prefix_cache=True,
+                )
+                ms = ModelServer()
+                ms.add_engine(eng)
+                srv = Server(ms.app, port=0)
+                srv.start()
+                engines.append((eng, ms))
+                servers.append(srv)
+                replicas.append(
+                    Replica(f"replica-{r}", f"http://127.0.0.1:{srv.port}")
+                )
+            router = FleetRouter(
+                tuple(replicas), affinity=affinity,
+                page_size=BENCH_PREFIX_PAGE_SIZE,
+                # the arms measure PLACEMENT: the CPU mesh's slow
+                # prefill would trip the in-flight spill fallback and
+                # contaminate the affinity arm with spill traffic
+                spill_queue_per_slot=1e9,
+            )
+            rsrv = Server(router.app, port=0)
+            rsrv.start()
+            servers.append(rsrv)
+            url = (
+                f"http://127.0.0.1:{rsrv.port}/v1/models/gpt_fleet:generate"
+            )
+            # warm 1: compile every reachable program on EVERY replica
+            # directly (miss-shaped prefill@256 + insert + step, then a
+            # same-prefix resubmit for the hit/chunk path) — this
+            # measures routing, not XLA compiles
+            for r, srv in enumerate(servers[:num_replicas]):
+                durl = (
+                    f"http://127.0.0.1:{srv.port}"
+                    f"/v1/models/gpt_fleet:generate"
+                )
+                wp = wrng.integers(0, 50257, (prompt_len,))
+                wtail = wrng.integers(0, 50257, (prompt_len - shared_len,))
+                for p in (wp, np.concatenate([wp[:shared_len], wtail])):
+                    post(durl, _json.dumps({
+                        "prompt_ids": [p.tolist()],
+                        "max_new_tokens": new_tokens,
+                    }).encode())
+            # warm 2: commit the templates THROUGH the router — affinity
+            # places each on its rendezvous home, spray scatters them
+            for t in templates:
+                post(url, _json.dumps({
+                    "prompt_ids": [t.tolist()], "max_new_tokens": 2,
+                }).encode())
+            pre = [eng.stats() for eng, _ in engines]
+
+            lat = [None] * num_requests
+            ttft = [None] * num_requests
+            done_at = [None] * num_requests
+            errors = []
+            lock = threading.Lock()
+            t0 = time.monotonic() + 0.05
+
+            def fire(i):
+                time.sleep(max(0.0, t0 + offsets[i] - time.monotonic()))
+                t_send = time.monotonic()
+                try:
+                    body, hdr = post(url, payloads[i])
+                    assert len(body["sequences"][0]) >= new_tokens
+                except Exception as e:  # noqa: BLE001 - recorded, not lost
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    return
+                t_done = time.monotonic()
+                with lock:
+                    lat[i] = t_done - t_send
+                    done_at[i] = t_done
+                    ttft[i] = (
+                        float(hdr["X-TTFT-Ms"]) / 1e3
+                        if hdr.get("X-TTFT-Ms")
+                        else t_done - t_send
+                    )
+
+            threads = [
+                threading.Thread(target=fire, args=(i,))
+                for i in range(num_requests)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            ok = [x for x in lat if x is not None]
+            if not ok:
+                raise RuntimeError(
+                    f"all {num_requests} routed requests failed; first: "
+                    f"{errors[0] if errors else 'unknown'}"
+                )
+            wall = max(x for x in done_at if x is not None) - t0
+            tfs = sorted(t for t in ttft if t is not None)
+            pct = lambda xs, q: xs[min(len(xs) - 1, int(len(xs) * q))]  # noqa: E731
+            stats_post = [eng.stats() for eng, _ in engines]
+            hit_tokens = sum(
+                s["prefix_hit_tokens"] - p["prefix_hit_tokens"]
+                for s, p in zip(stats_post, pre)
+            )
+            # denominator counts SERVED requests only: asymmetric
+            # failures between the arms must not masquerade as a cache
+            # advantage in the headline ratio
+            prompt_tokens = prompt_len * len(ok)
+            # parity gate (affinity arm): the same greedy request direct
+            # to a replica vs through the router must be BITWISE equal —
+            # the router adds placement, never content
+            parity = None
+            if affinity:
+                pp = np.concatenate([
+                    templates[0],
+                    np.random.default_rng(7).integers(
+                        0, 50257, (prompt_len - shared_len,)
+                    ),
+                ])
+                pbody = _json.dumps({
+                    "prompt_ids": [pp.tolist()], "max_new_tokens": 8,
+                }).encode()
+                via_router, _ = post(url, pbody)
+                direct, _ = post(
+                    f"http://127.0.0.1:{servers[0].port}"
+                    f"/v1/models/gpt_fleet:generate",
+                    pbody,
+                )
+                parity = (
+                    via_router["sequences"] == direct["sequences"]
+                )
+            out = {
+                "failed_requests": len(errors),
+                "tokens_per_sec": round(
+                    len(ok) * new_tokens / wall, 1
+                ),
+                "ttft_p50_ms": round(pct(tfs, 0.5) * 1e3, 2),
+                "ttft_p99_ms": round(pct(tfs, 0.99) * 1e3, 2),
+                "fleet_prefix_hit_rate": round(
+                    hit_tokens / prompt_tokens, 3
+                ),
+                # per-replica key-space slices (the stats satellite),
+                # deltas over the MEASURED trace (warm-up keys out):
+                # affinity -> near-disjoint, spray -> everyone sees most
+                "first_page_hashes_per_replica": [
+                    s["first_page_hashes"] - p["first_page_hashes"]
+                    for s, p in zip(stats_post, pre)
+                ],
+                "requests_per_replica": [
+                    s["admitted"] - p["admitted"]
+                    for s, p in zip(stats_post, pre)
+                ],
+            }
+            if parity is not None:
+                out["parity_bitwise"] = bool(parity)
+            return out
+        finally:
+            for srv in servers:
+                srv.stop()
+            for _, ms in engines:
+                ms.close()
+
+    affinity_arm = run_arm(affinity=True)
+    spray_arm = run_arm(affinity=False)
+    spray_rate = spray_arm["fleet_prefix_hit_rate"]
+    return {
+        "model": "gpt_small",
+        "num_requests": num_requests,
+        "num_replicas": num_replicas,
+        "num_templates": num_templates,
+        "shared_fraction": 0.8,
+        "prompt_len": prompt_len,
+        "shared_prefix_len": shared_len,
+        "page_size": BENCH_PREFIX_PAGE_SIZE,
+        "max_len": BENCH_PREFIX_MAX_LEN,
+        "affinity": affinity_arm,
+        "spray": spray_arm,
+        # the acceptance headline: fleet cache behavior, affinity vs
+        # spray on the identical trace (target >= 1.5x)
+        "router_hit_rate_ratio": round(
+            affinity_arm["fleet_prefix_hit_rate"] / spray_rate, 2
+        ) if spray_rate else None,
+        "router_affinity_hit_rate": affinity_arm["fleet_prefix_hit_rate"],
+        "router_spray_hit_rate": spray_rate,
+        "router_ttft_p50_speedup": round(
+            spray_arm["ttft_p50_ms"] / affinity_arm["ttft_p50_ms"], 2
+        ) if affinity_arm["ttft_p50_ms"] else None,
+        "router_parity_bitwise": (
+            1.0 if affinity_arm.get("parity_bitwise") else 0.0
+        ),
+    }
+
+
 def bench_generate(
     batch: int = 8,
     prompt_len: int = 64,
@@ -2225,6 +2506,10 @@ def _entry_specs(batch: int, steps: int):
             None,
             False,
         ),
+        # the 80%-shared-prefix trace through a routed 3-replica fleet:
+        # prefix-affinity vs random spray, fleet-wide hit rate + TTFT,
+        # greedy parity through the router (docs/SERVING.md fleet routing)
+        ("serving_router", "bench_serving_router()", 480, None, False),
         # the cache-less decode baseline the KV cache is supposed to beat;
         # one plain-forward compile, cheap at the tail
         ("generate_floor", "bench_generate_nocache()", 240, None, False),
@@ -2245,6 +2530,7 @@ _HEADLINE_KEYS = (
     "tokens_per_sec",
     "steps_per_sec",
     "items_per_sec",
+    "router_hit_rate_ratio",
     "p50_ms",
     "ring_flash_causal_speedup",
     "best_trial_loss",
@@ -2264,6 +2550,10 @@ _EXTRA_FINAL_KEYS = (
     # paged-KV + prefix cache (serving_continuous prefix phase)
     "prefix_hit_rate",
     "kv_pages_per_request",
+    # kft-router fleet phase (serving_router): affinity vs spray
+    "router_affinity_hit_rate",
+    "router_ttft_p50_speedup",
+    "router_parity_bitwise",
 )
 
 
@@ -2350,6 +2640,7 @@ def _summary(results: dict, batch: int, complete: bool, t0: float) -> dict:
         "ring_attention": results.get("ring_attention"),
         "serving_generate": results.get("serving_generate"),
         "serving_continuous": results.get("serving_continuous"),
+        "serving_router": results.get("serving_router"),
         "long_context_attention": results.get("long_context_attention"),
         "attention_sweep": sweep or None,
         "device_kind": probe.get("device_kind"),

@@ -13,8 +13,9 @@ reachability.
   bug class.
 - **env-reachability**: every `KFT_*` env var the controllers render into
   pod env must be consumed by the runtime side (runtime/, training/,
-  parallel/, checkpointing/, serving/, images.py); a rendered-but-unread
-  var means a controller contract the pods silently ignore.
+  parallel/, checkpointing/, serving/, routing/, images.py); a
+  rendered-but-unread var means a controller contract the pods silently
+  ignore.
 """
 
 from __future__ import annotations
@@ -58,6 +59,7 @@ _ENV_CONSUMER_PREFIXES = (
     "kubeflow_tpu/serving/",
     "kubeflow_tpu/observability/",
     "kubeflow_tpu/chaos/",
+    "kubeflow_tpu/routing/",
     "kubeflow_tpu/images.py",
 )
 _ENV_RE = re.compile(r"^KFT_[A-Z0-9_]+$")

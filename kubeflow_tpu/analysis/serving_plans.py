@@ -141,7 +141,10 @@ def bench_serving_plans() -> List[ServingPlanSpec]:
             # 160-token shared system prompt maps 10 copy-free pages);
             # the prefix_cache=off twin in the bench is
             # geometry-identical, so one plan covers both program
-            # families
+            # families — as are bench_serving_router's fleet replicas
+            # (same model/buckets/page geometry, one engine per
+            # replica), so the routed fleet runs lint-certified
+            # programs too
             name="bench:gpt_prefix",
             model="gpt_small",
             model_kwargs=dict(target, max_len=BENCH_PREFIX_MAX_LEN),

@@ -58,6 +58,23 @@ def namespaced_name(obj: Dict[str, Any]) -> str:
     return f"{m.get('namespace', 'default')}/{m.get('name', '')}"
 
 
+def pod_host(pod: Dict[str, Any]) -> str:
+    """The address another process reaches this pod at — ONE definition
+    of the preference order shared by every pod-dialing consumer (the
+    fleet collector's scrape targets, the kft-router's replica
+    registry): the reported pod IP, else the pod's gang DNS name
+    (hostname.subdomain.namespace), else the bare pod name."""
+    m = pod.get("metadata", {})
+    spec = pod.get("spec") or {}
+    host = (pod.get("status") or {}).get("podIP") or ""
+    if not host:
+        hostname = spec.get("hostname") or m.get("name", "")
+        subdomain = spec.get("subdomain", "")
+        ns = m.get("namespace", "default")
+        host = f"{hostname}.{subdomain}.{ns}" if subdomain else hostname
+    return host
+
+
 def owner_reference(owner: Dict[str, Any], controller: bool = True) -> Dict[str, Any]:
     m = owner["metadata"]
     return {

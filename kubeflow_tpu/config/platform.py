@@ -590,6 +590,58 @@ class AutoscaleConfig(ConfigNode):
 
 
 @dataclasses.dataclass
+class RouterConfig(ConfigNode):
+    """kft-router knobs (kubeflow_tpu/routing/; docs/SERVING.md "Fleet
+    routing"). When enabled the InferenceService controller deploys a
+    `<name>-router` pod beside the replica fleet running `python -m
+    kubeflow_tpu.routing`, rendering these as KFT_ROUTER_* (consumed by
+    routing/__main__.py knobs_from_env). The affinity page size is NOT a
+    knob here: the controller renders KFT_ROUTER_PAGE_SIZE from the one
+    ServingConfig.page_size, so the router's hash granularity and the
+    replicas' radix-cache page granularity cannot drift."""
+
+    enabled: bool = config_field(
+        default=False,
+        help="deploy the prefix-affinity front door for this service; "
+        "off = clients talk to the replica Service VIP directly and the "
+        "fleet's prefix caches stay per-process",
+    )
+    affinity: bool = config_field(
+        default=True,
+        help="route :generate by the prompt's first-page hash over a "
+        "rendezvous (HRW) ranking of live replicas, so shared prefixes "
+        "stick to the replica holding their radix chain; off = "
+        "round-robin spray (the bench's control arm)",
+    )
+    spill_queue_per_slot: float = config_field(
+        default=2.0,
+        help="queue-depth-per-slot threshold STRICTLY above which an "
+        "affinity request spills to its second rendezvous choice "
+        "instead of queueing behind the hot replica (an idle home "
+        "never spills, even at 0). Depth comes from the fleet "
+        "collector's per-replica signals when wired, else the router's "
+        "own in-flight count over KFT_ROUTER_REPLICA_SLOTS "
+        "(routing/router.py DEFAULT_SPILL_QUEUE_PER_SLOT pins the "
+        "same number)",
+    )
+    retry_budget: int = config_field(
+        default=2,
+        help="extra replica attempts after a 429 (draining; Retry-After "
+        "honored as a demotion window), connect failure or 5xx before "
+        "the router answers a clean 503 (routing/router.py "
+        "DEFAULT_RETRY_BUDGET pins the same number)",
+    )
+
+    def validate(self) -> None:
+        if self.spill_queue_per_slot < 0:
+            raise ConfigError(
+                "serving.router.spill_queue_per_slot must be >= 0"
+            )
+        if self.retry_budget < 0:
+            raise ConfigError("serving.router.retry_budget must be >= 0")
+
+
+@dataclasses.dataclass
 class ServingConfig(ConfigNode):
     """Continuous-batching decode-engine knobs (serving/engine.py;
     docs/SERVING.md). The InferenceService controller renders these as
@@ -675,10 +727,14 @@ class ServingConfig(ConfigNode):
     autoscale: AutoscaleConfig = config_field(
         default_factory=AutoscaleConfig
     )
+    router: RouterConfig = config_field(default_factory=RouterConfig)
     chaos: ChaosConfig = config_field(default_factory=ChaosConfig)
 
     def validate(self) -> None:
         self.autoscale.validate()
+        # like chaos below: a programmatically built config must hit the
+        # same rejection from_dict applies when the subtree key is present
+        self.router.validate()
         # from_dict only validates the chaos subtree when the key is
         # present; a programmatically built config (replace(), CR merge)
         # must hit the same parse rejection here, not crash-loop the pod

@@ -27,6 +27,9 @@ log = get_logger(__name__)
 KIND = "InferenceService"
 DEFAULT_IMAGE = "kubeflow-tpu/model-server:latest"
 SERVE_PORT = 8500
+# the kft-router front door's port (routing/__main__.py
+# DEFAULT_ROUTER_PORT documents the same number)
+ROUTER_PORT = 8600
 
 
 def new_inference_service(
@@ -165,10 +168,11 @@ class InferenceServiceController(Controller):
             "autoscale": dataclasses.asdict(
                 self.serving_defaults.autoscale
             ),
+            "router": dataclasses.asdict(self.serving_defaults.router),
             "chaos": dataclasses.asdict(self.serving_defaults.chaos),
         }
         overrides = dict(spec.get("serving") or {})
-        for subtree in ("observability", "autoscale", "chaos"):
+        for subtree in ("observability", "autoscale", "router", "chaos"):
             sub_override = overrides.pop(subtree, None) or {}
             merged[subtree].update(sub_override)
         merged.update(overrides)
@@ -285,6 +289,101 @@ class InferenceServiceController(Controller):
             store.record_event(svc_cr, reason, detail)
         return True
 
+    def _reconcile_router(
+        self,
+        store: StateStore,
+        svc_cr: Dict[str, Any],
+        namespace: str,
+        name: str,
+        spec: Dict[str, Any],
+        cfg: ServingConfig,
+    ) -> None:
+        """The kft-router front door (kubeflow_tpu/routing/): when
+        serving.router.enabled, a `<name>-router` Deployment + Service
+        run `python -m kubeflow_tpu.routing` with the KFT_ROUTER_*
+        contract. The replica registry is re-rendered on EVERY reconcile
+        from the replica count (the workload controller's stable
+        `<name>-0..N-1` pod names), so a scale event updates the router's
+        fleet in the same pass that resizes the Deployment; drains
+        between reconciles are the router's own 429/probe demotion.
+        Disabled = any previously rendered router is torn down."""
+        router_name = f"{name}-router"
+        if not cfg.router.enabled:
+            for kind in ("Deployment", "Service"):
+                try:
+                    store.delete(kind, router_name, namespace)
+                except KeyError:
+                    pass
+            return
+        replicas = int(spec.get("replicas", 1))
+        registry = ",".join(
+            f"{name}-{i}=http://{name}-{i}:{SERVE_PORT}"
+            for i in range(replicas)
+        )
+        env = {
+            "KFT_ROUTER_AFFINITY": "1" if cfg.router.affinity else "0",
+            # the affinity hash granularity IS the fleet's radix-cache
+            # page granularity — rendered from the one page_size knob
+            "KFT_ROUTER_PAGE_SIZE": str(cfg.page_size),
+            "KFT_ROUTER_SPILL_QUEUE_PER_SLOT": (
+                f"{cfg.router.spill_queue_per_slot:g}"
+            ),
+            "KFT_ROUTER_RETRY_BUDGET": str(cfg.router.retry_budget),
+            # the spill denominator for the router's in-flight fallback
+            # signal — the replicas' slot capacity, from the one
+            # ServingConfig the replicas themselves run
+            "KFT_ROUTER_REPLICA_SLOTS": str(cfg.num_slots),
+            "KFT_ROUTER_REPLICAS": registry,
+        }
+        if cfg.observability.statusz_enabled:
+            # the fleet collector scrapes router_* off the router's
+            # /metrics like any serving-side surface — but the router pod
+            # must NOT carry the `inferenceservice` label (it would count
+            # as a replica in serving_signals and the Service VIP)
+            env["KFT_FLEET_METRICS_PORT"] = str(ROUTER_PORT)
+        container = {
+            "name": "router",
+            "image": spec.get("image", DEFAULT_IMAGE),
+            "command": [
+                "python",
+                "-m",
+                "kubeflow_tpu.routing",
+                "--service", f"{namespace}/{name}",
+                "--port", str(ROUTER_PORT),
+            ],
+            "ports": [{"containerPort": ROUTER_PORT}],
+            "env": [
+                {"name": k, "value": v} for k, v in sorted(env.items())
+            ],
+            "readinessProbe": {
+                "httpGet": {"path": "/healthz", "port": ROUTER_PORT},
+                "periodSeconds": 5,
+            },
+        }
+        dep = new_deployment(
+            router_name,
+            namespace,
+            1,
+            {"containers": [container]},
+            labels={"app": "kft-router", "inferenceservice-router": name},
+        )
+        set_owner(dep, svc_cr)
+        store.apply(dep)
+        svc = new_object(
+            "Service",
+            router_name,
+            namespace,
+            api_version="v1",
+            spec={
+                "selector": {"inferenceservice-router": name},
+                "ports": [
+                    {"port": ROUTER_PORT, "targetPort": ROUTER_PORT}
+                ],
+            },
+        )
+        set_owner(svc, svc_cr)
+        store.apply(svc)
+
     def reconcile(self, store: StateStore, namespace: str, name: str) -> Result:
         svc_cr = store.try_get(KIND, name, namespace)
         if svc_cr is None or svc_cr["metadata"].get("deletionTimestamp"):
@@ -316,6 +415,15 @@ class InferenceServiceController(Controller):
                     self._serving_env(spec, serving_cfg).items()
                 )
             ],
+            # /healthz distinguishes draining from dead (serving/
+            # server.py: 503 + {"draining": true} while close(drain=True)
+            # runs): the kubelet pulls a draining replica out of the
+            # Service endpoints without killing it, and the kft-router
+            # probes the same endpoint to demote it
+            "readinessProbe": {
+                "httpGet": {"path": "/healthz", "port": SERVE_PORT},
+                "periodSeconds": 5,
+            },
         }
         # draining shutdown: the grace period must COVER the WORST-CASE
         # shutdown, or the kubelet's SIGKILL lands mid-cleanup and drops
@@ -361,6 +469,8 @@ class InferenceServiceController(Controller):
         )
         set_owner(svc, svc_cr)
         store.apply(svc)
+
+        self._reconcile_router(store, svc_cr, namespace, name, spec, serving_cfg)
 
         if self.use_istio:
             vs = new_object(

@@ -9,7 +9,9 @@ InferenceService add a replica". This module is that layer:
 
 - **Discovery** — scrape targets come from the cluster store's pod
   objects: pods labeled `inferenceservice: <name>` are serving replicas,
-  pods labeled with the TPUJob gang label are training hosts. The
+  pods labeled `inferenceservice-router: <name>` are kft-router front
+  doors (router_* series; never counted as replicas), pods labeled with
+  the TPUJob gang label are training hosts. The
   controller-rendered `KFT_FLEET_METRICS_PORT` env on the pod names the
   scrape port; `KFT_FLEET_INSTANCE` names the replica/host identity.
 - **Aggregation** — every target's /metrics text parses back into
@@ -127,6 +129,11 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "profile_namespaces_created_total": "sum",
     "profiler_captures_total": "sum",
     "reconcile_total": "sum",
+    # kft-router front door (kubeflow_tpu/routing/)
+    "router_affinity_hits_total": "sum",
+    "router_requests_total": "sum",
+    "router_retry_total": "sum",
+    "router_spill_total": "sum",
     "serving_decode_steps_total": "sum",
     "serving_draft_accepted_total": "sum",
     "serving_draft_proposed_total": "sum",
@@ -216,14 +223,21 @@ def _container_env(pod: Dict[str, Any]) -> Dict[str, str]:
 # "tpujob."-prefixed value here used to do.
 _JOB_NAME_LABEL = "kubeflow-tpu.dev/job-name"
 _SERVING_LABEL = "inferenceservice"
+# the kft-router pod label (controllers/inference.py _reconcile_router):
+# the router is scrapeable (router_* series ride the aggregation policy)
+# but deliberately NOT labeled `inferenceservice` — it must never count
+# as a replica in serving_signals or join the Service VIP
+_ROUTER_LABEL = "inferenceservice-router"
 
 
 def discover_targets(store) -> List[ScrapeTarget]:
     """Scrape targets from the cluster store's pod objects: any pod whose
     env carries KFT_FLEET_METRICS_PORT is scrapeable; its labels say
-    which fleet it belongs to. Address preference: the pod IP the
-    executor reported (status.podIP), else the pod's gang DNS name
-    (hostname.subdomain.namespace), else the bare pod name."""
+    which fleet it belongs to. Addressing is the shared `pod_host`
+    preference order (cluster/objects.py): the reported pod IP, else
+    the pod's gang DNS name, else the bare pod name."""
+    from kubeflow_tpu.cluster.objects import pod_host
+
     out: List[ScrapeTarget] = []
     for pod in store.list("Pod"):
         meta = pod.get("metadata", {})
@@ -234,19 +248,14 @@ def discover_targets(store) -> List[ScrapeTarget]:
             continue
         if _SERVING_LABEL in labels:
             role, owner = "serving", labels[_SERVING_LABEL]
+        elif _ROUTER_LABEL in labels:
+            role, owner = "router", labels[_ROUTER_LABEL]
         elif _JOB_NAME_LABEL in labels:
             role, owner = "training", labels[_JOB_NAME_LABEL]
         else:
             continue
         ns = meta.get("namespace", "default")
-        spec = pod.get("spec") or {}
-        host = (pod.get("status") or {}).get("podIP") or ""
-        if not host:
-            hostname = spec.get("hostname") or meta.get("name", "")
-            subdomain = spec.get("subdomain", "")
-            host = (
-                f"{hostname}.{subdomain}.{ns}" if subdomain else hostname
-            )
+        host = pod_host(pod)
         out.append(
             ScrapeTarget(
                 role=role,
@@ -636,7 +645,7 @@ class FleetCollector:
             )
         for ns, job, host in stale_stragglers:
             self._g_straggler.set(0.0, job=f"{ns}/{job}", host=host)
-        for role in ("serving", "training"):
+        for role in ("serving", "training", "router"):
             self._g_targets.set(float(counts.get(role, 0)), role=role)
 
     # -- consumers ---------------------------------------------------------
@@ -688,6 +697,43 @@ class FleetCollector:
                 rate_429_per_s=self._group_429.get(key, 0.0),
                 sweep=self._sweeps,
             )
+
+    def replica_serving_signals(
+        self, namespace: str, name: str, instance: Optional[str] = None
+    ) -> Dict[str, Dict[str, float]]:
+        """PER-REPLICA engine signals for one InferenceService — the
+        router's load-aware spill input (kubeflow_tpu/routing/
+        fleet_signals_source): each reachable replica's queue depth and
+        slot capacity from its last good scrape, keyed by the replica's
+        fleet instance id. The aggregated `serving_signals` answers the
+        autoscaler's fleet-total question; the router needs to know WHICH
+        replica is hot, so this keeps the rows unmerged. `instance`
+        narrows the work to one replica's row (the request-hot-path
+        query — O(1) metric collapsing instead of O(replicas) per
+        routed request)."""
+        key = ("serving", namespace, name)
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for t, st in self._state.items():
+                if (t.role, t.namespace, t.owner) != key:
+                    continue
+                if instance is not None and t.instance != instance:
+                    continue
+                if st.parsed is None or st.error:
+                    continue
+
+                def val(metric: str) -> float:
+                    pm = st.parsed.get(metric)
+                    if pm is None:
+                        return 0.0
+                    v = _collapse(pm, AGGREGATION_POLICY.get(metric, "sum"))
+                    return 0.0 if v is None else v
+
+                out[t.instance] = {
+                    "queue_depth": val("serving_queue_depth"),
+                    "num_slots": val("serving_num_slots"),
+                }
+        return out
 
     # -- merged cross-host Perfetto export ---------------------------------
 

@@ -1,0 +1,50 @@
+"""Prefix-affinity keys and rendezvous (HRW) replica ranking.
+
+The affinity contract (docs/SERVING.md "Fleet routing"): two requests
+whose prompts share their first `page_size`-aligned chunk — exactly the
+granularity the radix prefix cache commits pages at (serving/engine.py)
+— must hash to the same key, and the same key must rank the same replica
+first for as long as that replica is in the fleet. Rendezvous hashing
+gives the second half: adding or removing one replica reassigns only the
+keys that ranked the changed replica first; every other key keeps its
+replica (and therefore its warm radix chain).
+
+Tokenize-free by construction: keys are computed from the wire-level
+`prompt_ids` integers, so the router never loads a tokenizer (or the
+model) and one router build fronts every model family.
+
+This module is pure (hashlib only, no jax) so both the router front door
+and the decode engine's first-page-cardinality accounting import it
+without pulling in each other's heavy dependencies.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, List, Sequence
+
+
+def first_page_key(token_ids: Sequence, page_size: int) -> str:
+    """Stable affinity key for one prompt row: the first `page_size`
+    token ids (the first committed page — the radix cache's sharing
+    unit). Prompts shorter than one page key on what they have: they can
+    never hit the page-aligned cache, but identical short prompts still
+    deserve the same replica."""
+    n = max(1, int(page_size))
+    head = ",".join(str(int(t)) for t in list(token_ids)[:n])
+    return hashlib.sha1(head.encode("ascii")).hexdigest()
+
+
+def rendezvous_rank(key: str, replica_ids: Iterable[str]) -> List[str]:
+    """Highest-random-weight (rendezvous) order of `replica_ids` for
+    `key`: every (key, replica) pair gets an independent score and the
+    ranking sorts by it, so membership changes reshuffle minimally —
+    removing a replica only promotes the second choice of the keys it
+    owned; adding one steals only the keys it now scores highest for.
+    Ties (identical ids) are impossible because ids are dict keys at the
+    call sites; the score string is unique per (key, id)."""
+
+    def score(rid: str) -> str:
+        return hashlib.sha1(f"{key}|{rid}".encode("utf-8")).hexdigest()
+
+    return sorted(replica_ids, key=score, reverse=True)

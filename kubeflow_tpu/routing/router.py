@@ -1,0 +1,769 @@
+"""kft-router — the prefix-affinity front door over a serving fleet.
+
+The control plane already scales replicas (the InferenceService
+autoscaler) and drains them cleanly on scale-down (ModelServer
+close(drain=True)), but every client still talked to ONE replica: the
+radix prefix cache is per-process, so an autoscaled fleet was N cold
+caches. This module is the data-plane half the ROADMAP's "Sharded
+serving" rung 2 names: a WSGI front door that
+
+- keeps a **replica registry** — static for tests/bench, or discovered
+  from the cluster store's inferenceservice-labeled pods (the same label
+  scheme the fleet collector's `discover_targets` scrapes by), with the
+  InferenceService controller re-rendering the list on every scale
+  event;
+- tracks **health and drains** — a replica answering 429 + Retry-After
+  (the draining-shutdown contract, docs/ROBUSTNESS.md) or failing its
+  /healthz probe is demoted and re-admitted on recovery;
+- admits with **prefix affinity** — the first `page_size`-aligned chunk
+  of the prompt hashes (tokenize-free, over the wire-level ids) to a
+  rendezvous (HRW) ranking of the live replicas, so requests sharing a
+  radix prefix land on the replica that already holds those pages and
+  the per-process prefix cache becomes a fleet-wide one for free;
+- **spills** load-aware — when the affinity target's queue depth per
+  slot (the fleet collector's per-replica serving signals when wired;
+  the router's own per-replica in-flight count otherwise, so the
+  standalone pod spills too) EXCEEDS `spill_queue_per_slot`, the
+  request takes the SECOND rendezvous choice instead of queueing
+  behind the hot spot;
+- **retries bounded** — a 429 (honoring Retry-After: the draining
+  replica stays demoted for the advertised window), a connect failure
+  or a 5xx moves to the next rendezvous choice, at most `retry_budget`
+  extra attempts; exhaustion is a clean 503, never a hang.
+
+Every routed request records a `request.route` span (the chosen replica,
+attempt number, affinity/spill verdicts) and the four `router_*` fleet
+series (utils/metrics.py; AGGREGATION_POLICY-covered).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.analysis.serving_plans import DEFAULT_PAGE_SIZE
+from kubeflow_tpu.api.wsgi import App, BadRequest, HttpError
+from kubeflow_tpu.observability.trace import default_tracer
+from kubeflow_tpu.routing.affinity import first_page_key, rendezvous_rank
+from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.utils.metrics import (
+    router_affinity_hits_counter,
+    router_requests_counter,
+    router_retries_counter,
+    router_spills_counter,
+)
+
+log = get_logger(__name__)
+
+# Router knob defaults — ONE definition point shared by RouterConfig
+# (config/platform.py documents the same numbers), the controller's env
+# render and the entrypoint's env parse (routing/__main__.py).
+DEFAULT_SPILL_QUEUE_PER_SLOT = 2.0
+DEFAULT_RETRY_BUDGET = 2
+DEFAULT_PROBE_INTERVAL_S = 5.0
+# upstream request bound: a hung replica must surface as the router's
+# 503/retry path, not a stuck client socket (mirrors the model server's
+# ENGINE_WAIT_S generosity)
+UPSTREAM_TIMEOUT_S = 600.0
+
+# the serving-replica pod label (controllers/inference.py deployment
+# labels); duplicated as a string so this module never imports the
+# controller layer — the same pairing fleet.py documents for discovery
+_SERVING_LABEL = "inferenceservice"
+_SERVE_PORT = 8500
+
+# response headers the router passes through from the replica (the
+# engine's TTFT attribution, the echoed request id, a drain's
+# Retry-After) — everything else is hop-local
+_PASSTHROUGH_HEADERS = (
+    ("x-ttft-ms", "X-TTFT-Ms"),
+    ("x-request-id", "X-Request-Id"),
+    ("retry-after", "Retry-After"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Replica:
+    """One serving replica the router can admit to."""
+
+    id: str         # stable identity (pod name / bench label) — the HRW key
+    base_url: str   # e.g. http://pod-0:8500 (no trailing slash)
+
+
+@dataclasses.dataclass
+class _ReplicaState:
+    """Per-replica health bookkeeping (guarded by the router lock)."""
+
+    healthy: bool = True
+    draining: bool = False     # informational (healthz/statusz rendering)
+    demoted_until: float = 0.0  # monotonic deadline of a 429/drain demotion
+    fails: int = 0
+    last_error: str = ""
+
+    def available(self, now: float) -> bool:
+        return self.healthy and now >= self.demoted_until
+
+
+def discover_replicas(
+    store, namespace: str, name: str, port: int = _SERVE_PORT
+) -> List[Replica]:
+    """Replica registry from the cluster store's pod objects: every pod
+    labeled `inferenceservice: <name>` in `namespace` is a routable
+    replica (the exact label scheme FleetCollector.discover_targets
+    scrapes by). Addressing is the shared `pod_host` preference order
+    (cluster/objects.py), the same one the collector dials — so the
+    router's registry ids and the fleet's instance ids stay pairable."""
+    from kubeflow_tpu.cluster.objects import pod_host
+
+    out: List[Replica] = []
+    for pod in store.list("Pod"):
+        meta = pod.get("metadata", {})
+        labels = meta.get("labels", {}) or {}
+        if labels.get(_SERVING_LABEL) != name:
+            continue
+        if meta.get("namespace", "default") != namespace:
+            continue
+        host = pod_host(pod)
+        out.append(
+            Replica(
+                id=meta.get("name", host),
+                base_url=f"http://{host}:{port}",
+            )
+        )
+    return sorted(out, key=lambda r: r.id)
+
+
+def fleet_signals_source(
+    collector, namespace: str, name: str
+) -> Callable[[str], Optional[Dict[str, float]]]:
+    """Adapt a FleetCollector into the router's spill-signal shape: a
+    callable mapping a replica id (the pod's KFT_FLEET_INSTANCE) to its
+    last-scraped {queue_depth, num_slots} row
+    (observability/fleet.py replica_serving_signals)."""
+
+    def signals(replica_id: str) -> Optional[Dict[str, float]]:
+        # instance-narrowed: one replica's row per routed request, not
+        # a full-fleet collapse discarded after one .get()
+        return collector.replica_serving_signals(
+            namespace, name, instance=replica_id
+        ).get(replica_id)
+
+    return signals
+
+
+# Transport: (method, url, body-bytes-or-None, headers) ->
+# (status, body bytes, lowercase header dict). Injectable so unit tests
+# route against in-process fakes and the bench/e2e use real sockets.
+Transport = Callable[
+    [str, str, Optional[bytes], Dict[str, str]],
+    Tuple[int, bytes, Dict[str, str]],
+]
+
+
+def default_transport(
+    method: str,
+    url: str,
+    body: Optional[bytes],
+    headers: Dict[str, str],
+    timeout_s: float = UPSTREAM_TIMEOUT_S,
+) -> Tuple[int, bytes, Dict[str, str]]:
+    """urllib transport: HTTP error statuses return as statuses (the
+    router's routing verdicts need the 429/5xx, not an exception);
+    connection-level failures raise (the caller demotes the replica)."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=body, headers=dict(headers), method=method
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return (
+                resp.status,
+                resp.read(),
+                {k.lower(): v for k, v in resp.headers.items()},
+            )
+    except urllib.error.HTTPError as e:
+        data = e.read()
+        return e.code, data, {k.lower(): v for k, v in e.headers.items()}
+
+
+def _parse_retry_after(headers: Dict[str, str], default_s: float = 1.0) -> float:
+    raw = (headers or {}).get("retry-after", "").strip()
+    try:
+        return max(0.0, float(raw)) if raw else default_s
+    except ValueError:
+        return default_s
+
+
+class FleetRouter:
+    """The prefix-affinity front door: one WSGI app (`self.app`) fronting
+    N model-server replicas with the same REST surface clients already
+    speak — `:generate` rides affinity + spill + bounded retry; the other
+    `/v1/*` endpoints proxy to any live replica.
+
+    Thread model: handler threads and the probe loop share the replica
+    registry/state under `_lock`; upstream I/O always happens OUTSIDE the
+    lock. The injectable `transport`/`signals`/`clock` keep every routing
+    decision unit-testable without sockets."""
+
+    def __init__(
+        self,
+        replicas: Tuple[Replica, ...] = (),
+        *,
+        affinity: bool = True,
+        page_size: int = DEFAULT_PAGE_SIZE,
+        spill_queue_per_slot: float = DEFAULT_SPILL_QUEUE_PER_SLOT,
+        retry_budget: int = DEFAULT_RETRY_BUDGET,
+        signals: Optional[Callable[[str], Optional[Dict[str, float]]]] = None,
+        replica_slots: int = 0,
+        transport: Optional[Transport] = None,
+        probe_interval_s: float = DEFAULT_PROBE_INTERVAL_S,
+        clock: Callable[[], float] = time.monotonic,
+        statusz_enabled: bool = True,
+    ) -> None:
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        if spill_queue_per_slot < 0:
+            raise ValueError("spill_queue_per_slot must be >= 0")
+        if retry_budget < 0:
+            raise ValueError("retry_budget must be >= 0")
+        if probe_interval_s <= 0:
+            raise ValueError("probe_interval_s must be > 0")
+        self.affinity = bool(affinity)
+        self.page_size = int(page_size)
+        self.spill_queue_per_slot = float(spill_queue_per_slot)
+        self.retry_budget = int(retry_budget)
+        self.probe_interval_s = float(probe_interval_s)
+        self._signals = signals
+        # spill denominator when no collector is wired (the standalone
+        # pod): the replicas' slot capacity, rendered by the controller
+        # as KFT_ROUTER_REPLICA_SLOTS from the one ServingConfig.
+        # 0 = unknown (in-flight compares against slots=1).
+        self.replica_slots = int(replica_slots)
+        self._transport: Transport = transport or default_transport
+        # probes get their OWN short-deadline transport: a wedged replica
+        # must cost one probe-interval, not UPSTREAM_TIMEOUT_S, or the
+        # whole health loop freezes behind it. An injected transport is
+        # used as-is (tests own their timing).
+        if transport is not None:
+            self._probe_transport: Transport = transport
+        else:
+            self._probe_transport = (
+                lambda method, url, body, headers: default_transport(
+                    method, url, body, headers,
+                    timeout_s=max(1.0, float(probe_interval_s)),
+                )
+            )
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, Replica] = {}
+        self._states: Dict[str, _ReplicaState] = {}
+        self._inflight: Dict[str, int] = {}
+        # drain() flips this: new proxied requests answer 429 +
+        # Retry-After (the caller retries another router / the VIP)
+        # while in-flight ones finish — without the gate a sustained
+        # client stream would keep the in-flight count nonzero and
+        # drain could never converge. _proxying counts requests from
+        # the moment they pass the gate to _forward's return (the
+        # engine's _admitting pattern): per-replica _inflight only
+        # covers the transport call, and the gaps around it — ordering,
+        # between retry attempts — must not be invisible to drain()
+        self._draining = False
+        self._proxying = 0
+        for r in replicas:
+            self._replicas[r.id] = r
+            self._states[r.id] = _ReplicaState()
+        self._rr = 0  # round-robin cursor for the no-affinity spray path
+        self._tracer = default_tracer()
+        self._requests = router_requests_counter()
+        self._affinity_hits = router_affinity_hits_counter()
+        self._spills = router_spills_counter()
+        self._retries = router_retries_counter()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.app = self._build()
+        if statusz_enabled:
+            from kubeflow_tpu.observability.http import add_debug_routes
+
+            add_debug_routes(
+                self.app,
+                statusz_sections=[("router", self._statusz_lines)],
+                role="router",
+            )
+
+    # -- replica registry --------------------------------------------------
+
+    def set_replicas(self, replicas) -> None:
+        """Replace the registry (a scale event); surviving ids keep their
+        health state so a re-render cannot resurrect a demoted replica."""
+        with self._lock:
+            keep = {r.id: r for r in replicas}
+            self._replicas = keep
+            self._states = {
+                rid: self._states.get(rid, _ReplicaState()) for rid in keep
+            }
+            self._inflight = {
+                rid: self._inflight.get(rid, 0) for rid in keep
+            }
+
+    def add_replica(self, replica: Replica) -> None:
+        with self._lock:
+            self._replicas[replica.id] = replica
+            self._states.setdefault(replica.id, _ReplicaState())
+
+    def remove_replica(self, replica_id: str) -> None:
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+            self._states.pop(replica_id, None)
+            self._inflight.pop(replica_id, None)
+
+    def replica_states(self) -> Dict[str, Dict[str, Any]]:
+        """Snapshot for healthz/statusz/tests."""
+        with self._lock:
+            now = self._clock()
+            return {
+                rid: {
+                    "base_url": self._replicas[rid].base_url,
+                    "healthy": st.healthy,
+                    "draining": st.draining,
+                    "demoted": not st.available(now),
+                    "fails": st.fails,
+                    "last_error": st.last_error,
+                }
+                for rid, st in self._states.items()
+            }
+
+    # -- health bookkeeping ------------------------------------------------
+
+    def _note_ok(self, rid: str, clear_demotion: bool = True) -> None:
+        """Replica answered conclusively. `clear_demotion=False` is the
+        traffic path: it heals failure demotions (healthy again) but
+        must NOT cut short a live 429/Retry-After drain window — a 200
+        on some non-gated endpoint doesn't prove the drain ended, and
+        the advertised window is a promise to the drainer. The probe
+        path (an authoritative non-draining healthz) fully resets."""
+        with self._lock:
+            st = self._states.get(rid)
+            if st is None:
+                return
+            st.healthy = True
+            st.fails = 0
+            st.last_error = ""
+            if clear_demotion or self._clock() >= st.demoted_until:
+                st.draining = False
+                st.demoted_until = 0.0
+
+    def _note_failure(self, rid: str, err: str) -> None:
+        with self._lock:
+            st = self._states.get(rid)
+            if st is None:
+                return
+            st.healthy = False
+            st.fails += 1
+            st.last_error = err
+
+    def _note_draining(
+        self, rid: str, retry_after_s: float, draining: bool = True
+    ) -> None:
+        """A 429 (or a draining healthz): demote for the advertised (or
+        default) window — honoring Retry-After means the fleet stops
+        OFFERING traffic to the drainer, not just this one request.
+        `draining=False` is the queue-full 429 (no Retry-After header):
+        the replica is merely BUSY — it backs off the same way but must
+        not show as a phantom drain on healthz/statusz."""
+        with self._lock:
+            st = self._states.get(rid)
+            if st is None:
+                return
+            st.draining = draining
+            st.demoted_until = max(
+                st.demoted_until, self._clock() + max(0.0, retry_after_s)
+            )
+
+    # -- probing -----------------------------------------------------------
+
+    def probe_once(self) -> None:
+        """One health sweep: GET each replica's /healthz — CONCURRENTLY
+        and on the short probe-deadline transport, so one wedged replica
+        costs one probe interval, never the upstream request timeout or
+        the other replicas' verdicts. `draining` in the body (or a
+        non-ok verdict) demotes; a clean ok re-admits — the probe is how
+        a drained-then-restarted replica returns to rotation without
+        waiting for traffic to rediscover it."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._lock:
+            targets = list(self._replicas.values())
+        if not targets:
+            return
+
+        def _grab(rep: Replica):
+            try:
+                status, data, _ = self._probe_transport(
+                    "GET", rep.base_url + "/healthz", None, {}
+                )
+                return status, (json.loads(data) if data else {}), ""
+            except Exception as e:  # noqa: BLE001 - probes are best-effort
+                return None, None, f"{type(e).__name__}: {e}"
+
+        with ThreadPoolExecutor(max_workers=min(8, len(targets))) as pool:
+            results = list(pool.map(_grab, targets))
+        for rep, (status, doc, err) in zip(targets, results):
+            if status is None:
+                self._note_failure(rep.id, err)
+            elif doc.get("draining"):
+                self._note_draining(rep.id, self.probe_interval_s)
+            elif status < 500 and doc.get("ok"):
+                self._note_ok(rep.id)
+            else:
+                self._note_failure(rep.id, f"healthz {status}")
+
+    def start(self) -> None:
+        """Run the probe loop on a daemon thread until stop().
+        Restartable: a start() after stop() probes again."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="router-probe"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                log.exception("router probe sweep failed")
+            self._stop.wait(self.probe_interval_s)
+
+    def drain(self, deadline_s: float = 30.0) -> bool:
+        """Shutdown grace: flip the admission gate (new proxied requests
+        get 429 + Retry-After; /healthz reports draining so readiness
+        pulls this router from its endpoints), then wait (bounded) for
+        every in-flight proxied request to complete before the caller
+        stops the HTTP server — the router-side mirror of the replicas'
+        drain contract. The wsgi Server's handler threads are daemon, so
+        stopping it mid-proxy would kill exactly the requests the
+        fleet's drain machinery protects. Returns True when the router
+        went idle in time."""
+        with self._lock:
+            self._draining = True
+        deadline = self._clock() + max(0.0, float(deadline_s))
+        while True:
+            with self._lock:
+                busy = self._proxying
+            if busy == 0:
+                return True
+            if self._clock() >= deadline:
+                log.warning(
+                    "router drain deadline (%.1fs) expired with %d "
+                    "request(s) still in flight", deadline_s, busy,
+                )
+                return False
+            time.sleep(0.05)
+
+    # -- selection ---------------------------------------------------------
+
+    def _affinity_key(self, body: Dict[str, Any]) -> Optional[str]:
+        """The first row's first-page key, or None when the body has no
+        usable prompt (the replica's own validation will 400 it)."""
+        prompt = body.get("prompt_ids")
+        row = None
+        if isinstance(prompt, list) and prompt:
+            if isinstance(prompt[0], list):
+                row = prompt[0]
+            elif all(isinstance(t, int) for t in prompt):
+                row = prompt  # tolerate a flat row
+        if not row:
+            return None
+        try:
+            return first_page_key(row, self.page_size)
+        except (TypeError, ValueError):
+            return None
+
+    def _order_for(
+        self, key: Optional[str]
+    ) -> Tuple[List[Replica], bool]:
+        """Candidate replicas in attempt order plus the spill verdict.
+        Affinity keys rank by HRW (first = the prefix's home); keyless
+        requests spray round-robin. When every replica is demoted the
+        full registry is offered anyway — a stale demotion must degrade
+        to a retry, not a hard 503 while the fleet is actually fine."""
+        with self._lock:
+            now = self._clock()
+            live = [
+                self._replicas[rid]
+                for rid in self._replicas
+                if self._states[rid].available(now)
+            ]
+            if not live:
+                live = list(self._replicas.values())
+            if key is None and live:
+                start = self._rr % len(live)
+                self._rr += 1
+                return live[start:] + live[:start], False
+        if not live:
+            return [], False
+        by_id = {r.id: r for r in live}
+        order = [
+            by_id[rid] for rid in rendezvous_rank(key, list(by_id))
+        ]
+        spilled = False
+        if len(order) > 1:
+            sig = (
+                self._signals(order[0].id)
+                if self._signals is not None
+                else self._inflight_signals(order[0].id)
+            )
+            if sig:
+                slots = max(1.0, float(sig.get("num_slots") or 0.0))
+                depth = float(sig.get("queue_depth") or 0.0)
+                # strictly greater: an IDLE home must never spill, even
+                # at threshold 0 (">=" would divert 100% of traffic the
+                # moment an operator sets the knob to zero)
+                if depth / slots > self.spill_queue_per_slot:
+                    order[0], order[1] = order[1], order[0]
+                    spilled = True
+                    self._spills.inc()
+        return order, spilled
+
+    def _inflight_signals(self, rid: str) -> Dict[str, float]:
+        """The spill signal when no fleet collector is wired (the
+        standalone router pod): this router's own outstanding requests
+        against the replica — an exact queue-depth proxy for a
+        single-router fleet — over the controller-rendered slot
+        capacity (KFT_ROUTER_REPLICA_SLOTS; 0 = compare per single
+        slot)."""
+        with self._lock:
+            depth = float(self._inflight.get(rid, 0))
+        return {
+            "queue_depth": depth,
+            "num_slots": float(self.replica_slots) or 1.0,
+        }
+
+    # -- the routed request ------------------------------------------------
+
+    def _forward(
+        self,
+        req,
+        method: str,
+        path: str,
+        key: Optional[str],
+    ) -> Tuple[Any, int]:
+        """The attempt loop shared by every proxied route: walk the
+        candidate order, demoting on 429/connect-failure/5xx and
+        retrying within `retry_budget`; pass the first conclusive
+        replica verdict (including its 4xx) through unchanged. The
+        drain gate and the _proxying increment are ATOMIC (one lock
+        hold): a request either sees the gate or is counted — drain()
+        can never declare idle while an admitted request is between
+        attempts."""
+        with self._lock:
+            draining = self._draining
+            if not draining:
+                self._proxying += 1
+        if draining:
+            # shutdown gate: stop ADMITTING so drain() converges; the
+            # client's retry lands on another router / the Service VIP
+            self._requests.inc(outcome="rejected")
+            req.response_headers.append(("Retry-After", "1"))
+            raise HttpError(429, "router is draining for shutdown")
+        try:
+            return self._forward_admitted(req, method, path, key)
+        finally:
+            with self._lock:
+                self._proxying -= 1
+
+    def _forward_admitted(
+        self,
+        req,
+        method: str,
+        path: str,
+        key: Optional[str],
+    ) -> Tuple[Any, int]:
+        order, spilled = self._order_for(key)
+        if not order:
+            self._requests.inc(outcome="rejected")
+            raise HttpError(503, "no replicas registered")
+        payload = None
+        headers: Dict[str, str] = {}
+        if req.body is not None:
+            payload = json.dumps(req.body).encode()
+            headers["Content-Type"] = "application/json"
+        trace_id = req.headers.get("x-request-id")
+        if trace_id:
+            headers["X-Request-Id"] = trace_id
+        attempts = 0
+        retry_after_hint: Optional[float] = None
+        last_err = "no replica available"
+        for idx, rep in enumerate(order):
+            if attempts > self.retry_budget:
+                break
+            attempts += 1
+            on_affinity_target = key is not None and idx == 0 and not spilled
+            # in-flight accounting: the spill fallback's queue-depth
+            # proxy — incremented for exactly the duration the replica
+            # is working this attempt
+            with self._lock:
+                self._inflight[rep.id] = self._inflight.get(rep.id, 0) + 1
+            try:
+                with self._tracer.span(
+                    "request.route",
+                    trace_id=trace_id,
+                    replica=rep.id,
+                    attempt=attempts,
+                    affinity=on_affinity_target,
+                    spilled=spilled and idx == 0,
+                ):
+                    try:
+                        status, data, hdrs = self._transport(
+                            method, rep.base_url + path, payload, headers
+                        )
+                    except Exception as e:  # noqa: BLE001 - replica verdict
+                        last_err = f"{rep.id}: {type(e).__name__}: {e}"
+                        self._note_failure(rep.id, last_err)
+                        self._retries.inc()
+                        continue
+            finally:
+                with self._lock:
+                    self._inflight[rep.id] = max(
+                        0, self._inflight.get(rep.id, 0) - 1
+                    )
+            if status == 429:
+                # the drain contract: back off this replica for the
+                # advertised window, try the next rendezvous choice.
+                # No Retry-After header = queue-full, not draining —
+                # same backoff, no phantom drain flag.
+                ra = _parse_retry_after(hdrs)
+                self._note_draining(
+                    rep.id, ra, draining="retry-after" in hdrs
+                )
+                retry_after_hint = (
+                    ra if retry_after_hint is None
+                    else min(retry_after_hint, ra)
+                )
+                last_err = f"{rep.id}: 429 (retry-after {ra:g}s)"
+                self._retries.inc()
+                continue
+            if status >= 500:
+                last_err = f"{rep.id}: upstream {status}"
+                self._note_failure(rep.id, last_err)
+                self._retries.inc()
+                continue
+            # conclusive: success or the replica's own 4xx verdict —
+            # heals failure demotions but leaves a live drain window
+            # intact (clear_demotion=False)
+            self._note_ok(rep.id, clear_demotion=False)
+            self._requests.inc(outcome="ok" if status < 400 else "upstream_4xx")
+            if on_affinity_target and status < 400:
+                self._affinity_hits.inc()
+            for lower, canonical in _PASSTHROUGH_HEADERS:
+                if lower in hdrs:
+                    req.response_headers.append((canonical, hdrs[lower]))
+            try:
+                result = json.loads(data) if data else {}
+            except json.JSONDecodeError:
+                result = {"success": False, "log": "unparseable upstream body"}
+            return result, status
+        self._requests.inc(outcome="rejected")
+        if retry_after_hint is not None:
+            req.response_headers.append(
+                ("Retry-After", str(max(1, math.ceil(retry_after_hint))))
+            )
+        raise HttpError(
+            503,
+            f"no replica accepted the request after {attempts} "
+            f"attempt(s); last: {last_err}",
+        )
+
+    # -- WSGI surface ------------------------------------------------------
+
+    def _build(self) -> App:
+        app = App("kft-router")
+
+        @app.post("/v1/models/<name>:generate")
+        def generate(req):
+            body = req.body or {}
+            if not isinstance(body, dict):
+                raise BadRequest("request body must be a JSON object")
+            key = self._affinity_key(body) if self.affinity else None
+            return self._forward(
+                req, "POST", f"/v1/models/{req.params['name']}:generate", key
+            )
+
+        @app.post("/v1/models/<name>:predict")
+        def predict(req):
+            # :predict has no prefix to be affine to — spray it
+            return self._forward(
+                req, "POST", f"/v1/models/{req.params['name']}:predict", None
+            )
+
+        @app.get("/v1/models/<name>")
+        def model_status(req):
+            return self._forward(
+                req, "GET", f"/v1/models/{req.params['name']}", None
+            )
+
+        @app.get("/v1/models")
+        def list_models(req):
+            return self._forward(req, "GET", "/v1/models", None)
+
+        @app.get("/healthz")
+        def healthz(req):
+            states = self.replica_states()
+            available = sum(1 for s in states.values() if not s["demoted"])
+            with self._lock:
+                draining = self._draining
+            body = {
+                "ok": True,
+                "role": "router",
+                "draining": draining,
+                "replicas": {
+                    "total": len(states),
+                    "available": available,
+                    "draining": sum(
+                        1 for s in states.values() if s["draining"]
+                    ),
+                },
+            }
+            # same contract as the model server: 503 while draining so
+            # the readiness probe pulls this router from its endpoints
+            return (body, 503) if draining else body
+
+        return app
+
+    def _statusz_lines(self) -> List[str]:
+        lines = [
+            f"  affinity={'on' if self.affinity else 'off'} "
+            f"page_size={self.page_size} "
+            f"spill_queue_per_slot={self.spill_queue_per_slot:g} "
+            f"retry_budget={self.retry_budget}"
+        ]
+        states = self.replica_states()
+        for rid in sorted(states):
+            s = states[rid]
+            verdict = (
+                "draining" if s["draining"]
+                else ("demoted" if s["demoted"] else "ok")
+            )
+            err = f" ({s['last_error']})" if s["last_error"] else ""
+            lines.append(
+                f"  {rid:<24}{s['base_url']:<32}{verdict:<10}"
+                f"fails={s['fails']}{err}"
+            )
+        if not states:
+            lines.append("  <no replicas>")
+        return lines

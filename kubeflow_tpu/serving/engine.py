@@ -97,6 +97,7 @@ from kubeflow_tpu.serving.sampling import (
     speculative_accept,
 )
 from kubeflow_tpu.utils.logging import get_logger
+from kubeflow_tpu.routing.affinity import first_page_key
 from kubeflow_tpu.utils.metrics import (
     serving_accept_rate_histogram,
     serving_decode_steps_counter,
@@ -128,6 +129,12 @@ log = get_logger(__name__)
 _SALT_DRAFT = 1
 _SALT_ACCEPT = 2
 _SALT_CORRECT = 3
+
+# first-page-key cardinality bound: the stats()["first_page_hashes"]
+# distinct-count stops growing here (~160 KB of hex digests), so
+# all-unique production traffic cannot leak host memory through a
+# diagnostic counter
+FIRST_PAGE_KEYS_CAP = 4096
 
 
 class QueueFullError(RuntimeError):
@@ -1173,6 +1180,16 @@ class DecodeEngine:
         self._verifies = 0
         self._prefix_hit_tokens = 0
         self._prefix_lookups = 0
+        # distinct first-page hashes admitted (routing/affinity.py — the
+        # SAME key the kft-router shards on): per-replica cardinality is
+        # the fleet-routing evidence — affinity-routed replicas each see
+        # a near-disjoint slice of the key space, sprayed replicas all
+        # see most of it (bench_serving_router asserts exactly this
+        # without scraping raw counters). Bounded: past the cap the
+        # count saturates instead of growing host memory forever under
+        # all-unique traffic — "is this replica's key space sharded or
+        # sprayed" is answered orders of magnitude below the cap.
+        self._first_page_keys: set = set()
         self._cow_copies = 0
         self._prefill_compute_tokens = 0
         self._pages_allocated = 0
@@ -1292,6 +1309,14 @@ class DecodeEngine:
             self._queue.extend(reqs)
             self._queue_depth.set(len(self._queue), model=self.name)
             self._cv.notify_all()
+        # admitted for real: record each row's first-page affinity key
+        # (the router's sharding unit) for the stats cardinality
+        with self._stats_lock:
+            for req in reqs:
+                if len(self._first_page_keys) < FIRST_PAGE_KEYS_CAP:
+                    self._first_page_keys.add(
+                        first_page_key(req.prompt, self.page_size)
+                    )
 
     def submit(
         self,
@@ -1376,6 +1401,18 @@ class DecodeEngine:
                 ),
                 "prefix_lookups": self._prefix_lookups,
                 "prefix_hit_tokens": self._prefix_hit_tokens,
+                # fraction of prompt tokens served from the radix cache
+                # (hit / (hit + actually prefilled)); the router bench's
+                # fleet-wide cache verdict reads this, not raw counters
+                "prefix_cache_hit_rate": (
+                    self._prefix_hit_tokens
+                    / (self._prefix_hit_tokens + self._prefill_compute_tokens)
+                    if (self._prefix_hit_tokens + self._prefill_compute_tokens)
+                    else 0.0
+                ),
+                # distinct first-page affinity keys admitted (see
+                # routing/affinity.py): the per-replica key-space slice
+                "first_page_hashes": len(self._first_page_keys),
                 "cow_copies": self._cow_copies,
                 "prefill_compute_tokens": self._prefill_compute_tokens,
                 "pages_allocated": self._pages_allocated,
@@ -1424,6 +1461,14 @@ class DecodeEngine:
             "recent": recent,
             "stats": self.stats(),
         }
+
+    @property
+    def draining(self) -> bool:
+        """True once drain() flipped the admission gate (new submits get
+        429 + Retry-After) — the /healthz "draining, not dead" signal
+        the readiness probe and the kft-router read."""
+        with self._cv:
+            return self._draining
 
     def drain(self, deadline_s: float = 30.0) -> bool:
         """Draining shutdown: flip the admission gate (new submits get

@@ -284,6 +284,11 @@ class ModelServer:
         )
 
         self.drain_deadline_s = DEFAULT_DRAIN_DEADLINE_S
+        # flips at close(drain=True) entry so /healthz reports the drain
+        # the moment it starts — k8s readiness and the kft-router probe
+        # both read it to tell "draining" from "dead" instead of
+        # inferring it from 429s
+        self._draining = False
         self.app = self._build()
         if statusz_enabled:
             from kubeflow_tpu.observability.http import add_debug_routes
@@ -338,8 +343,10 @@ class ModelServer:
                 f"{'on' if state['prefix_cache'] else 'off'} "
                 f"nodes={state['prefix_nodes']} "
                 f"hit_tokens={st['prefix_hit_tokens']} "
+                f"hit_rate={st['prefix_cache_hit_rate']:.3f} "
                 f"lookups={st['prefix_lookups']} "
-                f"cow={st['cow_copies']}"
+                f"cow={st['cow_copies']} "
+                f"first_page_hashes={st['first_page_hashes']}"
             )
             for s in state["slots"]:
                 if s is not None:
@@ -397,6 +404,8 @@ class ModelServer:
         if drain_deadline_s is None:
             drain_deadline_s = self.drain_deadline_s
         drained = True
+        if drain:
+            self._draining = True
         if drain and self._engines:
             results: Dict[str, bool] = {}
 
@@ -536,6 +545,26 @@ class ModelServer:
 
     def _build(self) -> App:
         app = App("model-server")
+
+        @app.get("/healthz")
+        def healthz(req):
+            """Liveness/readiness verdict that DISTINGUISHES draining
+            from dead: {"ok", "draining", "models"}. A draining replica
+            (close(drain=True) underway, or any engine mid-drain)
+            answers 503 so the k8s readiness probe pulls it from the
+            Service endpoints and the kft-router demotes it — while a
+            dead replica answers nothing at all. Clients that only 429'd
+            against a drainer could never tell the two apart."""
+            names = sorted(
+                set(self._models)
+                | set(self._lms)
+                | set(self._engines)
+            )
+            draining = self._draining or any(
+                e.draining for e in self._engines.values()
+            )
+            body = {"ok": True, "draining": draining, "models": names}
+            return (body, 503) if draining else body
 
         @app.get("/v1/models/<name>")
         def model_status(req):

@@ -859,6 +859,48 @@ def serving_num_slots_gauge() -> Gauge:
     )
 
 
+def router_requests_counter() -> Counter:
+    """Requests through the fleet router by outcome: "ok" (a replica
+    answered 2xx/3xx), "upstream_4xx" (the replica's own client-error
+    verdict, passed through), "rejected" (retry budget exhausted or no
+    replicas — the router's clean 503)."""
+    return default_registry().counter(
+        "router_requests_total",
+        "requests through the fleet router by outcome",
+        ["outcome"],
+    )
+
+
+def router_affinity_hits_counter() -> Counter:
+    """Requests served by their prefix-affinity home — the first
+    rendezvous choice for the prompt's first-page hash. The fleet-wide
+    prefix-cache story rides this ratio: hits land where the radix
+    chain already lives (kubeflow_tpu/routing/)."""
+    return default_registry().counter(
+        "router_affinity_hits_total",
+        "requests routed to their first-choice (HRW) affinity replica",
+    )
+
+
+def router_spills_counter() -> Counter:
+    """Affinity requests diverted to their SECOND rendezvous choice
+    because the home replica's queue depth per slot breached the spill
+    threshold (router spill_queue_per_slot knob)."""
+    return default_registry().counter(
+        "router_spill_total",
+        "affinity requests spilled to the second rendezvous choice",
+    )
+
+
+def router_retries_counter() -> Counter:
+    """Replica attempts abandoned for the next rendezvous choice after a
+    429 (draining, Retry-After honored), a connect failure or a 5xx."""
+    return default_registry().counter(
+        "router_retry_total",
+        "replica attempts retried against another replica",
+    )
+
+
 def fleet_slo_compliant_gauge(registry: Optional[MetricsRegistry] = None) -> Gauge:
     """1 while the SLO rule's current fleet-level value satisfies its
     threshold, 0 while breached (kubeflow_tpu/observability/slo.py)."""

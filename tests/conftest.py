@@ -70,6 +70,9 @@ _COMPILE_CACHE_MODULES = frozenset({
     "test_pipeline",
     "test_models",
     "test_observability",
+    # engine-program family only (the gpt_and_params engines test_engine
+    # already soaks) — the router core itself never touches jax
+    "test_routing",
 })
 
 # One persistent dir shared with bench.py's battery cache: the workspace

@@ -1,0 +1,785 @@
+"""kft-router tests: affinity keys + HRW stability under membership
+change, the routing core (drain demotion with Retry-After honored,
+load-aware spill, bounded retry → clean 503), store discovery, the
+InferenceService controller's router render, the /healthz satellite,
+the engine's affinity-stats surface, the entrypoint env roundtrip, and
+the @slow two-replica socket e2e (shared-prefix requests land on ONE
+replica; greedy output through the router stays bitwise vs direct).
+
+Unit tests route against dict-driven fake transports (no sockets, no
+models); the engine-backed tests ride the session-scoped gpt_and_params
+fixture (conftest.py)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.cluster.objects import new_object
+from kubeflow_tpu.cluster.reconciler import ControllerManager
+from kubeflow_tpu.cluster.store import StateStore
+from kubeflow_tpu.controllers.inference import (
+    InferenceServiceController,
+    new_inference_service,
+)
+from kubeflow_tpu.controllers.statefulset import DeploymentController
+from kubeflow_tpu.routing import (
+    FleetRouter,
+    Replica,
+    discover_replicas,
+    first_page_key,
+    rendezvous_rank,
+)
+from kubeflow_tpu.routing.__main__ import knobs_from_env, parse_replicas
+
+
+def _ok_body(sequences=((1, 2, 3),)):
+    return json.dumps(
+        {"sequences": [list(s) for s in sequences]}
+    ).encode()
+
+
+def ok_handler(method, path, body, headers):
+    return 200, _ok_body(), {"x-ttft-ms": "1.00"}
+
+
+def drain_handler(retry_after="3"):
+    def handler(method, path, body, headers):
+        return (
+            429,
+            json.dumps({"success": False, "log": "draining"}).encode(),
+            {"retry-after": retry_after},
+        )
+
+    return handler
+
+
+class FakeFleet:
+    """Dict-driven transport: replica id -> handler; records every call
+    so tests assert WHERE requests landed."""
+
+    def __init__(self):
+        self.handlers = {}
+        self.calls = []
+        self.lock = threading.Lock()
+
+    def add(self, rid, handler=ok_handler) -> Replica:
+        self.handlers[rid] = handler
+        return Replica(rid, f"http://{rid}")
+
+    def transport(self, method, url, body, headers):
+        rid, _, path = url[len("http://"):].partition("/")
+        with self.lock:
+            self.calls.append((rid, "/" + path))
+        return self.handlers[rid](method, "/" + path, body, headers)
+
+    def calls_to(self, rid):
+        with self.lock:
+            return [c for c in self.calls if c[0] == rid]
+
+
+def gen_body(prompt, n=2):
+    return {"prompt_ids": [list(int(t) for t in prompt)], "max_new_tokens": n}
+
+
+def prompt_with_page(page, tail):
+    """page (page_size tokens) + tail — same page => same affinity key."""
+    return list(page) + list(tail)
+
+
+PAGE = list(range(100, 116))  # one 16-token page
+
+
+class TestAffinityKeys:
+    def test_first_page_key_is_page_aligned(self):
+        a = first_page_key(PAGE + [1, 2, 3], 16)
+        b = first_page_key(PAGE + [9, 9, 9, 9], 16)
+        c = first_page_key([0] + PAGE[1:] + [1, 2, 3], 16)
+        assert a == b  # divergence past the first page is invisible
+        assert a != c  # divergence inside the first page changes the key
+        # shorter than a page: keys on what it has, deterministically
+        assert first_page_key([1, 2], 16) == first_page_key([1, 2], 16)
+        assert first_page_key([1, 2], 16) != first_page_key([1, 3], 16)
+
+    def test_rendezvous_deterministic(self):
+        ids = ["a", "b", "c"]
+        key = first_page_key(PAGE, 16)
+        assert rendezvous_rank(key, ids) == rendezvous_rank(key, ids)
+
+    def test_rendezvous_minimal_reshuffle_on_remove(self):
+        ids = ["a", "b", "c"]
+        keys = [first_page_key([i, i + 1], 16) for i in range(100)]
+        before = {k: rendezvous_rank(k, ids)[0] for k in keys}
+        after = {k: rendezvous_rank(k, ["a", "b"])[0] for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        # ONLY the removed replica's keys move — everyone else keeps
+        # their replica (and its warm radix chain)
+        assert all(before[k] == "c" for k in moved)
+        assert any(before[k] == "c" for k in keys)
+
+    def test_rendezvous_minimal_reshuffle_on_add(self):
+        ids = ["a", "b", "c"]
+        keys = [first_page_key([i, i + 1], 16) for i in range(100)]
+        before = {k: rendezvous_rank(k, ids)[0] for k in keys}
+        after = {k: rendezvous_rank(k, ids + ["d"])[0] for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        # a new replica steals only the keys it now wins
+        assert all(after[k] == "d" for k in moved)
+        assert 0 < len(moved) < len(keys)
+
+
+def affinity_top(key_prompt, ids, page_size=16):
+    return rendezvous_rank(first_page_key(key_prompt, page_size), ids)
+
+
+class TestRouterCore:
+    def _router(self, fleet, replicas, **kw):
+        kw.setdefault("page_size", 16)
+        return FleetRouter(
+            tuple(replicas), transport=fleet.transport, **kw
+        )
+
+    def test_affinity_sticks_to_one_replica(self):
+        fleet = FakeFleet()
+        reps = [fleet.add(r) for r in ("a", "b", "c")]
+        router = self._router(fleet, reps)
+        hits0 = router._affinity_hits.value()
+        for i in range(8):
+            status, body = router.app.handle(
+                "POST",
+                "/v1/models/m:generate",
+                body=gen_body(prompt_with_page(PAGE, [i])),
+            )
+            assert status == 200 and body["sequences"]
+        landed = {c[0] for c in fleet.calls}
+        assert len(landed) == 1  # every shared-page request: ONE replica
+        assert landed == {affinity_top(PAGE, ["a", "b", "c"])[0]}
+        assert router._affinity_hits.value() - hits0 == 8
+
+    def test_spray_round_robin_spreads(self):
+        fleet = FakeFleet()
+        reps = [fleet.add(r) for r in ("a", "b")]
+        router = self._router(fleet, reps, affinity=False)
+        for i in range(4):
+            status, _ = router.app.handle(
+                "POST", "/v1/models/m:generate",
+                body=gen_body(prompt_with_page(PAGE, [i])),
+            )
+            assert status == 200
+        assert len(fleet.calls_to("a")) == 2
+        assert len(fleet.calls_to("b")) == 2
+
+    def test_draining_replica_demoted_and_retry_after_honored(self):
+        fleet = FakeFleet()
+        reps = [fleet.add(r) for r in ("a", "b")]
+        top = affinity_top(PAGE, ["a", "b"])[0]
+        other = "b" if top == "a" else "a"
+        fleet.handlers[top] = drain_handler(retry_after="30")
+        now = [0.0]
+        router = self._router(fleet, reps, clock=lambda: now[0])
+        # first request: 429 at the affinity home, retried to the other
+        status, body = router.app.handle(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert status == 200
+        assert [c[0] for c in fleet.calls] == [top, other]
+        assert router.replica_states()[top]["draining"]
+        # within the Retry-After window the drainer is NOT offered
+        fleet.calls.clear()
+        status, _ = router.app.handle(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert status == 200
+        assert [c[0] for c in fleet.calls] == [other]
+        # past the window the home replica is offered again (recovered)
+        fleet.handlers[top] = ok_handler
+        now[0] = 31.0
+        fleet.calls.clear()
+        status, _ = router.app.handle(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert status == 200
+        assert [c[0] for c in fleet.calls] == [top]
+
+    def test_traffic_200_does_not_cut_a_drain_window_short(self):
+        """A 200 from a non-gated endpoint (reached via the all-demoted
+        fallback) heals failure state but must not clear a live
+        429/Retry-After demotion — the advertised window is honored
+        until it expires or a probe confirms recovery."""
+        fleet = FakeFleet()
+
+        def drain_generate_ok_get(method, path, body, headers):
+            if method == "GET":
+                return 200, json.dumps({"models": []}).encode(), {}
+            return drain_handler("30")(method, path, body, headers)
+
+        reps = [fleet.add("a", drain_generate_ok_get)]
+        now = [0.0]
+        router = self._router(fleet, reps, clock=lambda: now[0])
+        status, _ = router.app.handle(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert status == 503  # sole replica draining
+        assert router.replica_states()["a"]["demoted"]
+        status, _ = router.app.handle("GET", "/v1/models")
+        assert status == 200  # served via the all-demoted fallback
+        st = router.replica_states()["a"]
+        assert st["demoted"] and st["draining"]  # window still holds
+        now[0] = 31.0
+        assert not router.replica_states()["a"]["demoted"]
+
+    def test_spill_to_second_choice_when_home_is_hot(self):
+        fleet = FakeFleet()
+        reps = [fleet.add(r) for r in ("a", "b", "c")]
+        order = affinity_top(PAGE, ["a", "b", "c"])
+        hot = {order[0]}
+
+        def signals(rid):
+            if rid in hot:
+                return {"queue_depth": 16.0, "num_slots": 4.0}
+            return {"queue_depth": 0.0, "num_slots": 4.0}
+
+        router = self._router(
+            fleet, reps, signals=signals, spill_queue_per_slot=2.0
+        )
+        spills0 = router._spills.value()
+        hits0 = router._affinity_hits.value()
+        status, _ = router.app.handle(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert status == 200
+        # landed on the SECOND rendezvous choice, counted as a spill,
+        # not as an affinity hit
+        assert [c[0] for c in fleet.calls] == [order[1]]
+        assert router._spills.value() - spills0 == 1
+        assert router._affinity_hits.value() - hits0 == 0
+
+    def test_zero_threshold_never_spills_an_idle_home(self):
+        fleet = FakeFleet()
+        reps = [fleet.add(r) for r in ("a", "b")]
+
+        def signals(rid):
+            return {"queue_depth": 0.0, "num_slots": 4.0}
+
+        router = self._router(
+            fleet, reps, signals=signals, spill_queue_per_slot=0.0
+        )
+        top = affinity_top(PAGE, ["a", "b"])[0]
+        status, _ = router.app.handle(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        # strictly-greater: threshold 0 with an idle home must still
+        # route to the affinity home, not divert 100% of traffic
+        assert status == 200
+        assert [c[0] for c in fleet.calls] == [top]
+
+    def test_inflight_fallback_spills_without_a_collector(self):
+        fleet = FakeFleet()
+        reps = [fleet.add(r) for r in ("a", "b", "c")]
+        order = affinity_top(PAGE, ["a", "b", "c"])
+        router = self._router(
+            fleet, reps, spill_queue_per_slot=1.0, replica_slots=2
+        )
+        # no signals wired: the router's own in-flight count is the
+        # spill signal (the standalone-pod path). Mark the home busy
+        # past threshold x slots and the next request takes the second
+        # rendezvous choice.
+        with router._lock:
+            router._inflight[order[0]] = 3  # 3/2 > 1.0
+        status, _ = router.app.handle(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert status == 200
+        assert [c[0] for c in fleet.calls] == [order[1]]
+
+    def test_retry_budget_exhaustion_is_clean_503(self):
+        fleet = FakeFleet()
+        reps = [fleet.add(r, drain_handler("7")) for r in ("a", "b", "c")]
+        router = self._router(fleet, reps, retry_budget=2)
+        rejected0 = router._requests.value(outcome="rejected")
+        status, body, headers = router.app.handle_full(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert status == 503
+        assert "no replica accepted" in body["log"]
+        assert len(fleet.calls) == 3  # 1 + retry_budget attempts
+        assert router._requests.value(outcome="rejected") - rejected0 == 1
+        # the drain's Retry-After survives to the client
+        assert dict(headers).get("Retry-After") == "7"
+
+    def test_connect_failure_demotes_then_probe_readmits(self):
+        fleet = FakeFleet()
+        reps = [fleet.add(r) for r in ("a", "b")]
+        top = affinity_top(PAGE, ["a", "b"])[0]
+        other = "b" if top == "a" else "a"
+
+        def boom(method, path, body, headers):
+            raise ConnectionError("refused")
+
+        fleet.handlers[top] = boom
+        router = self._router(fleet, reps)
+        status, _ = router.app.handle(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert status == 200
+        assert [c[0] for c in fleet.calls] == [top, other]
+        assert not router.replica_states()[top]["healthy"]
+        # demoted: the next request goes straight to the survivor
+        fleet.calls.clear()
+        router.app.handle(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert [c[0] for c in fleet.calls] == [other]
+        # recovery: a clean healthz probe re-admits it
+        def healthz_ok(method, path, body, headers):
+            assert path == "/healthz"
+            return 200, json.dumps(
+                {"ok": True, "draining": False, "models": ["m"]}
+            ).encode(), {}
+
+        fleet.handlers[top] = healthz_ok
+        router.probe_once()
+        assert router.replica_states()[top]["healthy"]
+
+    def test_probe_demotes_draining_healthz(self):
+        fleet = FakeFleet()
+        reps = [fleet.add(r) for r in ("a", "b")]
+
+        def healthz_draining(method, path, body, headers):
+            return 503, json.dumps(
+                {"ok": True, "draining": True, "models": ["m"]}
+            ).encode(), {}
+
+        fleet.handlers["a"] = healthz_draining
+        fleet.handlers["b"] = lambda m, p, b, h: (
+            200,
+            json.dumps({"ok": True, "draining": False, "models": []}).encode(),
+            {},
+        )
+        now = [0.0]
+        router = self._router(fleet, reps, clock=lambda: now[0])
+        router.probe_once()
+        states = router.replica_states()
+        assert states["a"]["draining"] and states["a"]["demoted"]
+        assert not states["b"]["demoted"]
+
+    def test_upstream_4xx_passes_through_without_retry(self):
+        fleet = FakeFleet()
+
+        def bad(method, path, body, headers):
+            return 400, json.dumps(
+                {"success": False, "log": "bad prompt"}
+            ).encode(), {}
+
+        reps = [fleet.add("a", bad), fleet.add("b", bad)]
+        router = self._router(fleet, reps)
+        status, body = router.app.handle(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert status == 400 and body["log"] == "bad prompt"
+        assert len(fleet.calls) == 1  # a replica's 4xx verdict is final
+
+    def test_no_replicas_is_503(self):
+        router = FleetRouter((), transport=FakeFleet().transport)
+        status, body = router.app.handle(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert status == 503
+
+    def test_other_endpoints_proxied(self):
+        fleet = FakeFleet()
+
+        def list_models(method, path, body, headers):
+            assert (method, path) == ("GET", "/v1/models")
+            return 200, json.dumps({"models": [{"name": "m"}]}).encode(), {}
+
+        reps = [fleet.add("a", list_models)]
+        router = self._router(fleet, reps)
+        status, body = router.app.handle("GET", "/v1/models")
+        assert status == 200 and body["models"][0]["name"] == "m"
+
+    def test_router_healthz_reports_fleet(self):
+        fleet = FakeFleet()
+        reps = [fleet.add(r) for r in ("a", "b")]
+        router = self._router(fleet, reps)
+        status, body = router.app.handle("GET", "/healthz")
+        assert status == 200
+        assert body["draining"] is False
+        assert body["replicas"] == {
+            "total": 2, "available": 2, "draining": 0,
+        }
+
+    def test_drain_gates_new_admissions_and_flips_healthz(self):
+        fleet = FakeFleet()
+        reps = [fleet.add(r) for r in ("a", "b")]
+        router = self._router(fleet, reps)
+        assert router.drain(deadline_s=1.0)  # idle: converges at once
+        # new admissions are rejected fast so in-flight stays drained —
+        # the client's retry lands on another router / the VIP
+        status, body, headers = router.app.handle_full(
+            "POST", "/v1/models/m:generate", body=gen_body(PAGE)
+        )
+        assert status == 429
+        assert dict(headers).get("Retry-After") == "1"
+        assert fleet.calls == []  # nothing reached a replica
+        # readiness contract: 503 + draining, same as the model server
+        status, body = router.app.handle("GET", "/healthz")
+        assert status == 503 and body["draining"] is True
+
+    def test_affinity_import_stays_light(self):
+        """The decode engine imports first_page_key through the routing
+        package; that must not drag in the router's wsgi/trace/metrics
+        surface (routing/__init__ resolves router exports lazily)."""
+        import subprocess
+        import sys
+
+        code = (
+            "import sys\n"
+            "from kubeflow_tpu.routing.affinity import first_page_key\n"
+            "heavy = [m for m in sys.modules if m in (\n"
+            "    'kubeflow_tpu.routing.router', 'kubeflow_tpu.api.wsgi',\n"
+            "    'kubeflow_tpu.observability.trace')]\n"
+            "assert not heavy, heavy\n"
+        )
+        subprocess.run(
+            [sys.executable, "-c", code], check=True, timeout=60,
+            cwd="/root/repo",
+        )
+
+
+class TestDiscovery:
+    def _pod(self, name, ns="default", labels=None, pod_ip=""):
+        pod = new_object(
+            "Pod", name, ns, api_version="v1",
+            labels=dict(labels or {}), spec={},
+        )
+        if pod_ip:
+            pod["status"] = {"podIP": pod_ip}
+        return pod
+
+    def test_fleet_collector_scrapes_router_pods_as_router_role(self):
+        """The router pod (inferenceservice-router label + advertised
+        metrics port) becomes a fleet scrape target with role "router"
+        — its router_* series join the aggregation — while NEVER
+        counting as a serving replica."""
+        from kubeflow_tpu.observability.fleet import discover_targets
+
+        store = StateStore()
+        pod = new_object(
+            "Pod", "svc-router-0", "default", api_version="v1",
+            labels={"app": "kft-router", "inferenceservice-router": "svc"},
+            spec={"containers": [{
+                "name": "router",
+                "env": [
+                    {"name": "KFT_FLEET_METRICS_PORT", "value": "8600"},
+                ],
+            }]},
+        )
+        store.create(pod)
+        targets = discover_targets(store)
+        assert [(t.role, t.owner, t.base_url) for t in targets] == [
+            ("router", "svc", "http://svc-router-0:8600"),
+        ]
+
+    def test_discovers_labeled_pods_in_namespace(self):
+        store = StateStore()
+        store.create(self._pod(
+            "svc-0", labels={"inferenceservice": "svc"}, pod_ip="10.0.0.1"
+        ))
+        store.create(self._pod("svc-1", labels={"inferenceservice": "svc"}))
+        store.create(self._pod(
+            "other-0", labels={"inferenceservice": "other"}
+        ))
+        store.create(self._pod(
+            "svc-9", ns="elsewhere", labels={"inferenceservice": "svc"}
+        ))
+        store.create(self._pod("plain-0"))
+        reps = discover_replicas(store, "default", "svc")
+        assert [(r.id, r.base_url) for r in reps] == [
+            ("svc-0", "http://10.0.0.1:8500"),  # pod IP preferred
+            ("svc-1", "http://svc-1:8500"),     # bare-name fallback
+        ]
+
+
+class TestControllerRender:
+    def _reconcile(self, serving=None, replicas=2):
+        store = StateStore()
+        cm = ControllerManager(store)
+        cm.register(DeploymentController())
+        cm.register(InferenceServiceController())
+        store.create(new_inference_service(
+            "lm", "team-a", model="gpt_small", replicas=replicas,
+            serving=serving or {},
+        ))
+        cm.run_until_idle(max_seconds=5)
+        return store, cm
+
+    def test_router_disabled_by_default(self):
+        store, _ = self._reconcile()
+        assert store.try_get("Deployment", "lm-router", "team-a") is None
+        assert store.try_get("Service", "lm-router", "team-a") is None
+
+    def test_router_render_env_and_service(self):
+        store, _ = self._reconcile(serving={
+            "page_size": 8,
+            "router": {
+                "enabled": True,
+                "spill_queue_per_slot": 1.5,
+                "retry_budget": 4,
+            },
+        }, replicas=3)
+        dep = store.get("Deployment", "lm-router", "team-a")
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert c["command"][:3] == ["python", "-m", "kubeflow_tpu.routing"]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env == {
+            "KFT_ROUTER_AFFINITY": "1",
+            # the hash granularity IS the fleet's page granularity —
+            # rendered from the ONE ServingConfig.page_size
+            "KFT_ROUTER_PAGE_SIZE": "8",
+            "KFT_ROUTER_SPILL_QUEUE_PER_SLOT": "1.5",
+            "KFT_ROUTER_RETRY_BUDGET": "4",
+            # spill denominator for the in-flight fallback signal —
+            # the replicas' own slot capacity
+            "KFT_ROUTER_REPLICA_SLOTS": "8",
+            # the replica registry: the workload controller's stable pod
+            # names, re-rendered on every scale event
+            "KFT_ROUTER_REPLICAS": (
+                "lm-0=http://lm-0:8500,lm-1=http://lm-1:8500,"
+                "lm-2=http://lm-2:8500"
+            ),
+            "KFT_FLEET_METRICS_PORT": "8600",
+        }
+        assert c["readinessProbe"]["httpGet"] == {
+            "path": "/healthz", "port": 8600,
+        }
+        svc = store.get("Service", "lm-router", "team-a")
+        assert svc["spec"]["selector"] == {"inferenceservice-router": "lm"}
+        assert svc["spec"]["ports"][0]["port"] == 8600
+        # the router pod must NOT carry the replica label (it would join
+        # the Service VIP and the fleet collector's replica counts)
+        labels = dep["spec"]["template"]["metadata"]["labels"]
+        assert "inferenceservice" not in labels
+
+    def test_scale_event_rerenders_registry(self):
+        store, cm = self._reconcile(
+            serving={"router": {"enabled": True}}, replicas=1
+        )
+        cr = store.get("InferenceService", "lm", "team-a")
+        cr["spec"]["replicas"] = 2
+        store.update(cr)
+        cm.run_until_idle(max_seconds=5)
+        dep = store.get("Deployment", "lm-router", "team-a")
+        env = {
+            e["name"]: e["value"]
+            for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert env["KFT_ROUTER_REPLICAS"] == (
+            "lm-0=http://lm-0:8500,lm-1=http://lm-1:8500"
+        )
+
+    def test_disable_tears_router_down(self):
+        store, cm = self._reconcile(serving={"router": {"enabled": True}})
+        assert store.try_get("Deployment", "lm-router", "team-a")
+        cr = store.get("InferenceService", "lm", "team-a")
+        cr["spec"]["serving"]["router"]["enabled"] = False
+        store.update(cr)
+        cm.run_until_idle(max_seconds=5)
+        assert store.try_get("Deployment", "lm-router", "team-a") is None
+        assert store.try_get("Service", "lm-router", "team-a") is None
+
+    def test_serving_container_gets_readiness_probe(self):
+        store, _ = self._reconcile()
+        dep = store.get("Deployment", "lm", "team-a")
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert c["readinessProbe"]["httpGet"] == {
+            "path": "/healthz", "port": 8500,
+        }
+
+    def test_invalid_router_config_rejected(self):
+        from kubeflow_tpu.config.core import ConfigError
+
+        ctl = InferenceServiceController()
+        with pytest.raises(ConfigError, match="retry_budget"):
+            ctl._serving_cfg(
+                {"serving": {"router": {"retry_budget": -1}}}
+            )
+
+
+class TestEntrypointKnobs:
+    def test_env_roundtrip_matches_controller_render(self):
+        knobs = knobs_from_env({
+            "KFT_ROUTER_AFFINITY": "0",
+            "KFT_ROUTER_PAGE_SIZE": "8",
+            "KFT_ROUTER_SPILL_QUEUE_PER_SLOT": "1.5",
+            "KFT_ROUTER_RETRY_BUDGET": "4",
+            "KFT_ROUTER_REPLICA_SLOTS": "8",
+            "KFT_ROUTER_REPLICAS": "r0=http://h0:8500,r1=http://h1:8500",
+        })
+        assert knobs["affinity"] is False
+        assert knobs["page_size"] == 8
+        assert knobs["spill_queue_per_slot"] == 1.5
+        assert knobs["retry_budget"] == 4
+        assert knobs["replica_slots"] == 8
+        assert knobs["replicas"] == [
+            Replica("r0", "http://h0:8500"),
+            Replica("r1", "http://h1:8500"),
+        ]
+
+    def test_env_defaults(self):
+        knobs = knobs_from_env({})
+        assert knobs["affinity"] is True
+        assert knobs["page_size"] == 16
+        assert knobs["spill_queue_per_slot"] == 2.0
+        assert knobs["retry_budget"] == 2
+        assert knobs["replica_slots"] == 0
+        assert knobs["replicas"] == []
+
+    def test_parse_replicas_bare_url(self):
+        assert parse_replicas("http://h:1/") == [
+            Replica("http://h:1/", "http://h:1")
+        ]
+
+
+class TestHealthzSatellite:
+    def test_plain_server_healthz_ok(self):
+        from kubeflow_tpu.serving.server import ModelServer
+
+        ms = ModelServer(statusz_enabled=False)
+        status, body = ms.app.handle("GET", "/healthz")
+        assert status == 200
+        assert body == {"ok": True, "draining": False, "models": []}
+
+    def test_drained_server_reports_draining_not_dead(self, gpt_and_params):
+        from kubeflow_tpu.serving.engine import DecodeEngine
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model, params = gpt_and_params
+        eng = DecodeEngine("g", model, params, num_slots=1, max_queue=4)
+        ms = ModelServer(statusz_enabled=False)
+        ms.add_engine(eng)
+        status, body = ms.app.handle("GET", "/healthz")
+        assert (status, body["draining"], body["models"]) == (
+            200, False, ["g"],
+        )
+        assert ms.close(drain=True)  # idle engine drains immediately
+        status, body = ms.app.handle("GET", "/healthz")
+        # 503 fails the readiness probe (pulled from endpoints) while
+        # the body still answers — draining, not dead
+        assert status == 503
+        assert body["ok"] is True and body["draining"] is True
+
+
+class TestEngineAffinityStats:
+    def test_stats_expose_hit_rate_and_first_page_cardinality(
+        self, gpt_and_params
+    ):
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        model, params = gpt_and_params
+        eng = DecodeEngine("g", model, params, num_slots=1, max_queue=8)
+        try:
+            page = list(range(16))  # page_size defaults to 16
+            eng.generate_row(page + [21, 22, 23, 24], 2)
+            r = eng.generate_row(page + [31, 32, 33, 34], 2)
+            assert len(r["tokens"]) == 2
+            eng.generate_row([40] * 20, 2)
+            s = eng.stats()
+            # two distinct first pages admitted across three requests
+            assert s["first_page_hashes"] == 2
+            # the second shared-page request mapped its committed first
+            # page copy-free: the hit rate is real and bounded
+            assert 0.0 < s["prefix_cache_hit_rate"] < 1.0
+            assert s["prefix_cache_hit_rate"] == pytest.approx(
+                s["prefix_hit_tokens"]
+                / (s["prefix_hit_tokens"] + s["prefill_compute_tokens"])
+            )
+        finally:
+            eng.close()
+
+
+@pytest.mark.slow
+class TestTwoReplicaAffinityE2E:
+    """The fleet story over real sockets: two full ModelServer replicas
+    + the router, shared-prefix traffic landing on ONE replica, and the
+    greedy-parity gate (router adds placement, never content)."""
+
+    def test_shared_prefix_lands_on_one_replica_bitwise(
+        self, gpt_and_params
+    ):
+        from kubeflow_tpu.api.wsgi import Server
+        from kubeflow_tpu.serving.engine import DecodeEngine
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model, params = gpt_and_params
+        engines, servers, reps = [], [], []
+        router = None
+        try:
+            for r in range(2):
+                eng = DecodeEngine(
+                    "g", model, params, num_slots=2, max_queue=16
+                )
+                ms = ModelServer(statusz_enabled=False)
+                ms.add_engine(eng)
+                srv = Server(ms.app, port=0)
+                srv.start()
+                engines.append((eng, ms))
+                servers.append(srv)
+                reps.append(
+                    Replica(f"replica-{r}", f"http://127.0.0.1:{srv.port}")
+                )
+            router = FleetRouter(tuple(reps), page_size=16)
+            rsrv = Server(router.app, port=0)
+            rsrv.start()
+            servers.append(rsrv)
+            url = f"http://127.0.0.1:{rsrv.port}/v1/models/g:generate"
+
+            page = list(range(200, 216))  # shared first page (gpt_tiny
+            #                               vocab 512, page_size 16)
+            results = []
+            for i in range(6):
+                payload = json.dumps({
+                    "prompt_ids": [page + [220 + i, 230 + i]],
+                    "max_new_tokens": 4,
+                }).encode()
+                req = urllib.request.Request(
+                    url, data=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    results.append(json.loads(resp.read()))
+            admitted = [eng.stats()["admitted"] for eng, _ in engines]
+            # every shared-prefix request landed on the SAME replica —
+            # the fleet's radix chain for this prefix lives exactly once
+            assert sorted(admitted) == [0, 6]
+            hot = engines[admitted.index(6)][0].stats()
+            assert hot["first_page_hashes"] == 1
+            assert hot["prefix_hit_tokens"] > 0  # fleet-wide cache is real
+
+            # greedy parity: the identical request direct to a replica
+            # must produce bitwise the router's output
+            payload = json.dumps({
+                "prompt_ids": [page + [250, 251]],
+                "max_new_tokens": 8,
+            }).encode()
+
+            def fetch(u):
+                req = urllib.request.Request(
+                    u, data=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    return json.loads(resp.read())["sequences"]
+
+            via_router = fetch(url)
+            direct = fetch(
+                f"http://127.0.0.1:{servers[0].port}/v1/models/g:generate"
+            )
+            assert via_router == direct
+        finally:
+            for srv in servers:
+                srv.stop()
+            for _, ms in engines:
+                ms.close()
