@@ -910,7 +910,7 @@ def bench_serving_continuous(
     # self-budgeting: a shrunk deadline shrinks the TRACE (fewer requests
     # through every phase), not the measurement method — the per-phase
     # ratios stay comparable, the entry always finishes inside its cap
-    num_requests = _budget_scaled(num_requests, sized_for_s=480, floor=4)
+    num_requests = _budget_scaled(num_requests, sized_for_s=540, floor=4)
     # engine geometry from the shared serving plan registry (the same
     # tuples kft-analyze's serving lint sweeps): largest prompt bucket
     # (32) + new_tokens + slack, ragged prompts over 3 buckets
@@ -949,6 +949,18 @@ def bench_serving_continuous(
     )
     model_server.add_engine(spec_k0)
     model_server.add_engine(spec_kd)
+
+    # the r13 quantized engine: SAME model/params/trace as the headline
+    # engine, int8 weights (quantized at ctor — the restore-time dtype
+    # transform's in-memory twin) + int8 KV pages read through the
+    # pallas in-place page walk (bench:gpt_quant in the plan registry,
+    # so the lint sweep certifies exactly this program family)
+    quant_engine = DecodeEngine(
+        "gpt_quant", model, params, num_slots=num_slots,
+        prefill_buckets=buckets, max_queue=max(64, num_requests),
+        quantize="int8", paged_attention="pallas",
+    )
+    model_server.add_engine(quant_engine)
 
     # the shared-prefix comparison rides one arrival trace through two
     # geometry-identical paged engines — radix prefix cache on vs off —
@@ -1207,6 +1219,48 @@ def bench_serving_continuous(
             spec_stats["draft_accepted"] - pre_spec["draft_accepted"]
         )
         accept_rate = round(accepted / proposed, 3) if proposed else 0.0
+        # -- quantized engine phase: same trace, int8 weights + KV pages
+        # through the pallas page walk. On THIS CPU mesh the phase
+        # measures overhead-parity (matmuls are compute-bound and the
+        # weight dequant materializes — docs/PERF.md r13 caveat); the
+        # bandwidth win is the TPU story. The capacity win is measured
+        # here for real: pages-per-HBM-GB is arithmetic on the pools.
+        quant = run_phase("gpt_quant", payloads_main)
+        from kubeflow_tpu.checkpointing.quantize import (
+            quantization_accuracy,
+        )
+
+        acc_ids = np.random.default_rng(6).integers(
+            0, 50257, (2, 48)
+        ).astype(np.int32)
+        quant_acc = quantization_accuracy(
+            model, params, quant_engine.params, acc_ids
+        )
+        gib = float(1 << 30)
+        pages_per_gb_bf16 = engine.num_pages / (
+            engine.kv_pool_bytes / gib
+        )
+        pages_per_gb_int8 = quant_engine.num_pages / (
+            quant_engine.kv_pool_bytes / gib
+        )
+        quantized = {
+            "tokens_per_sec": quant["tokens_per_sec"],
+            "phase": quant,
+            "quantized_speedup": round(
+                quant["tokens_per_sec"] / cont["tokens_per_sec"], 2
+            ) if cont["tokens_per_sec"] else 0.0,
+            "logit_max_abs_err": round(
+                quant_acc["logit_max_abs_err"], 4
+            ),
+            "loss_delta": round(quant_acc["loss_delta"], 5),
+            "kv_pool_bytes_bf16": engine.kv_pool_bytes,
+            "kv_pool_bytes_int8": quant_engine.kv_pool_bytes,
+            "pages_per_hbm_gb_bf16": round(pages_per_gb_bf16, 1),
+            "pages_per_hbm_gb_int8": round(pages_per_gb_int8, 1),
+            "pages_per_hbm_gb_ratio": round(
+                pages_per_gb_int8 / pages_per_gb_bf16, 2
+            ),
+        }
         # -- paged-KV prefix-cache phase: the 80%-shared trace ------------
         # TTFT through the engine is queue wait + prefill; the cache cuts
         # the PREFILL term, so the phase is arrival-limited (spaced
@@ -1330,6 +1384,12 @@ def bench_serving_continuous(
         },
         "engine_accept_rate": accept_rate,
         "drafted_tokens_per_sec": kd["tokens_per_sec"],
+        # int8 weights + KV pages (r13): same trace through the
+        # quantized pallas engine; capacity ratio is pool arithmetic
+        "quantized": quantized,
+        "quantized_tokens_per_sec": quantized["tokens_per_sec"],
+        "pages_per_hbm_gb": quantized["pages_per_hbm_gb_int8"],
+        "pages_per_hbm_gb_ratio": quantized["pages_per_hbm_gb_ratio"],
         # paged KV + radix prefix cache: same trace, cache on vs off
         "prefix": prefix,
         "prefix_hit_rate": prefix_hit_rate,
@@ -2543,6 +2603,9 @@ _HEADLINE_KEYS = (
 # computed MFU and the tracing-overhead gate ride the one always-parseable
 # record).
 _EXTRA_FINAL_KEYS = (
+    "quantized_tokens_per_sec",
+    "pages_per_hbm_gb",
+    "pages_per_hbm_gb_ratio",
     "engine_accept_rate",
     "drafted_tokens_per_sec",
     "training_model_flops_utilization",

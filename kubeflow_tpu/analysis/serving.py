@@ -30,7 +30,10 @@ the lint checks the programs the scheduler actually dispatches:
 - **serve-dtype**: KV-cache dtype discipline — cache leaves leave a
   program with the dtype they entered (no silent bf16->f32 upcast
   across a step), and are never wider than the model's weight dtype.
-  The gate the int8-KV roadmap item will extend.
+  This is the int8-KV gate, live since r13: quantized plans'
+  int8 value leaves and bf16 `cached_*_scale` leaves are both
+  narrower-than-model and must round-trip their stored dtype exactly
+  like full-width pools.
 - **mem-budget** (analysis/memory.py): params + the resident KV page
   pool(s) — num_pages x page_size of K/V per layer, the paged layout's
   decoupling of resident HBM from num_slots x max_len — (+ XLA temp
@@ -329,7 +332,7 @@ def check_cache_dtype(
                             f"dtype is {weight_dtype} — the KV cache is "
                             f"the engine's dominant resident buffer and "
                             f"must not be wider than the weights "
-                            f"(int8-KV will tighten this further)"
+                            f"(int8 pools pass as strictly narrower)"
                         ),
                     )
                 )
@@ -465,15 +468,19 @@ def analyze_serving_plan(
         draft = get_model(
             spec.draft_model, **resolve_model_kwargs(spec.draft_kwargs)
         )
-    from kubeflow_tpu.serving.engine import auto_num_pages
+    from kubeflow_tpu.serving.engine import resolve_num_pages
 
     page_size = spec.page_size
-    num_pages = spec.num_pages or auto_num_pages(
-        spec.num_slots, model.cfg.max_len, page_size
+    # the engine's own sizing rule (int8 auto pools carry the capacity
+    # ratio), so mem-budget prices the pool the engine will allocate
+    num_pages = resolve_num_pages(
+        spec.num_pages, spec.num_slots, model.cfg, page_size,
+        spec.quantize,
     )
     progs = EnginePrograms(
         model, draft_model=draft, num_draft_tokens=spec.num_draft_tokens,
         page_size=page_size, num_pages=num_pages,
+        paged_attention=spec.paged_attention, quantize=spec.quantize,
     )
     buckets = tuple(spec.prefill_buckets) or default_prefill_buckets(
         model.cfg.max_len
@@ -489,6 +496,8 @@ def analyze_serving_plan(
     stats["buckets"] = list(buckets)
     stats["page_size"] = page_size
     stats["num_pages"] = num_pages
+    stats["paged_attention"] = spec.paged_attention
+    stats["quantize"] = spec.quantize
 
     step_temp_bytes: Optional[int] = None
     stablehlo_bytes = 0

@@ -38,6 +38,21 @@ DEFAULT_MAX_QUEUE = 64
 # floored at one full-length request; serving/engine.py auto_num_pages).
 DEFAULT_PAGE_SIZE = 16
 DEFAULT_NUM_PAGES = 0
+# Decode read-path kernel: "gather" materializes a per-slot contiguous
+# KV view through the page table (ops/attention.py paged_kv_view);
+# "pallas" walks the page table in place (ops/paged_attention.py — no
+# gather, no temp; bitwise-identical greedy output, parity-tested).
+# Gather stays the default: the pallas kernel is the TPU bandwidth
+# winner, and off-TPU it runs in interpret mode (correct, not fast).
+PAGED_ATTENTION_CHOICES = ("gather", "pallas")
+DEFAULT_PAGED_ATTENTION = "gather"
+# Serving quantization: "int8" = per-channel int8 weights applied at
+# checkpoint restore (checkpointing/quantize.py) + int8 KV page pools
+# with per-vector bf16 scales (dequant fused into the read path). Gated
+# by the accuracy gate beside the parity tests; "none" is bitwise the
+# r10 engine.
+QUANTIZE_CHOICES = ("none", "int8")
+DEFAULT_QUANTIZE = "none"
 # Draining-shutdown budget (serving/engine.py drain; docs/ROBUSTNESS.md):
 # the ONE definition point — serving/main.py's env fallback and
 # ModelServer's close(drain=True) default import it, and the registry-
@@ -81,6 +96,8 @@ class ServingPlanSpec:
     draft_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
     page_size: int = DEFAULT_PAGE_SIZE  # tokens per KV pool block
     num_pages: int = DEFAULT_NUM_PAGES  # pool pages (0 = auto sizing)
+    paged_attention: str = DEFAULT_PAGED_ATTENTION  # decode read kernel
+    quantize: str = DEFAULT_QUANTIZE   # int8 weights + KV pages
     prefix_cache: bool = True          # radix prefix index (host-side; no
     #                                    program-set impact — listed so the
     #                                    registry documents the full knob
@@ -150,6 +167,18 @@ def bench_serving_plans() -> List[ServingPlanSpec]:
             model_kwargs=dict(target, max_len=BENCH_PREFIX_MAX_LEN),
             prefill_buckets=BENCH_PREFIX_BUCKETS,
             page_size=BENCH_PREFIX_PAGE_SIZE,
+        ),
+        ServingPlanSpec(
+            # the quantized engine (bench's quantized phase): int8
+            # weights + int8 KV pages read through the pallas in-place
+            # page walk — the serve-dtype rule certifies the int8 pool
+            # discipline and mem-budget prices the halved pool bytes
+            name="bench:gpt_quant",
+            model="gpt_small",
+            model_kwargs=dict(target),
+            prefill_buckets=BENCH_PREFILL_BUCKETS,
+            paged_attention="pallas",
+            quantize="int8",
         ),
         ServingPlanSpec(
             name="bench:gpt_spec_k0",

@@ -23,3 +23,9 @@ from kubeflow_tpu.checkpointing.manager import (  # noqa: F401
     restore_pytree,
     restore_subtree,
 )
+from kubeflow_tpu.checkpointing.quantize import (  # noqa: F401
+    dequantize_params,
+    is_quantized_params,
+    quantization_accuracy,
+    quantize_params_int8,
+)

@@ -626,15 +626,30 @@ def restore_latest(
 
 
 def restore_params(
-    directory: str, step: Optional[int] = None, prefix: str = "params"
+    directory: str,
+    step: Optional[int] = None,
+    prefix: str = "params",
+    transform: str = "",
 ) -> Dict[str, Any]:
     """The serving loader: the `prefix` subtree of the latest committed
     checkpoint as a nested dict of host numpy arrays — no target pytree or
-    mesh required (shapes/dtypes come from the manifest)."""
+    mesh required (shapes/dtypes come from the manifest). `transform`
+    names a dtype-transform stage applied to the assembled tree before it
+    is returned: "int8" quantizes every >=2-D floating leaf per output
+    channel (checkpointing/quantize.py — the serving.quantize=int8 weight
+    path), so the full-width tree never becomes the process's resident
+    copy. Assembly is manifest-global, so the transform's output is
+    IDENTICAL regardless of the mesh the checkpoint was saved on (the
+    resharding-restore invariant, pinned by tests/test_quantize.py)."""
     dirpath = _resolve_committed_dir(directory, step)
-    return _io_retry(
+    restored = _io_retry(
         lambda: _restore_params_once(dirpath, prefix), "params restore"
     )
+    if transform:
+        from kubeflow_tpu.checkpointing.quantize import apply_transform
+
+        restored = apply_transform(restored, transform)
+    return restored
 
 
 def _restore_params_once(dirpath: str, prefix: str) -> Dict[str, Any]:

@@ -1,0 +1,178 @@
+"""Per-channel int8 weight quantization — the checkpoint-restore dtype
+transform (`serving.quantize=int8`).
+
+Decode is bytes-bound (docs/PERF.md r5/r13): every one-token step streams
+the full parameter set from HBM, so halving the stored weight bytes is a
+direct bandwidth win on the step the engine runs forever. The transform
+is applied where the weights enter the serving process — checkpoint
+restore (`restore_params(..., transform="int8")` routes through here;
+the full-width tree is transient assembly state, not a resident copy)
+or once at engine construction for already-restored params (the
+build_server pod flow, where the ServedLm model surface keeps the
+full-width tree resident anyway) — and the engine's jitted program
+bodies dequantize on the fly (EnginePrograms `_live_params`): the
+engine's resident tree is int8 + per-channel scales (~half the bytes +
+1/fan-in overhead), and on TPU the dequant multiply fuses into the
+matmul's operand read. On the CPU test/bench mesh the dequant
+materializes instead — documented there, measured in bench.
+
+Granularity: symmetric per-OUTPUT-channel (one f32 scale per last-axis
+column) for every floating leaf with ndim >= 2 — matmul kernels, the
+embedding tables, the LM head. 1-D leaves (biases, LayerNorm) stay at
+their stored dtype: they are a rounding error of the byte budget and
+LayerNorm runs f32 by design.
+
+Quantized params travel as ONE pytree (jit-arg compatible):
+
+    {"qvalues": <params tree, int8 where quantized>,
+     "qscales": {<keystr path>: f32 [out], ...}}
+
+`quantization_accuracy` is the accuracy gate beside the parity tests:
+logit max-abs-err and held-out loss delta of the dequantized model vs
+the original — thresholds pinned in tests/test_quantize.py, enforced by
+the serving CI workflow's int8-accuracy step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+QUANT_TRANSFORMS = ("int8",)
+
+
+def _keystr(path) -> str:
+    import jax
+
+    return jax.tree_util.keystr(path)
+
+
+def _eligible(leaf) -> bool:
+    import jax.numpy as jnp
+
+    return (
+        getattr(leaf, "ndim", 0) >= 2
+        and jnp.issubdtype(leaf.dtype, jnp.floating)
+    )
+
+
+def quantize_leaf_int8(w):
+    """One weight leaf [..., out] → (int8 values, f32 scale [out]).
+    Symmetric per-output-channel: scale = amax(|w[..., c]|)/127 so the
+    dequantized column spans exactly the original's range."""
+    import jax.numpy as jnp
+
+    w32 = w.astype(jnp.float32)
+    axes = tuple(range(w.ndim - 1))
+    amax = jnp.max(jnp.abs(w32), axis=axes)
+    scale = amax / 127.0
+    q = jnp.round(w32 / jnp.where(scale > 0.0, scale, 1.0))
+    return jnp.clip(q, -127.0, 127.0).astype(jnp.int8), scale
+
+
+def quantize_params_int8(params) -> Dict[str, Any]:
+    """The restore-time transform: every eligible leaf → int8 + its
+    per-channel scale keyed by tree path; everything else rides through
+    untouched. Shape/structure-preserving on `qvalues`, so the quantized
+    tree answers the same tree queries the original did."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    qleaves = []
+    scales: Dict[str, Any] = {}
+    for path, leaf in flat:
+        if _eligible(leaf):
+            q, s = quantize_leaf_int8(leaf)
+            qleaves.append(q)
+            scales[_keystr(path)] = s
+        else:
+            qleaves.append(leaf)
+    return {
+        "qvalues": jax.tree_util.tree_unflatten(treedef, qleaves),
+        "qscales": scales,
+    }
+
+
+def is_quantized_params(params) -> bool:
+    """Recognize the quantized-params envelope (engine ctor + program
+    bodies branch on this statically)."""
+    return isinstance(params, dict) and set(params) == {
+        "qvalues", "qscales",
+    }
+
+
+def dequantize_params(qparams: Dict[str, Any], dtype):
+    """Inverse transform into the model's compute dtype: quantized
+    leaves become (int8 · scale) rounded once to `dtype` (flax layers
+    cast params to the compute dtype anyway, so nothing coarser than the
+    unquantized apply path happens here); untouched leaves (LayerNorm
+    f32 et al.) pass through bit-identical. Runs INSIDE the jitted
+    engine programs — the resident tree stays int8."""
+    import jax
+    import jax.numpy as jnp
+
+    scales = qparams["qscales"]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        qparams["qvalues"]
+    )
+    out = []
+    for path, leaf in flat:
+        s = scales.get(_keystr(path))
+        if s is None:
+            out.append(leaf)
+        else:
+            out.append(
+                (leaf.astype(jnp.float32) * s.astype(jnp.float32)).astype(
+                    dtype
+                )
+            )
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def apply_transform(params, transform: str):
+    """The checkpoint-restore dtype-transform stage
+    (checkpointing/manager.py restore_params): "" / None is identity,
+    "int8" is the per-channel weight quantization above. Unknown names
+    fail loudly — a typo'd transform must not silently serve unquantized
+    weights."""
+    if not transform:
+        return params
+    if transform == "int8":
+        return quantize_params_int8(params)
+    raise ValueError(
+        f"unknown checkpoint restore transform {transform!r} "
+        f"(known: {QUANT_TRANSFORMS})"
+    )
+
+
+def quantization_accuracy(model, params, qparams, ids) -> Dict[str, float]:
+    """The int8 accuracy gate: drive the SAME model over a held-out
+    batch with the original and the dequantized-quantized params and
+    report {"logit_max_abs_err", "loss_delta"} — max absolute logit
+    error and the absolute delta in mean next-token NLL. Thresholds are
+    pinned by tests/test_quantize.py and re-checked by the serving CI
+    workflow's int8-accuracy step; bench reports the same pair beside
+    the quantized throughput numbers."""
+    import jax
+    import jax.numpy as jnp
+
+    deq = dequantize_params(qparams, model.cfg.dtype)
+
+    @jax.jit
+    def logits_of(p):
+        return model.apply({"params": p}, ids, deterministic=True)[
+            "logits"
+        ]
+
+    ref = logits_of(params)
+    got = logits_of(deq)
+
+    def nll(logits):
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+        tgt = ids[:, 1:]
+        picked = jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+        return -jnp.mean(picked)
+
+    return {
+        "logit_max_abs_err": float(jnp.max(jnp.abs(ref - got))),
+        "loss_delta": float(jnp.abs(nll(got) - nll(ref))),
+    }

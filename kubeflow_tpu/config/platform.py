@@ -679,6 +679,26 @@ class ServingConfig(ConfigNode):
         "(pure random prompts) to skip the host-side bookkeeping and "
         "keep retired pages returning to the pool immediately.",
     )
+    paged_attention: str = config_field(
+        default="gather",
+        help="decode read-path kernel: 'gather' materializes a per-slot "
+        "contiguous KV view through the page table (XLA gather + temp "
+        "HBM); 'pallas' walks the page table in place (no gather, no "
+        "temp — the TPU bandwidth choice; greedy output is bitwise "
+        "identical either way). Off-TPU 'pallas' runs in interpret "
+        "mode: correct but slow — keep 'gather' on CPU meshes.",
+    )
+    quantize: str = config_field(
+        default="none",
+        help="serving quantization: 'int8' stores per-channel int8 "
+        "weights (applied at checkpoint restore) and int8 KV page "
+        "pools with per-vector scales — ~half the streamed bytes and "
+        "~2x the pool's token capacity at the same HBM; dequant is "
+        "fused into the decode read. Gate: the int8 accuracy check "
+        "(logit max-abs-err + held-out loss delta) must pass for the "
+        "served model; stay 'none' (bitwise the unquantized engine) "
+        "when it does not.",
+    )
     prefill_buckets: List[int] = config_field(
         default_factory=list,
         help="explicit prompt-length buckets (ascending powers of two); "
@@ -765,6 +785,40 @@ class ServingConfig(ConfigNode):
             raise ConfigError(
                 "serving.num_draft_tokens > 0 needs serving.draft_model "
                 "(speculative decoding drafts from a second model)"
+            )
+        # choices shared with the engine + the serving plan registry
+        # (analysis/serving_plans.py) — ONE definition point
+        from kubeflow_tpu.analysis.serving_plans import (
+            PAGED_ATTENTION_CHOICES,
+            QUANTIZE_CHOICES,
+        )
+
+        if self.paged_attention not in PAGED_ATTENTION_CHOICES:
+            raise ConfigError(
+                f"serving.paged_attention must be one of "
+                f"{list(PAGED_ATTENTION_CHOICES)}, got "
+                f"{self.paged_attention!r}"
+            )
+        if self.quantize not in QUANTIZE_CHOICES:
+            raise ConfigError(
+                f"serving.quantize must be one of "
+                f"{list(QUANTIZE_CHOICES)}, got {self.quantize!r}"
+            )
+        # both knobs live inside the decode engine; num_slots=0 disables
+        # it — reject instead of silently serving full-width gather (the
+        # same silently-ignored-knob class the draft knobs fixed in r5)
+        if self.num_slots < 1 and self.paged_attention != "gather":
+            raise ConfigError(
+                "serving.paged_attention=pallas needs serving.num_slots "
+                ">= 1: the kernel serves the decode engine's step, and "
+                "num_slots=0 disables the engine"
+            )
+        if self.num_slots < 1 and self.quantize != "none":
+            raise ConfigError(
+                "serving.quantize=int8 needs serving.num_slots >= 1: "
+                "quantization lives inside the decode engine, and "
+                "num_slots=0 disables it — the static path would "
+                "silently serve full-width weights"
             )
         if self.num_draft_tokens > 0 and self.num_slots < 1:
             raise ConfigError(

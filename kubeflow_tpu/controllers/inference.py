@@ -116,6 +116,10 @@ class InferenceServiceController(Controller):
             "KFT_SERVING_PAGE_SIZE": str(cfg.page_size),
             "KFT_SERVING_NUM_PAGES": str(cfg.num_pages),
             "KFT_SERVING_PREFIX_CACHE": "1" if cfg.prefix_cache else "0",
+            # decode read-path kernel + int8 quantization (r13: pallas
+            # in-place page walk, int8 weights + KV pages)
+            "KFT_SERVING_PAGED_ATTENTION": cfg.paged_attention,
+            "KFT_SERVING_QUANTIZE": cfg.quantize,
             "KFT_SERVING_DRAFT_MODEL": cfg.draft_model,
             "KFT_SERVING_DRAFT_TOKENS": str(cfg.num_draft_tokens),
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": cfg.draft_checkpoint_dir,
@@ -158,6 +162,8 @@ class InferenceServiceController(Controller):
             "page_size": self.serving_defaults.page_size,
             "num_pages": self.serving_defaults.num_pages,
             "prefix_cache": self.serving_defaults.prefix_cache,
+            "paged_attention": self.serving_defaults.paged_attention,
+            "quantize": self.serving_defaults.quantize,
             "drain_deadline_s": self.serving_defaults.drain_deadline_s,
             "draft_model": self.serving_defaults.draft_model,
             "num_draft_tokens": self.serving_defaults.num_draft_tokens,

@@ -90,12 +90,25 @@ class PagedState:
       embeddings index straight off the cursor.
 
     `page_size`/`num_pages` are static (they shape the pool): one jitted
-    program per pool geometry, exactly like max_len."""
+    program per pool geometry, exactly like max_len. So are the two
+    read-path knobs stacked on in r13:
+
+    - `attn_impl`: "gather" materializes a per-slot contiguous view
+      through the page table (ops/attention.py paged_kv_view) and runs
+      dense_attention over it; "pallas" walks the page table in place
+      (ops/paged_attention.py — no contiguous gather, no temp) on the
+      one-token step. Bitwise-identical greedy output either way; multi-
+      token windows (chunk prefill, the K>0 verify) always gather.
+    - `kv_quant`: "int8" stores the pools as int8 values + bf16
+      per-vector scales (`cached_*_scale` leaves), quantizing at write
+      and dequantizing at read (fused into the pallas page walk)."""
 
     page_table: Any
     cache_index: Any
     page_size: int = flax.struct.field(pytree_node=False)
     num_pages: int = flax.struct.field(pytree_node=False)
+    attn_impl: str = flax.struct.field(pytree_node=False, default="gather")
+    kv_quant: str = flax.struct.field(pytree_node=False, default="none")
 
 
 class CausalSelfAttention(nn.Module):
@@ -157,28 +170,88 @@ class CausalSelfAttention(nn.Module):
             # contiguous path's.
             from kubeflow_tpu.ops.attention import (
                 dense_attention,
+                dequant_kv,
                 paged_kv_update,
                 paged_kv_view,
+                quantize_kv,
             )
 
+            quantized = paged.kv_quant == "int8"
+            store_dtype = jnp.int8 if quantized else cfg.dtype
             pool_shape = (
                 paged.num_pages, paged.page_size, cfg.num_heads, head_dim
             )
             cached_k = self.variable(
-                "cache", "cached_key", jnp.zeros, pool_shape, cfg.dtype
+                "cache", "cached_key", jnp.zeros, pool_shape, store_dtype
             )
             cached_v = self.variable(
-                "cache", "cached_value", jnp.zeros, pool_shape, cfg.dtype
+                "cache", "cached_value", jnp.zeros, pool_shape, store_dtype
             )
             s = x.shape[1]
             idx = paged.cache_index
-            cached_k.value, cached_v.value = paged_kv_update(
-                cached_k.value, cached_v.value,
-                k.astype(cfg.dtype), v.astype(cfg.dtype),
-                paged.page_table, idx,
-            )
+            k_w, v_w = k.astype(cfg.dtype), v.astype(cfg.dtype)
+            k_scale = v_scale = None
+            if quantized:
+                # per-vector scales ride sibling pool leaves [..., H, 1]
+                # — same rank as the values, so every paged helper
+                # (update/view/insert/COW) routes them through the SAME
+                # page table unchanged
+                scale_shape = pool_shape[:-1] + (1,)
+                k_scale = self.variable(
+                    "cache", "cached_key_scale", jnp.zeros, scale_shape,
+                    jnp.bfloat16,
+                )
+                v_scale = self.variable(
+                    "cache", "cached_value_scale", jnp.zeros, scale_shape,
+                    jnp.bfloat16,
+                )
+                qk, sk = quantize_kv(k_w)
+                qv, sv = quantize_kv(v_w)
+                cached_k.value, cached_v.value = paged_kv_update(
+                    cached_k.value, cached_v.value, qk, qv,
+                    paged.page_table, idx,
+                )
+                k_scale.value, v_scale.value = paged_kv_update(
+                    k_scale.value, v_scale.value, sk, sv,
+                    paged.page_table, idx,
+                )
+            else:
+                cached_k.value, cached_v.value = paged_kv_update(
+                    cached_k.value, cached_v.value, k_w, v_w,
+                    paged.page_table, idx,
+                )
+            if s == 1 and paged.attn_impl == "pallas":
+                # the one-token hot path walks the page table in place —
+                # no contiguous per-slot view, no gather temp; int8
+                # dequant (the same dequant_kv the gather path uses)
+                # runs fused on the streamed page
+                from kubeflow_tpu.ops.paged_attention import (
+                    paged_attention,
+                )
+
+                out = paged_attention(
+                    q, cached_k.value, cached_v.value,
+                    paged.page_table, idx, dtype=cfg.dtype,
+                    k_scale=k_scale.value if quantized else None,
+                    v_scale=v_scale.value if quantized else None,
+                )
+                return nn.DenseGeneral(
+                    cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
+                    name="out",
+                )(out)
             k_view = paged_kv_view(cached_k.value, paged.page_table)
             v_view = paged_kv_view(cached_v.value, paged.page_table)
+            if quantized:
+                k_view = dequant_kv(
+                    k_view,
+                    paged_kv_view(k_scale.value, paged.page_table),
+                    cfg.dtype,
+                )
+                v_view = dequant_kv(
+                    v_view,
+                    paged_kv_view(v_scale.value, paged.page_table),
+                    cfg.dtype,
+                )
             view_len = k_view.shape[1]
             if s == 1:
                 # no pad holes in the paged layout: everything at or
@@ -478,13 +551,21 @@ def _prune_non_kv(tree):
     return tree
 
 
-def make_paged_pool(cache_one, num_pages: int, page_size: int):
+def make_paged_pool(
+    cache_one, num_pages: int, page_size: int, kv_quant: str = "none"
+):
     """Zeroed paged K/V pool shaped from a batch-1 prefill cache (or its
     eval_shape): each cached_key/cached_value leaf's trailing
     [1, max_len, H, D] becomes [num_pages, page_size, H, D] (leading
     layer axes preserved); every other cache leaf is dropped — the
-    engine owns that bookkeeping host-side."""
+    engine owns that bookkeeping host-side. `kv_quant="int8"` stores the
+    value leaves as int8 and adds a bf16 `<name>_scale` sibling leaf
+    [num_pages, page_size, H, 1] per pool (ops/attention.py quantize_kv
+    granularity) — same rank as the values, so every paged helper routes
+    scales through the page table unchanged."""
     import jax.tree_util as jtu
+
+    quantized = kv_quant == "int8"
 
     def conv(path, leaf):
         name = _cache_leaf_name(path)
@@ -492,7 +573,8 @@ def make_paged_pool(cache_one, num_pages: int, page_size: int):
             return None
         lead = tuple(leaf.shape[:-4])
         h, d = leaf.shape[-2], leaf.shape[-1]
-        return jnp.zeros(lead + (num_pages, page_size, h, d), leaf.dtype)
+        dtype = jnp.int8 if quantized else leaf.dtype
+        return jnp.zeros(lead + (num_pages, page_size, h, d), dtype)
 
     # unfreeze defensively: flax may hand a FrozenDict, and pruning needs
     # plain dicts
@@ -502,7 +584,56 @@ def make_paged_pool(cache_one, num_pages: int, page_size: int):
         cache_one = unfreeze(cache_one)
     except Exception:  # pragma: no cover - plain dicts already
         pass
-    return _prune_non_kv(jtu.tree_map_with_path(conv, dict(cache_one)))
+    pool = _prune_non_kv(jtu.tree_map_with_path(conv, dict(cache_one)))
+    if quantized:
+        _add_scale_leaves(pool)
+    return pool
+
+
+def _add_scale_leaves(tree) -> None:
+    """In-place: beside every cached_key/cached_value pool leaf, a bf16
+    `<name>_scale` leaf with D collapsed to 1 (one scale per written K/V
+    vector — quantize_kv's granularity)."""
+    for key in list(tree.keys()):
+        sub = tree[key]
+        if isinstance(sub, dict):
+            _add_scale_leaves(sub)
+        elif key in ("cached_key", "cached_value"):
+            tree[key + "_scale"] = jnp.zeros(
+                sub.shape[:-1] + (1,), jnp.bfloat16
+            )
+
+
+def quantize_kv_cache(cache_one):
+    """Quantize a batch-1 prefill cache's K/V rows for insertion into an
+    int8 pool: cached_key/cached_value leaves [..., max_len, H, D] become
+    int8 plus bf16 `<name>_scale` siblings [..., max_len, H, 1]; every
+    other cache leaf is dropped (`insert_pages` looks leaves up by pool
+    path, and the pool is K/V + scales only). Runs INSIDE the jitted
+    insert program so the int8 conversion happens once, on device, at
+    admission."""
+    from kubeflow_tpu.ops.attention import quantize_kv
+
+    def walk(node):
+        out = {}
+        for key, sub in node.items():
+            if isinstance(sub, dict):
+                pruned = walk(sub)
+                if pruned:
+                    out[key] = pruned
+            elif key in ("cached_key", "cached_value"):
+                q, s = quantize_kv(sub)
+                out[key] = q
+                out[key + "_scale"] = s
+        return out
+
+    try:
+        from flax.core import unfreeze
+
+        cache_one = unfreeze(cache_one)
+    except Exception:  # pragma: no cover - plain dicts already
+        pass
+    return walk(dict(cache_one))
 
 
 def _leaf_by_path(tree, path):

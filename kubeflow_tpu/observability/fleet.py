@@ -172,6 +172,7 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "notebook_running": "sum",
     "serving_kv_pages_in_use": "sum",
     "serving_kv_pages_total": "sum",
+    "serving_kv_pool_bytes": "sum",
     "serving_num_slots": "sum",
     "serving_queue_depth": "sum",
     "serving_slot_occupancy": "mean",

@@ -116,6 +116,43 @@ def paged_kv_update(
     return fk.reshape(pool_k.shape), fv.reshape(pool_v.shape)
 
 
+# -- int8 KV page quantization (serving.quantize=int8) ---------------------
+# Per-(token, head) symmetric int8 over the head_dim axis: one scale per
+# written K/V vector, stored beside the pool as [..., H, 1] bf16 leaves
+# (`cached_*_scale`) so every paged helper (view/update/insert/COW) routes
+# them through the SAME page table untouched. bf16 scales keep the scale
+# overhead at 2 bytes per D-element vector — bytes per cached token-head
+# drop from 2D (bf16) to D+2, which is where the ~1.9x pages-per-HBM-GB
+# comes from. Quantization error is bounded per vector (the scale tracks
+# each token's own magnitude, so one outlier token cannot flatten its
+# page); the accuracy gate (checkpointing/quantize.py) measures the
+# end-to-end effect.
+
+
+def quantize_kv(x: jax.Array) -> tuple:
+    """x [..., H, D] float → (int8 values [..., H, D], bf16 scales
+    [..., H, 1]). Symmetric per-vector: scale = amax/127 rounded to bf16
+    FIRST, then values quantized against the rounded scale — dequant
+    multiplies by exactly the stored scale, so the scale's own rounding
+    never compounds with the int8 rounding."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = (amax / 127.0).astype(jnp.bfloat16)
+    s = scale.astype(jnp.float32)
+    q = jnp.round(x.astype(jnp.float32) / jnp.where(s > 0.0, s, 1.0))
+    return jnp.clip(q, -127.0, 127.0).astype(jnp.int8), scale
+
+
+def dequant_kv(values: jax.Array, scales: jax.Array, dtype) -> jax.Array:
+    """Inverse of `quantize_kv` (values [..., H, D] int8 x scales
+    [..., H, 1]) — f32 multiply, rounded once into the compute dtype.
+    ONE definition point: the gather read path (models/gpt.py) and the
+    pallas kernel's fused page walk (ops/paged_attention.py) both call
+    this, so the two int8 read paths cannot drift numerically."""
+    return (
+        values.astype(jnp.float32) * scales.astype(jnp.float32)
+    ).astype(dtype)
+
+
 def dense_attention(
     q: jax.Array,
     k: jax.Array,

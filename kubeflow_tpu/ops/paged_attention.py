@@ -1,0 +1,204 @@
+"""Paged-attention decode — pallas TPU kernel that walks the page table
+in place.
+
+The gather read path (ops/attention.py `paged_kv_view`) materializes a
+per-slot CONTIGUOUS [B, max_len, H, D] view of the block-paged KV pool
+through the page table before attention ever runs: an XLA gather that
+costs ~3 ms across 12 layers per decode step on the bench engine AND a
+temp HBM allocation the mem-budget pass has to price. Decode is
+bytes-bound (PR 5's speculation data: the self-draft wins by streaming
+~1/6 the bytes), so copying every resident KV byte into a temp before
+reading it again is exactly the wrong place to spend the bandwidth.
+
+This kernel deletes the copy: the page table and cursors ride
+`PrefetchScalarGridSpec` scalar prefetch, and each (slot, page) grid
+step's BlockSpec index map routes the K/V (and int8 scale) page DMA
+straight through the page table — pool pages stream HBM→VMEM exactly
+once, nothing contiguous is ever materialized. Pages past a slot's
+cursor are clamped to the cursor page in the index map (the pipeline
+only issues a DMA when the mapped index changes — skipped pages cost no
+traffic, the flash kernel's causal-clamp trick) and their compute is
+`pl.when`-skipped.
+
+Numerics contract (the parity tests' bitwise gate, `serving.quantize=
+none`): the kernel performs EXACTLY the gather path's arithmetic — the
+same QK^T einsum in the compute dtype, the same `/sqrt(D)` scale, the
+same big-neg masking on the compute-dtype scores, the same f32 softmax,
+the same probs·V contraction. Per-score elements never cross page
+boundaries (each score depends on one K vector) and masked positions
+contribute exactly zero to the PV sum, so accumulating the row
+page-by-page into VMEM scratch is a layout change, not a math change —
+greedy output through this kernel is bitwise the gather engine's
+(tests/test_paged_kv.py TestPallasKernel).
+
+At `serving.quantize=int8` the pool stores int8 values + bf16 per-vector
+scales and the dequant (`ops/attention.py dequant_kv`, the SAME helper
+the gather path uses) runs fused inside the page walk, on the VMEM tile
+the DMA just landed: HBM streams one byte per KV element instead of two.
+
+Scope: the s == 1 one-token decode step — the hot loop that runs forever
+and whose bytes dominate. Multi-token windows (chunk prefill, the K>0
+verify) stay on the gather path: they amortize the gather over s
+positions and their math through the gather path is already the parity
+baseline. Off-TPU the kernel runs in interpret mode (the in-repo
+precedent: ops/flash_attention.py), so tier-1 parity tests exercise this
+exact code path under JAX_PLATFORMS=cpu.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubeflow_tpu.ops.attention import dequant_kv
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(
+    pt_ref,      # [B, MP] int32 scalar-prefetch (unused in body; maps route it)
+    cur_ref,     # [B] int32 scalar-prefetch
+    q_ref,       # (1, 1, H, D) this slot's query
+    k_ref,       # (1, ps, H, D) one pool K page (int8 when quantized)
+    v_ref,       # (1, ps, H, D) one pool V page
+    *refs,       # [ks_ref, vs_ref] when quantized; then o_ref, scratches
+    page_size: int,
+    dtype,
+    quantized: bool,
+):
+    if quantized:
+        ks_ref, vs_ref, o_ref, s_scratch, v_scratch = refs
+    else:
+        o_ref, s_scratch, v_scratch = refs
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+    ps = page_size
+    cur = cur_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        s_scratch[:] = jnp.zeros_like(s_scratch)
+        v_scratch[:] = jnp.zeros_like(v_scratch)
+
+    # pages whose first position is past the cursor hold nothing visible:
+    # skip their compute (their DMA was already elided by the clamped
+    # index maps). Positions past the cursor INSIDE a live page are
+    # masked at the softmax below, exactly like the gather path.
+    @pl.when(p * ps <= cur)
+    def _body():
+        q = q_ref[0, 0]                       # (H, D)
+        k = k_ref[0]                          # (ps, H, D)
+        v = v_ref[0]
+        if quantized:
+            k = dequant_kv(k, ks_ref[0], dtype)
+            v = dequant_kv(v, vs_ref[0], dtype)
+        # same ops as the gather path's dense_attention: QK^T in the
+        # compute dtype, then the /sqrt(D) scale — per-score elements
+        # depend on one K vector each, so paging the row changes nothing
+        depth = q.shape[-1]
+        # the same singleton-batched einsum FORM dense_attention uses
+        # (XLA's f32 reduction order is shape-dependent; see _finish)
+        s_page = jnp.einsum(
+            "bqhd,bkhd->bhqk", q[None, None], k[None]
+        )[0, :, 0, :] / jnp.sqrt(depth).astype(dtype)
+        s_scratch[:, pl.ds(p * ps, ps)] = s_page
+        v_scratch[pl.ds(p * ps, ps)] = v
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        view_len = n_pages * ps
+        scores = s_scratch[:]                 # (H, L) compute dtype
+        visible = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, view_len), 1) <= cur
+        )
+        big_neg = jnp.finfo(jnp.float32).min
+        scores = jnp.where(visible, scores, big_neg)
+        probs = jax.nn.softmax(
+            scores.astype(jnp.float32), axis=-1
+        ).astype(dtype)
+        # masked positions carry prob exactly 0: stale/zero V rows in the
+        # scratch contribute exactly nothing, same as the gather path.
+        # Same singleton-batched einsum FORM as dense_attention's PV —
+        # XLA's f32 reduction order is shape-dependent, and the collapsed
+        # "hk,khd->hd" spelling is 1 ulp off the gather path's
+        o_ref[0, 0] = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs[None, :, None], v_scratch[:][None]
+        )[0, 0]
+
+
+def paged_attention(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    page_table: jax.Array,
+    cursors: jax.Array,
+    *,
+    dtype,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+) -> jax.Array:
+    """One-token paged-attention decode over all slots.
+
+    q [B, 1, H, D] compute dtype; pool_k/pool_v [P, ps, H, D] (compute
+    dtype, or int8 with k_scale/v_scale [P, ps, H, 1]); page_table
+    [B, MP] int32; cursors [B] int32 (cursor masking IS visibility — the
+    paged layout has no pad holes). Returns [B, 1, H, D].
+
+    Every slot's row is walked page-by-page straight out of the pool —
+    no contiguous per-slot view is ever materialized.
+    """
+    b, s, h, d = q.shape
+    assert s == 1, "the pallas kernel serves the one-token decode step"
+    num_pages, ps = pool_k.shape[0], pool_k.shape[1]
+    mp = page_table.shape[1]
+    view_len = mp * ps
+    quantized = k_scale is not None
+
+    def page_idx(bi, p, pt, cur):
+        # clamp at the slot's last live page: steps past it re-map to the
+        # same index, and the pipeline elides the repeat DMA (a parked
+        # cursor of max_len clamps to the final table entry — its output
+        # is never read)
+        last = jnp.minimum(
+            jnp.maximum(cur[bi], 0) // ps, mp - 1
+        )
+        return (pt[bi, jnp.minimum(p, last)], 0, 0, 0)
+
+    q_spec = pl.BlockSpec((1, 1, h, d), lambda bi, p, pt, cur: (bi, 0, 0, 0))
+    kv_spec = pl.BlockSpec((1, ps, h, d), page_idx)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    args = [q, pool_k, pool_v]
+    if quantized:
+        sc_spec = pl.BlockSpec((1, ps, h, 1), page_idx)
+        in_specs += [sc_spec, sc_spec]
+        args += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mp),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, h, d), lambda bi, p, pt, cur: (bi, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((h, view_len), dtype),      # score row
+            pltpu.VMEM((view_len, h, d), dtype),   # dequantized V row
+        ],
+    )
+    kernel = functools.partial(
+        _kernel, page_size=ps, dtype=dtype, quantized=quantized
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), dtype),
+        interpret=_use_interpret(),
+    )(page_table, cursors, *args)

@@ -87,6 +87,15 @@ import numpy as np
 from kubeflow_tpu.analysis.serving_plans import (
     DEFAULT_NUM_SLOTS,
     DEFAULT_PAGE_SIZE,
+    DEFAULT_PAGED_ATTENTION,
+    DEFAULT_QUANTIZE,
+    PAGED_ATTENTION_CHOICES,
+    QUANTIZE_CHOICES,
+)
+from kubeflow_tpu.checkpointing.quantize import (
+    dequantize_params,
+    is_quantized_params,
+    quantize_params_int8,
 )
 from kubeflow_tpu.chaos import default_chaos
 from kubeflow_tpu.observability.trace import default_tracer
@@ -107,6 +116,7 @@ from kubeflow_tpu.utils.metrics import (
     serving_engine_recoveries_counter,
     serving_kv_pages_in_use_gauge,
     serving_kv_pages_total_gauge,
+    serving_kv_pool_bytes_gauge,
     serving_num_slots_gauge,
     serving_phase_histogram,
     serving_prefix_hit_tokens_counter,
@@ -210,6 +220,40 @@ def auto_num_pages(num_slots: int, max_len: int, page_size: int) -> int:
     into a failed decode."""
     per_slot = max_len // page_size
     return max(per_slot, (num_slots * per_slot * 3) // 4)
+
+
+def resolve_num_pages(
+    num_pages, num_slots: int, model_cfg, page_size: int,
+    quantize: str = "none",
+) -> int:
+    """The ONE pool-sizing rule, shared by the live engine and
+    kft-analyze's serving lint (analysis/serving.py) so the pool the
+    lint prices is the pool the engine allocates: explicit num_pages
+    wins; auto sizing takes 3/4 of the slot-row footprint and, at
+    quantize=int8, multiplies by the page capacity ratio — same HBM,
+    ~2x the pages."""
+    if num_pages:
+        return int(num_pages)
+    pages = auto_num_pages(num_slots, model_cfg.max_len, page_size)
+    if quantize == "int8":
+        head_dim = model_cfg.hidden_size // model_cfg.num_heads
+        pages = int(
+            pages * int8_page_capacity_ratio(
+                head_dim, np.dtype(model_cfg.dtype).itemsize
+            )
+        )
+    return pages
+
+
+def int8_page_capacity_ratio(head_dim: int, itemsize: int = 2) -> float:
+    """How many int8 pages fit in one unquantized page's HBM: a cached
+    K/V vector costs itemsize·D bytes unquantized vs D (int8 values) +
+    2 (one bf16 scale) quantized — (itemsize·D)/(D+2), e.g. 1.94x for
+    bf16 at D=64. Auto pool sizing multiplies by this at quantize=int8
+    so the SAME HBM budget holds ~2x the tokens — capacity the
+    admission gate and mem-budget see directly; bench reports the same
+    ratio as pages_per_hbm_gb."""
+    return (itemsize * float(head_dim)) / (head_dim + 2.0)
 
 
 # the per-slot dynamic sampling kernel — shared with the verify step's
@@ -491,6 +535,8 @@ class EnginePrograms:
         num_draft_tokens: int = 0,
         page_size: int = DEFAULT_PAGE_SIZE,
         num_pages: Optional[int] = None,
+        paged_attention: str = DEFAULT_PAGED_ATTENTION,
+        quantize: str = DEFAULT_QUANTIZE,
     ):
         from kubeflow_tpu.models.gpt import copy_pool_page
 
@@ -499,6 +545,20 @@ class EnginePrograms:
         self.num_draft_tokens = int(num_draft_tokens)
         if self.num_draft_tokens < 0:
             raise ValueError("num_draft_tokens must be >= 0")
+        self.paged_attention = str(paged_attention or
+                                   DEFAULT_PAGED_ATTENTION)
+        if self.paged_attention not in PAGED_ATTENTION_CHOICES:
+            raise ValueError(
+                f"paged_attention {self.paged_attention!r} must be one "
+                f"of {PAGED_ATTENTION_CHOICES}"
+            )
+        self.quantize = str(quantize or DEFAULT_QUANTIZE)
+        if self.quantize not in QUANTIZE_CHOICES:
+            raise ValueError(
+                f"quantize {self.quantize!r} must be one of "
+                f"{QUANTIZE_CHOICES}"
+            )
+        self.kv_quant = self.quantize  # pools follow the weight knob
         self.page_size = int(page_size)
         if self.page_size < 1 or self.page_size & (self.page_size - 1):
             raise ValueError(
@@ -517,15 +577,13 @@ class EnginePrograms:
             max(self.page_size, CHUNK_MIN_TOKENS), cfg.max_len
         )
         self.chunk_len -= self.chunk_len % self.page_size
-        self.num_pages = (
-            int(num_pages)
-            if num_pages
-            # callers (DecodeEngine, the serving lint) always pass the
-            # resolved pool size; this fallback only covers a direct
-            # construction, so it assumes the registry's default slots
-            else auto_num_pages(
-                DEFAULT_NUM_SLOTS, cfg.max_len, self.page_size
-            )
+        # callers (DecodeEngine, the serving lint) always pass the
+        # resolved pool size; the fallback covers direct construction
+        # and must apply the SAME rule (incl. the int8 capacity ratio),
+        # assuming the registry's default slots
+        self.num_pages = resolve_num_pages(
+            num_pages, DEFAULT_NUM_SLOTS, cfg, self.page_size,
+            self.quantize,
         )
         if self.num_pages < self.max_pages_per_slot:
             raise ValueError(
@@ -585,14 +643,26 @@ class EnginePrograms:
         from kubeflow_tpu.models.gpt import PagedState
 
         return PagedState(
-            page_table, cursors, self.page_size, self.num_pages
+            page_table, cursors, self.page_size, self.num_pages,
+            attn_impl=self.paged_attention, kv_quant=self.kv_quant,
         )
+
+    def _live_params(self, params, draft: bool = False):
+        """What the model applies: at quantize=int8 the RESIDENT tree is
+        int8 + per-channel scales (half the streamed weight bytes) and
+        the dequant into the compute dtype runs here, inside the jitted
+        program — on TPU it fuses into the matmul operand reads."""
+        if self.quantize != "int8":
+            return params
+        cfg = (self.draft_model if draft else self.model).cfg
+        return dequantize_params(params, cfg.dtype)
 
     # -- jitted program bodies ---------------------------------------------
 
     def _prefill_fn(self, params, ids, mask, key, temp, top_k, top_p):
         out, mutated = self.model.apply(
-            {"params": params}, ids, attention_mask=mask, prefill=True,
+            {"params": self._live_params(params)}, ids,
+            attention_mask=mask, prefill=True,
             mutable=["cache"],
         )
         last = jnp.maximum(mask.astype(jnp.int32).sum(1) - 1, 0)
@@ -604,8 +674,12 @@ class EnginePrograms:
         return mutated["cache"], tok[0]
 
     def _insert_fn(self, pool, cache_one, page_ids, real_len):
-        from kubeflow_tpu.models.gpt import insert_pages
+        from kubeflow_tpu.models.gpt import insert_pages, quantize_kv_cache
 
+        if self.kv_quant == "int8":
+            # prefill computed full-width K/V; the pool stores int8 +
+            # scales — quantize once, on device, at admission
+            cache_one = quantize_kv_cache(cache_one)
         return insert_pages(pool, cache_one, page_ids, real_len)
 
     def _chunk_fn(self, params, pool, ids, page_table, cursor, sample_idx,
@@ -620,7 +694,7 @@ class EnginePrograms:
         these windows over already-resident context."""
         paged = self._paged(page_table, cursor)
         out, mutated = self.model.apply(
-            {"params": params, "cache": pool}, ids,
+            {"params": self._live_params(params), "cache": pool}, ids,
             decode=True, paged=paged, mutable=["cache"],
         )
         logits = out["logits"][0, sample_idx]
@@ -634,7 +708,8 @@ class EnginePrograms:
                  counters, temps, top_ks, top_ps):
         paged = self._paged(page_table, cursors)
         out, mutated = self.model.apply(
-            {"params": params, "cache": pool}, tokens[:, None],
+            {"params": self._live_params(params), "cache": pool},
+            tokens[:, None],
             decode=True, paged=paged, mutable=["cache"],
         )
         nxt = _sample_slots(
@@ -650,7 +725,8 @@ class EnginePrograms:
         engine's first token comes from the TARGET prefill, bitwise the
         K=0 behavior), so this returns only the cache."""
         _, mutated = self.draft_model.apply(
-            {"params": dparams}, ids, attention_mask=mask, prefill=True,
+            {"params": self._live_params(dparams, draft=True)}, ids,
+            attention_mask=mask, prefill=True,
             mutable=["cache"],
         )
         return mutated["cache"]
@@ -661,7 +737,8 @@ class EnginePrograms:
         with the target's through chunked admission."""
         paged = self._paged(page_table, cursor)
         _, mutated = self.draft_model.apply(
-            {"params": dparams, "cache": dpool}, ids,
+            {"params": self._live_params(dparams, draft=True),
+             "cache": dpool}, ids,
             decode=True, paged=paged, mutable=["cache"],
         )
         return mutated["cache"]
@@ -676,12 +753,13 @@ class EnginePrograms:
         window positions as the target's verify forward. Cursors are
         host-owned: step j writes at cursors + j."""
         kk = self.num_draft_tokens
+        live_dparams = self._live_params(dparams, draft=True)
 
         def body(carry, j):
             dcache, tok = carry
             paged = self._paged(page_table, cursors + j)
             out, mutated = self.draft_model.apply(
-                {"params": dparams, "cache": dcache}, tok[:, None],
+                {"params": live_dparams, "cache": dcache}, tok[:, None],
                 decode=True, paged=paged, mutable=["cache"],
             )
             logits = out["logits"][:, 0].astype(jnp.float32)
@@ -736,7 +814,7 @@ class EnginePrograms:
         kk = self.num_draft_tokens
         paged = self._paged(page_table, cursors)
         out, mutated = self.model.apply(
-            {"params": params, "cache": pool}, window,
+            {"params": self._live_params(params), "cache": pool}, window,
             decode=True, paged=paged, mutable=["cache"],
         )
         logits = out["logits"].astype(jnp.float32)  # [S, K+1, V]
@@ -817,7 +895,8 @@ class EnginePrograms:
         dmask = jax.ShapeDtypeStruct((1, bucket), jnp.bool_)
         _, shapes = jax.eval_shape(
             lambda p, ids, m: self.model.apply(
-                {"params": p}, ids, attention_mask=m, prefill=True,
+                {"params": self._live_params(p)}, ids,
+                attention_mask=m, prefill=True,
                 mutable=["cache"],
             ),
             params, dummy, dmask,
@@ -829,7 +908,8 @@ class EnginePrograms:
         dmask = jax.ShapeDtypeStruct((1, bucket), jnp.bool_)
         _, shapes = jax.eval_shape(
             lambda p, ids, m: self.draft_model.apply(
-                {"params": p}, ids, attention_mask=m, prefill=True,
+                {"params": self._live_params(p, draft=True)}, ids,
+                attention_mask=m, prefill=True,
                 mutable=["cache"],
             ),
             draft_params, dummy, dmask,
@@ -839,16 +919,22 @@ class EnginePrograms:
     def abstract_params(self, model=None):
         """Parameter ShapeDtypeStructs from eval_shape over init — the
         analyzer's stand-in for real weights (same shapes/dtypes, zero
-        bytes allocated)."""
+        bytes allocated). At quantize=int8 this is the QUANTIZED
+        envelope (int8 leaves + per-channel scales): the resident form
+        the engine holds, which is what mem-budget must price."""
         m = self.model if model is None else model
         probe = min(8, m.cfg.max_len)
-        shapes = jax.eval_shape(
-            lambda: m.init(
+
+        def init():
+            p = m.init(
                 jax.random.PRNGKey(0), jnp.zeros((1, probe), jnp.int32),
                 deterministic=True,
+            )["params"]
+            return (
+                quantize_params_int8(p) if self.quantize == "int8" else p
             )
-        )
-        return shapes["params"]
+
+        return jax.eval_shape(init)
 
     def pool_shapes(self, cache_one):
         """The paged K/V pool structure (eval_shape over make_paged_pool
@@ -859,7 +945,9 @@ class EnginePrograms:
         from kubeflow_tpu.models.gpt import make_paged_pool
 
         return jax.eval_shape(
-            lambda c: make_paged_pool(c, self.num_pages, self.page_size),
+            lambda c: make_paged_pool(
+                c, self.num_pages, self.page_size, kv_quant=self.kv_quant
+            ),
             cache_one,
         )
 
@@ -1042,6 +1130,8 @@ class DecodeEngine:
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
         prefix_cache: bool = True,
+        paged_attention: Optional[str] = None,
+        quantize: Optional[str] = None,
     ):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -1049,10 +1139,11 @@ class DecodeEngine:
             raise ValueError("max_queue must be >= 1")
         self.name = name
         self.model = model
-        self.params = params
         self.num_slots = num_slots
         self.max_queue = max_queue
         cfg = model.cfg
+        self.paged_attention = paged_attention or DEFAULT_PAGED_ATTENTION
+        self.quantize = quantize or DEFAULT_QUANTIZE
         self.num_draft_tokens = int(num_draft_tokens)
         if self.num_draft_tokens > 0 and (
             draft_model is None or draft_params is None
@@ -1062,11 +1153,24 @@ class DecodeEngine:
                 "draft_params (speculative decoding drafts from a "
                 "resident second model)"
             )
+        if self.quantize == "int8":
+            # the restore-time dtype transform (checkpointing/quantize):
+            # params restored through restore_params(transform="int8")
+            # arrive already quantized; in-memory params quantize here
+            # ONCE — either way the resident tree is int8 + scales
+            if not is_quantized_params(params):
+                params = quantize_params_int8(params)
+            if draft_params is not None and not is_quantized_params(
+                draft_params
+            ):
+                draft_params = quantize_params_int8(draft_params)
+        self.params = params
         ps = int(page_size) if page_size else DEFAULT_PAGE_SIZE
-        pool_pages = (
-            int(num_pages)
-            if num_pages
-            else auto_num_pages(num_slots, cfg.max_len, ps)
+        # one pool-sizing rule with the serving lint (resolve_num_pages):
+        # auto sizing at quantize=int8 applies the capacity ratio — same
+        # HBM budget, ~2x the pages the admission gate can promise
+        pool_pages = resolve_num_pages(
+            num_pages, num_slots, cfg, ps, self.quantize
         )
         # the jitted program family (and the draft-compat + page-geometry
         # validation) lives in EnginePrograms — the same object
@@ -1075,6 +1179,7 @@ class DecodeEngine:
             model, draft_model=draft_model,
             num_draft_tokens=self.num_draft_tokens,
             page_size=ps, num_pages=pool_pages,
+            paged_attention=self.paged_attention, quantize=self.quantize,
         )
         self.page_size = ps
         self.num_pages = pool_pages
@@ -1100,10 +1205,10 @@ class DecodeEngine:
         from kubeflow_tpu.models.gpt import make_paged_pool
 
         self._cache_shapes = self.programs.cache_shapes(params, buckets[0])
-        self._make_paged_pool = make_paged_pool
-        self._pool = make_paged_pool(
-            self._cache_shapes, self.num_pages, self.page_size
+        self._make_paged_pool = lambda shapes: make_paged_pool(
+            shapes, self.num_pages, self.page_size, kv_quant=self.quantize
         )
+        self._pool = self._make_paged_pool(self._cache_shapes)
         self._insert = self.programs.insert
         self._step = self.programs.step
         self._chunk = self.programs.chunk
@@ -1118,8 +1223,8 @@ class DecodeEngine:
             self._draft_cache_shapes = self.programs.draft_cache_shapes(
                 draft_params, buckets[0]
             )
-            self._draft_pool = make_paged_pool(
-                self._draft_cache_shapes, self.num_pages, self.page_size
+            self._draft_pool = self._make_paged_pool(
+                self._draft_cache_shapes
             )
             self._draft_insert = self.programs.draft_insert
             self._draft_prefill = self.programs.draft_prefill
@@ -1224,6 +1329,7 @@ class DecodeEngine:
         self._prefix_lookups_m = serving_prefix_lookups_counter()
         self._pages_in_use_g = serving_kv_pages_in_use_gauge()
         self._pages_total_g = serving_kv_pages_total_gauge()
+        self._pool_bytes_g = serving_kv_pool_bytes_gauge()
         self._queue_depth.set(0, model=name)
         self._occupancy.set(0.0, model=name)
         # exported capacity: fleet-level ratios (queue/slots SLO rules,
@@ -1232,6 +1338,15 @@ class DecodeEngine:
         self._num_slots_gauge.set(num_slots, model=name)
         self._pages_total_g.set(self.num_pages, model=name)
         self._pages_in_use_g.set(0, model=name)
+        # resident pool bytes (target + draft, values + scales): what
+        # quantize=int8 actually buys, in the unit operators budget
+        pool_leaves = list(jax.tree_util.tree_leaves(self._pool))
+        if self._draft_pool is not None:
+            pool_leaves += jax.tree_util.tree_leaves(self._draft_pool)
+        self.kv_pool_bytes = int(
+            sum(l.size * l.dtype.itemsize for l in pool_leaves)
+        )
+        self._pool_bytes_g.set(self.kv_pool_bytes, model=name)
 
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"decode-engine-{name}"
@@ -1419,6 +1534,16 @@ class DecodeEngine:
                 "rewind_pages_returned": self._rewind_pages_returned,
                 "pages_in_use": self._pagepool.in_use,
                 "pages_total": self.num_pages,
+                # r13 read-path knobs: which decode kernel is live and
+                # what the pool stores (the /statusz + fleet evidence
+                # that a pallas/int8 rollout actually took effect)
+                "attention_kernel": self.paged_attention,
+                "quantize": self.quantize,
+                "kv_pool_dtype": (
+                    "int8" if self.quantize == "int8"
+                    else jnp.dtype(self.model.cfg.dtype).name
+                ),
+                "kv_pool_bytes": self.kv_pool_bytes,
             }
 
     def debug_state(self) -> dict:
@@ -1455,6 +1580,9 @@ class DecodeEngine:
             "page_size": self.page_size,
             "pages_total": self.num_pages,
             "pages_in_use": self._pagepool.in_use,
+            "attention_kernel": self.paged_attention,
+            "quantize": self.quantize,
+            "kv_pool_bytes": self.kv_pool_bytes,
             "prefix_cache": self.prefix_cache_enabled,
             "prefix_nodes": self._radix.nodes if self._radix else 0,
             "slots": slots,
@@ -1938,12 +2066,10 @@ class DecodeEngine:
                 self._slots[i] = None
                 slot.req.future.fail(err)
         self._temp_np[:] = 0.0
-        self._pool = self._make_paged_pool(
-            self._cache_shapes, self.num_pages, self.page_size
-        )
+        self._pool = self._make_paged_pool(self._cache_shapes)
         if self.num_draft_tokens > 0:
             self._draft_pool = self._make_paged_pool(
-                self._draft_cache_shapes, self.num_pages, self.page_size
+                self._draft_cache_shapes
             )
         self._pagepool.reset()
         if self._radix is not None:

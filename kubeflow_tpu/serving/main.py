@@ -21,6 +21,10 @@ from kubeflow_tpu.analysis.serving_plans import (
     DEFAULT_NUM_SLOTS,
     DEFAULT_NUM_PAGES,
     DEFAULT_PAGE_SIZE,
+    DEFAULT_PAGED_ATTENTION,
+    DEFAULT_QUANTIZE,
+    PAGED_ATTENTION_CHOICES,
+    QUANTIZE_CHOICES,
 )
 
 
@@ -42,6 +46,8 @@ def engine_knobs_from_env():
     auto power-of-two ladder), KFT_SERVING_PAGE_SIZE +
     KFT_SERVING_NUM_PAGES (paged-KV pool geometry; 0 pages = auto) +
     KFT_SERVING_PREFIX_CACHE (radix prefix index on/off),
+    KFT_SERVING_PAGED_ATTENTION (decode read kernel: gather | pallas) +
+    KFT_SERVING_QUANTIZE (none | int8 weights-and-KV-pages),
     KFT_SERVING_DRAFT_MODEL + KFT_SERVING_DRAFT_TOKENS (speculative
     decoding: registry draft model and tokens drafted per verify step; 0
     disables), KFT_SERVING_DRAIN_DEADLINE_S (SIGTERM/scale-down draining
@@ -56,6 +62,14 @@ def engine_knobs_from_env():
         "page_size": _env_int("KFT_SERVING_PAGE_SIZE", DEFAULT_PAGE_SIZE),
         "num_pages": _env_int("KFT_SERVING_NUM_PAGES", DEFAULT_NUM_PAGES),
         "prefix_cache": prefix_raw != "0",
+        "paged_attention": (
+            os.environ.get("KFT_SERVING_PAGED_ATTENTION", "").strip()
+            or DEFAULT_PAGED_ATTENTION
+        ),
+        "quantize": (
+            os.environ.get("KFT_SERVING_QUANTIZE", "").strip()
+            or DEFAULT_QUANTIZE
+        ),
         "draft_model": os.environ.get("KFT_SERVING_DRAFT_MODEL", "").strip(),
         "num_draft_tokens": _env_int("KFT_SERVING_DRAFT_TOKENS", 0),
         "draft_checkpoint_dir": os.environ.get(
@@ -89,6 +103,8 @@ def build_server(
     page_size: int = None,
     num_pages: int = None,
     prefix_cache: bool = None,
+    paged_attention: str = None,
+    quantize: str = None,
     draft_model: str = None,
     num_draft_tokens: int = None,
     draft_params=None,
@@ -169,6 +185,10 @@ def build_server(
             num_pages = env["num_pages"]
         if prefix_cache is None:
             prefix_cache = env["prefix_cache"]
+        if paged_attention is None:
+            paged_attention = env["paged_attention"]
+        if quantize is None:
+            quantize = env["quantize"]
         if draft_model is None:
             draft_model = env["draft_model"]
         if num_draft_tokens is None:
@@ -185,6 +205,19 @@ def build_server(
                 "num_draft_tokens > 0 needs num_slots >= 1: speculation "
                 "lives inside the decode engine, and num_slots=0 "
                 "disables it — drop the draft knobs or enable the engine"
+            )
+        if num_slots < 1 and paged_attention not in (None, "gather"):
+            raise ValueError(
+                "paged_attention=pallas needs num_slots >= 1: the "
+                "kernel serves the engine's decode step, and "
+                "num_slots=0 disables the engine"
+            )
+        if num_slots < 1 and quantize not in (None, "none"):
+            raise ValueError(
+                "quantize=int8 needs num_slots >= 1: quantization "
+                "lives inside the decode engine, and num_slots=0 "
+                "disables it — the static path would silently serve "
+                "full-width weights"
             )
         lm = ServedLm.from_registry(
             model, checkpoint_dir=checkpoint_dir or None, params=params
@@ -236,6 +269,8 @@ def build_server(
                     page_size=page_size or None,
                     num_pages=num_pages or None,
                     prefix_cache=prefix_cache,
+                    paged_attention=paged_attention,
+                    quantize=quantize,
                     draft_model=draft,
                     draft_params=draft_params,
                     num_draft_tokens=num_draft_tokens,
@@ -284,6 +319,20 @@ def main(argv=None) -> int:
         "KFT_SERVING_NUM_PAGES)",
     )
     ap.add_argument(
+        "--paged-attention", choices=PAGED_ATTENTION_CHOICES, default=None,
+        help="decode read-path kernel: gather (contiguous view through "
+        "the page table) or pallas (in-place page walk; bitwise-"
+        "identical greedy output, the TPU bandwidth choice; default "
+        "from KFT_SERVING_PAGED_ATTENTION, else gather)",
+    )
+    ap.add_argument(
+        "--quantize", choices=QUANTIZE_CHOICES, default=None,
+        help="serving quantization: int8 = per-channel int8 weights + "
+        "int8 KV pages with fused dequant (~half the streamed bytes, "
+        "~2x pool token capacity; default from KFT_SERVING_QUANTIZE, "
+        "else none)",
+    )
+    ap.add_argument(
         "--prefix-cache", type=int, choices=(0, 1), default=None,
         help="radix prefix cache on/off (default from "
         "KFT_SERVING_PREFIX_CACHE, else on)",
@@ -315,6 +364,8 @@ def main(argv=None) -> int:
         prefix_cache=(
             None if args.prefix_cache is None else bool(args.prefix_cache)
         ),
+        paged_attention=args.paged_attention,
+        quantize=args.quantize,
         draft_model=args.draft_model,
         num_draft_tokens=args.num_draft_tokens,
         draft_checkpoint_dir=args.draft_checkpoint_dir,

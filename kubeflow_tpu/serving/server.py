@@ -42,18 +42,26 @@ def bucket_for(n: int) -> int:
     return BATCH_BUCKETS[-1]
 
 
-def restore_checkpoint_params(checkpoint_dir: Optional[str]):
+def restore_checkpoint_params(
+    checkpoint_dir: Optional[str], transform: str = ""
+):
     """Params from the latest committed platform checkpoint — the one
     restore used by every serving loader (ServedModel + ServedLm). Reads
     the same manifest path training saves through
     (kubeflow_tpu/checkpointing), so a gang's checkpoints serve directly:
     uncommitted (torn) saves are invisible, and the shard layout the
-    training mesh used is irrelevant to the host-side assembly here."""
+    training mesh used is irrelevant to the host-side assembly here.
+    `transform="int8"` applies the restore-time dtype transform
+    (checkpointing/quantize.py) — the path for engine-only embedders
+    (a DecodeEngine built directly on restored weights) to never keep a
+    full-width tree alive; build_server's in-pod flow quantizes post-
+    restore instead, because the ServedLm model surface holds the
+    full-width params either way."""
     if checkpoint_dir is None:
         raise ValueError("need checkpoint_dir or params")
     from kubeflow_tpu.checkpointing import restore_params
 
-    return restore_params(checkpoint_dir)
+    return restore_params(checkpoint_dir, transform=transform)
 
 
 class ServedModel:
@@ -338,7 +346,10 @@ class ModelServer:
             )
             lines.append(
                 f"    kv pool: {state['pages_in_use']}"
-                f"/{state['pages_total']} pages of {state['page_size']} | "
+                f"/{state['pages_total']} pages of {state['page_size']} "
+                f"({st['kv_pool_dtype']}, {state['kv_pool_bytes']} B) | "
+                f"kernel: {st['attention_kernel']} "
+                f"quantize: {st['quantize']} | "
                 f"prefix cache: "
                 f"{'on' if state['prefix_cache'] else 'off'} "
                 f"nodes={state['prefix_nodes']} "

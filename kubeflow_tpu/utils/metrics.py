@@ -737,6 +737,18 @@ def serving_kv_pages_total_gauge() -> Gauge:
     )
 
 
+def serving_kv_pool_bytes_gauge() -> Gauge:
+    """Resident KV pool bytes (target + draft pools, values + int8
+    scales) — the engine's dominant HBM term in BYTES, so the fleet can
+    see what serving.quantize=int8 actually buys: the same gauge halves
+    (well, x(D+2)/(2D)) while serving_kv_pages_total doubles."""
+    return default_registry().gauge(
+        "serving_kv_pool_bytes",
+        "resident KV page pool bytes (all resident pools)",
+        ["model"],
+    )
+
+
 # ---------------------------------------------------------------------------
 # Observability-derived metrics (kubeflow_tpu/observability/; docs/
 # OBSERVABILITY.md): per-phase request accounting on the serving path and

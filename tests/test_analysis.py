@@ -604,6 +604,7 @@ class TestEngineUnderControlPlanePasses:
 
 
 class TestShippedPlansClean:
+    @pytest.mark.slow  # CI spmd-lint sweeps the same plans per subprocess
     def test_dryrun_plans_lower_clean(self, devices8):
         """Every dryrun plan traces/lowers clean in-process (the compile-
         mode remat capture over these same meshes is exercised by CI's
@@ -1018,6 +1019,35 @@ class TestServingPlansClean:
             4, 128, stats["page_size"]
         )
 
+    def test_tiny_quantized_pallas_plan_lowers_clean(self):
+        """The r13 int8+pallas family: int8 pools (value leaves
+        narrower-than-model, bf16 scale siblings round-tripping) and
+        the in-place page-walk step pass serve-dtype/donation/
+        program-count; mem-budget prices the int8 pool at roughly
+        a quarter of the f32 one (D=16: (D+2)/(4·D) plus scales)."""
+        from kubeflow_tpu.analysis.serving import analyze_serving_plan
+
+        findings, stats = analyze_serving_plan(
+            self._tiny(name="tiny:quant", paged_attention="pallas",
+                       quantize="int8")
+        )
+        bad = [f for f in findings if f.severity >= Severity.ERROR]
+        assert bad == [], "\n".join(f.render() for f in bad)
+        assert stats["quantize"] == "int8"
+        assert stats["paged_attention"] == "pallas"
+        _, base_stats = analyze_serving_plan(self._tiny())
+        quant_pool = stats["hbm"]["components_bytes"]["kv page pool"]
+        base_pool = base_stats["hbm"]["components_bytes"]["kv page pool"]
+        pages_ratio = stats["num_pages"] / base_stats["num_pages"]
+        # same HBM budget, more pages: bytes-per-page shrink covers the
+        # page-count growth (the capacity doubling mem-budget sees)
+        assert quant_pool <= base_pool
+        assert pages_ratio >= 1.7
+        # quantized params: ~1/4 the f32 param bytes (+ scales)
+        quant_params = stats["hbm"]["components_bytes"]["params"]
+        base_params = base_stats["hbm"]["components_bytes"]["params"]
+        assert quant_params < 0.4 * base_params
+
     def test_tiny_drafted_plan_lowers_clean(self):
         from kubeflow_tpu.analysis.serving import analyze_serving_plan
 
@@ -1045,7 +1075,7 @@ class TestServingPlansClean:
         )
 
         specs = shipped_serving_plans()
-        assert len(specs) == 5
+        assert len(specs) == 6
         for spec in specs:
             findings, stats = analyze_serving_plan_subprocess(
                 spec, REPO, timeout_s=600.0
@@ -1066,6 +1096,8 @@ class TestServingPlansClean:
             DEFAULT_NUM_PAGES,
             DEFAULT_NUM_SLOTS,
             DEFAULT_PAGE_SIZE,
+            DEFAULT_PAGED_ATTENTION,
+            DEFAULT_QUANTIZE,
         )
         from kubeflow_tpu.config.platform import ServingConfig
 
@@ -1073,6 +1105,7 @@ class TestServingPlansClean:
             "KFT_SERVING_NUM_SLOTS", "KFT_SERVING_MAX_QUEUE",
             "KFT_SERVING_PREFILL_BUCKETS", "KFT_SERVING_PAGE_SIZE",
             "KFT_SERVING_NUM_PAGES", "KFT_SERVING_PREFIX_CACHE",
+            "KFT_SERVING_PAGED_ATTENTION", "KFT_SERVING_QUANTIZE",
             "KFT_SERVING_DRAIN_DEADLINE_S",
         ):
             monkeypatch.delenv(var, raising=False)
@@ -1082,6 +1115,8 @@ class TestServingPlansClean:
         assert knobs["page_size"] == DEFAULT_PAGE_SIZE
         assert knobs["num_pages"] == DEFAULT_NUM_PAGES
         assert knobs["prefix_cache"] is True
+        assert knobs["paged_attention"] == DEFAULT_PAGED_ATTENTION
+        assert knobs["quantize"] == DEFAULT_QUANTIZE
         assert knobs["drain_deadline_s"] == DEFAULT_DRAIN_DEADLINE_S
         cfg = ServingConfig()
         assert cfg.num_slots == DEFAULT_NUM_SLOTS
@@ -1089,6 +1124,8 @@ class TestServingPlansClean:
         assert cfg.page_size == DEFAULT_PAGE_SIZE
         assert cfg.num_pages == DEFAULT_NUM_PAGES
         assert cfg.prefix_cache is True
+        assert cfg.paged_attention == DEFAULT_PAGED_ATTENTION
+        assert cfg.quantize == DEFAULT_QUANTIZE
         assert cfg.drain_deadline_s == DEFAULT_DRAIN_DEADLINE_S
 
     def test_registry_shared_with_bench(self):

@@ -182,6 +182,7 @@ class TestGptTrainer:
         }
         assert any("tensor" in str(s) for s in specs.values()), specs
 
+    @pytest.mark.slow  # tier-1 keeps test_ring_attention's kernel suite
     def test_causal_ring_matches_dense_on_sequence_mesh(self, devices8):
         """GPT with ring attention on a real `sequence` axis computes the
         same training losses as the dense model on a pure-data mesh — the
@@ -308,6 +309,7 @@ class TestGptTrainer:
             losses["flat"], losses["pp"], rtol=1e-5, atol=0.0
         )
 
+    @pytest.mark.slow  # tier-1 keeps test_moe's EP==DP equivalence
     def test_moe_ep_matches_dp_loss(self, devices8):
         """MoE-GPT on a real expert axis == the same model replicated —
         expert sharding changes layout, not math."""

@@ -36,6 +36,7 @@ class TestResNet:
         )
         assert "batch_stats" in variables
 
+    @pytest.mark.slow  # full resnet50 init just to count params
     def test_resnet50_param_count(self):
         model = get_model("resnet50")
         variables = model.init(

@@ -196,6 +196,7 @@ class TestTopKRouting:
         with pytest.raises(ValueError, match="k="):
             topk_route(jnp.zeros((1, 4, 4)), capacity=2, k=5)
 
+    @pytest.mark.slow  # tier-1 keeps top-1 EP training + EP==DP
     def test_top2_model_trains_ep(self, devices8):
         cfg = TrainingConfig(
             model="bert_tiny_moe",

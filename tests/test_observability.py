@@ -309,6 +309,12 @@ class TestEngineTracing:
             assert "[engines]" in text
             assert "g:" in text
             assert "queue=" in text and "prefill=" in text
+            # r13: the active decode kernel + KV pool dtype are operator-
+            # visible (a pallas/int8 rollout must be checkable from the
+            # status page, not just from config)
+            assert "kernel: gather" in text
+            assert "quantize: none" in text
+            assert "float32" in text  # the fixture model's pool dtype
             status, resp, _ = server.app.handle_full("GET", "/metrics")
             assert status == 200
             metrics_text = resp.body.decode()

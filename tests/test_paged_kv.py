@@ -350,6 +350,112 @@ class TestSpeculativeRewind:
         assert stats["prefix_hit_tokens"] > 0
 
 
+class TestPallasKernel:
+    """serving.paged_attention=pallas: the in-place page-table walk
+    (ops/paged_attention.py) replaces the contiguous gather on the
+    one-token step. The contract is the r10 one, unchanged: greedy
+    output BITWISE-identical to the fused-scan oracle — the kernel
+    performs the gather path's exact arithmetic, so switching kernels
+    changes where bytes move, never what is computed."""
+
+    @pytest.mark.parametrize(
+        "page_size",
+        [8, pytest.param(64, marks=pytest.mark.slow)],  # CI runs both;
+        # tier-1 keeps one geometry (the many-pages-per-slot one)
+    )
+    def test_bitwise_vs_generate_across_page_sizes(
+        self, gpt_and_params, page_size
+    ):
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "pl", model, params, num_slots=2, max_queue=8,
+            page_size=page_size, paged_attention="pallas",
+        )
+        try:
+            rows = _rows(4, 7)
+            futs = [eng.submit(r, 6) for r in rows]
+            outs = [f.wait(120) for f in futs]
+            stats = eng.stats()
+        finally:
+            eng.close()
+        for row, out in zip(rows, outs):
+            assert out["tokens"] == _ref_tokens(model, params, row, 6)
+        assert stats["attention_kernel"] == "pallas"
+
+    def test_bitwise_through_prefix_hit_and_cow(self, gpt_and_params):
+        """Prefix hits + COW admit through the gather-era helpers; the
+        pallas step then reads the same pages — bitwise end to end."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "plpx", model, params, num_slots=1, max_queue=8, page_size=8,
+            prefix_cache=True, paged_attention="pallas",
+        )
+        try:
+            row = _rows(20)[0]
+            a = eng.generate_row(row, 6, timeout=120)
+            b = eng.generate_row(row, 6, timeout=120)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        ref = _ref_tokens(model, params, row, 6)
+        assert a["tokens"] == ref
+        assert b["tokens"] == ref
+        assert stats["prefix_hit_tokens"] > 0
+
+    @pytest.mark.slow
+    def test_bitwise_under_speculation(self, gpt_and_params):
+        """K>0: draft one-token steps ride the pallas kernel, the verify
+        window rides the gather path (multi-token windows amortize the
+        gather; the kernel serves the s==1 hot loop) — the composition
+        must still be bitwise the oracle's, hostile draft included."""
+        model, params = gpt_and_params
+        dparams = jax.device_get(params)
+        dparams["head"]["kernel"] = np.roll(
+            np.asarray(dparams["head"]["kernel"]), 1, axis=-1
+        )
+        for dp, k in ((params, 3), (dparams, 2)):
+            eng = DecodeEngine(
+                "plsp", model, params, num_slots=1, max_queue=4,
+                page_size=8, prefix_cache=False, draft_model=model,
+                draft_params=dp, num_draft_tokens=k,
+                paged_attention="pallas",
+            )
+            try:
+                row = _rows(7)[0]
+                out = eng.generate_row(row, 6, timeout=120)
+            finally:
+                eng.close()
+            assert out["tokens"] == _ref_tokens(model, params, row, 6)
+
+    def test_stats_and_statusz_expose_kernel_and_dtype(
+        self, gpt_and_params
+    ):
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "plst", model, params, num_slots=1, autostart=False,
+            paged_attention="pallas",
+        )
+        try:
+            st = eng.stats()
+            dbg = eng.debug_state()
+        finally:
+            eng.close()
+        assert st["attention_kernel"] == "pallas"
+        assert st["quantize"] == "none"
+        assert st["kv_pool_dtype"] == "float32"  # the fixture's dtype
+        assert st["kv_pool_bytes"] > 0
+        assert dbg["attention_kernel"] == "pallas"
+        assert dbg["kv_pool_bytes"] == st["kv_pool_bytes"]
+
+    def test_unknown_kernel_rejected(self, gpt_and_params):
+        model, params = gpt_and_params
+        with pytest.raises(ValueError, match="paged_attention"):
+            DecodeEngine(
+                "plbad", model, params, num_slots=1, autostart=False,
+                paged_attention="cuda",
+            )
+
+
 class TestMetricsSurface:
     def test_paged_metrics_registered_and_move(self, gpt_and_params):
         from kubeflow_tpu.utils.metrics import default_registry
@@ -374,6 +480,11 @@ class TestMetricsSurface:
             "serving_prefix_cache_hit_tokens_total"
         ).value(**m) > 0
         assert reg.get("serving_kv_pages_total").value(**m) == eng.num_pages
+        # resident pool bytes: the fleet-visible HBM term (r13 — what
+        # quantize=int8 halves while pages_total doubles)
+        assert reg.get(
+            "serving_kv_pool_bytes"
+        ).value(**m) == eng.kv_pool_bytes > 0
         # the prefix index is still holding the committed pages
         assert reg.get("serving_kv_pages_in_use").value(**m) > 0
 

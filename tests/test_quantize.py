@@ -1,0 +1,380 @@
+"""Serving int8 quantization seams (checkpointing/quantize.py;
+serving/engine.py quantize="int8"; ops/attention.py KV quant).
+
+The r13 quantization stack has three seams, each pinned here:
+
+- the checkpoint-restore dtype transform: per-channel int8 weights
+  assembled from a manifest must be IDENTICAL regardless of the mesh the
+  checkpoint was saved on (restore is global-region assembly, so the
+  transform commutes with resharding), and dequantization must bound the
+  per-channel error at scale/2 (round-to-nearest against the stored
+  scale);
+- the accuracy gate: logit max-abs-err + held-out loss delta of the
+  dequantized model vs the original, thresholds PINNED — the serving CI
+  workflow's int8-accuracy step runs this file, so a quantization-math
+  regression fails the build, not an operator's model;
+- the capacity story: int8 KV pages cost (D+2)/(itemsize·D) of an
+  unquantized page, so the auto-sized pool doubles its page count at the
+  same HBM and the admission gate co-admits work that serialized at
+  full width.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.checkpointing import CheckpointManager, restore_params
+from kubeflow_tpu.checkpointing.quantize import (
+    dequantize_params,
+    is_quantized_params,
+    quantization_accuracy,
+    quantize_leaf_int8,
+    quantize_params_int8,
+)
+
+# the pinned accuracy-gate thresholds (measured on gpt_tiny at f32 and
+# bf16: max-abs-err 0.06/0.09, loss delta 0.002/0.004 — pinned with ~2.5x
+# slack so real regressions trip while numeric noise does not)
+LOGIT_MAX_ABS_ERR_THRESHOLD = 0.25
+LOSS_DELTA_THRESHOLD = 0.02
+
+
+class TestQuantizeLeaf:
+    def test_per_channel_scale_and_bound(self):
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(16, 8) * np.linspace(0.1, 4.0, 8))
+        q, scale = quantize_leaf_int8(w)
+        assert q.dtype == jnp.int8 and scale.shape == (8,)
+        # symmetric per-output-channel: scale spans each column's amax
+        np.testing.assert_allclose(
+            np.asarray(scale),
+            np.max(np.abs(np.asarray(w, np.float32)), axis=0) / 127.0,
+            rtol=1e-6,
+        )
+        # dequant error bounded by scale/2 per channel (round-to-nearest)
+        deq = np.asarray(q, np.float32) * np.asarray(scale)
+        err = np.abs(deq - np.asarray(w, np.float32))
+        assert np.all(err <= np.asarray(scale)[None, :] * 0.5 + 1e-7)
+
+    def test_zero_channel_survives(self):
+        w = jnp.zeros((4, 3))
+        q, scale = quantize_leaf_int8(w)
+        assert np.all(np.asarray(q) == 0) and np.all(np.asarray(scale) == 0)
+        deq = np.asarray(q, np.float32) * np.asarray(scale)
+        assert np.all(deq == 0)
+
+    def test_envelope_structure_and_passthrough(self, gpt_and_params):
+        model, params = gpt_and_params
+        qp = quantize_params_int8(params)
+        assert is_quantized_params(qp)
+        assert not is_quantized_params(params)
+        # same tree structure; >=2-D leaves int8, 1-D (LN/bias) untouched
+        assert jax.tree_util.tree_structure(
+            qp["qvalues"]
+        ) == jax.tree_util.tree_structure(params)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            qp["qvalues"]
+        )[0]:
+            orig = params
+            for entry in path:
+                orig = orig[entry.key]
+            if np.asarray(orig).ndim >= 2:
+                assert leaf.dtype == jnp.int8
+                assert jax.tree_util.keystr(path) in qp["qscales"]
+            else:
+                assert leaf.dtype == orig.dtype
+                np.testing.assert_array_equal(
+                    np.asarray(leaf), np.asarray(orig)
+                )
+        # dequant returns the original structure at the compute dtype
+        deq = dequantize_params(qp, model.cfg.dtype)
+        assert jax.tree_util.tree_structure(
+            deq
+        ) == jax.tree_util.tree_structure(params)
+
+
+class TestRestoreTransform:
+    def _save(self, tmp_path, devices8, shape, spec):
+        mesh = Mesh(np.array(devices8[:2]).reshape(shape), ("data", "fsdp"))
+        rng = np.random.RandomState(3)
+        kernel = rng.randn(16, 8).astype(np.float32)
+        bias = rng.randn(8).astype(np.float32)
+        state = {
+            "params": {
+                "dense": {
+                    "kernel": jax.device_put(
+                        jnp.asarray(kernel), NamedSharding(mesh, spec)
+                    ),
+                    "bias": jax.device_put(
+                        jnp.asarray(bias), NamedSharding(mesh, P())
+                    ),
+                }
+            }
+        }
+        with CheckpointManager(str(tmp_path), async_save=False) as mgr:
+            mgr.save(1, state, force=True)
+        return kernel, bias
+
+    def test_int8_roundtrip_on_resharded_manifest(
+        self, devices8, tmp_path
+    ):
+        """The restore-time transform is layout-invariant: quantized
+        params assembled from a 1x2-sharded save equal those from a
+        2x1-sharded save BITWISE (global-region assembly commutes with
+        the transform), and both equal quantizing the plain restore."""
+        a = tmp_path / "a"
+        b = tmp_path / "b"
+        kernel, bias = self._save(a, devices8, (1, 2), P("fsdp", None))
+        kernel_b, _ = self._save(b, devices8, (2, 1), P("data", None))
+        np.testing.assert_array_equal(kernel, kernel_b)
+
+        qa = restore_params(str(a), transform="int8")
+        qb = restore_params(str(b), transform="int8")
+        assert is_quantized_params(qa) and is_quantized_params(qb)
+        for ka in qa["qscales"]:
+            np.testing.assert_array_equal(
+                np.asarray(qa["qscales"][ka]), np.asarray(qb["qscales"][ka])
+            )
+        np.testing.assert_array_equal(
+            np.asarray(qa["qvalues"]["dense"]["kernel"]),
+            np.asarray(qb["qvalues"]["dense"]["kernel"]),
+        )
+        # transform(restore) == quantize(plain restore)
+        plain = restore_params(str(a))
+        ref = quantize_params_int8(plain)
+        np.testing.assert_array_equal(
+            np.asarray(qa["qvalues"]["dense"]["kernel"]),
+            np.asarray(ref["qvalues"]["dense"]["kernel"]),
+        )
+        # 1-D leaves ride through the transform untouched
+        np.testing.assert_array_equal(
+            np.asarray(qa["qvalues"]["dense"]["bias"]), bias
+        )
+        # dequant lands within the per-channel bound of the original
+        deq = np.asarray(
+            dequantize_params(qa, jnp.float32)["dense"]["kernel"]
+        )
+        scale = np.asarray(qa["qscales"]["['dense']['kernel']"])
+        assert np.all(
+            np.abs(deq - kernel) <= scale[None, :] * 0.5 + 1e-7
+        )
+
+    def test_serving_loader_threads_transform(self, devices8, tmp_path):
+        """The serving loader exposes the restore-time stage: an
+        engine-only embedder restores pre-quantized through ONE call."""
+        from kubeflow_tpu.serving.server import restore_checkpoint_params
+
+        self._save(tmp_path, devices8, (1, 2), P("fsdp", None))
+        qp = restore_checkpoint_params(str(tmp_path), transform="int8")
+        assert is_quantized_params(qp)
+        assert qp["qvalues"]["dense"]["kernel"].dtype == np.int8
+
+    def test_unknown_transform_rejected(self, devices8, tmp_path):
+        self._save(tmp_path, devices8, (1, 2), P("fsdp", None))
+        with pytest.raises(ValueError, match="unknown checkpoint"):
+            restore_params(str(tmp_path), transform="int4")
+
+
+class TestAccuracyGate:
+    def test_thresholds_pinned(self, gpt_and_params):
+        """The int8 accuracy gate beside the parity tests: quantized
+        gpt_tiny must land inside the PINNED logit/loss thresholds on a
+        held-out batch. A quantization-math regression (wrong axis, lost
+        scale, asymmetric clip) blows these bounds by orders of
+        magnitude."""
+        model, params = gpt_and_params
+        qp = quantize_params_int8(params)
+        ids = ((jnp.arange(32).reshape(2, 16) * 7 + 3) % 512).astype(
+            jnp.int32
+        )
+        acc = quantization_accuracy(model, params, qp, ids)
+        assert acc["logit_max_abs_err"] < LOGIT_MAX_ABS_ERR_THRESHOLD
+        assert acc["loss_delta"] < LOSS_DELTA_THRESHOLD
+        # and the gate is not vacuous: quantization does move the logits
+        assert acc["logit_max_abs_err"] > 0.0
+
+
+class TestPoolCapacity:
+    def test_auto_pool_pages_scale_by_capacity_ratio(self, gpt_and_params):
+        """quantize=int8 multiplies the auto-sized pool by the page
+        capacity ratio (same HBM, more pages) — the admission gate and
+        mem-budget see the doubled token capacity directly."""
+        from kubeflow_tpu.serving.engine import (
+            DecodeEngine,
+            auto_num_pages,
+            int8_page_capacity_ratio,
+        )
+
+        model, params = gpt_and_params
+        cfg = model.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        base = auto_num_pages(2, cfg.max_len, 16)
+        ratio = int8_page_capacity_ratio(
+            head_dim, np.dtype(cfg.dtype).itemsize
+        )
+        eng = DecodeEngine(
+            "qcap", model, params, num_slots=2, autostart=False,
+            quantize="int8",
+        )
+        try:
+            assert eng.num_pages == int(base * ratio)
+            # the bf16 serve case (D=64): >=1.9x pages per HBM GB — the
+            # r13 acceptance ratio, here checked at the formula level
+            assert int8_page_capacity_ratio(64, 2) >= 1.9
+            # pool BYTES stay within the unquantized budget (that is
+            # the whole point: more pages, same HBM)
+            bf16_eng = DecodeEngine(
+                "qcap0", model, params, num_slots=2, autostart=False,
+            )
+            try:
+                assert eng.kv_pool_bytes <= bf16_eng.kv_pool_bytes
+                assert eng.num_pages >= int(1.7 * bf16_eng.num_pages)
+            finally:
+                bf16_eng.close()
+        finally:
+            eng.close()
+
+    @pytest.mark.slow
+    def test_int8_pool_coadmits_what_fullwidth_serializes(
+        self, gpt_and_params
+    ):
+        """Capacity doubling THROUGH the admission gate: two long
+        requests whose reservations exceed a minimum full-width pool
+        must serialize there, but co-reside on the int8 pool at the
+        same byte budget."""
+        import time
+
+        from kubeflow_tpu.serving.engine import (
+            DecodeEngine,
+            int8_page_capacity_ratio,
+        )
+
+        model, params = gpt_and_params  # max_len 128
+        cfg = model.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        itemsize = np.dtype(cfg.dtype).itemsize
+        # full-width floor pool: 8 pages of 16 = one max_len request;
+        # int8 pool at the SAME byte budget
+        int8_pages = int(8 * int8_page_capacity_ratio(head_dim, itemsize))
+        assert int8_pages >= 14
+        row = (np.arange(4) * 3 + 1).astype(np.int32) % 512
+
+        def drive(eng):
+            """Submit two ~7-page requests; return max concurrently
+            admitted while the first is still resident."""
+            peak = 0
+            try:
+                f_a = eng.submit(row, 100)
+                f_b = eng.submit(row, 100)
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    s = eng.stats()
+                    resident = sum(
+                        x is not None for x in eng._slots
+                    )
+                    peak = max(peak, resident)
+                    if s["admitted"] >= 2 and resident <= 1:
+                        break  # b admitted after a retired: serialized
+                    if peak == 2:
+                        break
+                    time.sleep(0.005)
+                f_a.wait(300)
+                f_b.wait(300)
+            finally:
+                eng.close()
+            return peak
+
+        wide = DecodeEngine(
+            "wide", model, params, num_slots=2, max_queue=4,
+            page_size=16, num_pages=8, prefix_cache=False,
+        )
+        assert drive(wide) == 1  # pool floor: the gate serializes
+        quant = DecodeEngine(
+            "quant", model, params, num_slots=2, max_queue=4,
+            page_size=16, num_pages=int8_pages, prefix_cache=False,
+            quantize="int8",
+        )
+        assert drive(quant) == 2  # same bytes, twice the tokens
+
+
+class TestConfigChain:
+    def test_bad_knob_values_rejected_at_config_time(self):
+        import dataclasses
+
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import ServingConfig
+
+        for kw in (
+            {"paged_attention": "cuda"},
+            {"quantize": "int4"},
+            # both knobs live inside the engine: num_slots=0 disables it
+            # and must reject, not silently serve full-width gather
+            {"num_slots": 0, "quantize": "int8"},
+            {"num_slots": 0, "paged_attention": "pallas"},
+        ):
+            cfg = dataclasses.replace(ServingConfig(), **kw)
+            with pytest.raises(ConfigError):
+                cfg.validate()
+
+    def test_build_server_rejects_engineless_knobs(self, monkeypatch):
+        from kubeflow_tpu.serving.main import build_server
+
+        monkeypatch.delenv("KFT_SERVING_NUM_SLOTS", raising=False)
+        with pytest.raises(ValueError, match="quantize=int8"):
+            build_server(
+                "gpt_tiny", params={}, num_slots=0, quantize="int8",
+                batch_window_ms=0,
+            )
+        with pytest.raises(ValueError, match="paged_attention=pallas"):
+            build_server(
+                "gpt_tiny", params={}, num_slots=0,
+                paged_attention="pallas", batch_window_ms=0,
+            )
+
+
+class TestQuantizedEngine:
+    def test_engine_accepts_prequantized_params(self, gpt_and_params):
+        """The restore-time path: params already in the quantized
+        envelope (restore_params(transform="int8")) ride the ctor
+        unchanged — no double quantization, same stats surface."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        model, params = gpt_and_params
+        qp = quantize_params_int8(params)
+        eng = DecodeEngine(
+            "preq", model, qp, num_slots=1, autostart=False,
+            quantize="int8",
+        )
+        try:
+            assert eng.params is qp  # not re-wrapped
+            st = eng.stats()
+            assert st["quantize"] == "int8"
+            assert st["kv_pool_dtype"] == "int8"
+        finally:
+            eng.close()
+
+    def test_quantized_greedy_matches_across_read_paths(
+        self, gpt_and_params
+    ):
+        """int8 has no bitwise contract vs the full-width oracle — but
+        the TWO int8 read paths (gather+dequant, pallas fused dequant)
+        run the same math and must agree BITWISE with each other."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        model, params = gpt_and_params
+        row = (np.arange(7) * 3 + 1).astype(np.int32) % 512
+        outs = {}
+        for impl in ("gather", "pallas"):
+            eng = DecodeEngine(
+                f"q-{impl}", model, params, num_slots=1, max_queue=4,
+                quantize="int8", paged_attention=impl,
+            )
+            try:
+                outs[impl] = eng.generate_row(row, 6, timeout=300)[
+                    "tokens"
+                ]
+            finally:
+                eng.close()
+        assert outs["gather"] == outs["pallas"]

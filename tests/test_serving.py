@@ -222,6 +222,9 @@ class TestInferenceServiceController:
             "KFT_SERVING_PAGE_SIZE": "16",
             "KFT_SERVING_NUM_PAGES": "0",  # 0 = auto pool sizing
             "KFT_SERVING_PREFIX_CACHE": "1",
+            # decode read-path kernel + serving quantization (r13)
+            "KFT_SERVING_PAGED_ATTENTION": "gather",
+            "KFT_SERVING_QUANTIZE": "none",
             "KFT_SERVING_DRAFT_MODEL": "",  # speculation off by default
             "KFT_SERVING_DRAFT_TOKENS": "0",
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": "",
@@ -257,6 +260,8 @@ class TestInferenceServiceController:
         monkeypatch.setenv("KFT_SERVING_PAGE_SIZE", "8")
         monkeypatch.setenv("KFT_SERVING_NUM_PAGES", "24")
         monkeypatch.setenv("KFT_SERVING_PREFIX_CACHE", "0")
+        monkeypatch.setenv("KFT_SERVING_PAGED_ATTENTION", "pallas")
+        monkeypatch.setenv("KFT_SERVING_QUANTIZE", "int8")
         monkeypatch.setenv("KFT_SERVING_DRAIN_DEADLINE_S", "12")
         assert engine_knobs_from_env() == {
             "num_slots": 4,
@@ -265,6 +270,8 @@ class TestInferenceServiceController:
             "page_size": 8,
             "num_pages": 24,
             "prefix_cache": False,
+            "paged_attention": "pallas",
+            "quantize": "int8",
             "draft_model": "",
             "num_draft_tokens": 0,
             "draft_checkpoint_dir": "",
@@ -274,12 +281,16 @@ class TestInferenceServiceController:
         monkeypatch.setenv("KFT_SERVING_NUM_SLOTS", "")
         monkeypatch.setenv("KFT_SERVING_PAGE_SIZE", "")
         monkeypatch.setenv("KFT_SERVING_PREFIX_CACHE", "")
+        monkeypatch.setenv("KFT_SERVING_PAGED_ATTENTION", "")
+        monkeypatch.setenv("KFT_SERVING_QUANTIZE", "")
         monkeypatch.setenv("KFT_SERVING_DRAIN_DEADLINE_S", "")
         knobs = engine_knobs_from_env()
         assert knobs["num_slots"] == 8  # default
         assert knobs["prefill_buckets"] is None  # auto ladder
         assert knobs["page_size"] == 16  # default
         assert knobs["prefix_cache"] is True  # empty = default on
+        assert knobs["paged_attention"] == "gather"  # default kernel
+        assert knobs["quantize"] == "none"  # default: bitwise engine
         assert knobs["drain_deadline_s"] == 30.0  # default budget
 
 

@@ -11,6 +11,7 @@ These tests pin the fixed layouts (vocab_table-sharded lookup tables,
 
 import jax
 import numpy as np
+import pytest
 
 from __graft_entry__ import _REMAT_WARNING, capture_compiler_diagnostics
 from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
@@ -47,6 +48,7 @@ def _compile_and_check(model, axes, task_cls, model_kwargs=None, **cfg_kwargs):
 
 
 class TestNoInvoluntaryRemat:
+    @pytest.mark.slow  # tier-1 keeps the sp_mesh_gpt remat canary
     def test_sp_tp_dp_mesh_bert(self, devices8):
         """The round-3 offender: {data, tensor, sequence} on the encoder."""
         _compile_and_check(
@@ -56,6 +58,7 @@ class TestNoInvoluntaryRemat:
             {"attention_impl": "ring"},
         )
 
+    @pytest.mark.slow  # tier-1 keeps the sp_mesh_gpt remat canary
     def test_fsdp_pp_mesh_bert(self, devices8):
         """The second (previously unnoticed) offender: fsdp-sharded
         embedding tables under {data, fsdp, pipeline}."""
@@ -71,6 +74,7 @@ class TestNoInvoluntaryRemat:
             {"attention_impl": "ring"},
         )
 
+    @pytest.mark.slow  # tier-1 keeps the sp_mesh_gpt remat canary
     def test_sp_ulysses_mesh_bert(self, devices8):
         """Ulysses' round-5 shard_map formulation (explicit all_to_alls +
         per-device kernel) must compile remat-free on a real sequence
@@ -82,6 +86,7 @@ class TestNoInvoluntaryRemat:
             {"attention_impl": "ulysses"},
         )
 
+    @pytest.mark.slow  # tier-1 keeps the sp_mesh_gpt remat canary
     def test_pp_1f1b_mesh_gpt(self, devices8):
         """1f1b selected through the CONFIG tree, not a model kwarg
         (TrainingConfig.pipeline_schedule → Trainer → pipeline_scan):

@@ -149,6 +149,7 @@ class TestStudyLifecycle:
         assert best["metric"]["final_loss"] == 1.5
         assert done["status"]["trialsSucceeded"] == 3
 
+    @pytest.mark.slow  # real-training study; unit lifecycle tests stay tier-1
     def test_real_training_study_end_to_end(self, devices8):
         """Trials run REAL XLA training; study optimizes items/sec."""
         runner = InProcessTrainerRunner(steps_override=2)
@@ -181,6 +182,7 @@ class TestStudyLifecycle:
         assert best["metric"]["items_per_sec"] > 0
         assert done["status"]["trialsSucceeded"] == 2
 
+    @pytest.mark.slow  # real-training study; unit lifecycle tests stay tier-1
     def test_failed_trials_fail_study(self):
         runner = FakePodRunner()
         store, cm, executor = make_harness(runner)

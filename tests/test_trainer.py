@@ -113,6 +113,7 @@ class TestTrainerFSDP:
 
 
 class TestTrainerTP:
+    @pytest.mark.slow  # tier-1 keeps test_gpt's TP==DP equivalence
     def test_tp_matches_dp_loss(self, devices8):
         """Same seed, same data: TP=4 and pure DP runs must agree numerically."""
         tr_dp = tiny_bert_trainer(MeshConfig(data=8))
@@ -289,6 +290,7 @@ class TestGradientAccumulation:
         )
         return loss, leaf
 
+    @pytest.mark.slow  # tier-1 keeps test_accum_matches_full_batch
     def test_accum_exact_with_ragged_masks(self, devices8):
         """Valid-token-weighted accumulation (loss_items): the combined
         grad equals the full-batch token-mean grad even when microbatches
@@ -324,6 +326,7 @@ class TestGradientAccumulation:
         state, m = tr.train_step(state, batch, jax.random.PRNGKey(0))
         assert np.isfinite(float(jax.device_get(m["loss"])))
 
+    @pytest.mark.slow  # rejection path; full resnet trainer compile
     def test_batch_stats_models_rejected(self, devices8):
         from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
         from kubeflow_tpu.parallel.mesh import mesh_from_config

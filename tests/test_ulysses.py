@@ -196,6 +196,7 @@ class TestUlyssesNumerics:
 
 
 class TestUlyssesTrainer:
+    @pytest.mark.slow  # tier-1 keeps the ulysses kernel-parity tests
     def test_sp_matches_dp_loss(self, devices8):
         """data=2 x sequence=4 Ulysses run matches pure-DP loss (bert_tiny
         has 4 heads — exactly divisible by the sequence axis)."""
