@@ -950,6 +950,23 @@ def bench_serving_continuous(
     model_server.add_engine(spec_k0)
     model_server.add_engine(spec_kd)
 
+    # the r14 sharded engine: the spec-pair target (even 2048 vocab —
+    # every big leaf really shards; bench:gpt_sharded in the plan
+    # registry, so the lint sweep certifies exactly this program
+    # family) on a tensor=2 mesh — pools head-sharded, weights sharded
+    # at rest and gathered in-program. The 1×1 baseline is the K=0
+    # spec engine above: same model, same trace, same knobs. Needs the
+    # entry's 2 virtual CPU devices (the entry spec forces them);
+    # skipped gracefully on a 1-device process.
+    sharded_engine = None
+    if len(jax.devices()) >= 2:
+        sharded_engine = DecodeEngine(
+            "gpt_sharded", spec_model, spec_params, num_slots=num_slots,
+            prefill_buckets=buckets, max_queue=max(64, num_requests),
+            mesh_tensor=2,
+        )
+        model_server.add_engine(sharded_engine)
+
     # the r13 quantized engine: SAME model/params/trace as the headline
     # engine, int8 weights (quantized at ctor — the restore-time dtype
     # transform's in-memory twin) + int8 KV pages read through the
@@ -1219,6 +1236,45 @@ def bench_serving_continuous(
             spec_stats["draft_accepted"] - pre_spec["draft_accepted"]
         )
         accept_rate = round(accepted / proposed, 3) if proposed else 0.0
+        # -- sharded engine phase (r14): the SAME trace through the
+        # tensor=2 mesh, vs the 1×1 k0 engine above. On this CPU mesh
+        # the numbers are compute-bound (virtual devices share the
+        # host's cores, and the per-dispatch weight all-gather
+        # materializes — docs/PERF.md r14 caveat, the r10/r13 class);
+        # the architectural wins measured for real are the bitwise
+        # parity probe and the per-chip pool accounting: auto sizing
+        # doubles the pages, so kv_pool_bytes_per_chip comes out EQUAL
+        # to the 1×1 engine's total — same per-chip HBM, 2× the tokens.
+        if sharded_engine is not None:
+            parity_rows = [
+                np.random.default_rng(7).integers(
+                    0, spec_vocab, (p,)
+                ).astype(np.int32)
+                for p in prompt_lens
+            ]
+            parity = all(
+                spec_k0.generate_row(r, 8, timeout=600)["tokens"]
+                == sharded_engine.generate_row(r, 8, timeout=600)["tokens"]
+                for r in parity_rows
+            )
+            sh = run_phase(
+                "gpt_sharded", payloads_spec, vocab=spec_vocab
+            )
+            sharded = {
+                "mesh": "2x1",
+                "phase": sh,
+                "tokens_per_sec": sh["tokens_per_sec"],
+                "baseline_tokens_per_sec": k0["tokens_per_sec"],
+                "ttft_p50_ms": sh["ttft_p50_ms"],
+                "baseline_ttft_p50_ms": k0["ttft_p50_ms"],
+                "parity_bitwise": parity,
+                "kv_pool_bytes_per_chip": (
+                    sharded_engine.kv_pool_bytes_per_chip
+                ),
+                "baseline_kv_pool_bytes_per_chip": spec_k0.kv_pool_bytes,
+            }
+        else:
+            sharded = {"skipped": "needs >= 2 jax devices"}
         # -- quantized engine phase: same trace, int8 weights + KV pages
         # through the pallas page walk. On THIS CPU mesh the phase
         # measures overhead-parity (matmuls are compute-bound and the
@@ -1384,6 +1440,12 @@ def bench_serving_continuous(
         },
         "engine_accept_rate": accept_rate,
         "drafted_tokens_per_sec": kd["tokens_per_sec"],
+        # r14 sharded serving: same trace through the tensor=2 mesh
+        # (CPU-mesh numbers are compute-bound; parity + per-chip pool
+        # bytes are the real evidence — docs/PERF.md r14)
+        "sharded": sharded,
+        "sharded_tokens_per_sec": sharded.get("tokens_per_sec", 0.0),
+        "sharded_mesh": sharded.get("mesh", "skipped"),
         # int8 weights + KV pages (r13): same trace through the
         # quantized pallas engine; capacity ratio is pool arithmetic
         "quantized": quantized,
@@ -2563,7 +2625,17 @@ def _entry_specs(batch: int, steps: int):
             "serving_continuous",
             "bench_serving_continuous()",
             480,
-            None,
+            # the r14 sharded phase needs 2 devices; on the CPU backend
+            # they are virtual (the conftest's device-forcing analog —
+            # XLA's intra-op thread pool stays process-wide, so the
+            # single-device phases' numbers are unaffected), on a real
+            # multi-chip host the flag is inert
+            {
+                "XLA_FLAGS": (
+                    os.environ.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count=2"
+                ).strip()
+            },
             False,
         ),
         # the 80%-shared-prefix trace through a routed 3-replica fleet:
@@ -2606,6 +2678,9 @@ _EXTRA_FINAL_KEYS = (
     "quantized_tokens_per_sec",
     "pages_per_hbm_gb",
     "pages_per_hbm_gb_ratio",
+    # sharded serving (serving_continuous sharded phase, r14)
+    "sharded_tokens_per_sec",
+    "sharded_mesh",
     "engine_accept_rate",
     "drafted_tokens_per_sec",
     "training_model_flops_utilization",

@@ -472,16 +472,51 @@ def analyze_serving_plan(
 
     page_size = spec.page_size
     # the engine's own sizing rule (int8 auto pools carry the capacity
-    # ratio), so mem-budget prices the pool the engine will allocate
+    # ratio; sharded auto pools the per-chip shard count), so mem-budget
+    # prices the pool the engine will allocate
     num_pages = resolve_num_pages(
         spec.num_pages, spec.num_slots, model.cfg, page_size,
-        spec.quantize,
+        spec.quantize, spec.mesh_tensor,
     )
     progs = EnginePrograms(
         model, draft_model=draft, num_draft_tokens=spec.num_draft_tokens,
         page_size=page_size, num_pages=num_pages,
         paged_attention=spec.paged_attention, quantize=spec.quantize,
+        mesh_tensor=spec.mesh_tensor, mesh_fsdp=spec.mesh_fsdp,
     )
+    # the mesh axes a sharded plan's programs actually run over — what
+    # turns the pre-wired spmd passes live: shard-capable axis sizes for
+    # spmd-replicated-param, and the DCN layout for spmd-dcn-collective.
+    # A serving replica is single-slice BY CONTRACT (tensor/fsdp
+    # collectives run on every decode step; DCN latency there is the
+    # exact failure mode the pass exists for) — a plan declaring
+    # num_slices > 1 must fail the sweep, not lint around it.
+    mesh_axis_sizes = {
+        "tensor": int(spec.mesh_tensor), "fsdp": int(spec.mesh_fsdp),
+    }
+    # a serving replica's mesh has NO DCN-capable layout: its only axes
+    # are tensor/fsdp (data=1), both of which collect on every decode
+    # step and are excluded from parallel/mesh.py's DCN_FRIENDLY_AXES —
+    # so num_slices > 1 is rejected flat (there is no legal split to
+    # derive per-program dcn_axes from), and the per-program
+    # check_dcn_collectives walk below runs with an empty DCN set,
+    # vacuously clean for every single-slice plan
+    if spec.num_slices > 1:
+        findings.append(
+            Finding(
+                analyzer="spmd-dcn-collective",
+                severity=Severity.ERROR,
+                location=f"plan:{spec.name}",
+                symbol="mesh",
+                message=(
+                    f"serving mesh cannot span {spec.num_slices} "
+                    f"slices: tensor/fsdp collectives run on every "
+                    f"decode step and must stay within one slice's "
+                    f"ICI (DCN-friendly axes are data/pipeline, both "
+                    f"1 on a serving mesh)"
+                ),
+            )
+        )
     buckets = tuple(spec.prefill_buckets) or default_prefill_buckets(
         model.cfg.max_len
     )
@@ -498,6 +533,9 @@ def analyze_serving_plan(
     stats["num_pages"] = num_pages
     stats["paged_attention"] = spec.paged_attention
     stats["quantize"] = spec.quantize
+    stats["mesh"] = {
+        "tensor": spec.mesh_tensor, "fsdp": spec.mesh_fsdp,
+    }
 
     step_temp_bytes: Optional[int] = None
     stablehlo_bytes = 0
@@ -511,8 +549,10 @@ def analyze_serving_plan(
         findings.extend(
             check_host_transfer_jaxpr(spec.name, sig.name, closed.jaxpr)
         )
-        # inert until the engine gains a mesh (no DCN axes on one chip);
-        # the wiring is the point — the sharded-serving rung inherits it
+        # single-slice contract (enforced above): a serving mesh never
+        # derives a non-empty DCN axis set, so this walk is vacuously
+        # clean — kept so a future multi-slice-capable serving layout
+        # (a data axis) inherits the per-program check without rewiring
         findings.extend(
             check_dcn_collectives(closed.jaxpr, set(), spec.name)
         )
@@ -531,26 +571,51 @@ def analyze_serving_plan(
                 step_temp_bytes = None
     stats["stablehlo_bytes"] = stablehlo_bytes
 
-    # spmd-replicated-param wiring: the engine has no mesh today, so the
-    # pass runs with no shard-capable axes (inert); when sharded serving
-    # lands, the plan grows a mesh and this starts biting for free
+    # spmd-replicated-param, live since r14: sharded plans carry the
+    # real at-rest param shardings (parallel/serving_mesh.py — the same
+    # NamedShardings the engine device_puts), so a big leaf the layout
+    # leaves fully replicated while tensor/fsdp exist is flagged here.
+    # Unmeshed plans keep the inert ({}, {}) wiring: no shard-capable
+    # axes, nothing to demand.
     params = progs.abstract_params()
-    findings.extend(check_replicated_params(params, {}, {}, spec.name))
+    param_sh = progs._param_sh if progs.mesh is not None else {}
+    findings.extend(
+        check_replicated_params(
+            params, param_sh,
+            mesh_axis_sizes if progs.mesh is not None else {},
+            spec.name,
+        )
+    )
 
     # -- mem-budget: the resident bytes one chip must hold ----------------
     # (the KV term is POOL-sized — num_pages x page_size per layer — the
-    # paged representation's whole point vs num_slots x max_len rows)
+    # paged representation's whole point vs num_slots x max_len rows).
+    # On a mesh every component is priced at its REAL per-chip shard
+    # bytes through the same sharding trees the engine device_puts:
+    # params divide by their fsdp/tensor shard counts, pools by the
+    # heads shard — the accounting the auto pool sizing's mesh scaling
+    # is balanced against.
+    from kubeflow_tpu.analysis.memory import sharded_tree_bytes
+
+    def per_chip(shapes, shardings) -> int:
+        if progs.mesh is None or shardings is None:
+            return tree_bytes(shapes)
+        return sharded_tree_bytes(shapes, shardings, mesh_axis_sizes)
+
     cache_one = progs.cache_shapes(params, buckets[0])
+    pool_shapes = progs.pool_shapes(cache_one)
     components: Dict[str, int] = {
-        "params": tree_bytes(params),
-        "kv page pool": tree_bytes(progs.pool_shapes(cache_one)),
+        "params": per_chip(params, param_sh or None),
+        "kv page pool": per_chip(pool_shapes, progs._pool_sh),
     }
     if draft is not None:
         dparams = progs.abstract_params(draft)
         dcache_one = progs.draft_cache_shapes(dparams, buckets[0])
-        components["draft params"] = tree_bytes(dparams)
-        components["draft kv page pool"] = tree_bytes(
-            progs.pool_shapes(dcache_one)
+        components["draft params"] = per_chip(
+            dparams, progs._draft_param_sh
+        )
+        components["draft kv page pool"] = per_chip(
+            progs.pool_shapes(dcache_one), progs._draft_pool_sh
         )
     if step_temp_bytes:
         components["xla temp (step)"] = step_temp_bytes
@@ -577,6 +642,9 @@ def analyze_serving_plan_subprocess(
     crash/timeout becomes a `serve-analysis-error` finding — one broken
     plan must not hide the others' results."""
     payload = json.dumps({"spec": spec.to_dict()})
+    # sharded plans lower on a real (virtual CPU) mesh: the child gets
+    # exactly the plan's device count so build_serving_mesh can place it
+    devices = max(1, int(spec.mesh_tensor) * int(spec.mesh_fsdp))
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "kubeflow_tpu.analysis.serving"],
@@ -584,7 +652,7 @@ def analyze_serving_plan_subprocess(
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             timeout=timeout_s,
-            env=_force_device_env(1),
+            env=_force_device_env(devices),
             cwd=root,
         )
     except subprocess.TimeoutExpired:

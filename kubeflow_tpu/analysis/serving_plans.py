@@ -102,6 +102,14 @@ class ServingPlanSpec:
     #                                    program-set impact — listed so the
     #                                    registry documents the full knob
     #                                    surface the pod runs)
+    mesh_tensor: int = 1               # serving mesh: heads-sharded pools
+    mesh_fsdp: int = 1                 # serving mesh: fsdp-sharded weights
+    num_slices: int = 1                # slices a replica spans: ALWAYS 1
+    #                                    (tensor/fsdp collectives run every
+    #                                    step and must ride ICI); >1 makes
+    #                                    the spmd-dcn-collective pass fail
+    #                                    the plan — the serving data plane
+    #                                    never crosses DCN
     device_kind: str = "v5e"           # mem-budget HBM table key ("" skips)
     compile: bool = False              # also XLA-compile the step program
     #                                    (adds its temp allocation to the
@@ -179,6 +187,23 @@ def bench_serving_plans() -> List[ServingPlanSpec]:
             prefill_buckets=BENCH_PREFILL_BUCKETS,
             paged_attention="pallas",
             quantize="int8",
+        ),
+        ServingPlanSpec(
+            # the r14 sharded engine (bench's sharded phase): the SAME
+            # geometry as the spec-family engines on a tensor=2 mesh —
+            # pools head-sharded, weights fsdp/tensor-sharded at rest
+            # and gathered in-program. The even 2048 vocab (vs
+            # gpt_small's odd 50257, which training's annotation rules
+            # degrade to replicated) keeps every big leaf sharded, so
+            # the now-live spmd-replicated-param pass certifies the
+            # layout instead of warning about it. Lowered on 2 virtual
+            # devices; spmd-dcn-collective + mem-budget price the real
+            # shard counts.
+            name="bench:gpt_sharded",
+            model="gpt_small",
+            model_kwargs=dict(spec_target),
+            prefill_buckets=BENCH_PREFILL_BUCKETS,
+            mesh_tensor=2,
         ),
         ServingPlanSpec(
             name="bench:gpt_spec_k0",

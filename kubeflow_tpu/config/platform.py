@@ -642,6 +642,42 @@ class RouterConfig(ConfigNode):
 
 
 @dataclasses.dataclass
+class ServingMeshConfig(ConfigNode):
+    """The decode engine's serving mesh (parallel/serving_mesh.py;
+    docs/SERVING.md "Sharded serving"): `tensor × fsdp` chips per
+    replica. 1×1 (the default) is the unmeshed single-chip engine —
+    the bitwise baseline. `tensor` shards the KV pools on the heads
+    axis (per-chip pool bytes divide by it — the decode-bandwidth and
+    pool-capacity axis); `fsdp` shards the resident weights on the
+    embed dim, all-gathered at use (the weight-capacity axis — a model
+    too big for one chip serves sharded). Model-shape divisibility
+    (heads/mlp by tensor, hidden by fsdp) is validated where the model
+    is known: engine construction and the serving lint."""
+
+    tensor: int = config_field(
+        default=1,
+        help="chips sharding the KV pools' heads axis (and the "
+        "attention read/write); must divide the served model's "
+        "num_heads and mlp_dim",
+    )
+    fsdp: int = config_field(
+        default=1,
+        help="chips sharding the resident weights' embed dim "
+        "(all-gathered inside each program — FSDP serving); must "
+        "divide the model's hidden_size",
+    )
+
+    def validate(self) -> None:
+        for axis in ("tensor", "fsdp"):
+            v = getattr(self, axis)
+            if not isinstance(v, int) or v < 1:
+                raise ConfigError(
+                    f"serving.mesh.{axis} must be a positive int, "
+                    f"got {v!r}"
+                )
+
+
+@dataclasses.dataclass
 class ServingConfig(ConfigNode):
     """Continuous-batching decode-engine knobs (serving/engine.py;
     docs/SERVING.md). The InferenceService controller renders these as
@@ -741,6 +777,9 @@ class ServingConfig(ConfigNode):
         "Rendered as KFT_SERVING_DRAIN_DEADLINE_S; the serving pod's "
         "terminationGracePeriodSeconds is derived from it.",
     )
+    mesh: ServingMeshConfig = config_field(
+        default_factory=ServingMeshConfig
+    )
     observability: ObservabilityConfig = config_field(
         default_factory=ObservabilityConfig
     )
@@ -751,6 +790,7 @@ class ServingConfig(ConfigNode):
     chaos: ChaosConfig = config_field(default_factory=ChaosConfig)
 
     def validate(self) -> None:
+        self.mesh.validate()
         self.autoscale.validate()
         # like chaos below: a programmatically built config must hit the
         # same rejection from_dict applies when the subtree key is present
@@ -813,12 +853,19 @@ class ServingConfig(ConfigNode):
                 ">= 1: the kernel serves the decode engine's step, and "
                 "num_slots=0 disables the engine"
             )
-        if self.num_slots < 1 and self.quantize != "none":
+        # quantize=int8 with num_slots=0 is LEGAL since r14: the static
+        # ServedLm path routes through the same int8 resident tree +
+        # in-jit dequant the engine uses (serving/generate.py), so the
+        # knob is honored, not silently ignored (the r13 rejection
+        # existed because the static path would have served full-width)
+        if self.num_slots < 1 and (
+            self.mesh.tensor > 1 or self.mesh.fsdp > 1
+        ):
             raise ConfigError(
-                "serving.quantize=int8 needs serving.num_slots >= 1: "
-                "quantization lives inside the decode engine, and "
-                "num_slots=0 disables it — the static path would "
-                "silently serve full-width weights"
+                "serving.mesh needs serving.num_slots >= 1: the mesh "
+                "shards the decode engine's programs, and num_slots=0 "
+                "disables the engine — the static path would silently "
+                "serve single-chip"
             )
         if self.num_draft_tokens > 0 and self.num_slots < 1:
             raise ConfigError(

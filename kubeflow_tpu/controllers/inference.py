@@ -120,6 +120,11 @@ class InferenceServiceController(Controller):
             # in-place page walk, int8 weights + KV pages)
             "KFT_SERVING_PAGED_ATTENTION": cfg.paged_attention,
             "KFT_SERVING_QUANTIZE": cfg.quantize,
+            # serving mesh (r14 sharded serving: tensor shards the KV
+            # pools on heads, fsdp shards the resident weights; 1/1 =
+            # the unmeshed bitwise baseline)
+            "KFT_SERVING_MESH_TENSOR": str(cfg.mesh.tensor),
+            "KFT_SERVING_MESH_FSDP": str(cfg.mesh.fsdp),
             "KFT_SERVING_DRAFT_MODEL": cfg.draft_model,
             "KFT_SERVING_DRAFT_TOKENS": str(cfg.num_draft_tokens),
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": cfg.draft_checkpoint_dir,
@@ -168,6 +173,7 @@ class InferenceServiceController(Controller):
             "draft_model": self.serving_defaults.draft_model,
             "num_draft_tokens": self.serving_defaults.num_draft_tokens,
             "draft_checkpoint_dir": self.serving_defaults.draft_checkpoint_dir,
+            "mesh": dataclasses.asdict(self.serving_defaults.mesh),
             "observability": dataclasses.asdict(
                 self.serving_defaults.observability
             ),
@@ -178,7 +184,8 @@ class InferenceServiceController(Controller):
             "chaos": dataclasses.asdict(self.serving_defaults.chaos),
         }
         overrides = dict(spec.get("serving") or {})
-        for subtree in ("observability", "autoscale", "router", "chaos"):
+        for subtree in ("mesh", "observability", "autoscale", "router",
+                        "chaos"):
             sub_override = overrides.pop(subtree, None) or {}
             merged[subtree].update(sub_override)
         merged.update(overrides)

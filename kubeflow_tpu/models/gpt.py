@@ -91,7 +91,18 @@ class PagedState:
 
     `page_size`/`num_pages` are static (they shape the pool): one jitted
     program per pool geometry, exactly like max_len. So are the two
-    read-path knobs stacked on in r13:
+    read-path knobs stacked on in r13, and the serving mesh added in
+    r14:
+
+    - `mesh`: the engine's tensor×fsdp mesh (parallel/serving_mesh.py),
+      or None for the unmeshed bitwise baseline. With a mesh, the pool
+      scatter/view and the attention einsums run local to each chip's
+      HEAD shard (heads axis on `tensor`; contraction dims never split,
+      so the math is bitwise the unmeshed program's) and the attention
+      output is gathered to replicated before the out projection, whose
+      contraction IS the heads dim.
+
+    The two r13 read-path knobs:
 
     - `attn_impl`: "gather" materializes a per-slot contiguous view
       through the page table (ops/attention.py paged_kv_view) and runs
@@ -109,6 +120,9 @@ class PagedState:
     num_pages: int = flax.struct.field(pytree_node=False)
     attn_impl: str = flax.struct.field(pytree_node=False, default="gather")
     kv_quant: str = flax.struct.field(pytree_node=False, default="none")
+    # jax.sharding.Mesh is hashable, so it rides the static jit key like
+    # the other geometry knobs: one program per mesh shape
+    mesh: Any = flax.struct.field(pytree_node=False, default=None)
 
 
 class CausalSelfAttention(nn.Module):
@@ -175,7 +189,12 @@ class CausalSelfAttention(nn.Module):
                 paged_kv_view,
                 quantize_kv,
             )
+            from kubeflow_tpu.parallel.serving_mesh import (
+                gather_replicated,
+                head_shard,
+            )
 
+            mesh = paged.mesh
             quantized = paged.kv_quant == "int8"
             store_dtype = jnp.int8 if quantized else cfg.dtype
             pool_shape = (
@@ -190,6 +209,13 @@ class CausalSelfAttention(nn.Module):
             s = x.shape[1]
             idx = paged.cache_index
             k_w, v_w = k.astype(cfg.dtype), v.astype(cfg.dtype)
+            if mesh is not None:
+                # the new K/V vectors enter the pool layout before the
+                # scatter so the write stays local to each chip's head
+                # shard (pure resharding: bits unchanged)
+                q = head_shard(q, mesh)
+                k_w = head_shard(k_w, mesh)
+                v_w = head_shard(v_w, mesh)
             k_scale = v_scale = None
             if quantized:
                 # per-vector scales ride sibling pool leaves [..., H, 1]
@@ -215,11 +241,20 @@ class CausalSelfAttention(nn.Module):
                     k_scale.value, v_scale.value, sk, sv,
                     paged.page_table, idx,
                 )
+                if mesh is not None:
+                    k_scale.value = head_shard(k_scale.value, mesh)
+                    v_scale.value = head_shard(v_scale.value, mesh)
             else:
                 cached_k.value, cached_v.value = paged_kv_update(
                     cached_k.value, cached_v.value, k_w, v_w,
                     paged.page_table, idx,
                 )
+            if mesh is not None:
+                # the scattered pools stay head-sharded on the way out:
+                # the donated resident buffer's sharding must round-trip
+                # for the input→output aliasing to hold
+                cached_k.value = head_shard(cached_k.value, mesh)
+                cached_v.value = head_shard(cached_v.value, mesh)
             if s == 1 and paged.attn_impl == "pallas":
                 # the one-token hot path walks the page table in place —
                 # no contiguous per-slot view, no gather temp; int8
@@ -234,13 +269,28 @@ class CausalSelfAttention(nn.Module):
                     paged.page_table, idx, dtype=cfg.dtype,
                     k_scale=k_scale.value if quantized else None,
                     v_scale=v_scale.value if quantized else None,
+                    mesh=mesh,
                 )
+                if mesh is not None:
+                    # gather the per-shard head outputs before the out
+                    # projection: its contraction is the heads dim, and
+                    # splitting a contraction changes the f32 reduction
+                    # order (the 1-ulp class) — gathered, the matmul
+                    # runs replicated and bitwise the unmeshed program
+                    out = gather_replicated(out, mesh)
                 return nn.DenseGeneral(
                     cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
                     name="out",
                 )(out)
             k_view = paged_kv_view(cached_k.value, paged.page_table)
             v_view = paged_kv_view(cached_v.value, paged.page_table)
+            if mesh is not None:
+                # the gathered per-slot view keeps the pool's head
+                # sharding: QK^T/PV contract over head_dim and kv
+                # positions — never the sharded heads — so each chip
+                # computes exactly its head slice of the unmeshed math
+                k_view = head_shard(k_view, mesh)
+                v_view = head_shard(v_view, mesh)
             if quantized:
                 k_view = dequant_kv(
                     k_view,
@@ -269,6 +319,10 @@ class CausalSelfAttention(nn.Module):
                 q, k_view, v_view, mask=visible, dtype=cfg.dtype,
                 causal=False,
             )
+            if mesh is not None:
+                # heads gathered before the heads-dim contraction (see
+                # the pallas branch above) — bitwise by construction
+                out = gather_replicated(out, mesh)
             return nn.DenseGeneral(
                 cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
             )(out)
@@ -643,7 +697,7 @@ def _leaf_by_path(tree, path):
     return node
 
 
-def insert_pages(pool, cache_one, page_ids, real_len):
+def insert_pages(pool, cache_one, page_ids, real_len, mesh=None):
     """Scatter a batch-1 prefill cache's K/V rows [0, real_len) into the
     pool pages listed in `page_ids` [max_pages]: cache rows
     [c*page_size, (c+1)*page_size) land on page page_ids[c], and a chunk
@@ -652,8 +706,13 @@ def insert_pages(pool, cache_one, page_ids, real_len):
     the cursor, stay invisible to the masked read, and are overwritten
     by decode. `page_ids`/`real_len` may be traced — one compiled insert
     serves every slot and prompt length. The indexed scatter stores the
-    prefill's bits directly, so inserted bits equal the computed bits."""
+    prefill's bits directly, so inserted bits equal the computed bits.
+    With a serving `mesh` the written pool leaves are constrained back
+    to the head-sharded pool layout so the donated buffer's sharding
+    round-trips."""
     import jax.tree_util as jtu
+
+    from kubeflow_tpu.parallel.serving_mesh import head_shard
 
     mp = page_ids.shape[0]
 
@@ -670,29 +729,33 @@ def insert_pages(pool, cache_one, page_ids, real_len):
         valid = (jnp.arange(mp) * ps) < real_len  # [MP]
         idx = jnp.where(valid, page_ids, num_pages)
         if pool_leaf.ndim == 4:      # named-layer leaf [P, ps, H, D]
-            return pool_leaf.at[idx].set(
-                chunks, mode="drop"
-            )
-        # scanned-layer leaf [L, P, ps, H, D]: the leading slice keeps
-        # the page axis in place under advanced indexing
-        return pool_leaf.at[:, idx].set(
-            chunks, mode="drop"
-        )
+            written = pool_leaf.at[idx].set(chunks, mode="drop")
+        else:
+            # scanned-layer leaf [L, P, ps, H, D]: the leading slice
+            # keeps the page axis in place under advanced indexing
+            written = pool_leaf.at[:, idx].set(chunks, mode="drop")
+        return head_shard(written, mesh)
 
     return jtu.tree_map_with_path(ins, pool)
 
 
-def copy_pool_page(pool, src, dst):
+def copy_pool_page(pool, src, dst, mesh=None):
     """Copy page `src` onto page `dst` across every pool leaf — the
     prefix cache's copy-on-write: an admission that reuses a partially
     matched page gets its OWN copy to extend, leaving the shared
     original (and every other slot referencing it) untouched. `src`/
-    `dst` may be traced int32 — one compiled program serves every copy."""
+    `dst` may be traced int32 — one compiled program serves every copy.
+    With a serving `mesh` the copied leaves stay head-sharded (pure
+    data movement either way — a copy has no arithmetic)."""
+    from kubeflow_tpu.parallel.serving_mesh import head_shard
 
     def cp(leaf):
         ax = leaf.ndim - 4
         page = jax.lax.dynamic_slice_in_dim(leaf, src, 1, axis=ax)
-        return jax.lax.dynamic_update_slice_in_dim(leaf, page, dst, axis=ax)
+        return head_shard(
+            jax.lax.dynamic_update_slice_in_dim(leaf, page, dst, axis=ax),
+            mesh,
+        )
 
     return jax.tree.map(cp, pool)
 

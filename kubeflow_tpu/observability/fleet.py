@@ -173,6 +173,9 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "serving_kv_pages_in_use": "sum",
     "serving_kv_pages_total": "sum",
     "serving_kv_pool_bytes": "sum",
+    # per-chip pool bytes: the HBM-budget-limiting value — max, not sum
+    # (summing per-chip bytes across replicas describes no real chip)
+    "serving_kv_pool_bytes_per_chip": "max",
     "serving_num_slots": "sum",
     "serving_queue_depth": "sum",
     "serving_slot_occupancy": "mean",

@@ -144,6 +144,7 @@ def paged_attention(
     dtype,
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
+    mesh=None,
 ) -> jax.Array:
     """One-token paged-attention decode over all slots.
 
@@ -154,7 +155,46 @@ def paged_attention(
 
     Every slot's row is walked page-by-page straight out of the pool —
     no contiguous per-slot view is ever materialized.
+
+    With a serving `mesh` (parallel/serving_mesh.py) the kernel runs
+    inside shard_map over the `tensor` axis: each chip walks ONLY its
+    own head shard of the pool (the page DMA stays local — the sharded
+    engine's whole bandwidth story), the page table and cursors ride in
+    replicated, and the output comes back head-sharded. Attention is
+    per-head independent, so the per-shard walk computes exactly the
+    bits of its slice of the unmeshed kernel — the bitwise parity
+    contract survives the mesh.
     """
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from kubeflow_tpu.parallel.serving_mesh import POOL_HEAD_AXIS
+        from kubeflow_tpu.parallel.shard_map import shard_map_pallas
+
+        h_spec = P(None, None, POOL_HEAD_AXIS, None)
+        in_specs = [h_spec, h_spec, h_spec, P(), P()]
+        args = [q, pool_k, pool_v, page_table, cursors]
+        if k_scale is not None:
+            in_specs += [h_spec, h_spec]
+            args += [k_scale, v_scale]
+
+        def body(qs, pk, pv, pt, cur, *scales):
+            ks, vs = scales if scales else (None, None)
+            return paged_attention(
+                qs, pk, pv, pt, cur, dtype=dtype, k_scale=ks, v_scale=vs
+            )
+
+        return shard_map_pallas(
+            body,
+            in_specs=tuple(in_specs),
+            out_specs=h_spec,
+            axis_names=(POOL_HEAD_AXIS,),
+            mesh=mesh,
+            # the leading dim is the SLOT batch: its page table/cursors
+            # ride replicated — widening slots over (data, fsdp) would
+            # index a global table with local rows
+            widen_batch=False,
+        )(*args)
     b, s, h, d = q.shape
     assert s == 1, "the pallas kernel serves the one-token decode step"
     num_pages, ps = pool_k.shape[0], pool_k.shape[1]

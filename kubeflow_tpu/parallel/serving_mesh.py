@@ -1,0 +1,186 @@
+"""Serving-mesh sharding: the decode engine's tensor×fsdp layout rules.
+
+The DecodeEngine's program family (serving/engine.py EnginePrograms) runs
+on a `tensor × fsdp` mesh built with the same `parallel/mesh.py`
+machinery training uses. The layout contract — chosen so greedy output
+stays BITWISE identical to the 1×1 engine, which the parity tests
+enforce — is:
+
+- **Params shard at REST** by the training-side PartitionSpec rules
+  (training/annotations.py `logical_axes_for` → parallel/sharding.py
+  `param_specs`): fsdp shards the embed dim, tensor shards heads/mlp/
+  vocab dims, indivisible dims degrade to replicated exactly as in
+  training. Every program body gathers them to replicated at use
+  (`EnginePrograms._live_params`) — the FSDP serving shape: resident
+  weight HBM is sharded (a model too big for one chip can serve), the
+  all-gather moves bits exactly, and all weight matmuls then run
+  replicated — bitwise the single-chip program.
+- **KV pools shard on the heads axis under `tensor`** (and replicate
+  under `fsdp`): attention is per-head independent, so the page
+  scatter/gather and the QK^T / PV einsums run local to each chip's
+  head shard — their contraction dims (head_dim, kv positions) are
+  never split, so each shard computes exactly the bits of its slice of
+  the unsharded program. The attention output is gathered to replicated
+  BEFORE the out projection (whose contraction IS the heads dim —
+  splitting it would change the f32 reduction order, the 1-ulp class
+  PR 13 documented), so everything downstream is replicated again.
+
+Nothing here enters an ambient mesh context: the model's logical
+`shard_constraint`s (bare PartitionSpecs) raise without one and degrade
+to no-ops, so the NamedSharding constraints these helpers produce are
+the ONLY layout directives in the serving programs — the partitioner
+cannot be steered into splitting a contraction behind our back.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# the mesh axis the KV pools (and the attention segment) shard on: the
+# heads dim of every pool leaf ([..., num_pages, page_size, H, D] and the
+# [..., H, 1] int8 scale siblings alike — H sits at -2 in both)
+POOL_HEAD_AXIS = "tensor"
+
+
+def build_serving_mesh(
+    tensor: int, fsdp: int, devices=None
+) -> Optional[Mesh]:
+    """The engine's mesh: `tensor × fsdp` over the first tensor*fsdp
+    local devices (data=1 — scale-out across replicas is the router's
+    job, not the engine's). 1×1 returns None: the unmeshed engine is the
+    bitwise baseline and must not even construct a Mesh."""
+    t, f = int(tensor), int(fsdp)
+    if t < 1 or f < 1:
+        raise ValueError(
+            f"serving mesh axes must be >= 1, got tensor={t} fsdp={f}"
+        )
+    if t * f == 1:
+        return None
+    from kubeflow_tpu.config.platform import MeshConfig
+    from kubeflow_tpu.parallel.mesh import mesh_from_config
+
+    if devices is None:
+        devices = jax.devices()
+    need = t * f
+    if len(devices) < need:
+        raise ValueError(
+            f"serving mesh tensor={t} x fsdp={f} needs {need} devices, "
+            f"this process has {len(devices)}"
+        )
+    return mesh_from_config(
+        MeshConfig(data=1, fsdp=f, tensor=t), devices=list(devices)[:need]
+    )
+
+
+def validate_serving_mesh(
+    model_cfg, tensor: int, fsdp: int, role: str = "model"
+) -> None:
+    """The divisibility contract: tensor must divide the head count (the
+    KV pool shards on heads — there is no degraded fallback for the
+    engine's dominant buffer) and the mlp dim; fsdp must divide the
+    hidden (embed) dim. Other weight dims (e.g. an odd vocab) degrade to
+    replicated exactly as training's `logical_axes_for` does — visible
+    to the spmd-replicated-param lint, never a silent wrong answer."""
+    t, f = int(tensor), int(fsdp)
+    if t > 1:
+        if model_cfg.num_heads % t:
+            raise ValueError(
+                f"serving mesh tensor={t} does not divide the {role}'s "
+                f"num_heads={model_cfg.num_heads}: the KV pools shard "
+                f"on the heads axis"
+            )
+        if model_cfg.mlp_dim % t:
+            raise ValueError(
+                f"serving mesh tensor={t} does not divide the {role}'s "
+                f"mlp_dim={model_cfg.mlp_dim}"
+            )
+    if f > 1 and model_cfg.hidden_size % f:
+        raise ValueError(
+            f"serving mesh fsdp={f} does not divide the {role}'s "
+            f"hidden_size={model_cfg.hidden_size}"
+        )
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pool_partition_spec(ndim: int) -> P:
+    """Heads-sharded spec for one pool leaf: H sits at -2 in every pool
+    leaf shape ([..., P, ps, H, D] values and [..., P, ps, H, 1] int8
+    scales; scan_layers prepends a layer axis)."""
+    entries = [None] * ndim
+    entries[ndim - 2] = POOL_HEAD_AXIS
+    return P(*entries)
+
+
+def pool_shardings(pool_tree, mesh: Mesh):
+    """NamedSharding per pool leaf (values AND scale siblings), heads
+    axis on `tensor`, replicated over everything else (incl. fsdp)."""
+    return jax.tree.map(
+        lambda leaf: NamedSharding(mesh, pool_partition_spec(leaf.ndim)),
+        pool_tree,
+    )
+
+
+def param_shardings(params, mesh: Mesh):
+    """At-rest NamedShardings for the engine's resident param tree via
+    the training-side rules (training/annotations.py): fsdp on embed
+    dims, tensor on heads/mlp/vocab dims, indivisible dims degraded to
+    replicated. Handles the int8 envelope ({qvalues, qscales}) —
+    qvalues shard by the same rules (quantization is shape-preserving),
+    the per-channel scale vectors are a rounding error and replicate."""
+    from kubeflow_tpu.checkpointing.quantize import is_quantized_params
+    from kubeflow_tpu.parallel.sharding import param_specs
+    from kubeflow_tpu.training.annotations import logical_axes_for
+
+    if is_quantized_params(params):
+        return {
+            "qvalues": param_shardings(params["qvalues"], mesh),
+            "qscales": jax.tree.map(
+                lambda _: replicated_sharding(mesh), params["qscales"]
+            ),
+        }
+    sizes = dict(mesh.shape)
+    axes = logical_axes_for(
+        params, fsdp_size=sizes.get("fsdp", 1), mesh_axis_sizes=sizes
+    )
+    specs = param_specs(params, axes, mesh=mesh)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+
+
+def head_shard(x, mesh: Optional[Mesh]):
+    """Constrain an activation/pool array whose -2 axis is heads to the
+    pool layout (no-op without a mesh)."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, pool_partition_spec(x.ndim))
+    )
+
+
+def gather_replicated(tree, mesh: Optional[Mesh]):
+    """Constrain every leaf to fully replicated — the in-program weight
+    all-gather (and the attention-output gather before the heads-dim
+    contraction). Collectives move bits exactly: everything computed
+    from the gathered values is bitwise the unmeshed program."""
+    if mesh is None:
+        return tree
+    rep = replicated_sharding(mesh)
+    return jax.tree.map(
+        lambda leaf: jax.lax.with_sharding_constraint(leaf, rep), tree
+    )
+
+
+def abstract_with_shardings(shapes_tree, shardings_tree) -> Any:
+    """ShapeDtypeStructs carrying shardings — what the serving lint
+    lowers so the analyzed HLO is the SHARDED program (donation marks,
+    collectives and all), not an unmeshed shadow of it."""
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes_tree,
+        shardings_tree,
+    )

@@ -95,6 +95,7 @@ def shard_map_pallas(
     out_specs: P,
     axis_names: Sequence[str],
     mesh=None,
+    widen_batch: bool = True,
 ):
     """shard_map for bodies that run pallas kernels — vma checking off.
 
@@ -103,6 +104,14 @@ def shard_map_pallas(
     `jax.shard_map` that is passed through directly; on the legacy API the
     specs are widened per the batch convention and the map runs fully
     manual with `check_rep=False` (see module docstring).
+
+    `widen_batch=False` passes the specs through VERBATIM on the legacy
+    path too (every unnamed dim replicated inside the body). The serving
+    engine's paged-attention wrap needs this: its leading dim is the
+    SLOT batch whose page table/cursors ride replicated scalar-prefetch
+    specs — widening the slot dim over (data, fsdp) would hand each
+    shard local slot rows against a GLOBAL page table, silently reading
+    the wrong pages.
     """
     axis_set = set(axis_names)
     new_shard_map = getattr(jax, "shard_map", None)
@@ -130,7 +139,7 @@ def shard_map_pallas(
         # (or ragged against) the data axes cannot be manually split — it
         # stays replicated inside the body instead, which is the same
         # program partial-manual mode would have produced
-        batch = _present_batch_axes(m)
+        batch = _present_batch_axes(m) if widen_batch else ()
         dp = 1
         for a in batch:
             dp *= dict(m.shape)[a]

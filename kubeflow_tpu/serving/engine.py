@@ -117,6 +117,7 @@ from kubeflow_tpu.utils.metrics import (
     serving_kv_pages_in_use_gauge,
     serving_kv_pages_total_gauge,
     serving_kv_pool_bytes_gauge,
+    serving_kv_pool_bytes_per_chip_gauge,
     serving_num_slots_gauge,
     serving_phase_histogram,
     serving_prefix_hit_tokens_counter,
@@ -224,14 +225,16 @@ def auto_num_pages(num_slots: int, max_len: int, page_size: int) -> int:
 
 def resolve_num_pages(
     num_pages, num_slots: int, model_cfg, page_size: int,
-    quantize: str = "none",
+    quantize: str = "none", mesh_tensor: int = 1,
 ) -> int:
     """The ONE pool-sizing rule, shared by the live engine and
     kft-analyze's serving lint (analysis/serving.py) so the pool the
     lint prices is the pool the engine allocates: explicit num_pages
-    wins; auto sizing takes 3/4 of the slot-row footprint and, at
-    quantize=int8, multiplies by the page capacity ratio — same HBM,
-    ~2x the pages."""
+    wins; auto sizing takes 3/4 of the slot-row footprint and then
+    scales by PER-CHIP bytes — at quantize=int8 the page capacity
+    ratio (~2x pages in the same HBM), and on a tensor-sharded mesh
+    the shard count (each chip holds 1/tensor of every page's heads,
+    so the same per-chip budget holds tensor× the pages)."""
     if num_pages:
         return int(num_pages)
     pages = auto_num_pages(num_slots, model_cfg.max_len, page_size)
@@ -242,7 +245,7 @@ def resolve_num_pages(
                 head_dim, np.dtype(model_cfg.dtype).itemsize
             )
         )
-    return pages
+    return pages * max(1, int(mesh_tensor))
 
 
 def int8_page_capacity_ratio(head_dim: int, itemsize: int = 2) -> float:
@@ -537,11 +540,28 @@ class EnginePrograms:
         num_pages: Optional[int] = None,
         paged_attention: str = DEFAULT_PAGED_ATTENTION,
         quantize: str = DEFAULT_QUANTIZE,
+        mesh_tensor: int = 1,
+        mesh_fsdp: int = 1,
     ):
-        from kubeflow_tpu.models.gpt import copy_pool_page
+        from kubeflow_tpu.parallel.serving_mesh import (
+            build_serving_mesh,
+            validate_serving_mesh,
+        )
 
         cfg = model.cfg
         self.model = model
+        # -- serving mesh (parallel/serving_mesh.py): 1x1 = None = the
+        # unmeshed bitwise baseline; anything larger shards params at
+        # rest by the training rules and the KV pools on the heads axis
+        self.mesh_tensor = int(mesh_tensor or 1)
+        self.mesh_fsdp = int(mesh_fsdp or 1)
+        validate_serving_mesh(cfg, self.mesh_tensor, self.mesh_fsdp)
+        if draft_model is not None and num_draft_tokens > 0:
+            validate_serving_mesh(
+                draft_model.cfg, self.mesh_tensor, self.mesh_fsdp,
+                role="draft",
+            )
+        self.mesh = build_serving_mesh(self.mesh_tensor, self.mesh_fsdp)
         self.num_draft_tokens = int(num_draft_tokens)
         if self.num_draft_tokens < 0:
             raise ValueError("num_draft_tokens must be >= 0")
@@ -579,11 +599,12 @@ class EnginePrograms:
         self.chunk_len -= self.chunk_len % self.page_size
         # callers (DecodeEngine, the serving lint) always pass the
         # resolved pool size; the fallback covers direct construction
-        # and must apply the SAME rule (incl. the int8 capacity ratio),
-        # assuming the registry's default slots
+        # and must apply the SAME rule (incl. the int8 capacity ratio
+        # and the per-chip mesh scaling), assuming the registry's
+        # default slots
         self.num_pages = resolve_num_pages(
             num_pages, DEFAULT_NUM_SLOTS, cfg, self.page_size,
-            self.quantize,
+            self.quantize, self.mesh_tensor,
         )
         if self.num_pages < self.max_pages_per_slot:
             raise ValueError(
@@ -613,24 +634,63 @@ class EnginePrograms:
                 )
         self.draft_model = draft_model
 
+        # -- sharding descriptors (mesh only): params at rest by the
+        # training rules, pools head-sharded on `tensor`. Computed from
+        # eval_shape trees (zero bytes); the SAME NamedShardings serve
+        # the live engine's device placement, the jits' out_shardings
+        # (explicit out_shardings keep the donation aliasing PINNED in
+        # the lowered HLO — unspecified, jax degrades the mark to a
+        # compile-time jax.buffer_donor hint the serve-donation lint
+        # cannot verify), and the analyzer's abstract lowering.
+        self._rep = None
+        self._param_sh = None
+        self._draft_param_sh = None
+        self._pool_sh = None
+        self._draft_pool_sh = None
+        if self.mesh is not None:
+            from kubeflow_tpu.parallel.serving_mesh import (
+                param_shardings,
+                pool_shardings,
+                replicated_sharding,
+            )
+
+            self._rep = replicated_sharding(self.mesh)
+            probe_bucket = min(8, cfg.max_len)
+            aparams = self.abstract_params()
+            self._param_sh = param_shardings(aparams, self.mesh)
+            pool = self.pool_shapes(self.cache_shapes(aparams, probe_bucket))
+            self._pool_sh = pool_shardings(pool, self.mesh)
+            if self.num_draft_tokens > 0:
+                adparams = self.abstract_params(draft_model)
+                self._draft_param_sh = param_shardings(
+                    adparams, self.mesh
+                )
+                dpool = self.pool_shapes(
+                    self.draft_cache_shapes(adparams, probe_bucket)
+                )
+                self._draft_pool_sh = pool_shardings(dpool, self.mesh)
+
         # the resident pools are always consumed-and-replaced: donate
         # them so XLA aliases input→output instead of copying the
         # engine's dominant buffer on every admission and every one-token
         # step (undonated = 2× pool HBM + one full pool copy per token)
+        rep, psh, dsh = self._rep, self._pool_sh, self._draft_pool_sh
         self.prefill = jax.jit(self._prefill_fn)
-        self.insert = jax.jit(self._insert_fn, donate_argnums=(0,))
-        self.chunk = jax.jit(self._chunk_fn, donate_argnums=(1,))
-        self.cow = jax.jit(copy_pool_page, donate_argnums=(0,))
-        self.step = jax.jit(self._step_fn, donate_argnums=(1,))
+        self.insert = self._jit(self._insert_fn, (0,), psh)
+        self.chunk = self._jit(self._chunk_fn, (1,), (psh, rep))
+        self.cow = self._jit(self._cow_fn, (0,), psh)
+        self.step = self._jit(self._step_fn, (1,), (psh, rep))
         if self.num_draft_tokens > 0:
             self.draft_prefill = jax.jit(self._draft_prefill_fn)
-            self.draft_insert = jax.jit(self._insert_fn, donate_argnums=(0,))
-            self.draft_chunk = jax.jit(
-                self._draft_chunk_fn, donate_argnums=(1,)
+            self.draft_insert = self._jit(self._insert_fn, (0,), dsh)
+            self.draft_chunk = self._jit(self._draft_chunk_fn, (1,), dsh)
+            self.draft_cow = self._jit(self._cow_fn, (0,), dsh)
+            self.draft = self._jit(
+                self._draft_fn, (1,), (dsh, rep, rep)
             )
-            self.draft_cow = jax.jit(copy_pool_page, donate_argnums=(0,))
-            self.draft = jax.jit(self._draft_fn, donate_argnums=(1,))
-            self.verify = jax.jit(self._verify_fn, donate_argnums=(1,))
+            self.verify = self._jit(
+                self._verify_fn, (1,), (psh, rep, rep)
+            )
         else:
             self.draft_prefill = None
             self.draft_insert = None
@@ -639,19 +699,52 @@ class EnginePrograms:
             self.draft = None
             self.verify = None
 
+    def _jit(self, fn, donate_argnums, out_shardings):
+        """jax.jit with the pool-program treatment: donation always; on
+        a mesh ALSO explicit out_shardings, which is what keeps the
+        `tf.aliasing_output` donation mark pinned in the sharded HLO
+        (serve-donation's evidence). Unmeshed, this is byte-for-byte
+        the r13 jit call."""
+        if self.mesh is None:
+            return jax.jit(fn, donate_argnums=donate_argnums)
+        return jax.jit(
+            fn, donate_argnums=donate_argnums, out_shardings=out_shardings
+        )
+
+    def _cow_fn(self, pool, src, dst):
+        from kubeflow_tpu.models.gpt import copy_pool_page
+
+        return copy_pool_page(pool, src, dst, mesh=self.mesh)
+
     def _paged(self, page_table, cursors):
         from kubeflow_tpu.models.gpt import PagedState
 
         return PagedState(
             page_table, cursors, self.page_size, self.num_pages,
             attn_impl=self.paged_attention, kv_quant=self.kv_quant,
+            mesh=self.mesh,
         )
 
     def _live_params(self, params, draft: bool = False):
         """What the model applies: at quantize=int8 the RESIDENT tree is
         int8 + per-channel scales (half the streamed weight bytes) and
         the dequant into the compute dtype runs here, inside the jitted
-        program — on TPU it fuses into the matmul operand reads."""
+        program — on TPU it fuses into the matmul operand reads.
+
+        On a mesh the resident tree is ALSO sharded (fsdp on embed
+        dims, tensor on heads/mlp/vocab — the capacity that lets a
+        model too big for one chip serve at all) and gathers to
+        replicated here, inside the program: the all-gather moves bits
+        exactly, every weight matmul then runs replicated, and greedy
+        output stays bitwise the 1×1 engine's. At int8 the gather moves
+        the int8 tree — half the gathered bytes — and dequantizes
+        after."""
+        if self.mesh is not None:
+            from kubeflow_tpu.parallel.serving_mesh import (
+                gather_replicated,
+            )
+
+            params = gather_replicated(params, self.mesh)
         if self.quantize != "int8":
             return params
         cfg = (self.draft_model if draft else self.model).cfg
@@ -680,7 +773,9 @@ class EnginePrograms:
             # prefill computed full-width K/V; the pool stores int8 +
             # scales — quantize once, on device, at admission
             cache_one = quantize_kv_cache(cache_one)
-        return insert_pages(pool, cache_one, page_ids, real_len)
+        return insert_pages(
+            pool, cache_one, page_ids, real_len, mesh=self.mesh
+        )
 
     def _chunk_fn(self, params, pool, ids, page_table, cursor, sample_idx,
                   key, temp, top_k, top_p):
@@ -967,21 +1062,48 @@ class EnginePrograms:
         compile-bound program set; the serving lint lowers each entry and
         checks donation aliasing, cache dtype discipline, and
         host-transfer freedom against it."""
-        sds = jax.ShapeDtypeStruct
         i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
         s = int(num_slots)
         mp = self.max_pages_per_slot
         buckets = tuple(sorted(prefill_buckets))
         if params is None:
             params = self.abstract_params()
+
+        # on a mesh the abstract args CARRY their shardings, so the
+        # analyzer's trace/lower produces the sharded HLO the engine
+        # dispatches (donation marks, collectives and all) — an
+        # unmeshed shadow program would make every sharding check inert
+        if self.mesh is not None:
+            from kubeflow_tpu.parallel.serving_mesh import (
+                abstract_with_shardings,
+            )
+
+            def sds(shape, dt):
+                return jax.ShapeDtypeStruct(shape, dt, sharding=self._rep)
+
+            def shard_tree(tree, shardings):
+                return abstract_with_shardings(tree, shardings)
+
+            params = shard_tree(params, self._param_sh)
+        else:
+            sds = jax.ShapeDtypeStruct
+
+            def shard_tree(tree, shardings):  # noqa: ARG001 - no mesh
+                return tree
+
+        def rep_tree(tree):
+            return shard_tree(
+                tree, jax.tree.map(lambda _: self._rep, tree)
+            )
+
         key = sds((2,), u32)
         keys = sds((s, 2), u32)
 
         def vec(dt):
             return sds((s,), dt)
 
-        cache_one = self.cache_shapes(params, buckets[0])
-        pool = self.pool_shapes(cache_one)
+        cache_one = rep_tree(self.cache_shapes(params, buckets[0]))
+        pool = shard_tree(self.pool_shapes(cache_one), self._pool_sh)
         pt = sds((s, mp), i32)
         pt1 = sds((1, mp), i32)
         sigs: List[ProgramSignature] = []
@@ -1018,8 +1140,16 @@ class EnginePrograms:
         if self.num_draft_tokens > 0:
             if draft_params is None:
                 draft_params = self.abstract_params(self.draft_model)
-            dcache_one = self.draft_cache_shapes(draft_params, buckets[0])
-            dpool = self.pool_shapes(dcache_one)
+            if self.mesh is not None:
+                draft_params = shard_tree(
+                    draft_params, self._draft_param_sh
+                )
+            dcache_one = rep_tree(
+                self.draft_cache_shapes(draft_params, buckets[0])
+            )
+            dpool = shard_tree(
+                self.pool_shapes(dcache_one), self._draft_pool_sh
+            )
             kk = self.num_draft_tokens
             vocab = self.model.cfg.vocab_size
             for b in buckets:
@@ -1132,6 +1262,8 @@ class DecodeEngine:
         prefix_cache: bool = True,
         paged_attention: Optional[str] = None,
         quantize: Optional[str] = None,
+        mesh_tensor: Optional[int] = None,
+        mesh_fsdp: Optional[int] = None,
     ):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -1165,22 +1297,40 @@ class DecodeEngine:
             ):
                 draft_params = quantize_params_int8(draft_params)
         self.params = params
+        self.mesh_tensor = int(mesh_tensor or 1)
+        self.mesh_fsdp = int(mesh_fsdp or 1)
         ps = int(page_size) if page_size else DEFAULT_PAGE_SIZE
         # one pool-sizing rule with the serving lint (resolve_num_pages):
         # auto sizing at quantize=int8 applies the capacity ratio — same
-        # HBM budget, ~2x the pages the admission gate can promise
+        # HBM budget, ~2x the pages the admission gate can promise —
+        # and on a tensor mesh the per-chip shard count
         pool_pages = resolve_num_pages(
-            num_pages, num_slots, cfg, ps, self.quantize
+            num_pages, num_slots, cfg, ps, self.quantize,
+            self.mesh_tensor,
         )
         # the jitted program family (and the draft-compat + page-geometry
-        # validation) lives in EnginePrograms — the same object
-        # kft-analyze lowers
+        # + mesh-divisibility validation) lives in EnginePrograms — the
+        # same object kft-analyze lowers
         self.programs = EnginePrograms(
             model, draft_model=draft_model,
             num_draft_tokens=self.num_draft_tokens,
             page_size=ps, num_pages=pool_pages,
             paged_attention=self.paged_attention, quantize=self.quantize,
+            mesh_tensor=self.mesh_tensor, mesh_fsdp=self.mesh_fsdp,
         )
+        self.mesh = self.programs.mesh
+        if self.mesh is not None:
+            # params live SHARDED at rest (the capacity win); the
+            # program bodies gather them at use. Placement is exact bit
+            # movement — output parity is unaffected.
+            self.params = jax.device_put(
+                self.params, self.programs._param_sh
+            )
+            params = self.params
+            if draft_params is not None:
+                draft_params = jax.device_put(
+                    draft_params, self.programs._draft_param_sh
+                )
         self.page_size = ps
         self.num_pages = pool_pages
         self._max_pages = self.programs.max_pages_per_slot
@@ -1205,8 +1355,21 @@ class DecodeEngine:
         from kubeflow_tpu.models.gpt import make_paged_pool
 
         self._cache_shapes = self.programs.cache_shapes(params, buckets[0])
-        self._make_paged_pool = lambda shapes: make_paged_pool(
-            shapes, self.num_pages, self.page_size, kv_quant=self.quantize
+
+        def _build_pool(shapes, shardings):
+            pool = make_paged_pool(
+                shapes, self.num_pages, self.page_size,
+                kv_quant=self.quantize,
+            )
+            if shardings is not None:
+                # the pools live head-sharded from birth: every program
+                # donates them, and the aliasing needs the committed
+                # input sharding to match the out_shardings
+                pool = jax.device_put(pool, shardings)
+            return pool
+
+        self._make_paged_pool = lambda shapes, sh=None: _build_pool(
+            shapes, sh if sh is not None else self.programs._pool_sh
         )
         self._pool = self._make_paged_pool(self._cache_shapes)
         self._insert = self.programs.insert
@@ -1224,7 +1387,7 @@ class DecodeEngine:
                 draft_params, buckets[0]
             )
             self._draft_pool = self._make_paged_pool(
-                self._draft_cache_shapes
+                self._draft_cache_shapes, self.programs._draft_pool_sh
             )
             self._draft_insert = self.programs.draft_insert
             self._draft_prefill = self.programs.draft_prefill
@@ -1347,6 +1510,14 @@ class DecodeEngine:
             sum(l.size * l.dtype.itemsize for l in pool_leaves)
         )
         self._pool_bytes_g.set(self.kv_pool_bytes, model=name)
+        # per-chip resident pool bytes: pools shard on heads under
+        # `tensor` (every leaf, int8 scales included) and replicate
+        # under `fsdp` — one chip holds 1/tensor of the total. The
+        # fleet-visible sharded-rollout evidence, and the same per-chip
+        # number the mem-budget lint prices.
+        self.kv_pool_bytes_per_chip = self.kv_pool_bytes // self.mesh_tensor
+        self._pool_bytes_chip_g = serving_kv_pool_bytes_per_chip_gauge()
+        self._pool_bytes_chip_g.set(self.kv_pool_bytes_per_chip, model=name)
 
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"decode-engine-{name}"
@@ -1544,6 +1715,12 @@ class DecodeEngine:
                     else jnp.dtype(self.model.cfg.dtype).name
                 ),
                 "kv_pool_bytes": self.kv_pool_bytes,
+                # r14 sharded-serving rollout evidence: the mesh this
+                # engine's programs actually run on, and what one chip
+                # holds of the pools
+                "mesh_tensor": self.mesh_tensor,
+                "mesh_fsdp": self.mesh_fsdp,
+                "kv_pool_bytes_per_chip": self.kv_pool_bytes_per_chip,
             }
 
     def debug_state(self) -> dict:
@@ -1583,6 +1760,10 @@ class DecodeEngine:
             "attention_kernel": self.paged_attention,
             "quantize": self.quantize,
             "kv_pool_bytes": self.kv_pool_bytes,
+            "mesh": {
+                "tensor": self.mesh_tensor, "fsdp": self.mesh_fsdp,
+            },
+            "kv_pool_bytes_per_chip": self.kv_pool_bytes_per_chip,
             "prefix_cache": self.prefix_cache_enabled,
             "prefix_nodes": self._radix.nodes if self._radix else 0,
             "slots": slots,
@@ -2069,7 +2250,7 @@ class DecodeEngine:
         self._pool = self._make_paged_pool(self._cache_shapes)
         if self.num_draft_tokens > 0:
             self._draft_pool = self._make_paged_pool(
-                self._draft_cache_shapes
+                self._draft_cache_shapes, self.programs._draft_pool_sh
             )
         self._pagepool.reset()
         if self._radix is not None:

@@ -144,13 +144,33 @@ class ServedLm:
     sampling knobs are compile-time constants and join the key."""
 
     def __init__(
-        self, name: str, model, params, max_batch: int = 8, max_cached: int = 16
+        self, name: str, model, params, max_batch: int = 8,
+        max_cached: int = 16, quantize: str = "none",
     ):
         import threading
         from collections import OrderedDict
 
+        from kubeflow_tpu.checkpointing.quantize import (
+            is_quantized_params,
+            quantize_params_int8,
+        )
+
         self.name = name
         self.model = model
+        # static-path int8 (r14): the RESIDENT tree is int8 + scales —
+        # the same envelope the engine holds — and every compiled
+        # generate dequantizes inside its jit, so the static `:generate`
+        # path streams half the weight bytes instead of silently
+        # serving full-width when serving.quantize=int8 with the
+        # engine off (num_slots=0)
+        self.quantize = str(quantize or "none")
+        if self.quantize not in ("none", "int8"):
+            raise ValueError(
+                f"ServedLm quantize must be none|int8, got "
+                f"{self.quantize!r}"
+            )
+        if self.quantize == "int8" and not is_quantized_params(params):
+            params = quantize_params_int8(params)
         self.params = params
         self.max_batch = max_batch
         self.max_cached = max_cached
@@ -167,6 +187,7 @@ class ServedLm:
         params=None,
         served_name: Optional[str] = None,
         scan_layers: bool = True,
+        quantize: Optional[str] = None,
         **model_kwargs,
     ) -> "ServedLm":
         """Build from the platform model registry; params from the latest
@@ -175,7 +196,15 @@ class ServedLm:
         Serving defaults to scan_layers=True (depth-independent decode
         lowering); the params convert between the named-layer and
         scanned layouts automatically in BOTH directions, so any
-        checkpoint loads into either serving configuration."""
+        checkpoint loads into either serving configuration.
+
+        `quantize="int8"`: when the restored layout already matches the
+        serving layout, the restore routes THROUGH the int8 dtype
+        transform (`restore_params(transform="int8")` — the full-width
+        tree is transient assembly state, never resident); when a
+        named↔scanned restack is needed it must see the full-width
+        tree's paths (the scale vectors key on them), so the restack
+        runs first and the ctor quantizes once after."""
         from kubeflow_tpu.models.gpt import (
             stack_layer_params,
             unstack_layer_params,
@@ -183,15 +212,34 @@ class ServedLm:
         from kubeflow_tpu.models.registry import get_model
         from kubeflow_tpu.serving.server import restore_checkpoint_params
 
+        quantize = quantize or "none"
         model = get_model(model_name, scan_layers=scan_layers, **model_kwargs)
         if params is None:
+            params = restore_checkpoint_params(
+                checkpoint_dir,
+                transform="int8" if quantize == "int8" else "",
+            )
+        tree = params["qvalues"] if quantize == "int8" and isinstance(
+            params, dict
+        ) and "qvalues" in params else params
+        has_named = any(str(k).startswith("layer_") for k in tree)
+        needs_stack = scan_layers and "layers" not in tree and has_named
+        needs_unstack = (
+            not scan_layers and "layers" in tree and not has_named
+        )
+        if (needs_stack or needs_unstack) and tree is not params:
+            # the envelope's scales key on tree paths — restacking
+            # under them would orphan every scale. Re-assemble
+            # full-width (transient), restack, let the ctor quantize.
             params = restore_checkpoint_params(checkpoint_dir)
-        has_named = any(str(k).startswith("layer_") for k in params)
-        if scan_layers and "layers" not in params and has_named:
+            tree = params
+        if needs_stack:
             params = stack_layer_params(params, model.cfg.num_layers)
-        elif not scan_layers and "layers" in params and not has_named:
+        elif needs_unstack:
             params = unstack_layer_params(params, model.cfg.num_layers)
-        return cls(served_name or model_name, model, params)
+        return cls(
+            served_name or model_name, model, params, quantize=quantize
+        )
 
     @staticmethod
     def _bucket_tokens(n: int, headroom: int) -> int:
@@ -283,7 +331,21 @@ class ServedLm:
                 # the tunneled compile endpoint for three rounds while the
                 # params-as-args form compiles in seconds), and any param
                 # hot-swap would silently keep serving the stale constants
+                quantized = self.quantize == "int8"
+
                 def run(params, p, m, rng):
+                    if quantized:
+                        # resident tree stays int8 + scales; the dequant
+                        # into the compute dtype runs INSIDE the jit —
+                        # the engine's _live_params treatment, on the
+                        # static path
+                        from kubeflow_tpu.checkpointing.quantize import (
+                            dequantize_params,
+                        )
+
+                        params = dequantize_params(
+                            params, self.model.cfg.dtype
+                        )
                     return generate(
                         self.model,
                         params,

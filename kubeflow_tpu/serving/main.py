@@ -48,6 +48,9 @@ def engine_knobs_from_env():
     KFT_SERVING_PREFIX_CACHE (radix prefix index on/off),
     KFT_SERVING_PAGED_ATTENTION (decode read kernel: gather | pallas) +
     KFT_SERVING_QUANTIZE (none | int8 weights-and-KV-pages),
+    KFT_SERVING_MESH_TENSOR + KFT_SERVING_MESH_FSDP (the serving mesh —
+    tensor shards the KV pools on heads, fsdp shards the resident
+    weights; 1/1 = the unmeshed single-chip engine),
     KFT_SERVING_DRAFT_MODEL + KFT_SERVING_DRAFT_TOKENS (speculative
     decoding: registry draft model and tokens drafted per verify step; 0
     disables), KFT_SERVING_DRAIN_DEADLINE_S (SIGTERM/scale-down draining
@@ -70,6 +73,8 @@ def engine_knobs_from_env():
             os.environ.get("KFT_SERVING_QUANTIZE", "").strip()
             or DEFAULT_QUANTIZE
         ),
+        "mesh_tensor": _env_int("KFT_SERVING_MESH_TENSOR", 1),
+        "mesh_fsdp": _env_int("KFT_SERVING_MESH_FSDP", 1),
         "draft_model": os.environ.get("KFT_SERVING_DRAFT_MODEL", "").strip(),
         "num_draft_tokens": _env_int("KFT_SERVING_DRAFT_TOKENS", 0),
         "draft_checkpoint_dir": os.environ.get(
@@ -105,6 +110,8 @@ def build_server(
     prefix_cache: bool = None,
     paged_attention: str = None,
     quantize: str = None,
+    mesh_tensor: int = None,
+    mesh_fsdp: int = None,
     draft_model: str = None,
     num_draft_tokens: int = None,
     draft_params=None,
@@ -189,6 +196,10 @@ def build_server(
             paged_attention = env["paged_attention"]
         if quantize is None:
             quantize = env["quantize"]
+        if mesh_tensor is None:
+            mesh_tensor = env["mesh_tensor"]
+        if mesh_fsdp is None:
+            mesh_fsdp = env["mesh_fsdp"]
         if draft_model is None:
             draft_model = env["draft_model"]
         if num_draft_tokens is None:
@@ -212,15 +223,22 @@ def build_server(
                 "kernel serves the engine's decode step, and "
                 "num_slots=0 disables the engine"
             )
-        if num_slots < 1 and quantize not in (None, "none"):
+        if num_slots < 1 and (
+            (mesh_tensor or 1) > 1 or (mesh_fsdp or 1) > 1
+        ):
             raise ValueError(
-                "quantize=int8 needs num_slots >= 1: quantization "
-                "lives inside the decode engine, and num_slots=0 "
-                "disables it — the static path would silently serve "
-                "full-width weights"
+                "a serving mesh needs num_slots >= 1: the mesh shards "
+                "the decode engine's programs, and num_slots=0 "
+                "disables the engine — the static path would silently "
+                "serve single-chip"
             )
+        # num_slots=0 + quantize=int8 is the STATIC int8 path (r14,
+        # PR 13 leftover (c)): ServedLm keeps the resident tree int8 +
+        # scales and dequantizes inside its jitted generate — the knob
+        # is honored on both paths, never silently full-width
         lm = ServedLm.from_registry(
-            model, checkpoint_dir=checkpoint_dir or None, params=params
+            model, checkpoint_dir=checkpoint_dir or None, params=params,
+            quantize=(quantize if num_slots < 1 else None),
         )
         server.add_lm(lm)
         if num_slots > 0:
@@ -271,6 +289,8 @@ def build_server(
                     prefix_cache=prefix_cache,
                     paged_attention=paged_attention,
                     quantize=quantize,
+                    mesh_tensor=mesh_tensor,
+                    mesh_fsdp=mesh_fsdp,
                     draft_model=draft,
                     draft_params=draft_params,
                     num_draft_tokens=num_draft_tokens,
@@ -333,6 +353,18 @@ def main(argv=None) -> int:
         "else none)",
     )
     ap.add_argument(
+        "--mesh-tensor", type=int, default=None,
+        help="serving mesh chips sharding the KV pools' heads axis "
+        "(must divide the model's num_heads/mlp_dim; default from "
+        "KFT_SERVING_MESH_TENSOR, else 1)",
+    )
+    ap.add_argument(
+        "--mesh-fsdp", type=int, default=None,
+        help="serving mesh chips sharding the resident weights' embed "
+        "dim, all-gathered at use (must divide hidden_size; default "
+        "from KFT_SERVING_MESH_FSDP, else 1)",
+    )
+    ap.add_argument(
         "--prefix-cache", type=int, choices=(0, 1), default=None,
         help="radix prefix cache on/off (default from "
         "KFT_SERVING_PREFIX_CACHE, else on)",
@@ -366,6 +398,8 @@ def main(argv=None) -> int:
         ),
         paged_attention=args.paged_attention,
         quantize=args.quantize,
+        mesh_tensor=args.mesh_tensor,
+        mesh_fsdp=args.mesh_fsdp,
         draft_model=args.draft_model,
         num_draft_tokens=args.num_draft_tokens,
         draft_checkpoint_dir=args.draft_checkpoint_dir,

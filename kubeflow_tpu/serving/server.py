@@ -344,10 +344,13 @@ class ModelServer:
                 f"tokens={st['tokens']} "
                 f"occupancy={st['mean_occupancy']:.3f}"
             )
+            mesh = state["mesh"]
             lines.append(
                 f"    kv pool: {state['pages_in_use']}"
                 f"/{state['pages_total']} pages of {state['page_size']} "
                 f"({st['kv_pool_dtype']}, {state['kv_pool_bytes']} B) | "
+                f"mesh: tensor={mesh['tensor']} fsdp={mesh['fsdp']} "
+                f"({state['kv_pool_bytes_per_chip']} B/chip) | "
                 f"kernel: {st['attention_kernel']} "
                 f"quantize: {st['quantize']} | "
                 f"prefix cache: "

@@ -749,6 +749,20 @@ def serving_kv_pool_bytes_gauge() -> Gauge:
     )
 
 
+def serving_kv_pool_bytes_per_chip_gauge() -> Gauge:
+    """What ONE chip of the serving mesh actually holds of the pools:
+    `serving_kv_pool_bytes / mesh_tensor` (the pools shard on the heads
+    axis under `tensor` and replicate under `fsdp`). On the 1×1 engine
+    it equals the total — the fleet-visible evidence that a sharded
+    rollout (r14) really divided the resident pool, and the number the
+    mem-budget lint prices against the chip's HBM."""
+    return default_registry().gauge(
+        "serving_kv_pool_bytes_per_chip",
+        "resident KV pool bytes per mesh chip",
+        ["model"],
+    )
+
+
 # ---------------------------------------------------------------------------
 # Observability-derived metrics (kubeflow_tpu/observability/; docs/
 # OBSERVABILITY.md): per-phase request accounting on the serving path and

@@ -1062,10 +1062,75 @@ class TestServingPlansClean:
         assert "draft_chunk" in stats["programs"]
         assert "draft kv page pool" in stats["hbm"]["components_bytes"]
 
+    def test_tiny_sharded_plan_lowers_clean_and_prices_per_chip(self):
+        """The r14 sharded family: the SAME programs lower on a real
+        tensor=2 virtual mesh (donation marks pinned on the sharded
+        HLO, spmd passes non-inert) and mem-budget prices PER-CHIP
+        bytes — the auto pool doubles its pages while the per-chip
+        pool term stays exactly the unmeshed plan's (same per-chip
+        HBM, tensor× the tokens: the ONE sizing rule)."""
+        from kubeflow_tpu.analysis.serving import analyze_serving_plan
+
+        findings, stats = analyze_serving_plan(
+            self._tiny(name="tiny:sharded", mesh_tensor=2)
+        )
+        bad = [f for f in findings if f.severity >= Severity.ERROR]
+        assert bad == [], "\n".join(f.render() for f in bad)
+        assert stats["mesh"] == {"tensor": 2, "fsdp": 1}
+        _, base_stats = analyze_serving_plan(self._tiny())
+        assert stats["num_pages"] == 2 * base_stats["num_pages"]
+        assert (
+            stats["hbm"]["components_bytes"]["kv page pool"]
+            == base_stats["hbm"]["components_bytes"]["kv page pool"]
+        )
+        # sharded params: strictly fewer per-chip bytes than replicated
+        assert (
+            stats["hbm"]["components_bytes"]["params"]
+            < base_stats["hbm"]["components_bytes"]["params"]
+        )
+
+    def test_sharded_replicated_param_pass_is_live(self):
+        """spmd-replicated-param runs non-inert over sharded plans: a
+        big leaf the serving layout leaves fully replicated while the
+        mesh has shard-capable axes is flagged through the SAME
+        sharding tree the engine device_puts (here: an odd vocab that
+        training's annotation rules degrade to replicated on an even
+        tensor axis)."""
+        from kubeflow_tpu.analysis.spmd import check_replicated_params
+        from kubeflow_tpu.models.registry import get_model
+        from kubeflow_tpu.serving.engine import EnginePrograms
+
+        model = get_model("gpt_tiny", vocab_size=513)
+        progs = EnginePrograms(model, page_size=16, mesh_tensor=2)
+        params = progs.abstract_params()
+        findings = check_replicated_params(
+            params, progs._param_sh, {"tensor": 2, "fsdp": 1},
+            "seed:replicated", threshold=1000,
+        )
+        assert any(
+            "tok_emb" in f.symbol or "head" in f.symbol for f in findings
+        )
+
+    def test_multislice_serving_plan_rejected(self):
+        """A serving replica never spans slices: tensor/fsdp
+        collectives run every decode step, and the dcn pass fails any
+        plan that declares num_slices > 1 instead of linting around
+        it."""
+        from kubeflow_tpu.analysis.serving import analyze_serving_plan
+
+        findings, _ = analyze_serving_plan(
+            self._tiny(name="tiny:dcn", mesh_tensor=2, num_slices=2)
+        )
+        assert any(
+            f.analyzer == "spmd-dcn-collective" and f.symbol == "mesh"
+            for f in findings
+        )
+
     @pytest.mark.slow
     def test_shipped_serving_plans_clean(self):
         """Every plan in the shipped registry — the default engine plus
-        the three bench engines — lints clean at production size, one
+        the bench engines (incl. the tensor=2 sharded one, lowered on
+        2 virtual devices) — lints clean at production size, one
         subprocess each (the CI serving-lint step's exact sweep)."""
         from kubeflow_tpu.analysis.serving import (
             analyze_serving_plan_subprocess,
@@ -1075,7 +1140,8 @@ class TestServingPlansClean:
         )
 
         specs = shipped_serving_plans()
-        assert len(specs) == 6
+        assert len(specs) == 7
+        assert "bench:gpt_sharded" in {s.name for s in specs}
         for spec in specs:
             findings, stats = analyze_serving_plan_subprocess(
                 spec, REPO, timeout_s=600.0
@@ -1106,6 +1172,7 @@ class TestServingPlansClean:
             "KFT_SERVING_PREFILL_BUCKETS", "KFT_SERVING_PAGE_SIZE",
             "KFT_SERVING_NUM_PAGES", "KFT_SERVING_PREFIX_CACHE",
             "KFT_SERVING_PAGED_ATTENTION", "KFT_SERVING_QUANTIZE",
+            "KFT_SERVING_MESH_TENSOR", "KFT_SERVING_MESH_FSDP",
             "KFT_SERVING_DRAIN_DEADLINE_S",
         ):
             monkeypatch.delenv(var, raising=False)
@@ -1117,6 +1184,10 @@ class TestServingPlansClean:
         assert knobs["prefix_cache"] is True
         assert knobs["paged_attention"] == DEFAULT_PAGED_ATTENTION
         assert knobs["quantize"] == DEFAULT_QUANTIZE
+        # the mesh default is 1x1 — the unmeshed bitwise baseline —
+        # in the env fallback, the plan registry AND ServingConfig
+        assert knobs["mesh_tensor"] == 1
+        assert knobs["mesh_fsdp"] == 1
         assert knobs["drain_deadline_s"] == DEFAULT_DRAIN_DEADLINE_S
         cfg = ServingConfig()
         assert cfg.num_slots == DEFAULT_NUM_SLOTS
@@ -1126,6 +1197,8 @@ class TestServingPlansClean:
         assert cfg.prefix_cache is True
         assert cfg.paged_attention == DEFAULT_PAGED_ATTENTION
         assert cfg.quantize == DEFAULT_QUANTIZE
+        assert cfg.mesh.tensor == 1
+        assert cfg.mesh.fsdp == 1
         assert cfg.drain_deadline_s == DEFAULT_DRAIN_DEADLINE_S
 
     def test_registry_shared_with_bench(self):
@@ -1190,8 +1263,11 @@ class TestServingPlansClean:
             ):
                 (in_programs if lo <= sub.lineno <= hi
                  else elsewhere).append(sub.lineno)
-        # prefill/insert/chunk/cow/step + the 6-member draft family
-        assert len(in_programs) == 11
+        # the two prefill jits plus BOTH branches of the _jit helper
+        # every pool program routes through (r14: _jit adds explicit
+        # out_shardings on a mesh so the donation aliasing stays pinned
+        # in the sharded HLO; unmeshed it is the plain donating jit)
+        assert len(in_programs) == 4
         assert elsewhere == [], (
             f"jax.jit outside EnginePrograms at lines {elsewhere}"
         )

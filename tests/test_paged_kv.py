@@ -142,6 +142,7 @@ class TestPrefixCache:
         assert a2["tokens"] == a["tokens"]
         assert stats["cow_copies"] >= 1
 
+    @pytest.mark.slow
     def test_small_hit_on_long_prompt_prefers_head_prefill(
         self, gpt_and_params
     ):
@@ -149,7 +150,12 @@ class TestPrefixCache:
         admits as a MISS: chunk windows run at a worse FLOP rate than
         the bucketed head prefill, so a tiny hit would make admission
         slower than no hit at all. The guard drops the match; output
-        stays the oracle's and the whole prompt is computed."""
+        stays the oracle's and the whole prompt is computed.
+
+        @slow (r14 tier-1 tranche): runs unfiltered in the serving CI
+        paged-kv-parity step; tier-1 keeps the SAME small-hit guard
+        contract below the bucket
+        (test_small_hit_on_short_prompt_prefers_bucketed_prefill)."""
         model, params = gpt_and_params
         eng = DecodeEngine(
             "smallhit", model, params, num_slots=1, max_queue=8,
@@ -197,12 +203,18 @@ class TestPrefixCache:
         assert out["tokens"] == _ref_tokens(model, params, long_row, 4)
         assert post["prefill_compute_tokens"] - pre == long_row.size
 
+    @pytest.mark.slow
     def test_tree_eviction_under_pool_pressure(self, gpt_and_params):
         """A minimum-size pool with the prefix index holding committed
         pages: a new admission that needs them evicts LRU leaves (the
         incremental evictable accounting must agree), and everything
         stays bitwise-correct — including re-serving the evicted prompt
-        afterwards (as a miss)."""
+        afterwards (as a miss).
+
+        @slow (r14 tier-1 tranche): runs unfiltered in the serving CI
+        paged-kv-parity step; tier-1 keeps pool-pressure coverage
+        through test_pool_pressure_queues_then_429s_cleanly (the
+        admission-gate half of the same contract)."""
         model, params = gpt_and_params
         eng = DecodeEngine(
             "evict", model, params, num_slots=1, max_queue=4,
@@ -382,9 +394,15 @@ class TestPallasKernel:
             assert out["tokens"] == _ref_tokens(model, params, row, 6)
         assert stats["attention_kernel"] == "pallas"
 
+    @pytest.mark.slow
     def test_bitwise_through_prefix_hit_and_cow(self, gpt_and_params):
         """Prefix hits + COW admit through the gather-era helpers; the
-        pallas step then reads the same pages — bitwise end to end."""
+        pallas step then reads the same pages — bitwise end to end.
+
+        @slow (r14 tier-1 tranche): runs unfiltered in the serving CI
+        pallas-parity step; tier-1 keeps the kernel's bitwise contract
+        through test_bitwise_vs_generate_across_page_sizes[8] and the
+        prefix/COW contract through the gather-path TestPrefixCache."""
         model, params = gpt_and_params
         eng = DecodeEngine(
             "plpx", model, params, num_slots=1, max_queue=8, page_size=8,

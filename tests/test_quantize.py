@@ -309,29 +309,68 @@ class TestConfigChain:
         for kw in (
             {"paged_attention": "cuda"},
             {"quantize": "int4"},
-            # both knobs live inside the engine: num_slots=0 disables it
-            # and must reject, not silently serve full-width gather
-            {"num_slots": 0, "quantize": "int8"},
+            # the pallas kernel serves the ENGINE's step; num_slots=0
+            # disables the engine and must reject, not silently gather
             {"num_slots": 0, "paged_attention": "pallas"},
         ):
             cfg = dataclasses.replace(ServingConfig(), **kw)
             with pytest.raises(ConfigError):
                 cfg.validate()
+        # num_slots=0 + int8 is LEGAL since r14: the static ServedLm
+        # path serves the int8 tree (the r13 rejection existed because
+        # it would have silently served full-width)
+        dataclasses.replace(
+            ServingConfig(), num_slots=0, quantize="int8"
+        ).validate()
 
-    def test_build_server_rejects_engineless_knobs(self, monkeypatch):
+    def test_build_server_rejects_engineless_pallas(self, monkeypatch):
         from kubeflow_tpu.serving.main import build_server
 
         monkeypatch.delenv("KFT_SERVING_NUM_SLOTS", raising=False)
-        with pytest.raises(ValueError, match="quantize=int8"):
-            build_server(
-                "gpt_tiny", params={}, num_slots=0, quantize="int8",
-                batch_window_ms=0,
-            )
         with pytest.raises(ValueError, match="paged_attention=pallas"):
             build_server(
                 "gpt_tiny", params={}, num_slots=0,
                 paged_attention="pallas", batch_window_ms=0,
             )
+
+    def test_static_path_serves_int8(self, gpt_and_params, monkeypatch):
+        """num_slots=0 + quantize=int8 (PR 13 leftover (c)): the static
+        ServedLm path keeps the RESIDENT tree int8 + scales and its
+        jitted generate dequantizes in-program — greedy output equals
+        generate() over the dequantized quantized weights (the int8
+        oracle), proving the knob is honored, not silently full-width."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubeflow_tpu.serving.generate import generate
+        from kubeflow_tpu.serving.main import build_server
+
+        monkeypatch.delenv("KFT_SERVING_NUM_SLOTS", raising=False)
+        model, params = gpt_and_params
+        server = build_server(
+            "gpt_tiny", params=params, num_slots=0, quantize="int8",
+            batch_window_ms=0,
+        )
+        try:
+            lm = server._lms["gpt_tiny"]
+            # the resident tree IS the envelope — the liveness proof
+            # (tiny-model tokens can coincide with full-width)
+            assert is_quantized_params(lm.params)
+            row = ((np.arange(9) * 3 + 1) % 512).tolist()
+            status, body = server.app.handle(
+                "POST", "/v1/models/gpt_tiny:generate",
+                body={"prompt_ids": [row], "max_new_tokens": 6},
+            )
+        finally:
+            server.close()
+        assert status == 200, body
+        deq = dequantize_params(
+            quantize_params_int8(params), model.cfg.dtype
+        )
+        ref = np.asarray(
+            generate(model, deq, jnp.asarray([row], jnp.int32), 6)
+        )[0, 9:].tolist()
+        assert body["sequences"][0][-6:] == ref
 
 
 class TestQuantizedEngine:

@@ -225,6 +225,9 @@ class TestInferenceServiceController:
             # decode read-path kernel + serving quantization (r13)
             "KFT_SERVING_PAGED_ATTENTION": "gather",
             "KFT_SERVING_QUANTIZE": "none",
+            # serving mesh (r14 sharded serving; 1/1 = unmeshed engine)
+            "KFT_SERVING_MESH_TENSOR": "1",
+            "KFT_SERVING_MESH_FSDP": "1",
             "KFT_SERVING_DRAFT_MODEL": "",  # speculation off by default
             "KFT_SERVING_DRAFT_TOKENS": "0",
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": "",
@@ -262,6 +265,8 @@ class TestInferenceServiceController:
         monkeypatch.setenv("KFT_SERVING_PREFIX_CACHE", "0")
         monkeypatch.setenv("KFT_SERVING_PAGED_ATTENTION", "pallas")
         monkeypatch.setenv("KFT_SERVING_QUANTIZE", "int8")
+        monkeypatch.setenv("KFT_SERVING_MESH_TENSOR", "2")
+        monkeypatch.setenv("KFT_SERVING_MESH_FSDP", "4")
         monkeypatch.setenv("KFT_SERVING_DRAIN_DEADLINE_S", "12")
         assert engine_knobs_from_env() == {
             "num_slots": 4,
@@ -272,6 +277,8 @@ class TestInferenceServiceController:
             "prefix_cache": False,
             "paged_attention": "pallas",
             "quantize": "int8",
+            "mesh_tensor": 2,
+            "mesh_fsdp": 4,
             "draft_model": "",
             "num_draft_tokens": 0,
             "draft_checkpoint_dir": "",
@@ -283,6 +290,8 @@ class TestInferenceServiceController:
         monkeypatch.setenv("KFT_SERVING_PREFIX_CACHE", "")
         monkeypatch.setenv("KFT_SERVING_PAGED_ATTENTION", "")
         monkeypatch.setenv("KFT_SERVING_QUANTIZE", "")
+        monkeypatch.setenv("KFT_SERVING_MESH_TENSOR", "")
+        monkeypatch.setenv("KFT_SERVING_MESH_FSDP", "")
         monkeypatch.setenv("KFT_SERVING_DRAIN_DEADLINE_S", "")
         knobs = engine_knobs_from_env()
         assert knobs["num_slots"] == 8  # default
@@ -291,6 +300,8 @@ class TestInferenceServiceController:
         assert knobs["prefix_cache"] is True  # empty = default on
         assert knobs["paged_attention"] == "gather"  # default kernel
         assert knobs["quantize"] == "none"  # default: bitwise engine
+        assert knobs["mesh_tensor"] == 1  # default: unmeshed engine
+        assert knobs["mesh_fsdp"] == 1
         assert knobs["drain_deadline_s"] == 30.0  # default budget
 
 

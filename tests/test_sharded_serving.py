@@ -1,0 +1,416 @@
+"""Sharded serving: the decode engine on a tensor×fsdp mesh
+(serving/engine.py + parallel/serving_mesh.py; docs/SERVING.md "Sharded
+serving").
+
+The load-bearing contract is the r10/r13 one extended to the mesh:
+greedy output through the SHARDED engine is BITWISE identical to the
+1×1 engine (itself bitwise `generate()`) — sharding changes where bytes
+LIVE and which chip computes which head, never what is computed. The
+layout is constructed for that: params gather to replicated before any
+weight matmul (an all-gather moves bits exactly), the head-sharded
+attention segment never splits a contraction dim, and the attention
+output gathers before the heads-dim out projection. This file pins the
+contract across page sizes, prefix hits/COW, chunked prefill, K>0
+speculation and the pallas kernel, plus the per-chip pool-sizing math,
+the divisibility validation, and the operator surface.
+
+Runs on the conftest's 8 virtual CPU devices (the single-process
+analog of `XLA_FLAGS=--xla_force_host_platform_device_count`); the CI
+serving workflow's `sharded-parity` step runs it in full, @slow
+variants included.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.engine import DecodeEngine
+from kubeflow_tpu.serving.generate import generate
+
+
+# gpt_and_params comes from conftest.py: ONE session-scoped tiny-gpt
+# shared by every engine-family suite (the tier-1 time-budget tranche)
+
+
+def _rows(*lens):
+    return [
+        (np.arange(n) * (3 + 2 * i) + i + 1).astype(np.int32) % 512
+        for i, n in enumerate(lens)
+    ]
+
+
+def _ref_tokens(model, params, row, n):
+    out = generate(model, params, jnp.asarray(row, jnp.int32)[None, :], n)
+    return np.asarray(out)[0, len(row):].tolist()
+
+
+class TestShardedParity:
+    def test_bitwise_vs_generate_mesh_2x1(self, gpt_and_params):
+        """tensor=2: pools head-sharded, weights sharded at rest and
+        gathered in-program — greedy output bitwise the fused-scan
+        oracle's (== the 1×1 engine's)."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "sh21", model, params, num_slots=2, max_queue=8, page_size=8,
+            mesh_tensor=2,
+        )
+        try:
+            rows = _rows(4, 7)
+            futs = [eng.submit(r, 6) for r in rows]
+            outs = [f.wait(180) for f in futs]
+        finally:
+            eng.close()
+        for row, out in zip(rows, outs):
+            assert out["tokens"] == _ref_tokens(model, params, row, 6)
+
+    @pytest.mark.slow
+    def test_bitwise_vs_generate_mesh_2x1_page64(self, gpt_and_params):
+        """Page geometry stays a storage-layout knob on the mesh too."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "sh64", model, params, num_slots=2, max_queue=8,
+            page_size=64, mesh_tensor=2,
+        )
+        try:
+            rows = _rows(4, 7)
+            outs = [f.wait(180) for f in [eng.submit(r, 6) for r in rows]]
+        finally:
+            eng.close()
+        for row, out in zip(rows, outs):
+            assert out["tokens"] == _ref_tokens(model, params, row, 6)
+
+    @pytest.mark.slow
+    def test_bitwise_fsdp_mesh_1x2(self, gpt_and_params):
+        """fsdp=2: weights sharded on the embed dim at rest (the
+        model-too-big-for-one-chip axis), pools replicated — the
+        in-program all-gather keeps every matmul replicated and
+        bitwise."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "sh12", model, params, num_slots=2, max_queue=8, page_size=8,
+            mesh_fsdp=2,
+        )
+        try:
+            rows = _rows(4, 7)
+            outs = [f.wait(180) for f in [eng.submit(r, 6) for r in rows]]
+        finally:
+            eng.close()
+        for row, out in zip(rows, outs):
+            assert out["tokens"] == _ref_tokens(model, params, row, 6)
+
+    @pytest.mark.slow
+    def test_bitwise_mesh_2x2(self, gpt_and_params):
+        """Both axes at once: 4 chips, heads sharded 2-way, weights
+        sharded both ways at rest."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "sh22", model, params, num_slots=2, max_queue=8, page_size=8,
+            mesh_tensor=2, mesh_fsdp=2,
+        )
+        try:
+            rows = _rows(4, 7)
+            outs = [f.wait(180) for f in [eng.submit(r, 6) for r in rows]]
+        finally:
+            eng.close()
+        for row, out in zip(rows, outs):
+            assert out["tokens"] == _ref_tokens(model, params, row, 6)
+
+    def test_prefix_hit_and_cow_through_mesh(self, gpt_and_params):
+        """The radix index / page tables are host-global (scheduler
+        state, mesh-agnostic); shared pages and the COW boundary copy
+        live on the sharded pool. A hit, a mid-page divergence and a
+        donor re-run all stay bitwise."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "shpx", model, params, num_slots=1, max_queue=8, page_size=8,
+            prefix_cache=True, mesh_tensor=2,
+        )
+        try:
+            base = _rows(20)[0]
+            a = eng.generate_row(base, 6, timeout=180)
+            b = eng.generate_row(base, 6, timeout=180)
+            div = base.copy()
+            div[18:] = (div[18:] + 101) % 512
+            c = eng.generate_row(div, 6, timeout=180)
+            a2 = eng.generate_row(base, 6, timeout=180)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        ref = _ref_tokens(model, params, base, 6)
+        assert a["tokens"] == ref
+        assert b["tokens"] == ref  # bitwise THROUGH the prefix hit
+        assert c["tokens"] == _ref_tokens(model, params, div, 6)
+        assert a2["tokens"] == ref  # donor chain intact after the COW
+        assert stats["prefix_hit_tokens"] > 0
+        assert stats["cow_copies"] >= 1
+
+    def test_chunked_prefill_through_mesh(self, gpt_and_params):
+        """A prompt past the largest bucket rides head prefill + chunk
+        windows (multi-token paged decode) over the sharded pool."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "shch", model, params, num_slots=1, max_queue=8, page_size=8,
+            prefill_buckets=[32], prefix_cache=False, mesh_tensor=2,
+        )
+        try:
+            long_row = _rows(70)[0]
+            out = eng.generate_row(long_row, 5, timeout=180)
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, long_row, 5)
+
+    def test_speculation_through_mesh(self, gpt_and_params):
+        """K>0 on the mesh: draft and verify both run sharded (the
+        draft pool shares the target's page ids AND its head sharding);
+        greedy output stays bitwise, rewound pages return."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "shsp", model, params, num_slots=1, max_queue=4, page_size=8,
+            prefix_cache=False, draft_model=model, draft_params=params,
+            num_draft_tokens=3, mesh_tensor=2,
+        )
+        try:
+            row = _rows(7)[0]
+            out = eng.generate_row(row, 6, timeout=180)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, row, 6)
+        assert stats["pages_in_use"] == 0
+
+    @pytest.mark.slow
+    def test_hostile_draft_speculation_through_mesh(self, gpt_and_params):
+        """A rolled-head draft (acceptance provably 0) exercises the
+        full reject-and-rewind path on the sharded pools."""
+        model, params = gpt_and_params
+        dparams = jax.device_get(params)
+        dparams["head"]["kernel"] = np.roll(
+            np.asarray(dparams["head"]["kernel"]), 1, axis=-1
+        )
+        eng = DecodeEngine(
+            "shhd", model, params, num_slots=1, max_queue=4, page_size=8,
+            prefix_cache=False, draft_model=model, draft_params=dparams,
+            num_draft_tokens=2, mesh_tensor=2,
+        )
+        try:
+            row = _rows(7)[0]
+            out = eng.generate_row(row, 6, timeout=180)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, row, 6)
+        assert stats["rewind_pages_returned"] > 0
+        assert stats["pages_in_use"] == 0
+
+    def test_pallas_kernel_through_mesh(self, gpt_and_params):
+        """serving.paged_attention=pallas on the mesh: the kernel runs
+        inside shard_map over `tensor` — each chip walks only its own
+        head shard of the pool — and stays bitwise (attention is
+        per-head independent)."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "shpl", model, params, num_slots=2, max_queue=8, page_size=8,
+            paged_attention="pallas", mesh_tensor=2,
+        )
+        try:
+            rows = _rows(4, 7)
+            outs = [f.wait(180) for f in [eng.submit(r, 6) for r in rows]]
+            stats = eng.stats()
+        finally:
+            eng.close()
+        for row, out in zip(rows, outs):
+            assert out["tokens"] == _ref_tokens(model, params, row, 6)
+        assert stats["attention_kernel"] == "pallas"
+
+    @pytest.mark.slow
+    def test_int8_on_mesh_matches_int8_unmeshed(self, gpt_and_params):
+        """quantize=int8 composed with the mesh: no bitwise contract vs
+        the full-width oracle, but the sharded int8 engine must agree
+        BITWISE with the unmeshed int8 engine — same quantized bits,
+        same gathered-dequant math, different chips."""
+        model, params = gpt_and_params
+        row = _rows(9)[0]
+        outs = []
+        for kw in ({}, {"mesh_tensor": 2}):
+            eng = DecodeEngine(
+                "shq", model, params, num_slots=1, max_queue=4,
+                page_size=8, quantize="int8", **kw,
+            )
+            try:
+                outs.append(eng.generate_row(row, 6, timeout=180))
+            finally:
+                eng.close()
+        assert outs[0]["tokens"] == outs[1]["tokens"]
+
+
+class TestPoolSizingPerChip:
+    def test_auto_pages_scale_by_tensor(self, gpt_and_params):
+        """The ONE sizing rule (resolve_num_pages): each chip holds
+        1/tensor of every page, so the same per-chip HBM budget holds
+        tensor× the pages — and per-chip pool bytes stay exactly the
+        unmeshed engine's."""
+        from kubeflow_tpu.serving.engine import (
+            auto_num_pages,
+            resolve_num_pages,
+        )
+
+        model, params = gpt_and_params
+        cfg = model.cfg
+        base = auto_num_pages(2, cfg.max_len, 16)
+        assert resolve_num_pages(0, 2, cfg, 16, "none", 2) == 2 * base
+        # explicit num_pages always wins, mesh or not
+        assert resolve_num_pages(40, 2, cfg, 16, "none", 2) == 40
+        flat = DecodeEngine(
+            "szf", model, params, num_slots=2, page_size=16,
+            autostart=False,
+        )
+        sh = DecodeEngine(
+            "szs", model, params, num_slots=2, page_size=16,
+            mesh_tensor=2, autostart=False,
+        )
+        try:
+            assert sh.num_pages == 2 * flat.num_pages
+            assert sh.kv_pool_bytes == 2 * flat.kv_pool_bytes
+            assert sh.kv_pool_bytes_per_chip == flat.kv_pool_bytes
+            assert flat.kv_pool_bytes_per_chip == flat.kv_pool_bytes
+        finally:
+            flat.close()
+            sh.close()
+
+    def test_int8_and_tensor_scaling_compose(self, gpt_and_params):
+        from kubeflow_tpu.serving.engine import resolve_num_pages
+
+        model, _ = gpt_and_params
+        cfg = model.cfg
+        int8_only = resolve_num_pages(0, 2, cfg, 16, "int8", 1)
+        both = resolve_num_pages(0, 2, cfg, 16, "int8", 2)
+        assert both == 2 * int8_only
+
+
+class TestMeshValidation:
+    def test_tensor_must_divide_heads(self, gpt_and_params):
+        model, params = gpt_and_params  # gpt_tiny: 4 heads
+        with pytest.raises(ValueError, match="num_heads"):
+            DecodeEngine(
+                "bad", model, params, num_slots=1, autostart=False,
+                mesh_tensor=3,
+            )
+
+    def test_fsdp_must_divide_hidden(self, gpt_and_params):
+        model, params = gpt_and_params  # hidden 64
+        with pytest.raises(ValueError, match="hidden_size"):
+            DecodeEngine(
+                "bad", model, params, num_slots=1, autostart=False,
+                mesh_fsdp=3,
+            )
+
+    def test_draft_shape_validated_too(self, gpt_and_params):
+        from kubeflow_tpu.models import get_model
+
+        model, params = gpt_and_params
+        draft = get_model(
+            "gpt_tiny", dtype=jnp.float32, num_heads=1, hidden_size=16,
+            mlp_dim=32,
+        )
+        with pytest.raises(ValueError, match="draft"):
+            DecodeEngine(
+                "bad", model, params, num_slots=1, autostart=False,
+                draft_model=draft, draft_params={}, num_draft_tokens=2,
+                mesh_tensor=2,
+            )
+
+    def test_mesh_needs_enough_devices(self, gpt_and_params):
+        model, params = gpt_and_params  # hidden 64: fsdp=16 divides it
+        assert len(jax.devices()) < 16
+        with pytest.raises(ValueError, match="devices"):
+            DecodeEngine(
+                "bad", model, params, num_slots=1, autostart=False,
+                mesh_fsdp=16,
+            )
+
+    def test_config_rejects_bad_mesh(self):
+        import dataclasses
+
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import (
+            ServingConfig,
+            ServingMeshConfig,
+        )
+
+        for mesh in (
+            ServingMeshConfig(tensor=0),
+            ServingMeshConfig(fsdp=-1),
+        ):
+            with pytest.raises(ConfigError, match="serving.mesh"):
+                dataclasses.replace(
+                    ServingConfig(), mesh=mesh
+                ).validate()
+        with pytest.raises(ConfigError, match="num_slots"):
+            dataclasses.replace(
+                ServingConfig(), num_slots=0,
+                mesh=ServingMeshConfig(tensor=2),
+            ).validate()
+        # 1x1 (the default) is always valid
+        ServingConfig().validate()
+
+
+class TestOperatorSurface:
+    def test_stats_debug_and_gauge_expose_mesh(self, gpt_and_params):
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "shst", model, params, num_slots=1, autostart=False,
+            page_size=16, mesh_tensor=2,
+        )
+        try:
+            st = eng.stats()
+            dbg = eng.debug_state()
+        finally:
+            eng.close()
+        assert st["mesh_tensor"] == 2
+        assert st["mesh_fsdp"] == 1
+        assert st["kv_pool_bytes_per_chip"] * 2 == st["kv_pool_bytes"]
+        assert dbg["mesh"] == {"tensor": 2, "fsdp": 1}
+        assert dbg["kv_pool_bytes_per_chip"] == st["kv_pool_bytes_per_chip"]
+        gauge = default_registry().get("serving_kv_pool_bytes_per_chip")
+        assert gauge.value(model="shst") == st["kv_pool_bytes_per_chip"]
+
+    def test_statusz_shows_mesh_line(self, gpt_and_params):
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "shsz", model, params, num_slots=1, autostart=False,
+            mesh_tensor=2,
+        )
+        server = ModelServer()
+        server.add_engine(eng)
+        try:
+            status, resp, _ = server.app.handle_full("GET", "/statusz")
+        finally:
+            server.close()
+        assert status == 200
+        text = resp.body.decode()
+        assert "mesh: tensor=2 fsdp=1" in text
+        assert "B/chip" in text
+
+    def test_env_chain_reaches_engine(self, gpt_and_params, monkeypatch):
+        """KFT_SERVING_MESH_* → engine_knobs_from_env → build_server →
+        a DecodeEngine whose programs really run on the mesh."""
+        from kubeflow_tpu.serving.main import build_server
+
+        model, params = gpt_and_params
+        monkeypatch.setenv("KFT_SERVING_MESH_TENSOR", "2")
+        monkeypatch.setenv("KFT_SERVING_MESH_FSDP", "1")
+        monkeypatch.setenv("KFT_SERVING_NUM_SLOTS", "1")
+        server = build_server(
+            "gpt_tiny", params=params, batch_window_ms=0
+        )
+        try:
+            engine = server._engines["gpt_tiny"]
+            assert engine.mesh_tensor == 2
+            assert engine.mesh is not None
+        finally:
+            server.close()
