@@ -209,6 +209,18 @@ class ObservabilityConfig(ConfigNode):
         help="serve /statusz + /debug/trace (+ /metrics on the training "
         "runtime's debug port); off = endpoints not mounted",
     )
+    trace_sample_prob: float = config_field(
+        default=1.0,
+        help="tail-sampling keep probability for UNREMARKABLE completed "
+        "request traces (error traces and >p99-latency traces are "
+        "always kept); 1.0 keeps everything, 0.0 keeps only errors "
+        "and tails — the /tracez retention knob for high-QPS fleets",
+    )
+    trace_sample_keep: int = config_field(
+        default=128,
+        help="completed-traces ring capacity served by /tracez (kept "
+        "request traces, oldest dropped first)",
+    )
     slo_rules: List[str] = config_field(
         default_factory=list,
         help="declarative fleet SLO rules (observability/slo.py), e.g. "
@@ -239,6 +251,14 @@ class ObservabilityConfig(ConfigNode):
         if self.trace_buffer_spans < 1:
             raise ConfigError(
                 "observability.trace_buffer_spans must be >= 1"
+            )
+        if not 0.0 <= self.trace_sample_prob <= 1.0:
+            raise ConfigError(
+                "observability.trace_sample_prob must be in [0, 1]"
+            )
+        if self.trace_sample_keep < 1:
+            raise ConfigError(
+                "observability.trace_sample_keep must be >= 1"
             )
         if self.fleet_scrape_interval_s <= 0:
             raise ConfigError(

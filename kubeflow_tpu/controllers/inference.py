@@ -140,6 +140,14 @@ class InferenceServiceController(Controller):
             "KFT_TRACE_STATUSZ": (
                 "1" if cfg.observability.statusz_enabled else "0"
             ),
+            # distributed-tracing tail sampling (observability/trace.py
+            # finish_trace): keep probability + /tracez ring capacity
+            "KFT_TRACE_SAMPLE_PROB": (
+                f"{cfg.observability.trace_sample_prob:g}"
+            ),
+            "KFT_TRACE_SAMPLE_KEEP": str(
+                cfg.observability.trace_sample_keep
+            ),
         }
         if cfg.observability.statusz_enabled:
             # kft-fleet contract (observability/fleet.py): the collector

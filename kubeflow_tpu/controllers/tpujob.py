@@ -551,6 +551,8 @@ class TPUTrainJobController(Controller):
         env["KFT_TRACE_ENABLED"] = "1" if obs.trace_enabled else "0"
         env["KFT_TRACE_BUFFER_SPANS"] = str(obs.trace_buffer_spans)
         env["KFT_TRACE_STATUSZ"] = "1" if obs.statusz_enabled else "0"
+        env["KFT_TRACE_SAMPLE_PROB"] = f"{obs.trace_sample_prob:g}"
+        env["KFT_TRACE_SAMPLE_KEEP"] = str(obs.trace_sample_keep)
         if obs.statusz_enabled:
             # every gang host serves /statusz + /debug/trace + /metrics on
             # this port (runtime/launcher.py; pods have distinct network

@@ -15,24 +15,34 @@ from kubeflow_tpu.observability.mfu import (
 )
 from kubeflow_tpu.observability.trace import (
     DEFAULT_BUFFER_SPANS,
+    TRACEPARENT_HEADER,
     Span,
     SpanRecord,
     Tracer,
     configure_from_env,
     default_tracer,
+    format_traceparent,
     knobs_from_env,
+    mint_span_id,
+    mint_trace_id,
+    parse_traceparent,
 )
 
 __all__ = [
     "DEFAULT_BUFFER_SPANS",
+    "TRACEPARENT_HEADER",
     "Span",
     "SpanRecord",
     "Tracer",
     "chip_peaks",
     "configure_from_env",
     "default_tracer",
+    "format_traceparent",
     "goodput",
     "knobs_from_env",
+    "mint_span_id",
+    "mint_trace_id",
+    "parse_traceparent",
     "peak_flops_per_chip",
     "step_flops",
 ]

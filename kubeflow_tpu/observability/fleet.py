@@ -64,6 +64,7 @@ from typing import (
 )
 
 from kubeflow_tpu.chaos import ChaosError, default_chaos
+from kubeflow_tpu.observability.trace import EXEMPLAR_TOP_K as _EXEMPLAR_TOP_K
 from kubeflow_tpu.observability.slo import (
     SloEngine,
     SloStatus,
@@ -124,6 +125,10 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "deployments_total": "sum",
     "http_requests_total": "sum",
     "kft_faults_injected_total": "sum",
+    # distributed-tracing tail sampler (observability/trace.py
+    # finish_trace): kept-by-reason + sampled-out across the fleet
+    "kft_trace_kept_total": "sum",
+    "kft_trace_sampled_out_total": "sum",
     "notebook_create_total": "sum",
     "notebook_culling_total": "sum",
     "profile_namespaces_created_total": "sum",
@@ -134,6 +139,9 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "router_requests_total": "sum",
     "router_retry_total": "sum",
     "router_spill_total": "sum",
+    # traceparent propagation: fresh-mint count (requests_total minus
+    # this = traffic arriving already traced)
+    "router_trace_minted_total": "sum",
     "serving_decode_steps_total": "sum",
     "serving_draft_accepted_total": "sum",
     "serving_draft_proposed_total": "sum",
@@ -156,6 +164,9 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "deployment_seconds": "merge",
     "http_request_seconds": "merge",
     "reconcile_seconds": "merge",
+    # router request wall time (routing/router.py): fleet quantiles for
+    # front-door SLO rules, exemplar trace ids ride /tracez
+    "router_request_seconds": "merge",
     "serving_accept_rate": "merge",
     "serving_drain_seconds": "merge",
     "serving_fused_batch_rows": "merge",
@@ -806,12 +817,211 @@ class FleetCollector:
                 if e.get("ph") != "M":
                     e["ts"] = round(float(e.get("ts", 0.0)) + offset, 3)
                 events.append(e)
+        events.extend(self._request_flow_events(events))
         meta = [e for e in events if e.get("ph") == "M"]
         body = sorted(
             (e for e in events if e.get("ph") != "M"),
             key=lambda e: e["ts"],
         )
         return {"traceEvents": meta + body, "displayTimeUnit": "ms"}
+
+    @staticmethod
+    def _trace_root(trace_id: str) -> str:
+        """Multi-row requests tag row i `<id>/<i>` (serving/engine.py
+        submit_batch) — causality groups on the request id."""
+        return trace_id.split("/", 1)[0]
+
+    @staticmethod
+    def _request_flow_events(
+        events: List[Dict[str, Any]]
+    ) -> List[Dict[str, Any]]:
+        """Perfetto flow events binding one request's spans ACROSS
+        process tracks: for every trace id whose spans live in >= 2
+        pids (the router hop + the replica), emit an s→t→f flow chain
+        anchored at each process's earliest span of that trace — the
+        merged timeline renders the request as ONE connected flow
+        instead of coincidentally aligned slices."""
+        anchors: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            tid_ = (e.get("args") or {}).get("trace_id")
+            if not isinstance(tid_, str):
+                continue
+            root = FleetCollector._trace_root(tid_)
+            per_pid = anchors.setdefault(root, {})
+            cur = per_pid.get(e["pid"])
+            if cur is None or e["ts"] < cur["ts"]:
+                per_pid[e["pid"]] = e
+        flows: List[Dict[str, Any]] = []
+        flow_id = 0
+        for root in sorted(anchors):
+            per_pid = anchors[root]
+            if len(per_pid) < 2:
+                continue
+            flow_id += 1
+            chain = sorted(per_pid.values(), key=lambda e: e["ts"])
+            for i, anchor in enumerate(chain):
+                ph = "s" if i == 0 else ("f" if i == len(chain) - 1 else "t")
+                ev = {
+                    "name": "request",
+                    "cat": "request",
+                    "ph": ph,
+                    "id": flow_id,
+                    "pid": anchor["pid"],
+                    "tid": anchor["tid"],
+                    # nudged inside the anchor slice so Perfetto binds
+                    # the flow to it rather than the slice boundary
+                    "ts": round(anchor["ts"] + 0.001, 3),
+                    "args": {"trace_id": root},
+                }
+                if ph == "f":
+                    ev["bp"] = "e"
+                flows.append(ev)
+        return flows
+
+    def merged_tracez(self) -> Dict[str, Any]:
+        """Fetch every target's /tracez live and merge the kept request
+        traces BY TRACE ID across processes: the router's spans and the
+        replica's spans for one request (same router-minted trace id)
+        land in one merged trace, each span stamped with the process it
+        came from and clock-shifted onto the collector timeline exactly
+        like merged_chrome_trace. Per-series exemplars merge worst-first
+        across the fleet — the metric→trace index `slo_exemplars` (and
+        /fleetz) serves."""
+        targets = sorted(
+            self._targets_fn(),
+            key=lambda x: (x.role, x.namespace, x.owner, x.instance),
+        )
+
+        def _grab(t: ScrapeTarget):
+            try:
+                doc = json.loads(self._fetch(t.base_url + "/tracez"))
+            except Exception:  # noqa: BLE001 - partial fleets still export
+                return None
+            return doc, self._clock() * 1e6
+
+        grabbed: List[Any] = []
+        if targets:
+            with ThreadPoolExecutor(
+                max_workers=min(8, len(targets))
+            ) as pool:
+                grabbed = list(pool.map(_grab, targets))
+        merged: Dict[str, Dict[str, Any]] = {}
+        exemplars: Dict[str, List[Dict[str, Any]]] = {}
+        for t, got in zip(targets, grabbed):
+            if got is None:
+                continue
+            doc, ref_us = got
+            capture = doc.get("captureUs")
+            offset_s = (
+                (ref_us - float(capture)) / 1e6 if capture is not None
+                else 0.0
+            )
+            for trace in doc.get("traces", []):
+                root = self._trace_root(str(trace.get("trace_id", "")))
+                if not root:
+                    continue
+                tgt = merged.setdefault(
+                    root,
+                    {
+                        "trace_id": root,
+                        "processes": [],
+                        "error": False,
+                        "keep_reasons": [],
+                        "dur_s": 0.0,
+                        "spans": [],
+                    },
+                )
+                if t.instance not in tgt["processes"]:
+                    tgt["processes"].append(t.instance)
+                tgt["error"] = tgt["error"] or bool(trace.get("error"))
+                reason = trace.get("keep_reason")
+                if reason and reason not in tgt["keep_reasons"]:
+                    tgt["keep_reasons"].append(reason)
+                if trace.get("dur_s"):
+                    tgt["dur_s"] = max(
+                        tgt["dur_s"], float(trace["dur_s"])
+                    )
+                for span in trace.get("spans", []):
+                    span = dict(span)
+                    span["instance"] = t.instance
+                    span["t_start"] = (
+                        float(span.get("t_start", 0.0)) + offset_s
+                    )
+                    tgt["spans"].append(span)
+            self._merge_exemplar_doc(exemplars, doc, t.instance)
+        for tgt in merged.values():
+            tgt["spans"].sort(key=lambda s: s["t_start"])
+        return {
+            "traces": merged,
+            "exemplars": self._top_exemplars(exemplars),
+        }
+
+    @staticmethod
+    def _merge_exemplar_doc(
+        into: Dict[str, List[Dict[str, Any]]],
+        doc: Dict[str, Any],
+        instance: str,
+    ) -> None:
+        for series, obs in (doc.get("exemplars") or {}).items():
+            into.setdefault(series, []).extend(
+                {**o, "instance": instance} for o in obs
+            )
+
+    @staticmethod
+    def _top_exemplars(
+        ex: Dict[str, List[Dict[str, Any]]]
+    ) -> Dict[str, List[Dict[str, Any]]]:
+        for obs in ex.values():
+            obs.sort(key=lambda o: -float(o.get("value", 0.0)))
+            del obs[_EXEMPLAR_TOP_K:]
+        return ex
+
+    def fleet_exemplars(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Per-series worst offenders fleet-wide, via the EXEMPLARS-ONLY
+        /tracez shape (`?exemplars_only=1`): a few KB per target instead
+        of every kept trace's span list — the cheap lookup /fleetz
+        renders with, leaving the full-trace merge to merged_tracez()
+        (/debug/fleet-tracez)."""
+        targets = sorted(
+            self._targets_fn(),
+            key=lambda x: (x.role, x.namespace, x.owner, x.instance),
+        )
+
+        def _grab(t: ScrapeTarget):
+            try:
+                return json.loads(
+                    self._fetch(t.base_url + "/tracez?exemplars_only=1")
+                )
+            except Exception:  # noqa: BLE001 - best effort
+                return None
+
+        grabbed: List[Any] = []
+        if targets:
+            with ThreadPoolExecutor(
+                max_workers=min(8, len(targets))
+            ) as pool:
+                grabbed = list(pool.map(_grab, targets))
+        exemplars: Dict[str, List[Dict[str, Any]]] = {}
+        for t, doc in zip(targets, grabbed):
+            if doc is not None:
+                self._merge_exemplar_doc(exemplars, doc, t.instance)
+        return self._top_exemplars(exemplars)
+
+    def slo_exemplars(self) -> Dict[str, List[Dict[str, Any]]]:
+        """SLO rule name → the fleet's worst-offender exemplars for the
+        rule's left-hand metric (merged live off every target's
+        exemplars-only /tracez). The link from 'burn rate is high' to
+        'here are the exact traces that burned it' — rendered on
+        /fleetz next to each SLO row."""
+        merged = self.fleet_exemplars()
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        for status in self.slo_statuses():
+            obs = merged.get(status.rule.lhs.metric)
+            if obs:
+                out[status.rule.name] = obs
+        return out
 
     # -- /fleetz rendering -------------------------------------------------
 
@@ -862,6 +1072,13 @@ class FleetCollector:
             lines.append("  <none>")
         lines.append("")
         lines.append("[slo]")
+        # metric→trace exemplars: the fleet's worst offenders for each
+        # rule's metric, pulled live off every target's /tracez (best
+        # effort — an unreachable fleet still renders the SLO table)
+        try:
+            slo_exemplars = self.slo_exemplars()
+        except Exception:  # noqa: BLE001 - fleetz must render
+            slo_exemplars = {}
         for status in statuses:
             r = status.rule
             cur = "n/a" if status.value is None else f"{status.value:.4g}"
@@ -873,6 +1090,12 @@ class FleetCollector:
                 f"  {r.name:<32}{r.raw:<44}current={cur:<12}"
                 f"{verdict:<8}burn={status.burn_rate:.2f}"
             )
+            for ex in slo_exemplars.get(r.name, [])[:3]:
+                lines.append(
+                    f"    worst: trace {ex.get('trace_id', '?')} "
+                    f"({float(ex.get('value', 0.0)):.4g}s "
+                    f"on {ex.get('instance', '?')})"
+                )
         if not statuses:
             lines.append("  <none>")
         lines.append("")

@@ -16,6 +16,12 @@ server (serving/server.py) and the training runtime's debug server
   side).
 - GET /metrics       — the existing registry's Prometheus exposition text
   (utils/metrics.py renderer; the derived MFU/phase metrics ride it).
+- GET /tracez        — the tail sampler's kept COMPLETED request traces
+  (error traces, >p99-latency traces, and a `sample_prob` share of the
+  rest) plus the per-series worst-offender exemplars, as JSON. The
+  fleet collector pulls this from every process and merges spans by
+  trace id (observability/fleet.py merged_tracez). `?trace_id=<id>`
+  narrows to one request (exact id or its `<id>/<row>` children).
 """
 
 from __future__ import annotations
@@ -84,7 +90,9 @@ def add_debug_routes(
             (
                 f"[kft-trace] enabled={st['enabled']} "
                 f"buffered={st['buffered']}/{st['capacity']} "
-                f"dropped={st['dropped']}"
+                f"dropped={st['dropped']} "
+                f"sample_prob={st['sample_prob']:g} "
+                f"tracez={st['completed_traces']}/{st['sample_keep']}"
             ),
         ]
         for title, fn in sections:
@@ -101,6 +109,24 @@ def add_debug_routes(
         return Response(
             default_registry().render(), "text/plain; charset=utf-8"
         )
+
+    @app.get("/tracez")
+    def tracez(req):
+        # ?exemplars_only=1: the fleet's per-SLO worst-offender lookup —
+        # skip serializing every kept trace's span list
+        exemplars_only = req.query.get("exemplars_only") not in (
+            None, "", "0"
+        )
+        doc = tr.tracez(include_traces=not exemplars_only)
+        trace_id = req.query.get("trace_id")
+        if trace_id and "traces" in doc:
+            child_prefix = trace_id + "/"
+            doc["traces"] = [
+                t for t in doc["traces"]
+                if t["trace_id"] == trace_id
+                or str(t["trace_id"]).startswith(child_prefix)
+            ]
+        return Response(json.dumps(doc), "application/json")
 
     return app
 
@@ -131,8 +157,12 @@ def add_fleet_routes(app: App, collector) -> App:
       rates, and the gang straggler table.
     - GET /debug/fleet-trace — every target's trace ring stitched onto
       one timeline (per-host Perfetto process tracks, scrape-time
-      clock-offset estimation); save the body and load it in Perfetto
-      exactly like /debug/trace.
+      clock-offset estimation, cross-process request FLOW events binding
+      one trace id's spans across tracks); save the body and load it in
+      Perfetto exactly like /debug/trace.
+    - GET /debug/fleet-tracez — every target's /tracez merged by trace
+      id: one request's router + replica spans in one JSON trace, plus
+      the fleet-merged worst-offender exemplars per latency series.
     """
 
     @app.get("/fleetz")
@@ -149,6 +179,13 @@ def add_fleet_routes(app: App, collector) -> App:
     def fleet_trace(req):
         return Response(
             json.dumps(collector.merged_chrome_trace()),
+            "application/json",
+        )
+
+    @app.get("/debug/fleet-tracez")
+    def fleet_tracez(req):
+        return Response(
+            json.dumps(collector.merged_tracez()),
             "application/json",
         )
 

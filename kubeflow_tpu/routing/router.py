@@ -47,14 +47,22 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.analysis.serving_plans import DEFAULT_PAGE_SIZE
 from kubeflow_tpu.api.wsgi import App, BadRequest, HttpError
-from kubeflow_tpu.observability.trace import default_tracer
+from kubeflow_tpu.observability.trace import (
+    TRACEPARENT_HEADER,
+    default_tracer,
+    format_traceparent,
+    mint_trace_id,
+    parse_traceparent,
+)
 from kubeflow_tpu.routing.affinity import first_page_key, rendezvous_rank
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import (
     router_affinity_hits_counter,
+    router_request_seconds_histogram,
     router_requests_counter,
     router_retries_counter,
     router_spills_counter,
+    router_trace_minted_counter,
 )
 
 log = get_logger(__name__)
@@ -65,6 +73,10 @@ log = get_logger(__name__)
 DEFAULT_SPILL_QUEUE_PER_SLOT = 2.0
 DEFAULT_RETRY_BUDGET = 2
 DEFAULT_PROBE_INTERVAL_S = 5.0
+# Retry-After ceiling: a replica can ask for a long backoff but never an
+# unbounded one — 'Retry-After: inf' (or a far-future HTTP-date) from a
+# buggy replica must not demote it until process restart
+RETRY_AFTER_CAP_S = 3600.0
 # upstream request bound: a hung replica must surface as the router's
 # 503/retry path, not a stuck client socket (mirrors the model server's
 # ENGINE_WAIT_S generosity)
@@ -193,11 +205,41 @@ def default_transport(
 
 
 def _parse_retry_after(headers: Dict[str, str], default_s: float = 1.0) -> float:
+    """Seconds to back a replica off, from its Retry-After header.
+
+    RFC 9110 allows BOTH forms: delta-seconds ("3") and an HTTP-date
+    ("Wed, 21 Oct 2015 07:28:00 GMT" — also the obsolete RFC 850 and
+    asctime shapes, which parsedate handles). Anything else — garbage,
+    a negative delta, non-finite values ('inf'/'nan', which float()
+    happily parses), a date already in the past — clamps to the
+    DEFAULT, never to a zero-length window (a demotion the drain
+    contract asked for must not evaporate on a malformed header);
+    finite-but-huge values cap at RETRY_AFTER_CAP_S — never an
+    unbounded demotion."""
     raw = (headers or {}).get("retry-after", "").strip()
-    try:
-        return max(0.0, float(raw)) if raw else default_s
-    except ValueError:
+    if not raw:
         return default_s
+    try:
+        delta = float(raw)
+    except ValueError:
+        from email.utils import parsedate_to_datetime
+
+        try:
+            when = parsedate_to_datetime(raw)
+        except (TypeError, ValueError):
+            return default_s
+        if when is None:
+            return default_s
+        import datetime
+
+        if when.tzinfo is None:
+            when = when.replace(tzinfo=datetime.timezone.utc)
+        delta = (
+            when - datetime.datetime.now(datetime.timezone.utc)
+        ).total_seconds()
+    if not math.isfinite(delta) or delta <= 0.0:
+        return default_s
+    return min(delta, RETRY_AFTER_CAP_S)
 
 
 class FleetRouter:
@@ -284,6 +326,8 @@ class FleetRouter:
         self._affinity_hits = router_affinity_hits_counter()
         self._spills = router_spills_counter()
         self._retries = router_retries_counter()
+        self._request_seconds = router_request_seconds_histogram()
+        self._trace_minted = router_trace_minted_counter()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.app = self._build()
@@ -582,10 +626,70 @@ class FleetRouter:
             req.response_headers.append(("Retry-After", "1"))
             raise HttpError(429, "router is draining for shutdown")
         try:
-            return self._forward_admitted(req, method, path, key)
+            return self._forward_traced(req, method, path, key)
         finally:
             with self._lock:
                 self._proxying -= 1
+
+    def _forward_traced(
+        self,
+        req,
+        method: str,
+        path: str,
+        key: Optional[str],
+    ) -> Tuple[Any, int]:
+        """Distributed-tracing envelope around the attempt loop: continue
+        a client-sent W3C `traceparent` (or mint one), run the loop under
+        that thread-local trace context so every router span — and, via
+        the forwarded header, every replica span — carries ONE trace id,
+        then feed the outcome to the tail sampler (`finish_trace`: 5xx/
+        exhaustion outcomes are error-kept) and the latency series +
+        worst-offender exemplars. With tracing disabled the entire
+        envelope is the latency observation plus one bool check."""
+        tracer = self._tracer
+        trace_id: Optional[str] = None
+        parent_span_id: Optional[str] = None
+        if tracer.enabled:
+            inbound = parse_traceparent(
+                req.headers.get(TRACEPARENT_HEADER)
+            )
+            if inbound is not None:
+                trace_id, parent_span_id = inbound
+            else:
+                trace_id = mint_trace_id()
+                self._trace_minted.inc()
+            # the id clients (and operators) query /tracez and
+            # /debug/trace with — echoed whether minted or continued
+            req.response_headers.append(("X-Trace-Id", trace_id))
+        t0 = time.monotonic()
+        error = False
+        try:
+            with tracer.trace_context(trace_id, parent_span_id):
+                with tracer.span(
+                    "router.request",
+                    path=path,
+                    affinity=key is not None,
+                ):
+                    return self._forward_admitted(
+                        req, method, path, key, trace_id
+                    )
+        except HttpError as e:
+            # a replica's own 4xx verdict is the CLIENT's problem; 5xx
+            # and retry-budget exhaustion are fleet failures worth a
+            # kept error trace
+            error = e.status >= 500
+            raise
+        except Exception:
+            error = True
+            raise
+        finally:
+            dur = time.monotonic() - t0
+            self._request_seconds.observe(dur)
+            if trace_id is not None:
+                tracer.observe_exemplar(
+                    "router_request_seconds", dur, trace_id
+                )
+                tracer.finish_trace(trace_id, error=error, dur_s=dur)
 
     def _forward_admitted(
         self,
@@ -593,8 +697,16 @@ class FleetRouter:
         method: str,
         path: str,
         key: Optional[str],
+        trace_id: Optional[str] = None,
     ) -> Tuple[Any, int]:
-        order, spilled = self._order_for(key)
+        with self._tracer.span("router.order", affinity=key is not None):
+            order, spilled = self._order_for(key)
+        if spilled and len(order) > 1:
+            # the spill decision, queryable per request: who was hot,
+            # where the request went instead
+            self._tracer.event(
+                "router.spill", home=order[1].id, spilled_to=order[0].id
+            )
         if not order:
             self._requests.inc(outcome="rejected")
             raise HttpError(503, "no replicas registered")
@@ -603,9 +715,9 @@ class FleetRouter:
         if req.body is not None:
             payload = json.dumps(req.body).encode()
             headers["Content-Type"] = "application/json"
-        trace_id = req.headers.get("x-request-id")
-        if trace_id:
-            headers["X-Request-Id"] = trace_id
+        request_id = req.headers.get("x-request-id")
+        if request_id:
+            headers["X-Request-Id"] = request_id
         attempts = 0
         retry_after_hint: Optional[float] = None
         last_err = "no replica available"
@@ -620,14 +732,23 @@ class FleetRouter:
             with self._lock:
                 self._inflight[rep.id] = self._inflight.get(rep.id, 0) + 1
             try:
-                with self._tracer.span(
+                route_span = self._tracer.span(
                     "request.route",
-                    trace_id=trace_id,
                     replica=rep.id,
                     attempt=attempts,
                     affinity=on_affinity_target,
                     spilled=spilled and idx == 0,
-                ):
+                )
+                with route_span:
+                    # propagate: THIS attempt's span is the remote
+                    # parent of every span the replica records for the
+                    # request (trace_id is None = tracing off: the
+                    # header is simply not sent)
+                    span_id = getattr(route_span, "span_id", None)
+                    if trace_id is not None and span_id is not None:
+                        headers["Traceparent"] = format_traceparent(
+                            trace_id, span_id
+                        )
                     try:
                         status, data, hdrs = self._transport(
                             method, rep.base_url + path, payload, headers
@@ -648,6 +769,9 @@ class FleetRouter:
                 # No Retry-After header = queue-full, not draining —
                 # same backoff, no phantom drain flag.
                 ra = _parse_retry_after(hdrs)
+                self._tracer.event(
+                    "router.backoff", replica=rep.id, retry_after_s=ra
+                )
                 self._note_draining(
                     rep.id, ra, draining="retry-after" in hdrs
                 )

@@ -1197,10 +1197,11 @@ class _Request:
     __slots__ = (
         "prompt", "max_new", "temperature", "top_k", "top_p", "eos_id",
         "seed", "t_submit", "future", "trace_id", "queue_span",
+        "parent_span_id",
     )
 
     def __init__(self, prompt, max_new, temperature, top_k, top_p, eos_id,
-                 seed, trace_id=None):
+                 seed, trace_id=None, parent_span_id=None):
         self.prompt = prompt  # np.int32 [P], real tokens only
         self.max_new = max_new
         self.temperature = temperature
@@ -1211,9 +1212,14 @@ class _Request:
         self.t_submit = time.monotonic()
         # completes with {"tokens": [...], "ttft_s": float}
         self.future = Completion()
-        # request-scoped trace id (X-Request-Id on the REST path): every
-        # span kft-trace records for this request carries it
+        # request-scoped trace id (X-Request-Id on the REST path, or the
+        # router-minted traceparent trace id): every span kft-trace
+        # records for this request carries it
         self.trace_id = trace_id
+        # the REMOTE parent span (the router's forward-attempt span,
+        # captured from the submitter thread's trace context): carried on
+        # the scheduler-thread spans too, which never see that context
+        self.parent_span_id = parent_span_id
         self.queue_span = None  # started at enqueue, ended at admission
 
 
@@ -1565,7 +1571,8 @@ class DecodeEngine:
         if trace_id is None and self._tracer.enabled:
             trace_id = self._tracer.new_trace_id("req")
         return _Request(prompt, n, temperature, top_k, top_p, eos_id,
-                        int(seed), trace_id=trace_id)
+                        int(seed), trace_id=trace_id,
+                        parent_span_id=self._tracer.current_parent_span_id())
 
     def _enqueue(self, reqs: List[_Request]) -> None:
         with self._cv:
@@ -1590,6 +1597,7 @@ class DecodeEngine:
                 # ends when the scheduler pops the request for admission
                 req.queue_span = self._tracer.start_span(
                     "request.queue_wait", trace_id=req.trace_id,
+                    parent_span_id=req.parent_span_id,
                     model=self.name, prompt_len=int(req.prompt.size),
                 )
             self._queue.extend(reqs)
@@ -1967,7 +1975,8 @@ class DecodeEngine:
         p = int(prompt.size)
         ps = self.page_size
         prefill_span = self._tracer.start_span(
-            "request.prefill", trace_id=req.trace_id, model=self.name,
+            "request.prefill", trace_id=req.trace_id,
+            parent_span_id=req.parent_span_id, model=self.name,
             slot=slot_idx, prompt_len=p,
         )
         self._slot_reserve[slot_idx] = self._reserve_pages(p, req.max_new)
@@ -2141,7 +2150,8 @@ class DecodeEngine:
         # the request's remaining life is the decode phase (cross-
         # iteration: ended by _finish, possibly many steps later)
         slot.decode_span = self._tracer.start_span(
-            "request.decode", trace_id=req.trace_id, model=self.name,
+            "request.decode", trace_id=req.trace_id,
+            parent_span_id=req.parent_span_id, model=self.name,
             slot=slot_idx,
         )
         self._ttft.observe(slot.ttft_s, model=self.name)
@@ -2193,7 +2203,8 @@ class DecodeEngine:
             slot.decode_span.end(tokens=len(slot.tokens))
             slot.decode_span = None
         self._tracer.event(
-            "request.retire", trace_id=slot.req.trace_id, model=self.name,
+            "request.retire", trace_id=slot.req.trace_id,
+            parent_span_id=slot.req.parent_span_id, model=self.name,
             slot=slot_idx, tokens=len(slot.tokens),
         )
         with self._stats_lock:

@@ -155,7 +155,9 @@ def build_server(
     if statusz_enabled is None:
         statusz_enabled = obs["statusz_enabled"]
     default_tracer().configure(
-        enabled=trace_enabled, capacity=trace_buffer_spans
+        enabled=trace_enabled, capacity=trace_buffer_spans,
+        sample_prob=obs["trace_sample_prob"],
+        sample_keep=obs["trace_sample_keep"],
     )
 
     server = ModelServer(statusz_enabled=statusz_enabled)

@@ -501,27 +501,48 @@ class ModelServer:
         else:
             mask = np.ones_like(x, dtype=bool)
         eos_id = body.get("eos_id")
-        # per-request trace id: the client's X-Request-Id header when
-        # present (wsgi lowercases header names), else a generated one —
-        # every engine span for this request carries it, and the response
-        # echoes it so clients can correlate a /debug/trace dump
-        trace_id = req.headers.get("x-request-id") or None
-        if trace_id is None:
-            from kubeflow_tpu.observability.trace import default_tracer
+        # per-request trace id, in preference order: the trace-id half of
+        # a W3C-style `traceparent` (the kft-router mints one per routed
+        # request — its span-id half names the router attempt span as the
+        # REMOTE PARENT of every engine span recorded here, so one
+        # request is ONE trace id across the router hop and this
+        # replica), else the client's X-Request-Id header (wsgi
+        # lowercases header names), else a generated id. The response
+        # echoes the id so clients can correlate a /debug/trace or
+        # /tracez dump.
+        from kubeflow_tpu.observability.trace import (
+            default_tracer,
+            parse_traceparent,
+        )
 
-            trace_id = default_tracer().new_trace_id("req")
+        tracer = default_tracer()
+        remote_parent = None
+        trace_id = None
+        if tracer.enabled:
+            inbound = parse_traceparent(req.headers.get("traceparent"))
+            if inbound is not None:
+                trace_id, remote_parent = inbound
+        if trace_id is None:
+            trace_id = req.headers.get("x-request-id") or None
+        if trace_id is None:
+            trace_id = tracer.new_trace_id("req")
         req.response_headers.append(("X-Request-Id", trace_id))
         try:
-            futures = engine.submit_batch(
-                [x[i][mask[i]] for i in range(x.shape[0])],
-                n,
-                temperature=body.get("temperature", 0.0),
-                top_k=body.get("top_k", 0),
-                top_p=body.get("top_p", 1.0),
-                eos_id=eos_id,
-                seed=body.get("seed", 0),
-                trace_id=trace_id,
-            )
+            # thread-local trace context: the queue spans submit_batch
+            # opens on THIS handler thread inherit the remote parent;
+            # restored on exit so a reused connection thread never
+            # leaks this request's context into the next
+            with tracer.trace_context(trace_id, remote_parent):
+                futures = engine.submit_batch(
+                    [x[i][mask[i]] for i in range(x.shape[0])],
+                    n,
+                    temperature=body.get("temperature", 0.0),
+                    top_k=body.get("top_k", 0),
+                    top_p=body.get("top_p", 1.0),
+                    eos_id=eos_id,
+                    seed=body.get("seed", 0),
+                    trace_id=trace_id,
+                )
         except EngineDrainingError as e:
             # draining shutdown: same 429 wire status as queue-full, plus
             # Retry-After so well-behaved clients back off — through the
@@ -540,10 +561,25 @@ class ModelServer:
             raise BadRequest(f"bad generate request: {e}")
         # one deadline for the whole request: sequential per-row waits
         # against a hung engine would hold the socket rows × ENGINE_WAIT_S
-        deadline = time.monotonic() + self.ENGINE_WAIT_S
-        results = [
-            f.wait(max(0.0, deadline - time.monotonic())) for f in futures
-        ]
+        t_admit = time.monotonic()
+        deadline = t_admit + self.ENGINE_WAIT_S
+        error = False
+        try:
+            results = [
+                f.wait(max(0.0, deadline - time.monotonic()))
+                for f in futures
+            ]
+        except BaseException:
+            # a failed/hung engine row (device failure, recovery fail-
+            # fast, deadline): the request 500s — exactly the trace the
+            # tail sampler must ALWAYS keep
+            error = True
+            raise
+        finally:
+            tracer.finish_trace(
+                trace_id, error=error,
+                dur_s=time.monotonic() - t_admit,
+            )
         sequences = []
         for i, r in enumerate(results):
             toks = r["tokens"]
@@ -555,6 +591,11 @@ class ModelServer:
             sequences.append(x[i].tolist() + toks)
         ttft = max(r["ttft_s"] for r in results)
         req.response_headers.append(("X-TTFT-Ms", f"{ttft * 1e3:.2f}"))
+        # metric→trace exemplar: the TTFT series' worst recent offenders
+        # stay linkable to their traces (/tracez; docs/OBSERVABILITY.md)
+        tracer.observe_exemplar(
+            "serving_time_to_first_token_seconds", ttft, trace_id
+        )
         return {"sequences": sequences}
 
     def _build(self) -> App:

@@ -927,6 +927,64 @@ def router_retries_counter() -> Counter:
     )
 
 
+# ---------------------------------------------------------------------------
+# Distributed-tracing series (kubeflow_tpu/observability/trace.py tail
+# sampling + kubeflow_tpu/routing/ traceparent propagation; docs/
+# OBSERVABILITY.md "Distributed request tracing"). One definition point:
+# the tracer's finish_trace and the router both hit the same series.
+# ---------------------------------------------------------------------------
+
+# router request wall time spans one proxied hop (ms) to a retried,
+# backoff-laden request under drain churn (seconds) — the same envelope
+# as TTFT, reused so fleet SLO rules can quantile either
+ROUTER_REQUEST_BUCKETS = SERVING_TTFT_BUCKETS
+
+
+def router_request_seconds_histogram() -> Histogram:
+    """Wall seconds per routed request through the fleet router (the
+    whole attempt loop: ordering, every forward attempt, backoff between
+    retries). The router-side latency series whose worst offenders carry
+    trace-id exemplars on /tracez — `router_request_seconds_p99 < ...`
+    is the natural fleet SLO rule for the front door."""
+    return default_registry().histogram(
+        "router_request_seconds",
+        "wall seconds per request through the fleet router",
+        buckets=ROUTER_REQUEST_BUCKETS,
+    )
+
+
+def router_trace_minted_counter() -> Counter:
+    """Routed requests for which the router MINTED a fresh traceparent
+    (no valid inbound one): total router requests minus this is how much
+    client traffic already arrives traced — the rollout signal for
+    upstream propagation."""
+    return default_registry().counter(
+        "router_trace_minted_total",
+        "requests the router minted a new traceparent for",
+    )
+
+
+def trace_kept_counter() -> Counter:
+    """Completed request traces the tail sampler KEPT, by reason:
+    "error" (failed request — always kept), "tail" (slower than the
+    rolling p99 — always kept), "sampled" (survived the probabilistic
+    keep). Served by /tracez (observability/trace.py finish_trace)."""
+    return default_registry().counter(
+        "kft_trace_kept_total",
+        "request traces kept by the tail sampler",
+        ["reason"],
+    )
+
+
+def trace_sampled_out_counter() -> Counter:
+    """Completed request traces the tail sampler dropped (fast, healthy
+    and unlucky against sample_prob)."""
+    return default_registry().counter(
+        "kft_trace_sampled_out_total",
+        "request traces dropped by the tail sampler",
+    )
+
+
 def fleet_slo_compliant_gauge(registry: Optional[MetricsRegistry] = None) -> Gauge:
     """1 while the SLO rule's current fleet-level value satisfies its
     threshold, 0 while breached (kubeflow_tpu/observability/slo.py)."""

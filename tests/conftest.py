@@ -73,6 +73,9 @@ _COMPILE_CACHE_MODULES = frozenset({
     # engine-program family only (the gpt_and_params engines test_engine
     # already soaks) — the router core itself never touches jax
     "test_routing",
+    # same engine-program family (the r15 propagation fleet rides the
+    # session gpt_and_params engines at test_observability's geometry)
+    "test_tracing",
 })
 
 # One persistent dir shared with bench.py's battery cache: the workspace

@@ -81,9 +81,18 @@ class TestGreedyParity:
 
 
 class TestSlotScheduling:
+    @pytest.mark.slow
     def test_mixed_max_new_tokens_retire_and_refill(self, gpt_and_params):
         """Slots retire at different steps (mixed lengths) and refill from
-        the FIFO queue; every request completes with its own length."""
+        the FIFO queue; every request completes with its own length.
+
+        @slow (r15 tier-1 tranche, 13s: 5 requests, 24 emitted tokens):
+        runs unfiltered in the serving CI workflow's engine step; tier-1
+        keeps the mixed-length retire+refill contract via
+        TestGreedyParity::test_ragged_prompts_staggered_admission_bitwise
+        (4 requests with n_new 6/7/5/8 through 2 slots — at least one
+        retire+refill by construction, bitwise-checked) and early retire
+        via test_eos_stops_slot_and_matches_scan_prefix."""
         model, params = gpt_and_params
         eng = DecodeEngine("g", model, params, num_slots=2, max_queue=16)
         try:
@@ -594,10 +603,19 @@ class TestMetricsSurface:
         assert reg.get("serving_tokens_total").value(model="gm") == 3
         assert reg.get("serving_queue_depth").value(model="gm") == 0
 
+    @pytest.mark.slow
     def test_concurrent_submitters_race_free(self, gpt_and_params):
         """8 threads submitting through 2 slots: everything completes and
         every greedy result still matches the oracle (the engine's
-        queue/slot locking under real contention)."""
+        queue/slot locking under real contention).
+
+        @slow (r15 tier-1 tranche, 17s: 8 requests through the full
+        decode loop): runs unfiltered in the serving CI workflow's
+        engine step; tier-1 keeps admission atomicity under contention
+        (TestServerIntegration::test_batch_admission_is_atomic) and the
+        same queue→slot reuse correctness single-threaded
+        (TestGreedyParity::test_ragged_prompts_staggered_admission_
+        bitwise — 4 requests racing 2 slots from the scheduler side)."""
         model, params = gpt_and_params
         eng = DecodeEngine("g", model, params, num_slots=2, max_queue=32)
         rows = _rows(3, 4, 5, 6, 7, 3, 4, 5)

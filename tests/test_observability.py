@@ -423,22 +423,33 @@ class TestTrainerObservability:
 
 class TestKnobFlow:
     def test_knobs_from_env_defaults_and_parsing(self):
+        from kubeflow_tpu.observability.trace import (
+            ENV_TRACE_SAMPLE_KEEP,
+            ENV_TRACE_SAMPLE_PROB,
+        )
+
         assert knobs_from_env({}) == {
             "trace_enabled": True,
             "trace_buffer_spans": 4096,
             "statusz_enabled": True,
+            "trace_sample_prob": 1.0,
+            "trace_sample_keep": 128,
         }
         knobs = knobs_from_env(
             {
                 ENV_TRACE_ENABLED: "0",
                 ENV_TRACE_BUFFER_SPANS: "128",
                 ENV_TRACE_STATUSZ: "0",
+                ENV_TRACE_SAMPLE_PROB: "0.25",
+                ENV_TRACE_SAMPLE_KEEP: "32",
             }
         )
         assert knobs == {
             "trace_enabled": False,
             "trace_buffer_spans": 128,
             "statusz_enabled": False,
+            "trace_sample_prob": 0.25,
+            "trace_sample_keep": 32,
         }
 
     def test_configure_from_env_applies_to_default_tracer(self):

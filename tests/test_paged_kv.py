@@ -311,12 +311,22 @@ class TestPoolExhaustion:
 
 
 class TestSpeculativeRewind:
+    @pytest.mark.slow
     def test_rewind_returns_pages_under_k_gt_0(self, gpt_and_params):
         """A hostile draft (rolled head: acceptance provably 0) makes
         every verify window claim its K-token overhang and reject it:
         the host-side rewind must hand those pages back (the pool's
         free count recovers every iteration), and the stream stays
-        bitwise the oracle's."""
+        bitwise the oracle's.
+
+        @slow (r15 tier-1 tranche, 12s: a distinct (K=2, ps=8) program
+        family): runs unfiltered in the serving CI workflow's
+        paged-kv-parity step; tier-1 keeps the max-rewind bitwise
+        contract (test_spec_decode.py TestAcceptanceBookkeeping::
+        test_hostile_draft_accepts_nothing — the same rolled-head
+        zero-accept draft) and pool-accounting-returns-to-free via
+        TestPoolExhaustion::test_pool_pressure_queues_then_429s_cleanly
+        (pages_in_use back to 0 after load)."""
         model, params = gpt_and_params
         dparams = jax.device_get(params)
         dparams["head"]["kernel"] = np.roll(

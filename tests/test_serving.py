@@ -240,6 +240,10 @@ class TestInferenceServiceController:
             "KFT_TRACE_ENABLED": "1",
             "KFT_TRACE_BUFFER_SPANS": "4096",
             "KFT_TRACE_STATUSZ": "1",
+            # distributed-tracing tail sampling (keep-all by default;
+            # tests/test_tracing.py pins the knob flow)
+            "KFT_TRACE_SAMPLE_PROB": "1",
+            "KFT_TRACE_SAMPLE_KEEP": "128",
             # kft-fleet contract: the fleet collector scrapes every
             # replica's /metrics on the serving port
             # (observability/fleet.py; tests/test_fleet.py)

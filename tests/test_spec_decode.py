@@ -63,13 +63,23 @@ def _drafted_engine(model, params, draft_params, k=3, **kw):
 
 
 class TestGreedyParity:
+    @pytest.mark.slow
     def test_bitwise_vs_generate_and_k0_engine_ragged_staggered(
         self, gpt_and_params, wrong_draft_params
     ):
         """4 ragged requests through 2 slots (staggered admission by
         construction) — drafted engines at acceptance-1.0 AND
         acceptance-0 must both emit bitwise the K=0 engine's stream,
-        which is bitwise the fused scan's."""
+        which is bitwise the fused scan's.
+
+        @slow (r15 tier-1 tranche, 23s: compiles THREE engines' program
+        families): runs unfiltered in the serving CI workflow's
+        spec-decode-parity step; tier-1 keeps the staggered-ragged
+        contract on the K=0 engine (test_engine.py TestGreedyParity::
+        test_ragged_prompts_staggered_admission_bitwise) and the drafted
+        acceptance-1.0/acceptance-0 bitwise parity single-slot
+        (TestAcceptanceBookkeeping::test_identical_draft_accepts_
+        everything / test_hostile_draft_accepts_nothing)."""
         model, params = gpt_and_params
         rows = _rows(4, 6, 7, 3)
         n_new = [6, 7, 5, 8]
@@ -190,7 +200,15 @@ class TestAcceptanceBookkeeping:
         # 5 post-prefill tokens, one per verify iteration
         assert st["verify_steps"] == 5
 
+    @pytest.mark.slow
     def test_metrics_surface(self, gpt_and_params):
+        """@slow (r15 tier-1 tranche, 7s: the distinct (K=2, slots=1)
+        pair compiles its own draft/verify family): runs unfiltered in
+        the serving CI workflow's spec-decode-parity step; tier-1 keeps
+        the same accept-bookkeeping contract on the engine's stats()
+        surface (test_identical_draft_accepts_everything pins proposed/
+        accepted/accept_rate) and the registry-counter surface for the
+        base serving series (test_engine.py TestMetricsSurface)."""
         from kubeflow_tpu.utils.metrics import default_registry
 
         model, params = gpt_and_params

@@ -1,0 +1,943 @@
+"""Distributed request tracing (r15): W3C-style traceparent propagation
+router → model server → engine, strict thread-locality of trace
+contexts, tail-based sampling + the /tracez export, the fleet
+collector's cross-process merge (one request = one flow), and the
+metric→trace exemplars that link an SLO breach to replayable traces.
+
+The load-bearing contracts:
+- a traceparent minted by the FleetRouter is continued by the replica:
+  EVERY replica span of the request carries the router-minted trace id,
+  and the replica spans' remote parent is the router's forward-attempt
+  span (verified over an in-process router→two-replica fleet);
+- greedy output through the traced path is bitwise the untraced path's;
+- tail sampling keeps error traces and >p99 traces at sample_prob=0 and
+  drops the unremarkable rest;
+- the merged Perfetto export renders one request's spans across two
+  process tracks as a single connected flow;
+- trace contexts are thread-local: concurrent requests on different
+  threads never cross-contaminate, and a reused thread never inherits a
+  previous request's context.
+
+Pure-logic tests use private Tracer instances; the fleet e2e rides the
+session-scoped gpt_and_params fixture (conftest.py) at the same engine
+geometry as test_observability so the jit cache is shared.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.observability.trace import (
+    ENV_TRACE_SAMPLE_KEEP,
+    ENV_TRACE_SAMPLE_PROB,
+    Tracer,
+    configure_from_env,
+    default_tracer,
+    format_traceparent,
+    mint_span_id,
+    mint_trace_id,
+    parse_traceparent,
+)
+
+
+@pytest.fixture(autouse=True)
+def _restore_default_tracer():
+    """Tests toggle the process tracer (enabled/sampling) — restore it,
+    and clear the rings so one test's kept traces never satisfy another
+    test's assertions."""
+    tr = default_tracer()
+    st = tr.stats()
+    yield
+    tr.configure(
+        enabled=st["enabled"],
+        capacity=st["capacity"],
+        sample_prob=st["sample_prob"],
+        sample_keep=st["sample_keep"],
+    )
+    tr.clear()
+
+
+class TestTraceparent:
+    def test_mint_format_parse_roundtrip(self):
+        tid, sid = mint_trace_id(), mint_span_id()
+        assert len(tid) == 32 and len(sid) == 16
+        hdr = format_traceparent(tid, sid)
+        assert hdr == f"00-{tid}-{sid}-01"
+        assert parse_traceparent(hdr) == (tid, sid)
+
+    def test_parse_is_case_insensitive_and_tolerant_of_whitespace(self):
+        tid, sid = mint_trace_id(), mint_span_id()
+        hdr = f"  00-{tid.upper()}-{sid.upper()}-01 "
+        assert parse_traceparent(hdr) == (tid, sid)
+
+    def test_malformed_headers_degrade_to_none(self):
+        sid = mint_span_id()
+        for bad in (
+            None,
+            "",
+            "garbage",
+            "00-short-" + sid + "-01",
+            "00-" + "g" * 32 + "-" + sid + "-01",   # non-hex
+            "00-" + "0" * 32 + "-" + sid + "-01",   # zero trace id
+            "00-" + mint_trace_id() + "-" + "0" * 16 + "-01",
+            "ff-" + mint_trace_id() + "-" + sid + "-01",  # version ff
+        ):
+            assert parse_traceparent(bad) is None, bad
+
+    def test_minted_ids_are_distinct(self):
+        assert len({mint_trace_id() for _ in range(64)}) == 64
+
+
+class TestThreadLocalContext:
+    def test_concurrent_contexts_never_cross_contaminate(self):
+        """The satellite regression: a trace id set on one handler
+        thread must be invisible to spans recorded concurrently on
+        other threads — each thread's spans carry exactly its own id."""
+        tr = Tracer(capacity=1024)
+        barrier = threading.Barrier(4)
+        errors = []
+
+        def worker(i):
+            try:
+                with tr.trace_context(f"ctx-{i}", f"{i:016x}"):
+                    barrier.wait(timeout=10)  # everyone holds a context
+                    for j in range(20):
+                        with tr.span(f"w{i}-s{j}"):
+                            assert tr.current_trace_id() == f"ctx-{i}"
+                assert tr.current_trace_id() is None
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        for r in tr.snapshot():
+            i = int(r.name[1])  # w<i>-s<j>
+            assert r.trace_id == f"ctx-{i}"
+            # the remote parent propagated per thread, never a neighbor's
+            root = r.parent_span_id if r.parent is None else None
+            if root is not None:
+                assert root == f"{i:016x}"
+
+    def test_context_restores_on_exception(self):
+        """A request handler raising must not leak its context to the
+        next request handled on the same (reused) thread."""
+        tr = Tracer(capacity=16)
+        with pytest.raises(RuntimeError):
+            with tr.trace_context("doomed"):
+                raise RuntimeError("x")
+        assert tr.current_trace_id() is None
+        assert tr.current_parent_span_id() is None
+
+    def test_nested_contexts_restore_outer(self):
+        tr = Tracer(capacity=16)
+        with tr.trace_context("outer", "aaaaaaaaaaaaaaaa"):
+            with tr.trace_context("inner", "bbbbbbbbbbbbbbbb"):
+                assert tr.current_trace_id() == "inner"
+                assert tr.current_parent_span_id() == "bbbbbbbbbbbbbbbb"
+            assert tr.current_trace_id() == "outer"
+            assert tr.current_parent_span_id() == "aaaaaaaaaaaaaaaa"
+
+    def test_set_trace_id_clears_stale_remote_parent(self):
+        tr = Tracer(capacity=16)
+        tr.set_trace_context("a", "cccccccccccccccc")
+        tr.set_trace_id("b")
+        assert tr.current_parent_span_id() is None
+        tr.set_trace_id(None)
+
+    def test_span_parent_ids_chain_locally_and_remotely(self):
+        tr = Tracer(capacity=16)
+        with tr.trace_context("t", "dddddddddddddddd"):
+            with tr.span("root"):
+                with tr.span("child"):
+                    pass
+        recs = {r.name: r for r in tr.snapshot()}
+        assert recs["root"].parent_span_id == "dddddddddddddddd"
+        assert recs["child"].parent_span_id == recs["root"].span_id
+        assert recs["child"].span_id != recs["root"].span_id
+
+
+class TestTailSampling:
+    def test_prob_one_keeps_everything_as_sampled(self):
+        tr = Tracer(capacity=64, sample_prob=1.0, sample_keep=8)
+        for i in range(5):
+            assert tr.finish_trace(f"t{i}", dur_s=0.01) == "sampled"
+        assert len(tr.completed_traces()) == 5
+
+    def test_prob_zero_drops_fast_keeps_error(self):
+        tr = Tracer(capacity=64, sample_prob=0.0, sample_keep=8)
+        with tr.span("s", trace_id="bad"):
+            pass
+        assert tr.finish_trace("ok-1", dur_s=0.01) is None
+        assert tr.finish_trace("bad", error=True, dur_s=0.01) == "error"
+        (kept,) = tr.completed_traces()
+        assert kept["trace_id"] == "bad"
+        assert kept["error"] is True
+        assert kept["keep_reason"] == "error"
+        assert [s["name"] for s in kept["spans"]] == ["s"]
+
+    def test_slower_than_p99_kept_as_tail(self):
+        tr = Tracer(capacity=64, sample_prob=0.0)
+        for i in range(30):
+            assert tr.finish_trace(f"f{i}", dur_s=0.01) is None
+        assert tr.finish_trace("slow", dur_s=1.0) == "tail"
+        # a uniform stream must NOT tail-keep everything (strict >)
+        assert tr.finish_trace("uniform", dur_s=0.01) is None
+
+    def test_tail_rule_waits_for_a_minimum_population(self):
+        tr = Tracer(capacity=64, sample_prob=0.0)
+        # first requests are trivially "the slowest so far" — not tails
+        assert tr.finish_trace("first", dur_s=9.0) is None
+
+    def test_completed_ring_is_bounded(self):
+        tr = Tracer(capacity=64, sample_prob=1.0, sample_keep=3)
+        for i in range(10):
+            tr.finish_trace(f"t{i}", dur_s=0.01)
+        kept = tr.completed_traces()
+        assert [t["trace_id"] for t in kept] == ["t7", "t8", "t9"]
+
+    def test_multi_row_children_collected_with_the_request(self):
+        tr = Tracer(capacity=64, sample_prob=1.0)
+        with tr.span("row0", trace_id="req/0"):
+            pass
+        with tr.span("row1", trace_id="req/1"):
+            pass
+        tr.finish_trace("req", dur_s=0.01)
+        (kept,) = tr.completed_traces()
+        assert {s["name"] for s in kept["spans"]} == {"row0", "row1"}
+
+    def test_disabled_tracer_finish_is_noop(self):
+        tr = Tracer(capacity=64, enabled=False, sample_prob=1.0)
+        assert tr.finish_trace("t", error=True, dur_s=9.0) is None
+        assert tr.completed_traces() == []
+
+    def test_disabled_path_is_microseconds(self):
+        """The bench gate's static half (the <2% bench_serving_router
+        criterion): with tracing disabled, the whole per-request tracing
+        envelope — finish_trace + observe_exemplar + a span + an event —
+        must cost microseconds against a multi-millisecond request (the
+        chaos layer's disarmed-seam discipline)."""
+        import time
+
+        tr = Tracer(capacity=64, enabled=False)
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tr.finish_trace("t", dur_s=0.01)
+            tr.observe_exemplar("series", 0.01, "t")
+            with tr.span("s"):
+                pass
+            tr.event("e")
+        per_call = (time.perf_counter() - t0) / (4 * n)
+        assert per_call < 2e-6, f"{per_call * 1e6:.2f}µs per disabled call"
+
+    def test_sampling_counters_move(self):
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        reg = default_registry()
+        tr = Tracer(capacity=16, sample_prob=0.0)
+        kept0 = reg.get("kft_trace_kept_total")
+        base_err = kept0.value(reason="error") if kept0 else 0.0
+        dropped0 = reg.get("kft_trace_sampled_out_total")
+        base_drop = dropped0.value() if dropped0 else 0.0
+        tr.finish_trace("e", error=True, dur_s=0.1)
+        tr.finish_trace("d", dur_s=0.1)
+        assert (
+            reg.get("kft_trace_kept_total").value(reason="error")
+            == base_err + 1
+        )
+        assert (
+            reg.get("kft_trace_sampled_out_total").value() == base_drop + 1
+        )
+
+    def test_env_knobs_apply_to_default_tracer(self):
+        configure_from_env(
+            {ENV_TRACE_SAMPLE_PROB: "0", ENV_TRACE_SAMPLE_KEEP: "7"}
+        )
+        st = default_tracer().stats()
+        assert st["sample_prob"] == 0.0
+        assert st["sample_keep"] == 7
+
+    def test_config_validates_sampling_knobs(self):
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import ObservabilityConfig
+
+        with pytest.raises(ConfigError, match="trace_sample_prob"):
+            ObservabilityConfig(trace_sample_prob=1.5).validate()
+        with pytest.raises(ConfigError, match="trace_sample_keep"):
+            ObservabilityConfig(trace_sample_keep=0).validate()
+
+    def test_inference_controller_renders_sampling_env(self):
+        from kubeflow_tpu.controllers.inference import (
+            InferenceServiceController,
+        )
+
+        ctrl = InferenceServiceController()
+        env = ctrl._serving_env({})
+        assert env["KFT_TRACE_SAMPLE_PROB"] == "1"
+        assert env["KFT_TRACE_SAMPLE_KEEP"] == "128"
+        env = ctrl._serving_env(
+            {"serving": {"observability": {"trace_sample_prob": 0.25}}}
+        )
+        assert env["KFT_TRACE_SAMPLE_PROB"] == "0.25"
+        assert env["KFT_TRACE_SAMPLE_KEEP"] == "128"
+
+
+class TestRetryAfterHardening:
+    def _parse(self, value, default_s=1.0):
+        from kubeflow_tpu.routing.router import _parse_retry_after
+
+        headers = {} if value is None else {"retry-after": value}
+        return _parse_retry_after(headers, default_s=default_s)
+
+    def test_delta_seconds(self):
+        assert self._parse("3") == 3.0
+        assert self._parse("0.5") == 0.5
+
+    def test_http_date_future(self):
+        import email.utils
+        import time
+
+        hdr = email.utils.formatdate(time.time() + 30, usegmt=True)
+        got = self._parse(hdr)
+        assert 25.0 < got <= 30.5
+
+    def test_http_date_past_clamps_to_default(self):
+        import email.utils
+        import time
+
+        hdr = email.utils.formatdate(time.time() - 30, usegmt=True)
+        assert self._parse(hdr, default_s=2.0) == 2.0
+
+    def test_garbage_negative_zero_clamp_to_default(self):
+        assert self._parse("garbage", default_s=2.0) == 2.0
+        assert self._parse("-5", default_s=2.0) == 2.0
+        assert self._parse("0", default_s=2.0) == 2.0
+        assert self._parse("Wed, 99 Foo", default_s=2.0) == 2.0
+        assert self._parse(None, default_s=2.0) == 2.0
+        assert self._parse("nan", default_s=2.0) == 2.0
+
+    def test_unbounded_values_never_demote_forever(self):
+        # float() parses 'inf' happily; a buggy replica must not be able
+        # to demote itself until process restart: non-finite = garbage
+        # (default), finite-but-huge caps at RETRY_AFTER_CAP_S
+        from kubeflow_tpu.routing.router import RETRY_AFTER_CAP_S
+
+        assert self._parse("inf", default_s=2.0) == 2.0
+        assert self._parse("1e308") == RETRY_AFTER_CAP_S
+        assert self._parse(str(RETRY_AFTER_CAP_S + 1)) == RETRY_AFTER_CAP_S
+
+
+# ---------------------------------------------------------------------------
+# Router-side propagation against a dict-driven fake fleet (no sockets,
+# no models — the test_routing FakeFleet pattern).
+# ---------------------------------------------------------------------------
+
+
+def _ok_handler(method, path, body, headers):
+    return (
+        200,
+        json.dumps({"sequences": [[1, 2, 3]]}).encode(),
+        {"x-ttft-ms": "1.00"},
+    )
+
+
+class _FakeFleet:
+    def __init__(self):
+        self.handlers = {}
+        self.seen_headers = []
+
+    def add(self, rid, handler=_ok_handler):
+        from kubeflow_tpu.routing.router import Replica
+
+        self.handlers[rid] = handler
+        return Replica(rid, f"http://{rid}")
+
+    def transport(self, method, url, body, headers):
+        rid, _, path = url[len("http://"):].partition("/")
+        self.seen_headers.append(
+            {k.lower(): v for k, v in headers.items()}
+        )
+        return self.handlers[rid](method, "/" + path, body, headers)
+
+
+def _gen_body():
+    return {"prompt_ids": [list(range(16)) + [1, 2]], "max_new_tokens": 2}
+
+
+class TestRouterPropagation:
+    def _router(self, fleet, replicas, **kw):
+        from kubeflow_tpu.routing.router import FleetRouter
+
+        kw.setdefault("page_size", 16)
+        return FleetRouter(
+            tuple(replicas), transport=fleet.transport, **kw
+        )
+
+    def test_router_mints_traceparent_and_records_spans(self):
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        tracer = default_tracer()
+        tracer.clear()
+        minted0 = default_registry().get(
+            "router_trace_minted_total"
+        )
+        base = minted0.value() if minted0 else 0.0
+        fleet = _FakeFleet()
+        router = self._router(fleet, [fleet.add("r0"), fleet.add("r1")])
+        status, body, headers = router.app.handle_full(
+            "POST", "/v1/models/m:generate", _gen_body()
+        )
+        assert status == 200, body
+        hdrs = dict(headers)
+        trace_id = hdrs.get("X-Trace-Id")
+        assert trace_id and len(trace_id) == 32
+        # the forwarded attempt carried a VALID traceparent continuing
+        # the same trace
+        (sent,) = fleet.seen_headers
+        parsed = parse_traceparent(sent["traceparent"])
+        assert parsed is not None and parsed[0] == trace_id
+        # router-side spans: the whole request, the ordering decision,
+        # the forward attempt — all under the minted trace id
+        names = {
+            r.name for r in tracer.snapshot() if r.trace_id == trace_id
+        }
+        assert {"router.request", "router.order", "request.route"} <= names
+        # the attempt span IS the advertised remote parent
+        (route_rec,) = [
+            r for r in tracer.snapshot()
+            if r.name == "request.route" and r.trace_id == trace_id
+        ]
+        assert parsed[1] == route_rec.span_id
+        assert (
+            default_registry().get("router_trace_minted_total").value()
+            == base + 1
+        )
+
+    def test_inbound_traceparent_is_continued_not_replaced(self):
+        tracer = default_tracer()
+        tracer.clear()
+        fleet = _FakeFleet()
+        router = self._router(fleet, [fleet.add("r0")])
+        tid, sid = mint_trace_id(), mint_span_id()
+        status, _, headers = router.app.handle_full(
+            "POST", "/v1/models/m:generate", _gen_body(),
+            headers={"Traceparent": format_traceparent(tid, sid)},
+        )
+        assert status == 200
+        assert dict(headers)["X-Trace-Id"] == tid
+        (sent,) = fleet.seen_headers
+        fwd_tid, fwd_sid = parse_traceparent(sent["traceparent"])
+        assert fwd_tid == tid          # same trace
+        assert fwd_sid != sid          # new parent: the router's attempt
+        # the router.request span hangs off the CLIENT's span
+        (root,) = [
+            r for r in tracer.snapshot()
+            if r.name == "router.request" and r.trace_id == tid
+        ]
+        assert root.parent_span_id == sid
+
+    def test_router_latency_series_and_exemplar_recorded(self):
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        tracer = default_tracer()
+        tracer.clear()
+        fleet = _FakeFleet()
+        router = self._router(fleet, [fleet.add("r0")])
+        hist0 = default_registry().get("router_request_seconds")
+        base = hist0.count() if hist0 else 0
+        status, _, headers = router.app.handle_full(
+            "POST", "/v1/models/m:generate", _gen_body()
+        )
+        assert status == 200
+        assert (
+            default_registry().get("router_request_seconds").count()
+            == base + 1
+        )
+        trace_id = dict(headers)["X-Trace-Id"]
+        ex = tracer.exemplars()["router_request_seconds"]
+        assert any(o["trace_id"] == trace_id for o in ex)
+
+    def test_forced_error_trace_kept_at_prob_zero(self):
+        tracer = default_tracer()
+        tracer.clear()
+        tracer.configure(sample_prob=0.0)
+
+        def fail_handler(method, path, body, headers):
+            return 500, b"{}", {}
+
+        fleet = _FakeFleet()
+        router = self._router(
+            fleet,
+            [fleet.add("r0", fail_handler), fleet.add("r1", fail_handler)],
+            retry_budget=1,
+        )
+        # a fast, healthy request first: sampled out at prob 0
+        fleet.handlers["r0"] = _ok_handler
+        fleet.handlers["r1"] = _ok_handler
+        status, _, headers = router.app.handle_full(
+            "POST", "/v1/models/m:generate", _gen_body()
+        )
+        assert status == 200
+        ok_id = dict(headers)["X-Trace-Id"]
+        assert all(
+            t["trace_id"] != ok_id for t in tracer.completed_traces()
+        )
+        # now every replica 5xxs: retry budget exhausts into a 503 and
+        # the trace is KEPT as an error
+        fleet.handlers["r0"] = fail_handler
+        fleet.handlers["r1"] = fail_handler
+        status, _, headers = router.app.handle_full(
+            "POST", "/v1/models/m:generate", _gen_body()
+        )
+        assert status == 503
+        err_id = dict(headers)["X-Trace-Id"]
+        (kept,) = [
+            t for t in tracer.completed_traces()
+            if t["trace_id"] == err_id
+        ]
+        assert kept["keep_reason"] == "error"
+        # the retried attempts are all in the kept trace
+        routes = [
+            s for s in kept["spans"] if s["name"] == "request.route"
+        ]
+        assert len(routes) == 2
+
+    def test_backoff_event_recorded_on_429(self):
+        tracer = default_tracer()
+        tracer.clear()
+
+        def drain_handler(method, path, body, headers):
+            return 429, b"{}", {"retry-after": "3"}
+
+        fleet = _FakeFleet()
+        router = self._router(
+            fleet, [fleet.add("r0", drain_handler), fleet.add("r1")]
+        )
+        status, _, headers = router.app.handle_full(
+            "POST", "/v1/models/m:generate", _gen_body()
+        )
+        assert status == 200
+        trace_id = dict(headers)["X-Trace-Id"]
+        backoffs = [
+            r for r in tracer.snapshot()
+            if r.name == "router.backoff" and r.trace_id == trace_id
+        ]
+        assert len(backoffs) == 1
+        assert backoffs[0].attrs["retry_after_s"] == 3.0
+
+    def test_tracing_disabled_sends_no_traceparent_and_still_serves(self):
+        tracer = default_tracer()
+        tracer.configure(enabled=False)
+        tracer.clear()
+        fleet = _FakeFleet()
+        router = self._router(fleet, [fleet.add("r0")])
+        status, _, headers = router.app.handle_full(
+            "POST", "/v1/models/m:generate", _gen_body()
+        )
+        assert status == 200
+        assert "X-Trace-Id" not in dict(headers)
+        (sent,) = fleet.seen_headers
+        assert "traceparent" not in sent
+        assert tracer.snapshot() == []
+        assert tracer.completed_traces() == []
+
+
+# ---------------------------------------------------------------------------
+# End-to-end propagation: an in-process router → two real ModelServer
+# replicas (session tiny-gpt engines). One trace id spans the router's
+# spans and EVERY replica span of the request.
+# ---------------------------------------------------------------------------
+
+
+class _InProcessFleet:
+    """Router transport dispatching straight into replica WSGI apps —
+    real ModelServer handlers, real engines, no sockets."""
+
+    def __init__(self, apps):
+        self.apps = apps  # rid -> App
+
+    def transport(self, method, url, body, headers):
+        from kubeflow_tpu.api.wsgi import Response
+
+        rid, _, path = url[len("http://"):].partition("/")
+        jbody = json.loads(body) if body else None
+        status, result, hdr_list = self.apps[rid].handle_full(
+            method, "/" + path, jbody, headers=dict(headers)
+        )
+        if isinstance(result, Response):
+            data = result.body
+        else:
+            data = json.dumps(result).encode()
+        return status, data, {k.lower(): v for k, v in hdr_list}
+
+
+class TestFleetPropagationE2E:
+    def _fleet(self, gpt_and_params, n_replicas=2):
+        from kubeflow_tpu.routing.router import FleetRouter, Replica
+        from kubeflow_tpu.serving.engine import DecodeEngine
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model, params = gpt_and_params
+        servers, engines, apps = [], [], {}
+        for i in range(n_replicas):
+            engine = DecodeEngine(
+                "g", model, params, num_slots=2, max_queue=16
+            )
+            server = ModelServer()
+            server.add_engine(engine)
+            servers.append(server)
+            engines.append(engine)
+            apps[f"rep{i}"] = server.app
+        fleet = _InProcessFleet(apps)
+        router = FleetRouter(
+            tuple(Replica(rid, f"http://{rid}") for rid in apps),
+            page_size=16,
+            transport=fleet.transport,
+        )
+        return router, engines
+
+    def test_one_trace_id_spans_router_and_replica(self, gpt_and_params):
+        tracer = default_tracer()
+        tracer.clear()
+        router, engines = self._fleet(gpt_and_params)
+        try:
+            status, body, headers = router.app.handle_full(
+                "POST",
+                "/v1/models/g:generate",
+                {
+                    "prompt_ids": [(np.arange(6) % 512).tolist()],
+                    "max_new_tokens": 3,
+                },
+            )
+            assert status == 200, body
+            trace_id = dict(headers)["X-Trace-Id"]
+            # EVERY replica span of the request carries the router-minted
+            # trace id (row 0 suffix), remote-parented on the router's
+            # forward-attempt span
+            recs = [
+                r for r in tracer.snapshot()
+                if r.trace_id == f"{trace_id}/0"
+            ]
+            names = {r.name for r in recs}
+            assert {
+                "request.queue_wait",
+                "request.prefill",
+                "request.decode",
+                "request.retire",
+            } <= names
+            (route_rec,) = [
+                r for r in tracer.snapshot()
+                if r.name == "request.route"
+                and r.trace_id == trace_id
+            ]
+            for r in recs:
+                assert r.parent_span_id == route_rec.span_id, r.name
+            # the router's own spans ride the same id
+            router_names = {
+                r.name for r in tracer.snapshot()
+                if r.trace_id == trace_id
+            }
+            assert {"router.request", "router.order"} <= router_names
+            # /tracez on the replica surface serves the kept trace with
+            # BOTH the replica spans and (shared in-process ring) the
+            # request spans grouped under the one id
+            status, resp, _ = router.app.handle_full(
+                "GET", "/tracez", query={"trace_id": trace_id}
+            )
+            assert status == 200
+            doc = json.loads(resp.body)
+            assert doc["traces"], "tail sampler kept nothing"
+            spans = {
+                s["name"] for t in doc["traces"] for s in t["spans"]
+            }
+            assert "request.prefill" in spans
+        finally:
+            for e in engines:
+                e.close()
+
+    def test_greedy_output_bitwise_traced_vs_untraced(self, gpt_and_params):
+        tracer = default_tracer()
+        prompt = (np.arange(7) % 512).tolist()
+        body = {"prompt_ids": [prompt], "max_new_tokens": 4}
+
+        def roundtrip():
+            router, engines = self._fleet(gpt_and_params, n_replicas=1)
+            try:
+                status, result, _ = router.app.handle_full(
+                    "POST", "/v1/models/g:generate", dict(body)
+                )
+                assert status == 200, result
+                return result["sequences"]
+            finally:
+                for e in engines:
+                    e.close()
+
+        tracer.configure(enabled=True)
+        traced = roundtrip()
+        tracer.configure(enabled=False)
+        untraced = roundtrip()
+        assert traced == untraced
+
+    def test_replica_ttft_exemplar_links_to_router_trace(
+        self, gpt_and_params
+    ):
+        tracer = default_tracer()
+        tracer.clear()
+        router, engines = self._fleet(gpt_and_params, n_replicas=1)
+        try:
+            status, _, headers = router.app.handle_full(
+                "POST",
+                "/v1/models/g:generate",
+                {
+                    "prompt_ids": [(np.arange(5) % 512).tolist()],
+                    "max_new_tokens": 2,
+                },
+            )
+            assert status == 200
+            trace_id = dict(headers)["X-Trace-Id"]
+            ex = tracer.exemplars()
+            ttft = ex["serving_time_to_first_token_seconds"]
+            assert any(o["trace_id"] == trace_id for o in ttft)
+            router_lat = ex["router_request_seconds"]
+            assert any(o["trace_id"] == trace_id for o in router_lat)
+        finally:
+            for e in engines:
+                e.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet collector merge: two PROCESSES' rings (modeled as two private
+# Tracer instances behind a dict-driven fetch) merge by trace id — one
+# request renders as a single flow across Perfetto process tracks.
+# ---------------------------------------------------------------------------
+
+
+class TestFleetMerge:
+    def _collector(self, docs, slo_rules=None):
+        from kubeflow_tpu.observability.fleet import (
+            FleetCollector,
+            ScrapeTarget,
+        )
+
+        targets = [
+            ScrapeTarget(
+                role="router", namespace="ns", owner="svc",
+                instance="router-0", base_url="http://router-0:8600",
+            ),
+            ScrapeTarget(
+                role="serving", namespace="ns", owner="svc",
+                instance="rep-0", base_url="http://rep-0:8500",
+            ),
+        ]
+
+        def fetch(url):
+            return docs[url]
+
+        return FleetCollector(
+            targets=lambda: targets,
+            fetch=fetch,
+            slo_rules=slo_rules or [],
+        )
+
+    def _two_process_rings(self):
+        """A router-process ring and a replica-process ring holding ONE
+        request's spans under one trace id (the propagation contract,
+        minus the sockets)."""
+        trace_id = mint_trace_id()
+        router_tr = Tracer(capacity=64, sample_prob=1.0)
+        with router_tr.trace_context(trace_id):
+            with router_tr.span("router.request"):
+                with router_tr.span("request.route", replica="rep-0"):
+                    pass
+        router_tr.finish_trace(trace_id, dur_s=0.2)
+        router_tr.observe_exemplar(
+            "router_request_seconds", 0.2, trace_id
+        )
+        replica_tr = Tracer(capacity=64, sample_prob=1.0)
+        with replica_tr.trace_context(f"{trace_id}/0"):
+            with replica_tr.span("request.prefill"):
+                pass
+            with replica_tr.span("request.decode"):
+                pass
+        replica_tr.finish_trace(f"{trace_id}/0", dur_s=0.15)
+        replica_tr.observe_exemplar(
+            "serving_time_to_first_token_seconds", 0.15, trace_id
+        )
+        return trace_id, router_tr, replica_tr
+
+    def test_merged_chrome_trace_renders_one_flow(self):
+        trace_id, router_tr, replica_tr = self._two_process_rings()
+        docs = {
+            "http://router-0:8600/debug/trace": router_tr.chrome_trace_json(),
+            "http://rep-0:8500/debug/trace": replica_tr.chrome_trace_json(),
+        }
+        doc = self._collector(docs).merged_chrome_trace()
+        xs = [
+            e for e in doc["traceEvents"]
+            if e["ph"] == "X"
+            and str(e["args"].get("trace_id", "")).startswith(trace_id)
+        ]
+        # spans from BOTH process tracks, one trace id
+        assert {e["pid"] for e in xs} == {0, 1}
+        # ...bound into a single flow: s on the first track, f on the
+        # other, sharing one flow id
+        flows = [
+            e for e in doc["traceEvents"]
+            if e.get("cat") == "request"
+            and e["args"].get("trace_id") == trace_id
+        ]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        assert len({e["id"] for e in flows}) == 1
+        assert {e["pid"] for e in flows} == {0, 1}
+
+    def test_merged_tracez_groups_spans_by_trace_id(self):
+        trace_id, router_tr, replica_tr = self._two_process_rings()
+        docs = {
+            "http://router-0:8600/tracez": json.dumps(router_tr.tracez()),
+            "http://rep-0:8500/tracez": json.dumps(replica_tr.tracez()),
+        }
+        merged = self._collector(docs).merged_tracez()
+        trace = merged["traces"][trace_id]
+        assert set(trace["processes"]) == {"router-0", "rep-0"}
+        names = [s["name"] for s in trace["spans"]]
+        assert "router.request" in names
+        assert "request.prefill" in names
+        # spans ordered on the stitched timeline and stamped with their
+        # process
+        instances = {s["instance"] for s in trace["spans"]}
+        assert instances == {"router-0", "rep-0"}
+        # fleet-merged exemplars keep the worst offenders per series
+        assert (
+            merged["exemplars"]["router_request_seconds"][0]["trace_id"]
+            == trace_id
+        )
+
+    def test_slo_exemplars_link_rule_to_traces(self):
+        # /fleetz's lookup rides the EXEMPLARS-ONLY /tracez shape — a
+        # few KB per target, no span lists
+        trace_id, router_tr, replica_tr = self._two_process_rings()
+        router_doc = router_tr.tracez(include_traces=False)
+        assert "traces" not in router_doc
+        docs = {
+            "http://router-0:8600/tracez?exemplars_only=1": json.dumps(
+                router_doc
+            ),
+            "http://rep-0:8500/tracez?exemplars_only=1": json.dumps(
+                replica_tr.tracez(include_traces=False)
+            ),
+        }
+        collector = self._collector(
+            docs, slo_rules=["ttft: serving_ttft_p99 < 5s"]
+        )
+        ex = collector.slo_exemplars()
+        assert ex["ttft"][0]["trace_id"] == trace_id
+        assert ex["ttft"][0]["instance"] == "rep-0"
+
+    def test_fleetz_shows_worst_offender_traces(self):
+        trace_id, router_tr, replica_tr = self._two_process_rings()
+        docs = {
+            "http://router-0:8600/tracez?exemplars_only=1": json.dumps(
+                router_tr.tracez(include_traces=False)
+            ),
+            "http://rep-0:8500/tracez?exemplars_only=1": json.dumps(
+                replica_tr.tracez(include_traces=False)
+            ),
+        }
+        collector = self._collector(
+            docs, slo_rules=["ttft: serving_ttft_p99 < 5s"]
+        )
+        text = "\n".join(collector.fleetz_lines())
+        assert f"worst: trace {trace_id}" in text
+
+    def test_fleet_tracez_route_served(self):
+        trace_id, router_tr, replica_tr = self._two_process_rings()
+        docs = {
+            "http://router-0:8600/tracez": json.dumps(router_tr.tracez()),
+            "http://rep-0:8500/tracez": json.dumps(replica_tr.tracez()),
+        }
+        from kubeflow_tpu.observability.http import build_debug_app
+
+        app = build_debug_app(fleet=self._collector(docs))
+        status, resp, _ = app.handle_full("GET", "/debug/fleet-tracez")
+        assert status == 200
+        doc = json.loads(resp.body)
+        assert trace_id in doc["traces"]
+
+    def test_unreachable_targets_degrade_gracefully(self):
+        _, router_tr, _ = self._two_process_rings()
+        docs = {
+            "http://router-0:8600/tracez": json.dumps(router_tr.tracez()),
+            # rep-0 missing: fetch raises KeyError
+        }
+        merged = self._collector(docs).merged_tracez()
+        # partial fleet still merges what it reached
+        assert all(
+            t["processes"] == ["router-0"]
+            for t in merged["traces"].values()
+        )
+
+
+class TestTracezEndpoint:
+    def test_tracez_served_on_model_server(self, gpt_and_params):
+        from kubeflow_tpu.serving.engine import DecodeEngine
+        from kubeflow_tpu.serving.server import ModelServer
+
+        tracer = default_tracer()
+        tracer.clear()
+        model, params = gpt_and_params
+        engine = DecodeEngine("g", model, params, num_slots=2, max_queue=16)
+        server = ModelServer()
+        server.add_engine(engine)
+        try:
+            tid, sid = mint_trace_id(), mint_span_id()
+            status, _, headers = server.app.handle_full(
+                "POST",
+                "/v1/models/g:generate",
+                {
+                    "prompt_ids": [(np.arange(4) % 512).tolist()],
+                    "max_new_tokens": 2,
+                },
+                headers={"Traceparent": format_traceparent(tid, sid)},
+            )
+            assert status == 200
+            # the replica CONTINUES the inbound trace: echoed id == the
+            # traceparent's, and the engine spans hang off the remote
+            # parent span
+            assert dict(headers)["X-Request-Id"] == tid
+            status, resp, _ = server.app.handle_full("GET", "/tracez")
+            assert status == 200
+            doc = json.loads(resp.body)
+            assert doc["sampling"]["prob"] == 1.0
+            (kept,) = [
+                t for t in doc["traces"]
+                if str(t["trace_id"]).startswith(tid)
+            ]
+            by_name = {s["name"]: s for s in kept["spans"]}
+            assert "request.prefill" in by_name
+            assert by_name["request.queue_wait"]["parent_span_id"] == sid
+            # filtered query narrows to the request
+            status, resp, _ = server.app.handle_full(
+                "GET", "/tracez", query={"trace_id": tid}
+            )
+            doc = json.loads(resp.body)
+            assert doc["traces"]
+            assert all(
+                str(t["trace_id"]).startswith(tid) for t in doc["traces"]
+            )
+            # exemplars-only shape: no span lists on the wire
+            status, resp, _ = server.app.handle_full(
+                "GET", "/tracez", query={"exemplars_only": "1"}
+            )
+            doc = json.loads(resp.body)
+            assert "traces" not in doc
+            assert "exemplars" in doc and "sampling" in doc
+        finally:
+            engine.close()
