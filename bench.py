@@ -1236,6 +1236,73 @@ def bench_serving_continuous(
             spec_stats["draft_accepted"] - pre_spec["draft_accepted"]
         )
         accept_rate = round(accepted / proposed, 3) if proposed else 0.0
+        # -- multi-query pallas kernel vs gather (r16): the chunk and
+        # verify windows — the two s>1-queries-per-page-walk programs —
+        # timed kernel (bench:gpt_mq_pallas geometry, certified
+        # gather-free by the serving lint) vs the SAME windows through
+        # spec_kd's paged_kv_view gather bodies. Programs are driven
+        # directly with zeros args and the donated pool fed back, so
+        # this is program latency, not scheduling. Off-TPU the kernel
+        # runs in interpret mode, so the CPU ratio is expected to favor
+        # the gather path (docs/PERF.md r16 caveat); the portable
+        # evidence is that both families execute and what each window
+        # costs on this backend.
+        import jax.numpy as _jnp
+
+        mq_engine = DecodeEngine(
+            "gpt_mq_pallas", spec_model, spec_params,
+            num_slots=num_slots, prefill_buckets=buckets,
+            max_queue=max(64, num_requests),
+            draft_model=spec_draft, draft_params=spec_draft_params,
+            num_draft_tokens=num_draft_tokens,
+            paged_attention="pallas", autostart=False,
+        )
+
+        def _time_sig(e, name, iters=2):
+            sig = next(
+                s
+                for s in e.programs.program_signatures(
+                    e.num_slots, e.prefill_buckets
+                )
+                if s.name == name
+            )
+            args = [
+                jax.tree.map(
+                    lambda a: _jnp.zeros(a.shape, a.dtype), arg
+                )
+                for arg in sig.args
+            ]
+            arg_idx, out_idx, _ = sig.cache_io[0]
+            times = []
+            for _ in range(iters + 1):  # first call compiles
+                t_sig = time.monotonic()
+                outs = sig.fn(*args)
+                jax.block_until_ready(outs)
+                times.append(time.monotonic() - t_sig)
+                if arg_idx is not None and out_idx >= 0:
+                    args[arg_idx] = outs[out_idx]
+                else:  # donated without feedback: fresh zeros
+                    args = [
+                        jax.tree.map(
+                            lambda a: _jnp.zeros(a.shape, a.dtype), arg
+                        )
+                        for arg in sig.args
+                    ]
+            return round(min(times[1:]) * 1e3, 2)
+
+        mq = {
+            "chunk_ms_kernel": _time_sig(mq_engine, "chunk"),
+            "verify_ms_kernel": _time_sig(mq_engine, "verify"),
+            "chunk_ms_gather": _time_sig(spec_kd, "chunk"),
+            "verify_ms_gather": _time_sig(spec_kd, "verify"),
+        }
+        mq["chunk_gather_over_kernel"] = round(
+            mq["chunk_ms_gather"] / mq["chunk_ms_kernel"], 3
+        ) if mq["chunk_ms_kernel"] else 0.0
+        mq["verify_gather_over_kernel"] = round(
+            mq["verify_ms_gather"] / mq["verify_ms_kernel"], 3
+        ) if mq["verify_ms_kernel"] else 0.0
+        mq_engine.close()
         # -- sharded engine phase (r14): the SAME trace through the
         # tensor=2 mesh, vs the 1×1 k0 engine above. On this CPU mesh
         # the numbers are compute-bound (virtual devices share the
@@ -1273,6 +1340,59 @@ def bench_serving_continuous(
                 ),
                 "baseline_kv_pool_bytes_per_chip": spec_k0.kv_pool_bytes,
             }
+            # r16 dispatch high-water: XLA's own accounting
+            # (compiled.memory_analysis() temp bytes) for the step
+            # program under per-layer weight gathering vs the pre-r16
+            # whole-tree body, rebuilt at the same geometry via the
+            # lazy-binding program overrides. The CPU scheduler already
+            # sinks whole-tree gathers to first use, so the pair can
+            # TIE here; on TPU the latency-hiding scheduler hoists
+            # them, which is the gap per-layer gathering closes
+            # (docs/PERF.md r16 — the priced one-layer unit in the
+            # mem-budget lint carries the full-model→one-layer claim).
+            try:
+                from kubeflow_tpu.parallel.serving_mesh import (
+                    gather_replicated,
+                )
+
+                ref_eng = DecodeEngine(
+                    "gpt_sharded_ref", spec_model, spec_params,
+                    num_slots=num_slots, prefill_buckets=buckets,
+                    max_queue=max(64, num_requests), mesh_tensor=2,
+                    autostart=False,
+                )
+                rp = ref_eng.programs
+                rp._apply_model = rp.model
+                rp._apply_draft = rp.draft_model
+                rp._live_params = (
+                    lambda p, draft=False: gather_replicated(p, rp.mesh)
+                )
+
+                def _step_temp(e):
+                    sig = next(
+                        s
+                        for s in e.programs.program_signatures(
+                            e.num_slots, e.prefill_buckets
+                        )
+                        if s.name == "step"
+                    )
+                    comp = sig.fn.trace(*sig.args).lower().compile()
+                    return int(
+                        comp.memory_analysis().temp_size_in_bytes
+                    )
+
+                per_layer_b = _step_temp(sharded_engine)
+                whole_tree_b = _step_temp(ref_eng)
+                ref_eng.close()
+                sharded["step_dispatch_temp_bytes"] = per_layer_b
+                sharded["step_dispatch_temp_bytes_whole_tree"] = (
+                    whole_tree_b
+                )
+                sharded["dispatch_highwater_ratio"] = round(
+                    per_layer_b / whole_tree_b, 3
+                ) if whole_tree_b else 0.0
+            except Exception as e:  # noqa: BLE001 - accounting optional
+                sharded["dispatch_highwater_error"] = type(e).__name__
         else:
             sharded = {"skipped": "needs >= 2 jax devices"}
         # -- quantized engine phase: same trace, int8 weights + KV pages
@@ -1440,12 +1560,24 @@ def bench_serving_continuous(
         },
         "engine_accept_rate": accept_rate,
         "drafted_tokens_per_sec": kd["tokens_per_sec"],
+        # r16 multi-query pallas: chunk/verify window latency, kernel
+        # (bench:gpt_mq_pallas) vs gather (spec_kd's programs) — on CPU
+        # the kernel interprets, so gather_over_kernel < 1 is expected
+        # off-TPU (docs/PERF.md r16)
+        "mq_pallas": mq,
+        "mq_chunk_gather_over_kernel": mq["chunk_gather_over_kernel"],
+        "mq_verify_gather_over_kernel": mq["verify_gather_over_kernel"],
         # r14 sharded serving: same trace through the tensor=2 mesh
         # (CPU-mesh numbers are compute-bound; parity + per-chip pool
         # bytes are the real evidence — docs/PERF.md r14)
         "sharded": sharded,
         "sharded_tokens_per_sec": sharded.get("tokens_per_sec", 0.0),
         "sharded_mesh": sharded.get("mesh", "skipped"),
+        # r16 per-layer weight gathering: step-program temp bytes,
+        # per-layer vs whole-tree-gather body (XLA accounting)
+        "dispatch_highwater_ratio": sharded.get(
+            "dispatch_highwater_ratio", 0.0
+        ),
         # int8 weights + KV pages (r13): same trace through the
         # quantized pallas engine; capacity ratio is pool arithmetic
         "quantized": quantized,
@@ -2681,6 +2813,10 @@ _EXTRA_FINAL_KEYS = (
     # sharded serving (serving_continuous sharded phase, r14)
     "sharded_tokens_per_sec",
     "sharded_mesh",
+    # r16 per-layer gathering + multi-query pallas window costs
+    "dispatch_highwater_ratio",
+    "mq_chunk_gather_over_kernel",
+    "mq_verify_gather_over_kernel",
     "engine_accept_rate",
     "drafted_tokens_per_sec",
     "training_model_flops_utilization",

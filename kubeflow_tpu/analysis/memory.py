@@ -107,6 +107,50 @@ def sharded_tree_bytes(
     return total
 
 
+def max_gather_unit_bytes(
+    shapes,
+    stacked_keys=("layers",),
+    dequant_dtype=None,
+) -> int:
+    """Dispatch high-water of per-layer weight gathering (r16): the
+    LARGEST single gather unit of a params tree. Under the sharded
+    engine's point-of-use gathering (models/gpt.py
+    `_maybe_gather_params`) each top-level subtree — one named layer,
+    the embeddings, the final LN, the head — gathers independently, and
+    a top-level key in `stacked_keys` is an nn.scan stack whose leading
+    axis is sliced BEFORE the gather, so its unit is ONE layer's slice
+    (leaf bytes / num_layers). The pre-r16 `gather_replicated` priced
+    the whole tree here; this is the number mem-budget charges instead.
+
+    `shapes` may be the plain params tree or the int8 envelope
+    ({"qvalues", "qscales"}); with `dequant_dtype` set, a quantized
+    leaf's unit adds its post-gather dequantized compute-dtype copy on
+    top of the gathered int8 bytes (both live at dispatch)."""
+    import jax
+    import numpy as np
+
+    env = isinstance(shapes, dict) and set(shapes) == {
+        "qvalues", "qscales",
+    }
+    tree = shapes["qvalues"] if env else shapes
+    scales = shapes["qscales"] if env else {}
+    if not isinstance(tree, dict):
+        return tree_bytes(tree)
+    units: Dict[str, int] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        top = getattr(path[0], "key", str(path[0]))
+        nbytes = _leaf_nbytes(leaf)
+        if top in stacked_keys and leaf.shape:
+            nbytes //= max(1, leaf.shape[0])
+        if (
+            jax.tree_util.keystr(path) in scales
+            and dequant_dtype is not None
+        ):
+            nbytes += nbytes * np.dtype(dequant_dtype).itemsize
+        units[top] = units.get(top, 0) + nbytes
+    return max(units.values(), default=0)
+
+
 def _fmt_bytes(n: float) -> str:
     if n >= 1 << 30:
         return f"{n / (1 << 30):.2f} GiB"

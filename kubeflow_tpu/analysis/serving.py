@@ -34,10 +34,20 @@ the lint checks the programs the scheduler actually dispatches:
   int8 value leaves and bf16 `cached_*_scale` leaves are both
   narrower-than-model and must round-trip their stored dtype exactly
   like full-width pools.
+- **serve-paged-gather** (r16): a `paged_attention="pallas"` plan's
+  pool-reading programs (chunk/step/draft/draft_chunk/verify) must
+  contain NO gather over the KV pool — the multi-query kernel walks
+  the page table in place for every window size, so a surviving
+  `paged_kv_view` gather temp means a silent fallback to the gather
+  read path.
 - **mem-budget** (analysis/memory.py): params + the resident KV page
   pool(s) — num_pages x page_size of K/V per layer, the paged layout's
   decoupling of resident HBM from num_slots x max_len — (+ XLA temp
-  allocation when the plan compiles) vs the declared chip's HBM.
+  allocation when the plan compiles) vs the declared chip's HBM. On a
+  mesh the dispatch term prices per-layer weight gathering (r16):
+  sharded params-at-rest plus ONE replicated gather unit
+  (`max_gather_unit_bytes` — the largest layer, dequant copy included
+  on int8 plans), not the whole gathered tree.
 
 The existing SPMD passes (`spmd-dcn-collective`, `spmd-replicated-param`)
 run over the same jaxprs/params: inert while the engine is single-chip,
@@ -340,6 +350,49 @@ def check_cache_dtype(
     return findings
 
 
+# The program families that READ the KV page pool per dispatch — the
+# set the serve-paged-gather check covers on pallas plans.
+_POOL_READ_FAMILIES = {"chunk", "step", "draft_chunk", "draft", "verify"}
+
+
+def check_paged_gather_free(
+    plan_name: str, sig_name: str, jaxpr, page_size: int
+) -> List[Finding]:
+    """A `paged_attention="pallas"` plan must not materialize the
+    contiguous per-slot KV view anywhere in a pool-reading program: the
+    pallas kernel (multi-query since r16 — s>1 chunk and K>0 verify
+    windows included) walks the page table in place, so a surviving
+    `paged_kv_view` gather (a `gather` eqn whose operand is the
+    [P, page_size, ...] pool itself) means some window size silently
+    fell back to the gather read path — exactly the view-sized HBM temp
+    per dispatch the kernel exists to kill. Detection keys on the
+    operand, not the output: embedding/position-table gathers read 2-D
+    tables and never match the pool's [pages, page_size, ...] layout."""
+    for eqn in _walk_eqns(jaxpr):
+        if eqn.primitive.name != "gather":
+            continue
+        src = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+        if len(src) >= 3 and src[1] == page_size:
+            out_shape = tuple(eqn.outvars[0].aval.shape)
+            return [
+                Finding(
+                    analyzer="serve-paged-gather",
+                    severity=Severity.ERROR,
+                    location=f"plan:{plan_name}",
+                    symbol=sig_name,
+                    message=(
+                        f"program {sig_name}: pallas plan still gathers "
+                        f"the KV pool (gather over {src} producing "
+                        f"{out_shape}) — this window size fell back to "
+                        f"the paged_kv_view read path, materializing a "
+                        f"view-sized HBM temp on every dispatch; route "
+                        f"the window through the multi-query kernel"
+                    ),
+                )
+            ]
+    return []
+
+
 def expected_program_names(
     buckets: Sequence[int], num_draft_tokens: int
 ) -> set:
@@ -561,6 +614,15 @@ def analyze_serving_plan(
                 spec.name, sig, traced.out_info, model, draft
             )
         )
+        if (
+            spec.paged_attention == "pallas"
+            and sig.family in _POOL_READ_FAMILIES
+        ):
+            findings.extend(
+                check_paged_gather_free(
+                    spec.name, sig.name, closed.jaxpr, page_size
+                )
+            )
         if spec.compile and sig.family == "step":
             compiled = lowered.compile()
             try:
@@ -608,6 +670,20 @@ def analyze_serving_plan(
         "params": per_chip(params, param_sh or None),
         "kv page pool": per_chip(pool_shapes, progs._pool_sh),
     }
+    if progs.mesh is not None:
+        from kubeflow_tpu.analysis.memory import max_gather_unit_bytes
+
+        # per-layer weight gathering (r16): a meshed plan's dispatch
+        # high-water is params-at-rest (sharded, above) PLUS one
+        # replicated gather unit — the largest single layer (its
+        # dequantized copy included on int8 plans) — NOT the whole
+        # gathered tree the pre-r16 `gather_replicated` body held live
+        components["gathered layer (dispatch)"] = max_gather_unit_bytes(
+            params,
+            dequant_dtype=(
+                model.cfg.dtype if spec.quantize == "int8" else None
+            ),
+        )
     if draft is not None:
         dparams = progs.abstract_params(draft)
         dcache_one = progs.draft_cache_shapes(dparams, buckets[0])

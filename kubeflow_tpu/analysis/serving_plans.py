@@ -41,7 +41,11 @@ DEFAULT_NUM_PAGES = 0
 # Decode read-path kernel: "gather" materializes a per-slot contiguous
 # KV view through the page table (ops/attention.py paged_kv_view);
 # "pallas" walks the page table in place (ops/paged_attention.py — no
-# gather, no temp; bitwise-identical greedy output, parity-tested).
+# gather, no temp; bitwise-identical greedy output, parity-tested) for
+# EVERY window size since r16: one-token step, s>1 chunk-prefill
+# windows, and the K>0 verify window all ride the same kernel
+# (multi-query variant), so a pallas engine's hot path is gather-free
+# end to end (the serving lint's serve-paged-gather pass asserts it).
 # Gather stays the default: the pallas kernel is the TPU bandwidth
 # winner, and off-TPU it runs in interpret mode (correct, not fast).
 PAGED_ATTENTION_CHOICES = ("gather", "pallas")
@@ -219,6 +223,26 @@ def bench_serving_plans() -> List[ServingPlanSpec]:
             num_draft_tokens=BENCH_NUM_DRAFT_TOKENS,
             draft_model="gpt_small",
             draft_kwargs=dict(spec_target, num_layers=BENCH_DRAFT_LAYERS),
+        ),
+        ServingPlanSpec(
+            # gpt_spec_kd's pallas twin (r16): the SAME K=4 geometry
+            # routed through the multi-query pallas kernel, so the
+            # serve-paged-gather pass certifies that the s>1 chunk and
+            # K>0 verify windows really run the in-place page walk — no
+            # paged_kv_view gather temp in any pool-reading program.
+            # bench's sharded phase times exactly this program family
+            # against the gather twin (kernel-vs-gather step latency);
+            # it stays a separate plan rather than a flip of gpt_spec_kd
+            # because the drafted phase's headline CPU throughput runs
+            # the kernel in interpret mode (correct, not fast).
+            name="bench:gpt_mq_pallas",
+            model="gpt_small",
+            model_kwargs=dict(spec_target),
+            prefill_buckets=BENCH_PREFILL_BUCKETS,
+            num_draft_tokens=BENCH_NUM_DRAFT_TOKENS,
+            draft_model="gpt_small",
+            draft_kwargs=dict(spec_target, num_layers=BENCH_DRAFT_LAYERS),
+            paged_attention="pallas",
         ),
     ]
 

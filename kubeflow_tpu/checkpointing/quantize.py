@@ -128,6 +128,44 @@ def dequantize_params(qparams: Dict[str, Any], dtype):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def pack_quantized_params(qparams: Dict[str, Any], stacked_keys=()):
+    """Envelope → per-leaf PACKED tree for point-of-use dequantization
+    (per-layer weight gathering, models/gpt.py `_param_gather_transform`):
+    every quantized leaf becomes {"qvalue": int8, "qscale": f32} at its
+    tree position; unquantized leaves ride through untouched. The module
+    that owns a leaf then gathers it at int8 and dequantizes post-gather
+    with exactly `dequantize_params`' arithmetic — same bits, half the
+    gathered bytes, and the dispatch high-water is one gather unit
+    instead of the whole tree.
+
+    Leaves under a top-level key in `stacked_keys` carry a leading
+    nn.scan layer axis [L, ...]: their single per-channel scale [out]
+    (quantize_leaf_int8 reduces over ALL leading axes, the layer axis
+    included) tiles to [L, out] so nn.scan slices value and scale
+    together — each layer's slice sees the same [out] scale the
+    full-tree dequant used, so the per-layer dequant is bitwise the
+    full dequant's slice. Runs INSIDE traced program bodies (the tile
+    is free under XLA; the resident tree stays the envelope)."""
+    import jax
+    import jax.numpy as jnp
+
+    scales = qparams["qscales"]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        qparams["qvalues"]
+    )
+    out = []
+    for path, leaf in flat:
+        s = scales.get(_keystr(path))
+        if s is None:
+            out.append(leaf)
+            continue
+        top = getattr(path[0], "key", str(path[0]))
+        if top in stacked_keys:
+            s = jnp.broadcast_to(s, (leaf.shape[0],) + s.shape)
+        out.append({"qvalue": leaf, "qscale": s})
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def apply_transform(params, transform: str):
     """The checkpoint-restore dtype-transform stage
     (checkpointing/manager.py restore_params): "" / None is identity,

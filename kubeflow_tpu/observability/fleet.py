@@ -146,6 +146,9 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "serving_draft_accepted_total": "sum",
     "serving_draft_proposed_total": "sum",
     "serving_engine_recoveries_total": "sum",
+    # read-path dispatches by variant label: summed per variant across
+    # the fleet, so any "gather" samples from a pallas fleet stand out
+    "serving_paged_attention_calls_total": "sum",
     "serving_prefix_cache_hit_tokens_total": "sum",
     "serving_prefix_cache_lookups_total": "sum",
     "serving_requests_total": "sum",

@@ -36,13 +36,19 @@ scales and the dequant (`ops/attention.py dequant_kv`, the SAME helper
 the gather path uses) runs fused inside the page walk, on the VMEM tile
 the DMA just landed: HBM streams one byte per KV element instead of two.
 
-Scope: the s == 1 one-token decode step — the hot loop that runs forever
-and whose bytes dominate. Multi-token windows (chunk prefill, the K>0
-verify) stay on the gather path: they amortize the gather over s
-positions and their math through the gather path is already the parity
-baseline. Off-TPU the kernel runs in interpret mode (the in-repo
-precedent: ops/flash_attention.py), so tier-1 parity tests exercise this
-exact code path under JAX_PLATFORMS=cpu.
+Scope: every paged read. The s == 1 one-token step rides `_kernel` (the
+hot loop that runs forever, unchanged since r13); multi-token windows
+(chunk prefill, the K>0 verify) ride `_mq_kernel` — the SAME
+scalar-prefetch page walk with s query rows per slot, a causal clamp at
+the window's LAST position (`(cur + s - 1) // ps`), per-query-row
+visibility (`key pos <= cur + j`), and the int8 dequant fused
+identically. That kills the last `paged_kv_view` gather temp in the
+engine's program family: at `paged_attention="pallas"` no program
+materializes a contiguous [B, max_len, H, D] view (the serving lint
+asserts this on the lowered chunk/verify programs). Off-TPU both
+kernels run in interpret mode (the in-repo precedent:
+ops/flash_attention.py), so tier-1 parity tests exercise these exact
+code paths under JAX_PLATFORMS=cpu.
 """
 
 from __future__ import annotations
@@ -134,6 +140,84 @@ def _kernel(
         )[0, 0]
 
 
+def _mq_kernel(
+    pt_ref,      # [B, MP] int32 scalar-prefetch (unused in body; maps route it)
+    cur_ref,     # [B] int32 scalar-prefetch
+    q_ref,       # (1, s, H, D) this slot's query window
+    k_ref,       # (1, ps, H, D) one pool K page (int8 when quantized)
+    v_ref,       # (1, ps, H, D) one pool V page
+    *refs,       # [ks_ref, vs_ref] when quantized; then o_ref, scratches
+    page_size: int,
+    dtype,
+    quantized: bool,
+):
+    """The s > 1 window variant of `_kernel`: one page walk per slot
+    serves all s query rows (chunk prefill, the K>0 verify window).
+    Query row j sits at logical position cur + j, so the live-page gate
+    and the visibility mask run against the window's span instead of the
+    single cursor — everything else (einsum forms, f32 softmax, fused
+    int8 dequant) is the one-token kernel's arithmetic verbatim."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, s_scratch, v_scratch = refs
+    else:
+        o_ref, s_scratch, v_scratch = refs
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+    ps = page_size
+    cur = cur_ref[b]
+    s = q_ref.shape[1]
+
+    @pl.when(p == 0)
+    def _init():
+        s_scratch[:] = jnp.zeros_like(s_scratch)
+        v_scratch[:] = jnp.zeros_like(v_scratch)
+
+    # a page is live when its first position is visible to the window's
+    # LAST query (position cur + s - 1); positions past a query's own
+    # cursor inside a live page are masked per query row at the softmax
+    @pl.when(p * ps <= cur + (s - 1))
+    def _body():
+        q = q_ref[0]                          # (s, H, D)
+        k = k_ref[0]                          # (ps, H, D)
+        v = v_ref[0]
+        if quantized:
+            k = dequant_kv(k, ks_ref[0], dtype)
+            v = dequant_kv(v, vs_ref[0], dtype)
+        depth = q.shape[-1]
+        # the same singleton-batched einsum FORM dense_attention uses
+        # (XLA's f32 reduction order is shape-dependent; see _finish)
+        s_page = jnp.einsum(
+            "bqhd,bkhd->bhqk", q[None], k[None]
+        )[0] / jnp.sqrt(depth).astype(dtype)   # (H, s, ps)
+        s_scratch[:, :, pl.ds(p * ps, ps)] = s_page
+        v_scratch[pl.ds(p * ps, ps)] = v
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        view_len = n_pages * ps
+        scores = s_scratch[:]                 # (H, s, L) compute dtype
+        # per-query causal visibility: query row j (logical position
+        # cur + j) sees key positions <= cur + j — exactly the gather
+        # path's s > 1 mask in models/gpt.py
+        q_pos = cur + jax.lax.broadcasted_iota(
+            jnp.int32, (s, view_len), 0
+        )
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, view_len), 1)
+        visible = k_pos <= q_pos
+        big_neg = jnp.finfo(jnp.float32).min
+        scores = jnp.where(visible[None], scores, big_neg)
+        probs = jax.nn.softmax(
+            scores.astype(jnp.float32), axis=-1
+        ).astype(dtype)
+        # masked positions carry prob exactly 0: stale/zero V rows in the
+        # scratch contribute exactly nothing, same as the gather path.
+        # Same singleton-batched einsum FORM as dense_attention's PV.
+        o_ref[0] = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs[None], v_scratch[:][None]
+        )[0]
+
+
 def paged_attention(
     q: jax.Array,
     pool_k: jax.Array,
@@ -146,12 +230,15 @@ def paged_attention(
     v_scale: Optional[jax.Array] = None,
     mesh=None,
 ) -> jax.Array:
-    """One-token paged-attention decode over all slots.
+    """Paged-attention read over all slots, any window size.
 
-    q [B, 1, H, D] compute dtype; pool_k/pool_v [P, ps, H, D] (compute
+    q [B, s, H, D] compute dtype; pool_k/pool_v [P, ps, H, D] (compute
     dtype, or int8 with k_scale/v_scale [P, ps, H, 1]); page_table
     [B, MP] int32; cursors [B] int32 (cursor masking IS visibility — the
-    paged layout has no pad holes). Returns [B, 1, H, D].
+    paged layout has no pad holes; query row j of slot b sits at logical
+    position cursors[b] + j). Returns [B, s, H, D]. s == 1 is the
+    one-token decode step; s > 1 is a chunk-prefill or K>0 verify
+    window (one page walk serves all s query rows).
 
     Every slot's row is walked page-by-page straight out of the pool —
     no contiguous per-slot view is ever materialized.
@@ -196,23 +283,23 @@ def paged_attention(
             widen_batch=False,
         )(*args)
     b, s, h, d = q.shape
-    assert s == 1, "the pallas kernel serves the one-token decode step"
     num_pages, ps = pool_k.shape[0], pool_k.shape[1]
     mp = page_table.shape[1]
     view_len = mp * ps
     quantized = k_scale is not None
 
     def page_idx(bi, p, pt, cur):
-        # clamp at the slot's last live page: steps past it re-map to the
-        # same index, and the pipeline elides the repeat DMA (a parked
-        # cursor of max_len clamps to the final table entry — its output
-        # is never read)
+        # clamp at the slot's last live page — the LAST position the
+        # window can see is cur + s - 1: steps past its page re-map to
+        # the same index, and the pipeline elides the repeat DMA (a
+        # parked cursor of max_len clamps to the final table entry — its
+        # output is never read). At s == 1 this is r13's clamp verbatim.
         last = jnp.minimum(
-            jnp.maximum(cur[bi], 0) // ps, mp - 1
+            jnp.maximum(cur[bi] + (s - 1), 0) // ps, mp - 1
         )
         return (pt[bi, jnp.minimum(p, last)], 0, 0, 0)
 
-    q_spec = pl.BlockSpec((1, 1, h, d), lambda bi, p, pt, cur: (bi, 0, 0, 0))
+    q_spec = pl.BlockSpec((1, s, h, d), lambda bi, p, pt, cur: (bi, 0, 0, 0))
     kv_spec = pl.BlockSpec((1, ps, h, d), page_idx)
     in_specs = [q_spec, kv_spec, kv_spec]
     args = [q, pool_k, pool_v]
@@ -226,19 +313,24 @@ def paged_attention(
         grid=(b, mp),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
-            (1, 1, h, d), lambda bi, p, pt, cur: (bi, 0, 0, 0)
+            (1, s, h, d), lambda bi, p, pt, cur: (bi, 0, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((h, view_len), dtype),      # score row
+            # score rows: (h, L) at s == 1 keeps the one-token kernel's
+            # r13 layout bit-for-bit; the window variant carries an s axis
+            pltpu.VMEM(
+                (h, view_len) if s == 1 else (h, s, view_len), dtype
+            ),
             pltpu.VMEM((view_len, h, d), dtype),   # dequantized V row
         ],
     )
     kernel = functools.partial(
-        _kernel, page_size=ps, dtype=dtype, quantized=quantized
+        _kernel if s == 1 else _mq_kernel,
+        page_size=ps, dtype=dtype, quantized=quantized,
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), dtype),
+        out_shape=jax.ShapeDtypeStruct((b, s, h, d), dtype),
         interpret=_use_interpret(),
     )(page_table, cursors, *args)

@@ -10,11 +10,17 @@ enforce — is:
   (training/annotations.py `logical_axes_for` → parallel/sharding.py
   `param_specs`): fsdp shards the embed dim, tensor shards heads/mlp/
   vocab dims, indivisible dims degrade to replicated exactly as in
-  training. Every program body gathers them to replicated at use
-  (`EnginePrograms._live_params`) — the FSDP serving shape: resident
+  training. Params stay SHARDED through every program body
+  (`EnginePrograms._live_params` passes them through as-is since r16);
+  each transformer block gathers only ITS OWN layer's weights to
+  replicated at point of use (models/gpt.py `_maybe_gather_params` on
+  the engine's gather-twin model) — the FSDP serving shape: resident
   weight HBM is sharded (a model too big for one chip can serve), the
-  all-gather moves bits exactly, and all weight matmuls then run
-  replicated — bitwise the single-chip program.
+  per-layer all-gather moves bits exactly, and all weight matmuls then
+  run replicated — bitwise the single-chip program, with the dispatch
+  high-water cut from the full model to one layer. int8 qvalues are
+  gathered AS int8 and dequantized after the gather, so the wire bytes
+  stay quantized.
 - **KV pools shard on the heads axis under `tensor`** (and replicate
   under `fsdp`): attention is per-head independent, so the page
   scatter/gather and the QK^T / PV einsums run local to each chip's

@@ -95,6 +95,7 @@ from kubeflow_tpu.analysis.serving_plans import (
 from kubeflow_tpu.checkpointing.quantize import (
     dequantize_params,
     is_quantized_params,
+    pack_quantized_params,
     quantize_params_int8,
 )
 from kubeflow_tpu.chaos import default_chaos
@@ -119,6 +120,7 @@ from kubeflow_tpu.utils.metrics import (
     serving_kv_pool_bytes_gauge,
     serving_kv_pool_bytes_per_chip_gauge,
     serving_num_slots_gauge,
+    serving_paged_attention_calls_counter,
     serving_phase_histogram,
     serving_prefix_hit_tokens_counter,
     serving_prefix_lookups_counter,
@@ -634,6 +636,33 @@ class EnginePrograms:
                 )
         self.draft_model = draft_model
 
+        # -- gather-twin models (mesh only): the SAME architecture with
+        # cfg.param_gather_mesh set, so every param-owning module gathers
+        # its OWN weights at point of use (models/gpt.py
+        # `_maybe_gather_params`) instead of `_live_params` all-gathering
+        # the whole tree up front — the fsdp dispatch high-water drops
+        # from full-model to one layer's gathered weights. Program
+        # BODIES apply the twin; `abstract_params` stays on the original
+        # (the resident/at-rest tree is mesh-independent, and the twin's
+        # init ignores the wrapper anyway). Unmeshed, the twin IS the
+        # original and every program is byte-for-byte pre-r16.
+        if self.mesh is not None:
+            import dataclasses
+
+            self._apply_model = model.clone(
+                cfg=dataclasses.replace(cfg, param_gather_mesh=self.mesh)
+            )
+            self._apply_draft = (
+                None if draft_model is None else draft_model.clone(
+                    cfg=dataclasses.replace(
+                        draft_model.cfg, param_gather_mesh=self.mesh
+                    )
+                )
+            )
+        else:
+            self._apply_model = model
+            self._apply_draft = draft_model
+
         # -- sharding descriptors (mesh only): params at rest by the
         # training rules, pools head-sharded on `tensor`. Computed from
         # eval_shape trees (zero bytes); the SAME NamedShardings serve
@@ -728,32 +757,42 @@ class EnginePrograms:
     def _live_params(self, params, draft: bool = False):
         """What the model applies: at quantize=int8 the RESIDENT tree is
         int8 + per-channel scales (half the streamed weight bytes) and
-        the dequant into the compute dtype runs here, inside the jitted
+        the dequant into the compute dtype runs inside the jitted
         program — on TPU it fuses into the matmul operand reads.
 
         On a mesh the resident tree is ALSO sharded (fsdp on embed
         dims, tensor on heads/mlp/vocab — the capacity that lets a
-        model too big for one chip serve at all) and gathers to
-        replicated here, inside the program: the all-gather moves bits
-        exactly, every weight matmul then runs replicated, and greedy
-        output stays bitwise the 1×1 engine's. At int8 the gather moves
-        the int8 tree — half the gathered bytes — and dequantizes
-        after."""
+        model too big for one chip serve at all) and STAYS sharded
+        through the program body: the gather to replicated happens per
+        param-owning module, at point of use, inside the gather-twin
+        model (`cfg.param_gather_mesh`, models/gpt.py
+        `_maybe_gather_params`) — under nn.scan the layer axis is
+        sliced BEFORE the gather runs, so each scan iteration moves
+        exactly one layer's weights and the dispatch high-water is one
+        gather unit, not the full tree. Gathers move bits exactly and
+        every weight matmul still runs replicated, so greedy output
+        stays bitwise the 1×1 engine's. At int8 the envelope repacks
+        here to per-leaf {"qvalue", "qscale"} (stacked scales tiled
+        along the scan layer axis so value and scale slice together);
+        the twin gathers the int8 leaf — half the gathered bytes — and
+        dequantizes post-gather with the exact `dequantize_params`
+        arithmetic."""
+        cfg = (self.draft_model if draft else self.model).cfg
         if self.mesh is not None:
-            from kubeflow_tpu.parallel.serving_mesh import (
-                gather_replicated,
+            if self.quantize != "int8":
+                return params
+            return pack_quantized_params(
+                params,
+                stacked_keys=("layers",) if cfg.scan_layers else (),
             )
-
-            params = gather_replicated(params, self.mesh)
         if self.quantize != "int8":
             return params
-        cfg = (self.draft_model if draft else self.model).cfg
         return dequantize_params(params, cfg.dtype)
 
     # -- jitted program bodies ---------------------------------------------
 
     def _prefill_fn(self, params, ids, mask, key, temp, top_k, top_p):
-        out, mutated = self.model.apply(
+        out, mutated = self._apply_model.apply(
             {"params": self._live_params(params)}, ids,
             attention_mask=mask, prefill=True,
             mutable=["cache"],
@@ -788,7 +827,7 @@ class EnginePrograms:
         recompute on prefix hits: a tail of any length is a sequence of
         these windows over already-resident context."""
         paged = self._paged(page_table, cursor)
-        out, mutated = self.model.apply(
+        out, mutated = self._apply_model.apply(
             {"params": self._live_params(params), "cache": pool}, ids,
             decode=True, paged=paged, mutable=["cache"],
         )
@@ -802,7 +841,7 @@ class EnginePrograms:
     def _step_fn(self, params, pool, tokens, page_table, cursors, keys,
                  counters, temps, top_ks, top_ps):
         paged = self._paged(page_table, cursors)
-        out, mutated = self.model.apply(
+        out, mutated = self._apply_model.apply(
             {"params": self._live_params(params), "cache": pool},
             tokens[:, None],
             decode=True, paged=paged, mutable=["cache"],
@@ -819,7 +858,7 @@ class EnginePrograms:
         the target prefilled — the draft's first token is never used (the
         engine's first token comes from the TARGET prefill, bitwise the
         K=0 behavior), so this returns only the cache."""
-        _, mutated = self.draft_model.apply(
+        _, mutated = self._apply_draft.apply(
             {"params": self._live_params(dparams, draft=True)}, ids,
             attention_mask=mask, prefill=True,
             mutable=["cache"],
@@ -831,7 +870,7 @@ class EnginePrograms:
         pool — the draft's cache stays position-for-position in lockstep
         with the target's through chunked admission."""
         paged = self._paged(page_table, cursor)
-        _, mutated = self.draft_model.apply(
+        _, mutated = self._apply_draft.apply(
             {"params": self._live_params(dparams, draft=True),
              "cache": dpool}, ids,
             decode=True, paged=paged, mutable=["cache"],
@@ -853,7 +892,7 @@ class EnginePrograms:
         def body(carry, j):
             dcache, tok = carry
             paged = self._paged(page_table, cursors + j)
-            out, mutated = self.draft_model.apply(
+            out, mutated = self._apply_draft.apply(
                 {"params": live_dparams, "cache": dcache}, tok[:, None],
                 decode=True, paged=paged, mutable=["cache"],
             )
@@ -908,7 +947,7 @@ class EnginePrograms:
         and the pages it claimed go back to the pool."""
         kk = self.num_draft_tokens
         paged = self._paged(page_table, cursors)
-        out, mutated = self.model.apply(
+        out, mutated = self._apply_model.apply(
             {"params": self._live_params(params), "cache": pool}, window,
             decode=True, paged=paged, mutable=["cache"],
         )
@@ -989,7 +1028,7 @@ class EnginePrograms:
         dummy = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
         dmask = jax.ShapeDtypeStruct((1, bucket), jnp.bool_)
         _, shapes = jax.eval_shape(
-            lambda p, ids, m: self.model.apply(
+            lambda p, ids, m: self._apply_model.apply(
                 {"params": self._live_params(p)}, ids,
                 attention_mask=m, prefill=True,
                 mutable=["cache"],
@@ -1002,7 +1041,7 @@ class EnginePrograms:
         dummy = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
         dmask = jax.ShapeDtypeStruct((1, bucket), jnp.bool_)
         _, shapes = jax.eval_shape(
-            lambda p, ids, m: self.draft_model.apply(
+            lambda p, ids, m: self._apply_draft.apply(
                 {"params": self._live_params(p, draft=True)}, ids,
                 attention_mask=m, prefill=True,
                 mutable=["cache"],
@@ -1468,6 +1507,11 @@ class DecodeEngine:
         self._prefill_compute_tokens = 0
         self._pages_allocated = 0
         self._rewind_pages_returned = 0
+        # read-path evidence (r16): window size (query rows per pool
+        # walk) -> variant that served it. A pallas engine must show
+        # EVERY window it ran — 1 (step), chunk_len, K+1 (verify) — as
+        # "pallas"; a "gather" entry here is a silent kernel fallback.
+        self._attn_windows: Dict[int, str] = {}
 
         # kft-trace (observability/): request phases + scheduler iteration
         # spans ride the process tracer; a disabled tracer makes every
@@ -1493,6 +1537,7 @@ class DecodeEngine:
         self._occupancy = serving_slot_occupancy_gauge()
         self._decode_steps = serving_decode_steps_counter()
         self._tokens_total = serving_tokens_counter()
+        self._attn_calls = serving_paged_attention_calls_counter()
         self._num_slots_gauge = serving_num_slots_gauge()
         self._prefix_hits_m = serving_prefix_hit_tokens_counter()
         self._prefix_lookups_m = serving_prefix_lookups_counter()
@@ -1717,6 +1762,14 @@ class DecodeEngine:
                 # what the pool stores (the /statusz + fleet evidence
                 # that a pallas/int8 rollout actually took effect)
                 "attention_kernel": self.paged_attention,
+                # r16 per-window-size read-path evidence: every window
+                # size (query rows per pool walk) this engine has
+                # dispatched, and which variant served it — a pallas
+                # engine showing "gather" for any window is the silent
+                # kernel-fallback regression
+                "paged_attention_windows": dict(
+                    sorted(self._attn_windows.items())
+                ),
                 "quantize": self.quantize,
                 "kv_pool_dtype": (
                     "int8" if self.quantize == "int8"
@@ -1960,6 +2013,19 @@ class DecodeEngine:
 
     # -- scheduler loop ----------------------------------------------------
 
+    def _note_attn(self, window: int) -> None:
+        """Record one pool-reading program dispatch at `window` query
+        rows per page walk: the {variant} counter the fleet sums, plus
+        the per-window-size map stats()/statusz expose (the evidence
+        that chunk and K>0 verify windows really ride the multi-query
+        kernel on a pallas engine, not the gather fallback)."""
+        self._attn_calls.inc(
+            model=self.name, variant=self.paged_attention
+        )
+        if window not in self._attn_windows:
+            with self._stats_lock:
+                self._attn_windows[window] = self.paged_attention
+
     def _admit(self, slot_idx: int, req: _Request) -> None:
         # the queue phase ends the moment the scheduler owns the request
         t_admit = time.monotonic()
@@ -2130,11 +2196,13 @@ class DecodeEngine:
                     self.params, self._pool, jnp.asarray(chunk), prow,
                     cur, sample_idx, base, temp, tk, tp,
                 )
+                self._note_attn(clen)
                 if self.num_draft_tokens > 0:
                     self._draft_pool = self._draft_chunk(
                         self.draft_params, self._draft_pool,
                         jnp.asarray(chunk), prow, cur,
                     )
+                    self._note_attn(clen)
                 if final:
                     first_tok = tok
                 computed += nreal
@@ -2367,6 +2435,7 @@ class DecodeEngine:
                 jnp.asarray(self._topk_np), jnp.asarray(self._topp_np),
             )
             toks = np.asarray(jax.device_get(tok))
+        self._note_attn(1)
         self._decode_steps.inc(model=self.name)
         self._tokens_total.inc(len(active), model=self.name)
         with self._stats_lock:
@@ -2457,6 +2526,11 @@ class DecodeEngine:
                 self._rewind_pages_returned += freed
             self._update_page_gauges()
         proposed = kk * len(active)
+        # the draft program walks the pool at window 1 (K single-token
+        # proposal steps inside one dispatch); verify reads it once at
+        # the full K+1 window — the multi-query kernel's s>1 hot case
+        self._note_attn(1)
+        self._note_attn(kk + 1)
         self._decode_steps.inc(model=self.name)
         self._verify_steps.inc(model=self.name)
         self._tokens_total.inc(emitted, model=self.name)

@@ -352,7 +352,15 @@ class ModelServer:
                 f"mesh: tensor={mesh['tensor']} fsdp={mesh['fsdp']} "
                 f"({state['kv_pool_bytes_per_chip']} B/chip) | "
                 f"kernel: {st['attention_kernel']} "
-                f"quantize: {st['quantize']} | "
+                f"windows: "
+                + (
+                    ",".join(
+                        f"{w}={v}"
+                        for w, v in st["paged_attention_windows"].items()
+                    )
+                    or "-"
+                )
+                + f" quantize: {st['quantize']} | "
                 f"prefix cache: "
                 f"{'on' if state['prefix_cache'] else 'off'} "
                 f"nodes={state['prefix_nodes']} "

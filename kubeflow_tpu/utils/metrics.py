@@ -689,6 +689,22 @@ def serving_verify_steps_counter() -> Counter:
     )
 
 
+def serving_paged_attention_calls_counter() -> Counter:
+    """Pool-reading program dispatches by read-path variant ("pallas" =
+    the in-place page-table walk, "gather" = the paged_kv_view
+    materialized view). Since r16's multi-query kernel every window size
+    of a pallas engine — one-token step, chunk-prefill window, K>0
+    draft/verify — rides the kernel, so a pallas engine emitting
+    variant="gather" samples is the fallback regression this series
+    exists to surface (the per-window-size split lives in engine
+    stats()["paged_attention_windows"] and /statusz)."""
+    return default_registry().counter(
+        "serving_paged_attention_calls_total",
+        "paged-attention program dispatches by read-path variant",
+        ["model", "variant"],
+    )
+
+
 # Paged-KV + radix prefix cache (serving/engine.py): hit tokens over
 # lookups is the TTFT lever — every hit token is prefill compute (and
 # pool HBM) the admission skipped; pages_in_use over pages_total is the

@@ -152,6 +152,71 @@ def gpt_and_params():
     return model, params
 
 
+@pytest.fixture(scope="session")
+def image_dp8_trainer(devices8):
+    """ONE shared resnet18 pure-DP Trainer for test_trainer's DP and
+    checkpoint suites (r16 tier-1 tranche): each test previously built
+    its own Trainer and re-paid the train-step compile. Tests must draw
+    fresh state via `init_state()` and treat the trainer itself as
+    shared (none mutate trainer config; `fit` keeps its own state)."""
+    from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+    from kubeflow_tpu.training.trainer import Trainer
+
+    cfg = TrainingConfig(
+        model="resnet18",
+        global_batch_size=16,
+        steps=2,
+        warmup_steps=1,
+        learning_rate=0.01,
+        mesh=MeshConfig(data=8),
+    )
+    tr = Trainer(cfg, model_kwargs={"num_classes": 10})
+    tr.task.image_size = 32
+    tr.task.num_classes = 10
+    return tr
+
+
+@pytest.fixture(scope="session")
+def gpt_dp8_trainer(devices8):
+    """Shared gpt_tiny pure-DP Trainer (r16 tier-1 tranche): serves as
+    both the loss-decrease vehicle and the DP reference side of the
+    TP==DP equivalence in test_gpt, one train-step compile total."""
+    from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+    from kubeflow_tpu.training.tasks import CausalLmTask
+    from kubeflow_tpu.training.trainer import Trainer
+
+    cfg = TrainingConfig(
+        model="gpt_tiny",
+        global_batch_size=8,
+        steps=2,
+        warmup_steps=1,
+        learning_rate=1e-3,
+        mesh=MeshConfig(data=8),
+    )
+    return Trainer(cfg, task=CausalLmTask(cfg, seq_len=32, vocab_size=512))
+
+
+@pytest.fixture(scope="session")
+def moe_ep_trainer(devices8):
+    """Shared bert_tiny_moe expert-parallel Trainer (r16 tier-1
+    tranche): the EP side of test_moe's trainer suite — loss decrease,
+    expert-axis sharding, and the EP==DP equivalence all ride one
+    compiled EP train step."""
+    from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+    from kubeflow_tpu.training.tasks import MlmTask
+    from kubeflow_tpu.training.trainer import Trainer
+
+    cfg = TrainingConfig(
+        model="bert_tiny_moe",
+        global_batch_size=8,
+        steps=2,
+        warmup_steps=1,
+        learning_rate=1e-3,
+        mesh=MeshConfig(data=2, expert=4),
+    )
+    return Trainer(cfg, task=MlmTask(cfg, seq_len=32, vocab_size=512))
+
+
 @pytest.fixture(autouse=True)
 def _no_leaked_nondaemon_threads():
     """Fail any test that leaves a live non-daemon thread behind.

@@ -964,6 +964,75 @@ class TestSeededMemBudget:
         monkeypatch.setenv(ENV_HBM_BYTES, "1024")
         assert hbm_bytes_per_chip("anything") == 1024.0
 
+    def test_per_layer_dispatch_pricing(self):
+        """r16: sharded dispatch is priced as params-at-rest plus ONE
+        gathered layer (`max_gather_unit_bytes`), not the whole tree.
+        A plan whose full param tree cannot fit next to its at-rest
+        shards PASSES at per-layer pricing; a plan whose single largest
+        gather unit is itself too big still FAILS."""
+        from kubeflow_tpu.analysis.memory import (
+            check_mem_budget,
+            max_gather_unit_bytes,
+            tree_bytes,
+        )
+
+        shapes = {
+            "embedding": jax.ShapeDtypeStruct((1 << 20,), np.float32),
+            "layers": {
+                "w": jax.ShapeDtypeStruct((16, 1 << 20), np.float32)
+            },
+        }
+        whole = tree_bytes(shapes)            # 68 MiB
+        unit = max_gather_unit_bytes(shapes)  # one 4 MiB layer
+        assert unit == 4 << 20
+        assert unit < whole
+        at_rest = 32 << 20
+        budget = 64 << 20  # 90% headroom → 57.6 MiB ceiling
+        # pre-r16 pricing: at-rest + whole-tree gather = 100 MiB > ceiling
+        assert check_mem_budget(
+            "seed", {"params": at_rest, "gathered params": whole}, budget
+        ) != []
+        # r16 pricing: at-rest + one layer = 36 MiB fits
+        assert check_mem_budget(
+            "seed",
+            {"params": at_rest, "gathered layer (dispatch)": unit},
+            budget,
+        ) == []
+        # genuinely too big: even one gathered layer cannot fit
+        assert check_mem_budget(
+            "seed",
+            {"params": at_rest, "gathered layer (dispatch)": unit},
+            34 << 20,
+        ) != []
+
+    def test_max_gather_unit_stacked_and_int8(self):
+        """The two pricing refinements behind the per-layer unit: a
+        stacked-scan leaf is charged at one layer slice, and an int8
+        envelope is charged as the int8 gather PLUS its post-gather
+        dequantized copy (the gather moves int8 bytes; dequant happens
+        after)."""
+        from kubeflow_tpu.analysis.memory import max_gather_unit_bytes
+
+        stacked = {
+            "layers": {"w": jax.ShapeDtypeStruct((4, 8, 8), np.float32)}
+        }
+        assert max_gather_unit_bytes(stacked) == 8 * 8 * 4
+
+        q = {"layers": {"w": jax.ShapeDtypeStruct((4, 8, 8), np.int8)}}
+        keystr = jax.tree_util.keystr(
+            jax.tree_util.tree_flatten_with_path(q)[0][0][0]
+        )
+        env = {
+            "qvalues": q,
+            "qscales": {keystr: jax.ShapeDtypeStruct((8,), np.float32)},
+        }
+        # int8 slice (64 B) + f32 dequant copy (256 B)
+        assert max_gather_unit_bytes(
+            env, dequant_dtype=np.float32
+        ) == 64 + 256
+        # without a dequant dtype only the gathered int8 bytes count
+        assert max_gather_unit_bytes(env) == 64
+
     def test_sharded_tree_bytes(self, devices8):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1140,8 +1209,10 @@ class TestServingPlansClean:
         )
 
         specs = shipped_serving_plans()
-        assert len(specs) == 7
+        assert len(specs) == 8
         assert "bench:gpt_sharded" in {s.name for s in specs}
+        # r16: the certified multi-query pallas K>0 family
+        assert "bench:gpt_mq_pallas" in {s.name for s in specs}
         for spec in specs:
             findings, stats = analyze_serving_plan_subprocess(
                 spec, REPO, timeout_s=600.0

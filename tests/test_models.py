@@ -20,20 +20,28 @@ class TestRegistry:
             get_model("vgg16")
 
 
+@pytest.fixture(scope="module")
+def resnet18_and_variables():
+    """ONE shared resnet18 init (r16 tier-1 tranche): the class's tests
+    read the same variables tree instead of paying the init compile
+    each."""
+    model = get_model("resnet18", num_classes=10)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), train=False
+    )
+    return model, variables
+
+
 class TestResNet:
-    def test_forward_shapes(self):
-        model = get_model("resnet18", num_classes=10)
+    def test_forward_shapes(self, resnet18_and_variables):
+        model, variables = resnet18_and_variables
         x = jnp.zeros((2, 32, 32, 3))
-        variables = model.init(jax.random.PRNGKey(0), x, train=False)
         logits = model.apply(variables, x, train=False)
         assert logits.shape == (2, 10)
         assert logits.dtype == jnp.float32
 
-    def test_batch_stats_collection_exists(self):
-        model = get_model("resnet18", num_classes=10)
-        variables = model.init(
-            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
-        )
+    def test_batch_stats_collection_exists(self, resnet18_and_variables):
+        _, variables = resnet18_and_variables
         assert "batch_stats" in variables
 
     @pytest.mark.slow  # full resnet50 init just to count params
@@ -46,10 +54,9 @@ class TestResNet:
         # ResNet-50 @1000 classes: ~25.6M params
         assert 25_000_000 < n < 26_100_000, n
 
-    def test_train_mode_updates_stats(self):
-        model = get_model("resnet18", num_classes=10)
-        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
-        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    def test_train_mode_updates_stats(self, resnet18_and_variables):
+        model, variables = resnet18_and_variables
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
         _, updates = model.apply(variables, x, train=True, mutable=["batch_stats"])
         old = variables["batch_stats"]["bn_init"]["mean"]
         new = updates["batch_stats"]["bn_init"]["mean"]

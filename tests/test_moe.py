@@ -78,8 +78,8 @@ class TestSwitchRouting:
 
 
 class TestMoeTrainer:
-    def test_loss_decreases_and_aux_present(self, devices8):
-        tr = moe_trainer(MeshConfig(data=2, expert=4))
+    def test_loss_decreases_and_aux_present(self, moe_ep_trainer):
+        tr = moe_ep_trainer
         data = tr.task.synthetic_data()
         state = tr.init_state()
         from kubeflow_tpu.training.data import make_global_batch
@@ -95,8 +95,8 @@ class TestMoeTrainer:
             assert np.isfinite(m["moe_aux_loss"])
         assert losses[-1] < losses[0]
 
-    def test_expert_weights_sharded_on_expert_axis(self, devices8):
-        tr = moe_trainer(MeshConfig(data=2, expert=4))
+    def test_expert_weights_sharded_on_expert_axis(self, moe_ep_trainer):
+        tr = moe_ep_trainer
         state = tr.init_state()
         specs = {
             jax.tree_util.keystr(path): leaf.sharding.spec
@@ -106,11 +106,19 @@ class TestMoeTrainer:
         assert expert_specs, specs
         assert all("expert" in str(s) for s in expert_specs), expert_specs
 
-    def test_ep_matches_dp_loss(self, devices8):
+    @pytest.mark.slow
+    def test_ep_matches_dp_loss(self, moe_ep_trainer):
         """Same seed/data: expert-parallel and pure-DP must agree numerically
-        — the dispatch all_to_all is a layout change, not a math change."""
+        — the dispatch all_to_all is a layout change, not a math change.
+
+        @slow (r16 tier-1 tranche): the pure-DP twin costs a second full
+        moe-trainer compile; runs unfiltered in the unit-tests CI
+        training step. Tier-1 keeps the cross-mesh loss-parity claim
+        through test_gpt.py::TestGptTrainer::test_tp_matches_dp_loss and
+        the EP layout through test_expert_weights_sharded_on_expert_axis.
+        """
         m_dp = moe_trainer(MeshConfig(data=8)).fit(steps=2, log_every=1)
-        m_ep = moe_trainer(MeshConfig(data=2, expert=4)).fit(steps=2, log_every=1)
+        m_ep = moe_ep_trainer.fit(steps=2, log_every=1)
         assert m_dp.loss == pytest.approx(m_ep.loss, rel=2e-2)
 
     def test_pipeline_plus_moe_trains(self, devices8):

@@ -374,11 +374,13 @@ class TestSpeculativeRewind:
 
 class TestPallasKernel:
     """serving.paged_attention=pallas: the in-place page-table walk
-    (ops/paged_attention.py) replaces the contiguous gather on the
-    one-token step. The contract is the r10 one, unchanged: greedy
-    output BITWISE-identical to the fused-scan oracle — the kernel
-    performs the gather path's exact arithmetic, so switching kernels
-    changes where bytes move, never what is computed."""
+    (ops/paged_attention.py) replaces the contiguous gather — since r16
+    for EVERY window size (the s>1 multi-query variant serves chunk
+    prefill and the K>0 verify; TestMultiQueryKernel pins those). The
+    contract is the r10 one, unchanged: greedy output BITWISE-identical
+    to the fused-scan oracle — the kernel performs the gather path's
+    exact arithmetic, so switching kernels changes where bytes move,
+    never what is computed."""
 
     @pytest.mark.parametrize(
         "page_size",
@@ -432,10 +434,10 @@ class TestPallasKernel:
 
     @pytest.mark.slow
     def test_bitwise_under_speculation(self, gpt_and_params):
-        """K>0: draft one-token steps ride the pallas kernel, the verify
-        window rides the gather path (multi-token windows amortize the
-        gather; the kernel serves the s==1 hot loop) — the composition
-        must still be bitwise the oracle's, hostile draft included."""
+        """K>0: draft one-token steps AND the K+1 verify window all ride
+        the pallas walk (the verify through the multi-query variant,
+        since r16) — the composition must still be bitwise the oracle's,
+        hostile draft included."""
         model, params = gpt_and_params
         dparams = jax.device_get(params)
         dparams["head"]["kernel"] = np.roll(
@@ -484,6 +486,125 @@ class TestPallasKernel:
             )
 
 
+class TestMultiQueryKernel:
+    """r16: s>1 windows ride the SAME pallas page walk as the one-token
+    step — the multi-query variant runs one page traversal for all s
+    query rows (per-query causal clamp inside the window) instead of
+    falling back to the paged_kv_view gather and its view-sized HBM
+    temp. Contract unchanged: bitwise the oracle through chunk-prefill
+    windows and the K>0 verify window; the engine's read-path evidence
+    (stats()["paged_attention_windows"] + the {variant} counter) must
+    show every window size it ran as "pallas"."""
+
+    def test_chunk_windows_bitwise_and_reported(self, gpt_and_params):
+        """A 70-token prompt over buckets [32] admits as head prefill +
+        chunk windows: the s=chunk_len windows route through the
+        multi-query kernel, the decode tail through the s==1 kernel —
+        and the per-window map records both as pallas."""
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "mqc", model, params, num_slots=1, max_queue=4, page_size=8,
+            prefill_buckets=[32], prefix_cache=False,
+            paged_attention="pallas",
+        )
+        try:
+            clen = eng.programs.chunk_len
+            long_row = _rows(70)[0]
+            out = eng.generate_row(long_row, 5, timeout=120)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, long_row, 5)
+        assert stats["paged_attention_windows"] == {
+            1: "pallas", clen: "pallas",
+        }
+        calls = default_registry().get(
+            "serving_paged_attention_calls_total"
+        )
+        assert calls.value(model="mqc", variant="pallas") > 0
+        assert calls.value(model="mqc", variant="gather") == 0
+
+    def test_verify_window_hostile_draft_bitwise(self, gpt_and_params):
+        """K=2 with the rolled-head draft (acceptance provably 0): every
+        verify window rejects its whole overhang through the multi-query
+        kernel, the rewind returns pages, and the stream stays the
+        oracle's. The K+1 window size must show up as pallas."""
+        model, params = gpt_and_params
+        dparams = jax.device_get(params)
+        dparams["head"]["kernel"] = np.roll(
+            np.asarray(dparams["head"]["kernel"]), 1, axis=-1
+        )
+        eng = DecodeEngine(
+            "mqh", model, params, num_slots=1, max_queue=4, page_size=8,
+            prefix_cache=False, draft_model=model, draft_params=dparams,
+            num_draft_tokens=2, paged_attention="pallas",
+        )
+        try:
+            row = _rows(7)[0]
+            out = eng.generate_row(row, 6, timeout=120)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, row, 6)
+        assert stats["paged_attention_windows"].get(3) == "pallas"
+        assert stats["rewind_pages_returned"] > 0
+
+    @pytest.mark.slow
+    def test_verify_window_perfect_draft_bitwise(self, gpt_and_params):
+        """K=3 with a perfect self-draft: maximal acceptance drives the
+        verify window's FULL causal span through the kernel every
+        iteration (the hostile draft only ever keeps one token).
+
+        @slow (r16 tier-1 tranche): runs unfiltered in the serving CI
+        multiquery-pallas-parity step; tier-1 keeps the verify-window
+        kernel contract through test_verify_window_hostile_draft_bitwise
+        (the same window family at acceptance 0)."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "mqp", model, params, num_slots=1, max_queue=4, page_size=8,
+            prefix_cache=False, draft_model=model, draft_params=params,
+            num_draft_tokens=3, paged_attention="pallas",
+        )
+        try:
+            row = _rows(7)[0]
+            out = eng.generate_row(row, 8, timeout=120)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        assert out["tokens"] == _ref_tokens(model, params, row, 8)
+        assert stats["paged_attention_windows"].get(4) == "pallas"
+
+    @pytest.mark.slow
+    def test_chunk_windows_int8_matches_gather_int8(self, gpt_and_params):
+        """Kernel-vs-gather at int8 (no full-width oracle exists): the
+        pallas chunk windows' fused dequant must agree BITWISE with the
+        gather read path's dequant-after-view on the same quantized
+        pool — the bench:gpt_quant program family's parity proof.
+
+        @slow (r16 tier-1 tranche): runs unfiltered in the serving CI
+        multiquery-pallas-parity step; tier-1 keeps the f32 chunk-window
+        contract (test_chunk_windows_bitwise_and_reported) and the int8
+        kernel step contract (test_quantize.py's pallas int8 suite)."""
+        model, params = gpt_and_params
+        long_row = _rows(70)[0]
+        outs = {}
+        for impl in ("gather", "pallas"):
+            eng = DecodeEngine(
+                f"mq8{impl[0]}", model, params, num_slots=1, max_queue=4,
+                page_size=8, prefill_buckets=[32], prefix_cache=False,
+                paged_attention=impl, quantize="int8",
+            )
+            try:
+                outs[impl] = eng.generate_row(
+                    long_row, 5, timeout=120
+                )["tokens"]
+            finally:
+                eng.close()
+        assert outs["pallas"] == outs["gather"]
+
+
 class TestMetricsSurface:
     def test_paged_metrics_registered_and_move(self, gpt_and_params):
         from kubeflow_tpu.utils.metrics import default_registry
@@ -515,6 +636,16 @@ class TestMetricsSurface:
         ).value(**m) == eng.kv_pool_bytes > 0
         # the prefix index is still holding the committed pages
         assert reg.get("serving_kv_pages_in_use").value(**m) > 0
+        # r16 read-path evidence, gather side: a gather engine's decode
+        # steps (window 1) report as variant=gather — the fleet-visible
+        # complement of TestMultiQueryKernel's pallas assertions
+        assert reg.get(
+            "serving_paged_attention_calls_total"
+        ).value(variant="gather", **m) > 0
+        windows = eng.stats()["paged_attention_windows"]
+        assert windows[1] == "gather"  # decode steps
+        # the prefix-hit tail rode chunk windows — same variant
+        assert set(windows.values()) == {"gather"}
 
     def test_debug_state_carries_page_map(self, gpt_and_params):
         model, params = gpt_and_params

@@ -22,6 +22,10 @@ def do_device_work():
 
 
 class TestProfilerService:
+    @pytest.mark.slow  # r16 tier-1 tranche: runs unfiltered in the
+    # unit-tests CI ui-and-images step; tier-1 keeps a real capture
+    # through test_oneshot_capture and the state machine through
+    # test_double_start_and_stray_stop_rejected
     def test_capture_produces_tb_trace(self, tmp_path):
         logdir = str(tmp_path / "traces")
         svc = ProfilerService(logdir)

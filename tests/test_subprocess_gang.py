@@ -56,7 +56,14 @@ def plane():
 
 
 class TestSubprocessGang:
+    @pytest.mark.slow
     def test_gang_of_real_processes_trains_through_controller(self, plane):
+        """@slow (r16 tier-1 tranche): runs unfiltered in the e2e CI
+        platform-e2e step. Tier-1 keeps the happy-path claim through
+        test_killed_member_triggers_real_respawn_with_resume_env (a
+        superset: trains through the controller AND respawns) and
+        test_multiprocess_gang.py::test_two_process_gang_trains_and_agrees.
+        """
         store, runner = plane
         store.create(
             new_tpu_train_job(

@@ -44,6 +44,15 @@ def tiny_bert_trainer(mesh: MeshConfig, batch: int = 8) -> Trainer:
     return Trainer(cfg, task=MlmTask(cfg, seq_len=32, vocab_size=512))
 
 
+@pytest.fixture(scope="module")
+def fsdp_bert_trainer(devices8):
+    """ONE shared data=2 × fsdp=4 bert trainer (r16 tier-1 tranche):
+    TestTrainerFSDP's tests share its compiled init/step programs.
+    Tests must draw fresh state via `init_state()`/`fit()` (both are
+    functional over the instance)."""
+    return tiny_bert_trainer(MeshConfig(data=2, fsdp=4))
+
+
 class TestCrossEntropy:
     def test_matches_manual(self):
         logits = jnp.array([[2.0, 0.0], [0.0, 2.0]])
@@ -71,8 +80,8 @@ class TestTaskAdapters:
 
 
 class TestTrainerDP(object):
-    def test_loss_decreases(self, devices8):
-        tr = tiny_image_trainer(MeshConfig(data=8))
+    def test_loss_decreases(self, image_dp8_trainer):
+        tr = image_dp8_trainer
         data = tr.task.synthetic_data()
         state = tr.init_state()
         rng = jax.random.PRNGKey(0)
@@ -86,16 +95,16 @@ class TestTrainerDP(object):
             losses.append(float(jax.device_get(m["loss"])))
         assert losses[-1] < losses[0]
 
-    def test_params_replicated_under_pure_dp(self, devices8):
-        tr = tiny_image_trainer(MeshConfig(data=8))
+    def test_params_replicated_under_pure_dp(self, image_dp8_trainer):
+        tr = image_dp8_trainer
         state = tr.init_state()
         leaf = jax.tree.leaves(state.params)[0]
         assert leaf.sharding.spec == P()
 
 
 class TestTrainerFSDP:
-    def test_params_sharded(self, devices8):
-        tr = tiny_bert_trainer(MeshConfig(data=2, fsdp=4))
+    def test_params_sharded(self, fsdp_bert_trainer):
+        tr = fsdp_bert_trainer
         state = tr.init_state()
         # the tok embedding [512, 64] should be sharded on fsdp via "embed"->fsdp?
         # embed dim 64 maps dim1; vocab-> tensor (size 1, dropped). Check some
@@ -106,9 +115,8 @@ class TestTrainerFSDP:
         }
         assert any("fsdp" in str(s) for s in specs.values()), specs
 
-    def test_fsdp_step_runs(self, devices8):
-        tr = tiny_bert_trainer(MeshConfig(data=2, fsdp=4))
-        m = tr.fit(steps=2, log_every=1)
+    def test_fsdp_step_runs(self, fsdp_bert_trainer):
+        m = fsdp_bert_trainer.fit(steps=2, log_every=1)
         assert np.isfinite(m.loss)
 
 
@@ -126,18 +134,18 @@ class TestTrainerTP:
 class TestDivergenceAndTaskClamp:
     def test_non_finite_loss_raises(self, devices8):
         """A diverged run must not report success (VERIFY finding: lr=0.1
-        on a transformer produced a 'Succeeded' job with loss=nan)."""
+        on a transformer produced a 'Succeeded' job with loss=nan) —
+        bert_tiny (a transformer, like the original finding) keeps the
+        compile cost a fraction of the resnet trainer's (r16 tranche)."""
         cfg = TrainingConfig(
-            model="resnet18",
-            global_batch_size=16,
+            model="bert_tiny",
+            global_batch_size=8,
             steps=6,
             warmup_steps=1,
             learning_rate=1e12,
             mesh=MeshConfig(data=8),
         )
-        tr = Trainer(cfg, model_kwargs={"num_classes": 10})
-        tr.task.image_size = 32
-        tr.task.num_classes = 10
+        tr = Trainer(cfg)
         with pytest.raises(FloatingPointError, match="non-finite loss"):
             tr.fit(steps=6, log_every=1)
 
@@ -169,8 +177,13 @@ class TestDivergenceAndTaskClamp:
 
 
 class TestCheckpoint:
-    def test_save_restore_roundtrip(self, devices8, tmp_path):
-        tr = tiny_image_trainer(MeshConfig(data=8))
+    @pytest.mark.slow  # r16 tier-1 tranche: runs unfiltered in the
+    # unit-tests CI training step; tier-1 keeps the trainer-level
+    # restore claim through test_resume_continues_training and the
+    # subsystem's own roundtrip/resharding coverage in
+    # test_checkpointing.py
+    def test_save_restore_roundtrip(self, image_dp8_trainer, tmp_path):
+        tr = image_dp8_trainer
         state = tr.init_state()
         mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
         assert mgr.save(1, state)
@@ -187,8 +200,8 @@ class TestCheckpoint:
             mgr.restore({})
         mgr.close()
 
-    def test_resume_continues_training(self, devices8, tmp_path):
-        tr = tiny_image_trainer(MeshConfig(data=8))
+    def test_resume_continues_training(self, image_dp8_trainer, tmp_path):
+        tr = image_dp8_trainer
         mgr = CheckpointManager(str(tmp_path / "c2"), async_save=False)
         state = tr.init_state()
         from kubeflow_tpu.training.data import make_global_batch
