@@ -1527,6 +1527,101 @@ def bench_serving_continuous(
             "hbm_per_request_pool_bytes": pool_bytes // num_slots,
             "hbm_per_request_slot_row_bytes": slot_row_bytes // num_slots,
         }
+
+        # -- restart-warm phase: the persistent prefix store across a
+        # restart (tiered KV; docs/SERVING.md "Tiered KV") --------------
+        # A seed replica commits T template prefixes, takes one hit on
+        # each (hot_chains ranks by hits, so the templates outrank their
+        # single-visit tails), and persists at drain. Two fresh replicas
+        # then serve one templated request per template: "cold" starts
+        # empty — every prompt prefills in full, what a restart costs
+        # without the store — and "warm" points kv_persist_dir at the
+        # seed's store and preloads before serving. Distinct templates
+        # per measured request keep the cold arm honest: its own radix
+        # cannot warm itself across the trace. TTFT is generate_row's
+        # ttft_s (admission latency, the same term the prefix phase
+        # measures); prompts are identical across arms, so the outputs
+        # must match bitwise.
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        rw_templates = 6  # measured; one extra (index 0) warms the jits
+        rw_rng = np.random.default_rng(6)
+        rw_tail = prefix_prompt_len - BENCH_SHARED_PREFIX_LEN
+        rw_prefixes = [
+            rw_rng.integers(0, 50257, (BENCH_SHARED_PREFIX_LEN,))
+            for _ in range(rw_templates + 1)
+        ]
+        rw_seed_prompts = [
+            np.concatenate(
+                [px, rw_rng.integers(0, 50257, (rw_tail,))]
+            ).tolist()
+            for px in rw_prefixes
+        ]
+        rw_prompts = [
+            np.concatenate(
+                [px, rw_rng.integers(0, 50257, (rw_tail,))]
+            ).tolist()
+            for px in rw_prefixes
+        ]
+        # every template chain fits the persist budget: (T+1) prefixes
+        # x their full-page depth
+        rw_chains = (rw_templates + 1) * (
+            BENCH_SHARED_PREFIX_LEN // BENCH_PREFIX_PAGE_SIZE
+        )
+
+        def rw_engine(name, persist=""):
+            return DecodeEngine(
+                name, px_model, px_params, num_slots=num_slots,
+                prefill_buckets=list(BENCH_PREFIX_BUCKETS),
+                page_size=BENCH_PREFIX_PAGE_SIZE, prefix_cache=True,
+                kv_persist_dir=persist or None,
+                kv_persist_chains=rw_chains,
+            )
+
+        rw_store = _tempfile.mkdtemp(prefix="kft-kvstore-")
+        try:
+            seed_eng = rw_engine("gpt_kvseed", persist=rw_store)
+            for i in range(rw_templates + 1):
+                seed_eng.generate_row([rw_prefixes[i].tolist()], 2)
+                seed_eng.generate_row([rw_seed_prompts[i]], 2)
+            seed_eng.drain(deadline_s=30.0)  # final persist at close
+
+            def rw_measure(eng):
+                # index 0 compiles/exercises the arm's own admission
+                # path (miss-shaped on cold, preloaded-hit on warm)
+                eng.generate_row([rw_prompts[0]], prefix_new_tokens)
+                toks, ttfts = [], []
+                for i in range(1, rw_templates + 1):
+                    r = eng.generate_row([rw_prompts[i]], prefix_new_tokens)
+                    toks.append(r["tokens"])
+                    ttfts.append(r["ttft_s"] * 1e3)
+                return toks, float(np.percentile(ttfts, 50))
+
+            cold_eng = rw_engine("gpt_kvcold")
+            cold_toks, cold_p50 = rw_measure(cold_eng)
+            cold_eng.close()
+            warm_eng = rw_engine("gpt_kvwarm", persist=rw_store)
+            rw_preloaded = warm_eng.stats()["kv_persisted_chains"]
+            warm_toks, warm_p50 = rw_measure(warm_eng)
+            rw_hits = warm_eng.stats()["prefix_hit_tokens"]
+            warm_eng.close()
+        finally:
+            _shutil.rmtree(rw_store, ignore_errors=True)
+        restart_warm_ratio = (
+            round(warm_p50 / cold_p50, 3) if cold_p50 else 0.0
+        )
+        restart_warm = {
+            "templates": rw_templates,
+            "prompt_len": prefix_prompt_len,
+            "shared_prefix_len": BENCH_SHARED_PREFIX_LEN,
+            "preloaded_pages": rw_preloaded,
+            "warm_prefix_hit_tokens": rw_hits,
+            "cold_ttft_p50_ms": round(cold_p50, 2),
+            "warm_ttft_p50_ms": round(warm_p50, 2),
+            "restart_warm_ttft_ratio": restart_warm_ratio,
+            "outputs_match": cold_toks == warm_toks,
+        }
     finally:
         server.stop()
         model_server.close()
@@ -1588,6 +1683,10 @@ def bench_serving_continuous(
         "prefix": prefix,
         "prefix_hit_rate": prefix_hit_rate,
         "kv_pages_per_request": pages_per_request,
+        # tiered KV: persisted prefix store across a simulated restart —
+        # warm (preloaded) vs cold TTFT p50 on per-template traffic
+        "restart_warm": restart_warm,
+        "restart_warm_ttft_ratio": restart_warm_ratio,
     }
 
 
@@ -2824,6 +2923,9 @@ _EXTRA_FINAL_KEYS = (
     # paged-KV + prefix cache (serving_continuous prefix phase)
     "prefix_hit_rate",
     "kv_pages_per_request",
+    # tiered KV (serving_continuous restart-warm phase): preloaded vs
+    # cold TTFT p50 — < 1.0 means the store makes restarts warm
+    "restart_warm_ttft_ratio",
     # kft-router fleet phase (serving_router): affinity vs spray
     "router_affinity_hit_rate",
     "router_ttft_p50_speedup",

@@ -398,14 +398,16 @@ def expected_program_names(
 ) -> set:
     """The paged engine's fixed family: one prefill per bucket, one
     page insert, one page-sized chunk-prefill window, one COW page copy,
+    one page spill/upload pair (the host KV tier's device boundary),
     one step — doubled (minus step/verify asymmetries) at K > 0."""
     names = {f"prefill@{b}" for b in buckets} | {
-        "insert", "chunk", "cow", "step",
+        "insert", "chunk", "cow", "spill", "upload", "step",
     }
     if num_draft_tokens > 0:
         names |= {f"draft_prefill@{b}" for b in buckets}
         names |= {
-            "draft_insert", "draft_chunk", "draft_cow", "draft", "verify",
+            "draft_insert", "draft_chunk", "draft_cow", "draft_spill",
+            "draft_upload", "draft", "verify",
         }
     return names
 
@@ -706,6 +708,44 @@ def analyze_serving_plan(
         "budget_bytes": int(budget) if budget else None,
         "temp_measured": step_temp_bytes is not None,
     }
+
+    # -- host KV tier: price the spill budget in pages --------------------
+    # The host tier holds FULL (unsharded) page copies: the spill
+    # program's out-sharding is replicated, so device_get hands every
+    # host the whole page regardless of the pool's heads shard. Every
+    # pool leaf (int8 envelopes AND their scale siblings) carries the
+    # page axis, so one page's host footprint is exactly the pool's
+    # total bytes divided by its page count. A budget smaller than that
+    # admits nothing — each radix evict fires the spill hook and the
+    # tier rejects the entry — the silently-dead-knob class, flagged
+    # here as an ERROR rather than left to a runtime log nobody reads.
+    if spec.kv_host_bytes > 0:
+        entry_bytes = tree_bytes(pool_shapes) // num_pages
+        if draft is not None:
+            entry_bytes += tree_bytes(progs.pool_shapes(dcache_one)) // (
+                num_pages
+            )
+        tier_pages = spec.kv_host_bytes // max(1, entry_bytes)
+        if tier_pages == 0:
+            findings.append(
+                Finding(
+                    analyzer="serve-host-tier",
+                    severity=Severity.ERROR,
+                    location=f"plan:{spec.name}",
+                    message=(
+                        f"kv_host_bytes={spec.kv_host_bytes} is smaller "
+                        f"than one page's host footprint ({entry_bytes} "
+                        "bytes): the spill tier can never admit an entry "
+                        "— raise the budget or set it to 0"
+                    ),
+                    symbol="kv_host_bytes",
+                )
+            )
+        stats["host"] = {
+            "budget_bytes": int(spec.kv_host_bytes),
+            "page_entry_bytes": int(entry_bytes),
+            "pages": int(tier_pages),
+        }
     return findings, stats
 
 

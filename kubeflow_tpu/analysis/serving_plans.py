@@ -106,6 +106,15 @@ class ServingPlanSpec:
     #                                    program-set impact — listed so the
     #                                    registry documents the full knob
     #                                    surface the pod runs)
+    kv_host_bytes: int = 0             # host-RAM spill tier budget (bytes;
+    #                                    0 = tier off). Host-side: no
+    #                                    program-set impact beyond the
+    #                                    spill/upload pair every engine
+    #                                    lowers anyway — but the lint
+    #                                    prices it (serving/analysis
+    #                                    host-tier check: a budget smaller
+    #                                    than one page's host footprint is
+    #                                    a silently-dead knob)
     mesh_tensor: int = 1               # serving mesh: heads-sharded pools
     mesh_fsdp: int = 1                 # serving mesh: fsdp-sharded weights
     num_slices: int = 1                # slices a replica spans: ALWAYS 1

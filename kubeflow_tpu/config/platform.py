@@ -788,6 +788,36 @@ class ServingConfig(ConfigNode):
         "but the accept rate is noise, so drafted serving is SLOWER than "
         "K=0 until real params are supplied.",
     )
+    kv_host_bytes: int = config_field(
+        default=0,
+        help="host-RAM budget (bytes) for the KV spill tier "
+        "(serving/kv_tiers.py): radix-evicted pages park their contents "
+        "in host memory instead of being freed, so a later admission "
+        "for the same prefix is a host-to-device upload, not a "
+        "re-prefill. 0 disables the tier. Rendered as "
+        "KFT_SERVING_KV_HOST_BYTES; the serving lint prices the budget "
+        "against the pod's memory request.",
+    )
+    kv_persist_dir: str = config_field(
+        default="",
+        help="directory for the on-disk persistent prefix store "
+        "(two-phase atomic generations, checkpoint-manifest style): the "
+        "engine periodically persists its hottest committed chains and "
+        "a restarted or newly scaled replica preloads them before "
+        "taking traffic. Empty = no persistence. Point at a volume that "
+        "survives the pod (PVC / mounted bucket).",
+    )
+    kv_persist_interval_s: float = config_field(
+        default=0.0,
+        help="seconds between persistent-prefix snapshots; a final "
+        "snapshot always runs at drain/shutdown. 0 = shutdown-only "
+        "(cheapest; covers rolling restarts, misses crashes).",
+    )
+    kv_persist_chains: int = config_field(
+        default=64,
+        help="max prefix pages per persisted generation, "
+        "hit-count-ranked hottest first (ancestor chains included).",
+    )
     drain_deadline_s: float = config_field(
         default=30.0,
         help="draining-shutdown budget (serving/engine.py drain): on "
@@ -909,6 +939,26 @@ class ServingConfig(ConfigNode):
             )
         if self.num_pages < 0:
             raise ConfigError("serving.num_pages must be >= 0 (0 = auto)")
+        if self.kv_host_bytes < 0:
+            raise ConfigError(
+                "serving.kv_host_bytes must be >= 0 (0 = no host tier)"
+            )
+        if self.kv_persist_interval_s < 0:
+            raise ConfigError(
+                "serving.kv_persist_interval_s must be >= 0 "
+                "(0 = shutdown-only snapshots)"
+            )
+        if self.kv_persist_chains < 1:
+            raise ConfigError("serving.kv_persist_chains must be >= 1")
+        if (
+            self.kv_host_bytes > 0 or self.kv_persist_dir
+        ) and not self.prefix_cache:
+            raise ConfigError(
+                "serving.kv_host_bytes / kv_persist_dir need "
+                "serving.prefix_cache=true: both tiers key off the "
+                "radix index's committed chains (the knobs would be "
+                "silently ignored)"
+            )
 
 
 @dataclasses.dataclass

@@ -116,6 +116,14 @@ class InferenceServiceController(Controller):
             "KFT_SERVING_PAGE_SIZE": str(cfg.page_size),
             "KFT_SERVING_NUM_PAGES": str(cfg.num_pages),
             "KFT_SERVING_PREFIX_CACHE": "1" if cfg.prefix_cache else "0",
+            # tiered KV (serving/kv_tiers.py): host-RAM spill budget and
+            # the on-disk persistent prefix store a warm restart preloads
+            "KFT_SERVING_KV_HOST_BYTES": str(cfg.kv_host_bytes),
+            "KFT_SERVING_KV_PERSIST_DIR": cfg.kv_persist_dir,
+            "KFT_SERVING_KV_PERSIST_INTERVAL_S": (
+                f"{cfg.kv_persist_interval_s:g}"
+            ),
+            "KFT_SERVING_KV_PERSIST_CHAINS": str(cfg.kv_persist_chains),
             # decode read-path kernel + int8 quantization (r13: pallas
             # in-place page walk, int8 weights + KV pages)
             "KFT_SERVING_PAGED_ATTENTION": cfg.paged_attention,

@@ -845,6 +845,44 @@ def copy_pool_page(pool, src, dst, mesh=None):
     return jax.tree.map(cp, pool)
 
 
+def gather_pool_page(pool, page):
+    """Extract page `page` from every pool leaf as a page-axis-free tree
+    — the spill tier's device→host read shape. `page` may be traced
+    int32 so one compiled program serves every spill. Scale siblings of
+    an int8 pool are ordinary leaves and ride along, so a quantized
+    page's envelope (values + scales) is gathered as a unit. Pure data
+    movement: the gathered bits ARE the pool's bits, which is what makes
+    the spill→re-admit round trip bitwise."""
+
+    def gather(leaf):
+        ax = leaf.ndim - 4
+        return jnp.squeeze(
+            jax.lax.dynamic_slice_in_dim(leaf, page, 1, axis=ax), axis=ax
+        )
+
+    return jax.tree.map(gather, pool)
+
+
+def scatter_pool_page(pool, page_tree, dst, mesh=None):
+    """Write a gathered page tree (`gather_pool_page`'s shape) onto page
+    `dst` of every pool leaf — the spill tier's host→device upload and
+    the persistent store's preload. Inverse of `gather_pool_page`: pure
+    data movement, so uploaded bits equal the spilled bits. With a
+    serving `mesh` the written leaves stay head-sharded (same contract
+    as `copy_pool_page`)."""
+    from kubeflow_tpu.parallel.serving_mesh import head_shard
+
+    def scatter(pool_leaf, page_leaf):
+        ax = pool_leaf.ndim - 4
+        page = jnp.expand_dims(page_leaf.astype(pool_leaf.dtype), axis=ax)
+        return head_shard(
+            jax.lax.dynamic_update_slice_in_dim(pool_leaf, page, dst, axis=ax),
+            mesh,
+        )
+
+    return jax.tree.map(scatter, pool, page_tree)
+
+
 class DecoderStage(nn.Module):
     """One pipeline stage: a contiguous run of decoder blocks."""
 

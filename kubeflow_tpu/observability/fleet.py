@@ -149,6 +149,8 @@ AGGREGATION_POLICY: Dict[str, str] = {
     # read-path dispatches by variant label: summed per variant across
     # the fleet, so any "gather" samples from a pallas fleet stand out
     "serving_paged_attention_calls_total": "sum",
+    "serving_kv_spill_hits_total": "sum",
+    "serving_kv_spill_pages_total": "sum",
     "serving_prefix_cache_hit_tokens_total": "sum",
     "serving_prefix_cache_lookups_total": "sum",
     "serving_requests_total": "sum",
@@ -186,6 +188,10 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "notebook_running": "sum",
     "serving_kv_pages_in_use": "sum",
     "serving_kv_pages_total": "sum",
+    # last persisted-generation size: a restart-warmth indicator, not a
+    # capacity — the fleet-wide "how warm can a restart get" is the
+    # LARGEST snapshot any replica committed, so max, not sum
+    "serving_kv_persisted_chains": "max",
     "serving_kv_pool_bytes": "sum",
     # per-chip pool bytes: the HBM-budget-limiting value — max, not sum
     # (summing per-chip bytes across replicas describes no real chip)

@@ -117,8 +117,11 @@ from kubeflow_tpu.utils.metrics import (
     serving_engine_recoveries_counter,
     serving_kv_pages_in_use_gauge,
     serving_kv_pages_total_gauge,
+    serving_kv_persisted_chains_gauge,
     serving_kv_pool_bytes_gauge,
     serving_kv_pool_bytes_per_chip_gauge,
+    serving_kv_spill_hits_counter,
+    serving_kv_spill_pages_counter,
     serving_num_slots_gauge,
     serving_paged_attention_calls_counter,
     serving_phase_histogram,
@@ -228,6 +231,7 @@ def auto_num_pages(num_slots: int, max_len: int, page_size: int) -> int:
 def resolve_num_pages(
     num_pages, num_slots: int, model_cfg, page_size: int,
     quantize: str = "none", mesh_tensor: int = 1,
+    telemetry=None,
 ) -> int:
     """The ONE pool-sizing rule, shared by the live engine and
     kft-analyze's serving lint (analysis/serving.py) so the pool the
@@ -236,10 +240,29 @@ def resolve_num_pages(
     scales by PER-CHIP bytes — at quantize=int8 the page capacity
     ratio (~2x pages in the same HBM), and on a tensor-sharded mesh
     the shard count (each chip holds 1/tensor of every page's heads,
-    so the same per-chip budget holds tensor× the pages)."""
+    so the same per-chip budget holds tensor× the pages).
+
+    `telemetry` (serving/kv_tiers.py `pool_sizing_telemetry`) feeds the
+    LIVE pressure of the previous engine incarnation into the auto
+    fraction: low observed utilization shrinks the pool toward 1/2 of
+    the slot-row footprint (HBM handed back to params/temps), high
+    utilization or a hot prefix cache keeps the full 3/4. The static
+    3/4 stays the CEILING — the mem-budget lint prices that bound, so a
+    telemetry-sized pool can only ever be cheaper than what the lint
+    approved — and the one-full-request floor still applies."""
     if num_pages:
         return int(num_pages)
     pages = auto_num_pages(num_slots, model_cfg.max_len, page_size)
+    if telemetry:
+        util = float(telemetry.get("pages_utilization", 1.0))
+        hit = float(telemetry.get("prefix_hit_rate", 0.0))
+        # demand signal: observed occupancy plus headroom for the reuse
+        # the prefix cache converts into residency; clamped to
+        # [1/2, 3/4] of the slot-row footprint (never above the static
+        # ceiling, never below half)
+        frac = min(0.75, max(0.5, util * 1.25 + 0.25 * hit))
+        per_slot = model_cfg.max_len // page_size
+        pages = max(per_slot, int(num_slots * per_slot * frac))
     if quantize == "int8":
         head_dim = model_cfg.hidden_size // model_cfg.num_heads
         pages = int(
@@ -364,7 +387,7 @@ class PagePool:
 
 
 class _RadixNode:
-    __slots__ = ("chunk", "page", "children", "parent", "last_used")
+    __slots__ = ("chunk", "page", "children", "parent", "last_used", "hits")
 
     def __init__(self, chunk, page, parent):
         self.chunk = chunk          # tuple of page_size token ids
@@ -372,6 +395,20 @@ class _RadixNode:
         self.parent = parent
         self.children: Dict[tuple, "_RadixNode"] = {}
         self.last_used = 0
+        self.hits = 0               # full-page match count — persist rank
+
+    def key(self) -> tuple:
+        """The page-aligned token prefix this node commits — the spill
+        tier's and the persistent store's entry key."""
+        parts = []
+        node = self
+        while node.chunk is not None:
+            parts.append(node.chunk)
+            node = node.parent
+        out = []
+        for chunk in reversed(parts):
+            out.extend(chunk)
+        return tuple(out)
 
 
 class RadixPrefixIndex:
@@ -400,6 +437,11 @@ class RadixPrefixIndex:
         # leaves maintained incrementally: eviction scans only these,
         # never the whole tree
         self._leaves: Dict[_RadixNode, None] = {}
+        # spill hook (serving/kv_tiers.py): called with (token_key, page)
+        # just before eviction releases the tree's LAST reference to a
+        # page — the engine's chance to park the page contents in the
+        # host tier before the pool reclaims the HBM. None = spill off.
+        self.spill_hook = None
 
     def reset(self) -> None:
         self.root = _RadixNode(None, -1, None)
@@ -422,6 +464,7 @@ class RadixPrefixIndex:
             if child is None:
                 break
             child.last_used = self._clock
+            child.hits += 1
             pages.append(child.page)
             node = child
             i += ps
@@ -489,8 +532,46 @@ class RadixPrefixIndex:
                 self._leaves[parent] = None
             self.nodes -= 1
             self.pool.unmark_tree(victim.page)
+            if (
+                self.spill_hook is not None
+                and self.pool.refcount(victim.page) == 1
+            ):
+                # the release below frees the page (tree held the last
+                # ref) — park its contents in the host tier first, keyed
+                # by the full page-aligned prefix it committed
+                self.spill_hook(victim.key(), victim.page, victim.hits)
             freed += self.pool.release([victim.page])
         return freed
+
+    def hot_chains(self, limit: int) -> List[Tuple[tuple, int, int]]:
+        """The hit-count-ranked persist set: up to `limit` committed
+        nodes as (token_key, page, hits), hottest first, each preceded
+        by every ancestor on its chain (the store's loader admits
+        parents before children). Walks the whole tree — persist
+        cadence is seconds, not steps."""
+        nodes: List[_RadixNode] = []
+        stack = list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            nodes.append(node)
+            stack.extend(node.children.values())
+        nodes.sort(key=lambda n: n.hits, reverse=True)
+        chosen: Dict[_RadixNode, None] = {}
+        for node in nodes:
+            if len(chosen) >= limit:
+                break
+            chain = []
+            walk = node
+            while walk.chunk is not None and walk not in chosen:
+                chain.append(walk)
+                walk = walk.parent
+            if len(chosen) + len(chain) > limit:
+                continue
+            for n in chain:
+                chosen[n] = None
+        out = [(n.key(), n.page, n.hits) for n in chosen]
+        out.sort(key=lambda e: len(e[0]))
+        return out
 
 
 class ProgramSignature(NamedTuple):
@@ -709,11 +790,19 @@ class EnginePrograms:
         self.chunk = self._jit(self._chunk_fn, (1,), (psh, rep))
         self.cow = self._jit(self._cow_fn, (0,), psh)
         self.step = self._jit(self._step_fn, (1,), (psh, rep))
+        # tier programs (serving/kv_tiers.py): spill gathers one page to
+        # a replicated page tree (device→host read shape; the pool must
+        # stay resident, so NO donation), upload scatters a page tree
+        # onto a pool page (donates the pool like every other writer)
+        self.spill = self._jit(self._spill_fn, (), rep)
+        self.upload = self._jit(self._upload_fn, (0,), psh)
         if self.num_draft_tokens > 0:
             self.draft_prefill = jax.jit(self._draft_prefill_fn)
             self.draft_insert = self._jit(self._insert_fn, (0,), dsh)
             self.draft_chunk = self._jit(self._draft_chunk_fn, (1,), dsh)
             self.draft_cow = self._jit(self._cow_fn, (0,), dsh)
+            self.draft_spill = self._jit(self._spill_fn, (), rep)
+            self.draft_upload = self._jit(self._upload_fn, (0,), dsh)
             self.draft = self._jit(
                 self._draft_fn, (1,), (dsh, rep, rep)
             )
@@ -725,6 +814,8 @@ class EnginePrograms:
             self.draft_insert = None
             self.draft_chunk = None
             self.draft_cow = None
+            self.draft_spill = None
+            self.draft_upload = None
             self.draft = None
             self.verify = None
 
@@ -744,6 +835,16 @@ class EnginePrograms:
         from kubeflow_tpu.models.gpt import copy_pool_page
 
         return copy_pool_page(pool, src, dst, mesh=self.mesh)
+
+    def _spill_fn(self, pool, page):
+        from kubeflow_tpu.models.gpt import gather_pool_page
+
+        return gather_pool_page(pool, page)
+
+    def _upload_fn(self, pool, page_tree, dst):
+        from kubeflow_tpu.models.gpt import scatter_pool_page
+
+        return scatter_pool_page(pool, page_tree, dst, mesh=self.mesh)
 
     def _paged(self, page_table, cursors):
         from kubeflow_tpu.models.gpt import PagedState
@@ -1170,6 +1271,30 @@ class EnginePrograms:
             (pool, sds((), i32), sds((), i32)),
             (0,), cache_io=((0, -1, False),),
         ))
+
+        # gathered-page abstract: one page of every pool leaf with the
+        # page axis dropped, replicated (the spill output / upload input
+        # crosses the host boundary, so it is never sharded)
+        def page_tree_of(pool_tree):
+            def drop(leaf):
+                ax = leaf.ndim - 4
+                return jax.ShapeDtypeStruct(
+                    leaf.shape[:ax] + leaf.shape[ax + 1:], leaf.dtype
+                )
+
+            return rep_tree(jax.tree.map(drop, pool_tree))
+
+        page_one = page_tree_of(pool)
+        sigs.append(ProgramSignature(
+            "spill", "spill", self.spill,
+            (pool, sds((), i32)),
+            (), cache_io=((0, -1, False),),
+        ))
+        sigs.append(ProgramSignature(
+            "upload", "upload", self.upload,
+            (pool, page_one, sds((), i32)),
+            (0,), cache_io=((0, -1, False),),
+        ))
         sigs.append(ProgramSignature(
             "step", "step", self.step,
             (params, pool, vec(i32), pt, vec(i32), keys, vec(i32),
@@ -1212,6 +1337,17 @@ class EnginePrograms:
             sigs.append(ProgramSignature(
                 "draft_cow", "draft_cow", self.draft_cow,
                 (dpool, sds((), i32), sds((), i32)),
+                (0,), cache_io=((0, -1, True),),
+            ))
+            dpage_one = page_tree_of(dpool)
+            sigs.append(ProgramSignature(
+                "draft_spill", "draft_spill", self.draft_spill,
+                (dpool, sds((), i32)),
+                (), cache_io=((0, -1, True),),
+            ))
+            sigs.append(ProgramSignature(
+                "draft_upload", "draft_upload", self.draft_upload,
+                (dpool, dpage_one, sds((), i32)),
                 (0,), cache_io=((0, -1, True),),
             ))
             sigs.append(ProgramSignature(
@@ -1309,6 +1445,11 @@ class DecodeEngine:
         quantize: Optional[str] = None,
         mesh_tensor: Optional[int] = None,
         mesh_fsdp: Optional[int] = None,
+        kv_host_bytes: int = 0,
+        kv_persist_dir: Optional[str] = None,
+        kv_persist_interval_s: float = 0.0,
+        kv_persist_chains: int = 64,
+        pool_telemetry=None,
     ):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -1351,7 +1492,7 @@ class DecodeEngine:
         # and on a tensor mesh the per-chip shard count
         pool_pages = resolve_num_pages(
             num_pages, num_slots, cfg, ps, self.quantize,
-            self.mesh_tensor,
+            self.mesh_tensor, telemetry=pool_telemetry,
         )
         # the jitted program family (and the draft-compat + page-geometry
         # + mesh-divisibility validation) lives in EnginePrograms — the
@@ -1449,6 +1590,25 @@ class DecodeEngine:
             if self.prefix_cache_enabled
             else None
         )
+        # -- KV tiers (serving/kv_tiers.py): host-RAM spill below the
+        # pool, on-disk persistence below that — both keyed by the same
+        # page-aligned token tuples the radix index commits
+        self.kv_host_bytes = int(kv_host_bytes or 0)
+        self.kv_persist_dir = kv_persist_dir or None
+        self.kv_persist_interval_s = float(kv_persist_interval_s or 0.0)
+        self.kv_persist_chains = int(kv_persist_chains)
+        self._host_tier = None
+        self._persist_store = None
+        if self._radix is not None and self.kv_host_bytes > 0:
+            from kubeflow_tpu.serving.kv_tiers import HostKVTier
+
+            self._host_tier = HostKVTier(self.kv_host_bytes)
+            self._radix.spill_hook = self._spill_page
+        if self._radix is not None and self.kv_persist_dir:
+            from kubeflow_tpu.serving.kv_tiers import PersistentPrefixStore
+
+            self._persist_store = PersistentPrefixStore(self.kv_persist_dir)
+        self._last_persist = time.monotonic()
         self._pt_np = np.zeros((num_slots, self._max_pages), np.int32)
         # parked cursor = max_len: the paged write masks positions past
         # the logical window, so idle/retired rows write nothing
@@ -1504,6 +1664,9 @@ class DecodeEngine:
         # sprayed" is answered orders of magnitude below the cap.
         self._first_page_keys: set = set()
         self._cow_copies = 0
+        self._spill_pages = 0
+        self._spill_hits = 0
+        self._persisted_chains = 0
         self._prefill_compute_tokens = 0
         self._pages_allocated = 0
         self._rewind_pages_returned = 0
@@ -1544,6 +1707,10 @@ class DecodeEngine:
         self._pages_in_use_g = serving_kv_pages_in_use_gauge()
         self._pages_total_g = serving_kv_pages_total_gauge()
         self._pool_bytes_g = serving_kv_pool_bytes_gauge()
+        self._spill_pages_m = serving_kv_spill_pages_counter()
+        self._spill_hits_m = serving_kv_spill_hits_counter()
+        self._persisted_chains_g = serving_kv_persisted_chains_gauge()
+        self._persisted_chains_g.set(0, model=name)
         self._queue_depth.set(0, model=name)
         self._occupancy.set(0.0, model=name)
         # exported capacity: fleet-level ratios (queue/slots SLO rules,
@@ -1569,6 +1736,12 @@ class DecodeEngine:
         self.kv_pool_bytes_per_chip = self.kv_pool_bytes // self.mesh_tensor
         self._pool_bytes_chip_g = serving_kv_pool_bytes_per_chip_gauge()
         self._pool_bytes_chip_g.set(self.kv_pool_bytes_per_chip, model=name)
+
+        # warm restart: preload the persisted hot chains into the pool +
+        # radix index BEFORE the scheduler starts, so the first admitted
+        # request already sees them as prefix hits
+        if self._persist_store is not None:
+            self._preload_persisted()
 
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"decode-engine-{name}"
@@ -1753,6 +1926,17 @@ class DecodeEngine:
                 # routing/affinity.py): the per-replica key-space slice
                 "first_page_hashes": len(self._first_page_keys),
                 "cow_copies": self._cow_copies,
+                # KV tiers (serving/kv_tiers.py): pages parked in host
+                # RAM at eviction, pages re-admitted from there, and the
+                # chain count in the last persisted generation
+                "kv_spill_pages": self._spill_pages,
+                "kv_spill_hits": self._spill_hits,
+                "kv_host_tier": (
+                    self._host_tier.stats()
+                    if self._host_tier is not None
+                    else None
+                ),
+                "kv_persisted_chains": self._persisted_chains,
                 "prefill_compute_tokens": self._prefill_compute_tokens,
                 "pages_allocated": self._pages_allocated,
                 "rewind_pages_returned": self._rewind_pages_returned,
@@ -1827,6 +2011,12 @@ class DecodeEngine:
             "kv_pool_bytes_per_chip": self.kv_pool_bytes_per_chip,
             "prefix_cache": self.prefix_cache_enabled,
             "prefix_nodes": self._radix.nodes if self._radix else 0,
+            "kv_host_tier": (
+                self._host_tier.stats()
+                if self._host_tier is not None
+                else None
+            ),
+            "kv_persist_dir": self.kv_persist_dir,
             "slots": slots,
             "recent": recent,
             "stats": self.stats(),
@@ -2011,6 +2201,187 @@ class DecodeEngine:
     def _update_page_gauges(self) -> None:
         self._pages_in_use_g.set(self._pagepool.in_use, model=self.name)
 
+    # -- KV tiers (serving/kv_tiers.py; scheduler thread only) -------------
+
+    def _spill_page(self, key, page: int, hits: int) -> None:
+        """Radix eviction's spill hook: the tree is about to release the
+        LAST reference to `page` — park its contents (target and, at
+        K>0, draft pools; int8 values with their scale siblings) in the
+        host tier, keyed by the page-aligned prefix it committed. The
+        gather is pure data movement, so a later re-admission uploads
+        the identical bits (the bitwise-parity contract)."""
+        from kubeflow_tpu.serving.kv_tiers import PageEntry
+
+        target = jax.device_get(
+            self.programs.spill(self._pool, jnp.int32(page))
+        )
+        draft = None
+        if self._draft_pool is not None:
+            draft = jax.device_get(
+                self.programs.draft_spill(self._draft_pool, jnp.int32(page))
+            )
+        if self._host_tier.put(key, PageEntry(target, draft, hits=hits)):
+            with self._stats_lock:
+                self._spill_pages += 1
+            self._spill_pages_m.inc(model=self.name)
+
+    def _upload_entry(self, entry, dst: int) -> None:
+        """Scatter one host-tier page onto pool page `dst` — target and
+        (at K>0) draft pools in lockstep, like every other write path.
+        The upload program donates the pool, so this is the same
+        consume-and-replace discipline as insert/cow/step."""
+        self._pool = self.programs.upload(
+            self._pool, entry.target, jnp.int32(dst)
+        )
+        if self._draft_pool is not None and entry.draft is not None:
+            self._draft_pool = self.programs.draft_upload(
+                self._draft_pool, entry.draft, jnp.int32(dst)
+            )
+
+    def _page_template(self, pool):
+        """Abstract one-page tree of `pool` (page axis dropped) — the
+        shape/dtype contract persisted entries must rebuild against."""
+        def drop(leaf):
+            ax = leaf.ndim - 4
+            return jax.ShapeDtypeStruct(
+                leaf.shape[:ax] + leaf.shape[ax + 1:], leaf.dtype
+            )
+
+        return jax.tree.map(drop, pool)
+
+    def _preload_persisted(self) -> None:
+        """Warm restart: load the persisted hot chains into the pool +
+        radix index before the scheduler takes traffic. Every preloaded
+        page lands tree-only (refcount 1, evictable) — pool pressure
+        from real traffic reclaims it LRU like any other committed
+        chain. ANY defect — torn store, shape drift, pool too small —
+        degrades to a cold start (reset + keep serving), never a crash
+        loop."""
+        from kubeflow_tpu.serving.kv_tiers import tree_from_flat
+
+        entries = self._persist_store.load(self.page_size, self.quantize)
+        if not entries:
+            return
+        ps = self.page_size
+        template = self._page_template(self._pool)
+        dtemplate = (
+            self._page_template(self._draft_pool)
+            if self._draft_pool is not None
+            else None
+        )
+        loaded = 0
+        try:
+            # entries arrive parents-first (sorted by chain length);
+            # chains whose parent was skipped (or never stored) are
+            # orphans and are skipped too — the radix index can only
+            # extend committed prefixes
+            path_pages: Dict[tuple, List[int]] = {(): []}
+            for ent in entries:
+                tokens = ent["tokens"]
+                if len(tokens) < ps or len(tokens) % ps:
+                    continue
+                parent_chain = path_pages.get(tokens[:-ps])
+                if parent_chain is None:
+                    continue
+                if self._draft_pool is not None and ent["draft"] is None:
+                    continue  # store predates the draft model: skip
+                # keep one full request's worth of pages free so the
+                # first admissions never queue behind the preload
+                if self._pagepool.free_count <= self._max_pages:
+                    break
+                target = tree_from_flat(template, ent["target"])
+                draft = (
+                    tree_from_flat(dtemplate, ent["draft"])
+                    if dtemplate is not None
+                    else None
+                )
+                pg = self._alloc_pages(1)[0]
+                self._pool = self.programs.upload(
+                    self._pool, target, jnp.int32(pg)
+                )
+                if draft is not None:
+                    self._draft_pool = self.programs.draft_upload(
+                        self._draft_pool, draft, jnp.int32(pg)
+                    )
+                chain = parent_chain + [pg]
+                self._radix.insert(np.asarray(tokens, np.int32), chain)
+                # drop the alloc reference: the tree's reference (from
+                # insert) keeps the page; it frees under eviction
+                self._pagepool.release([pg])
+                path_pages[tokens] = chain
+                loaded += 1
+                # restore the persisted heat so the next persist round
+                # ranks restored chains against fresh traffic fairly
+                node = self._radix.root
+                for i in range(0, len(tokens), ps):
+                    node = node.children[tokens[i : i + ps]]
+                node.hits = ent["hits"]
+        except Exception:  # noqa: BLE001 — cold start beats crash loop
+            log.exception(
+                "engine %s: persisted prefix preload failed; starting "
+                "cold", self.name,
+            )
+            self._pagepool.reset()
+            self._radix.reset()
+            loaded = 0
+        if loaded:
+            log.info(
+                "engine %s: preloaded %d persisted prefix page(s)",
+                self.name, loaded,
+            )
+        with self._stats_lock:
+            self._persisted_chains = loaded
+        self._persisted_chains_g.set(loaded, model=self.name)
+        self._update_page_gauges()
+
+    def _maybe_persist(self, final: bool = False) -> None:
+        """Persist the hit-count-ranked hottest committed chains via the
+        two-phase store. Rides the scheduler thread (the spill reads and
+        the radix walk both touch scheduler-owned state); `final` is the
+        shutdown snapshot drain()/close() trigger, interval-gated
+        otherwise. A failed persist (disk full, permissions) logs and
+        keeps serving — persistence is an optimization, never a
+        liveness dependency."""
+        if self._persist_store is None or self._radix is None:
+            return
+        now = time.monotonic()
+        if not final:
+            if (
+                self.kv_persist_interval_s <= 0
+                or now - self._last_persist < self.kv_persist_interval_s
+            ):
+                return
+        self._last_persist = now
+        chains = self._radix.hot_chains(self.kv_persist_chains)
+        if not chains:
+            return
+        entries = []
+        for key, page, hits in chains:
+            target = jax.device_get(
+                self.programs.spill(self._pool, jnp.int32(page))
+            )
+            draft = None
+            if self._draft_pool is not None:
+                draft = jax.device_get(
+                    self.programs.draft_spill(
+                        self._draft_pool, jnp.int32(page)
+                    )
+                )
+            entries.append((key, target, draft, hits))
+        try:
+            self._persist_store.persist(
+                entries, self.page_size, self.quantize, model=self.name
+            )
+        except Exception:  # noqa: BLE001 — persistence is best-effort
+            log.exception(
+                "engine %s: prefix-store persist failed; continuing "
+                "without a fresh snapshot", self.name,
+            )
+            return
+        with self._stats_lock:
+            self._persisted_chains = len(entries)
+        self._persisted_chains_g.set(len(entries), model=self.name)
+
     # -- scheduler loop ----------------------------------------------------
 
     def _note_attn(self, window: int) -> None:
@@ -2056,11 +2427,32 @@ class DecodeEngine:
                 self._prefix_lookups += 1
             self._prefix_lookups_m.inc(model=self.name)
             chain, full_m, partial = self._radix.match(prompt)
+            # host-tier probe (serving/kv_tiers.py): spilled chunks that
+            # CONTINUE the radix match re-admit as host→device page
+            # uploads instead of chunk-prefill compute. Probe-only here
+            # (`in` never mutates the LRU order-of-life); the entries
+            # are pulled after the hit-threshold verdict below.
+            tier_pages = 0
+            if self._host_tier is not None and len(self._host_tier):
+                while (
+                    full_m + (tier_pages + 1) * ps <= p
+                    and tuple(
+                        int(t)
+                        for t in prompt[: full_m + (tier_pages + 1) * ps]
+                    ) in self._host_tier
+                ):
+                    tier_pages += 1
             # never map the WHOLE prompt: the last real token must run
             # through a chunk window to produce the first-token logits
-            m = min(
-                full_m + (partial[1] if partial is not None else 0), p - 1
-            )
+            if tier_pages > 0:
+                # tier chunks extend past the radix frontier, so the
+                # frontier's partial (if any) is superseded
+                m = min(full_m + tier_pages * ps, p - 1)
+            else:
+                m = min(
+                    full_m + (partial[1] if partial is not None else 0),
+                    p - 1,
+                )
             if not (
                 m * 2 >= p
                 or (p > self.prefill_buckets[-1]
@@ -2078,33 +2470,80 @@ class DecodeEngine:
                 # rides chunk windows on the miss path too, so the hit
                 # strictly removes windows).
                 m = 0
+                tier_pages = 0
             q, r = divmod(m, ps)
-            for pg in chain[:q]:
+            n_radix = min(q, len(chain))
+            # pull the host copies NOW, before any page alloc below can
+            # trigger eviction→spill and LRU-rotate the tier under the
+            # probe: full chunks LEAVE the tier (the radix insert below
+            # re-commits them in HBM), the boundary chunk is peeked —
+            # its upload below is a private copy, so the shared host
+            # entry stays parked for other requests
+            tier_entries: List = []
+            tier_boundary = None
+            if tier_pages > 0:
+                for c in range(n_radix, q):
+                    tier_entries.append(
+                        self._host_tier.take(
+                            tuple(int(t) for t in prompt[: (c + 1) * ps])
+                        )
+                    )
+                if r > 0:
+                    tier_boundary = self._host_tier.get(
+                        tuple(int(t) for t in prompt[: (q + 1) * ps])
+                    )
+            for pg in chain[:n_radix]:
                 self._pagepool.retain([pg])
                 self._pt_np[slot_idx, len(pages)] = pg
                 pages.append(pg)
+            self._slot_pages[slot_idx] = pages  # alloc accounting
+            tier_hits = 0
+            for entry in tier_entries:
+                dst = self._alloc_pages(1)[0]
+                self._upload_entry(entry, dst)
+                self._pt_np[slot_idx, len(pages)] = dst
+                pages.append(dst)
+                tier_hits += 1
+            if tier_hits:
+                # commit the promoted chunks: existing radix chunks keep
+                # their page, uploaded chunks adopt theirs with a tree
+                # reference — the next admission for this prefix matches
+                # straight from HBM
+                self._radix.insert(prompt[: q * ps], pages[:q])
             shared = q
             matched = q * ps
             if r > 0:
-                # copy-on-write at the divergence/extension boundary:
-                # this slot will WRITE into the page's tail, so it gets
-                # its own copy; the donor page (and every other slot or
-                # tree reference) stays untouched
-                src = chain[q] if q < len(chain) else partial[0]
-                self._slot_pages[slot_idx] = pages  # alloc accounting
-                dst = self._alloc_pages(1)[0]
-                self._pool = self._cow(
-                    self._pool, jnp.int32(src), jnp.int32(dst)
-                )
-                if self.num_draft_tokens > 0:
-                    self._draft_pool = self._draft_cow(
-                        self._draft_pool, jnp.int32(src), jnp.int32(dst)
+                if tier_boundary is not None:
+                    # full-coverage tier hit capped at p-1: the boundary
+                    # chunk is a parked host page; its upload IS the
+                    # private copy (no COW program needed)
+                    dst = self._alloc_pages(1)[0]
+                    self._upload_entry(tier_boundary, dst)
+                    tier_hits += 1
+                else:
+                    # copy-on-write at the divergence/extension boundary:
+                    # this slot will WRITE into the page's tail, so it
+                    # gets its own copy; the donor page (and every other
+                    # slot or tree reference) stays untouched
+                    src = chain[q] if q < len(chain) else partial[0]
+                    dst = self._alloc_pages(1)[0]
+                    self._pool = self._cow(
+                        self._pool, jnp.int32(src), jnp.int32(dst)
                     )
+                    if self.num_draft_tokens > 0:
+                        self._draft_pool = self._draft_cow(
+                            self._draft_pool, jnp.int32(src),
+                            jnp.int32(dst),
+                        )
+                    with self._stats_lock:
+                        self._cow_copies += 1
                 self._pt_np[slot_idx, len(pages)] = dst
                 pages.append(dst)
                 matched = q * ps + r
+            if tier_hits:
                 with self._stats_lock:
-                    self._cow_copies += 1
+                    self._spill_hits += tier_hits
+                self._spill_hits_m.inc(tier_hits, model=self.name)
             if matched:
                 self._prefix_hits_m.inc(matched, model=self.name)
                 with self._stats_lock:
@@ -2307,7 +2746,9 @@ class DecodeEngine:
         be a donated tombstone — reset the page allocator and the prefix
         index (their page ids described the dead pools), and keep
         scheduling: queued requests were never admitted and remain
-        servable."""
+        servable. The host KV tier is KEPT: its entries are token-keyed
+        host copies, independent of any pool's page ids — after the
+        rebuild they re-admit exactly as before."""
         log.exception(
             "engine %s decode iteration failed; failing %d resident "
             "request(s) and rebuilding the KV pool(s)",
@@ -2344,6 +2785,14 @@ class DecodeEngine:
         self._update_page_gauges()
 
     def _loop(self) -> None:
+        # with the persistent store on an interval, the idle wait is
+        # timed so a quiet engine still takes its periodic snapshot
+        wait_s = (
+            min(1.0, self.kv_persist_interval_s)
+            if self._persist_store is not None
+            and self.kv_persist_interval_s > 0
+            else None
+        )
         while True:
             with self._cv:
                 while (
@@ -2351,13 +2800,21 @@ class DecodeEngine:
                     and not self._queue
                     and not any(s is not None for s in self._slots)
                 ):
-                    self._cv.wait()
-                if self._stop:
-                    return  # close() drains the queue and the slot table
+                    if not self._cv.wait(timeout=wait_s):
+                        break  # idle persist tick
+                stop = self._stop
+            if stop:
+                # shutdown snapshot: drain()→close() lands here with the
+                # radix still warm — exactly the hot set a restarted
+                # replica preloads. close() then drains the queue and
+                # the slot table.
+                self._maybe_persist(final=True)
+                return
             try:
                 self._iterate()
             except BaseException as e:  # noqa: BLE001 - thread must live
                 self._recover(e)
+            self._maybe_persist()
 
     def _iterate(self) -> None:
         # retire finished slots, then refill FIFO from the queue — each

@@ -1,0 +1,423 @@
+"""Tiered KV storage under the decode engine: host-RAM spill + disk prefix store.
+
+The paged KV pool (serving/engine.py) is HBM-resident and dies with the
+engine; the radix prefix index only ever *maps* pages that are still in
+the pool. This module adds the two colder tiers and the telemetry feed
+that sizes the hot one:
+
+  HBM page pool  --evict-->  HostKVTier  --persist-->  PersistentPrefixStore
+   (PagePool)    <--upload--  (host RAM)  <--preload--     (on disk)
+
+* `HostKVTier` — a bounded LRU pool of page *contents* on host RAM.
+  When radix eviction is about to free the last reference to a shared
+  page, the engine gathers the page (device→host, int8 envelope and its
+  bf16 scale siblings intact) and parks it here, keyed by the chain's
+  page-aligned token tuple. A later admission for the same prefix is a
+  host→device upload plus a refcount map — not a re-prefill.
+
+* `PersistentPrefixStore` — the hottest committed chains, persisted with
+  the checkpointing subsystem's two-phase rename-atomic commit protocol
+  (checkpointing/layout.py): entry files first, one directory fsync,
+  manifest last. A generation directory is committed iff its manifest
+  exists, so a restarted or newly scaled replica can never preload a
+  torn store — any defect (missing file, bad JSON, shape mismatch)
+  degrades to a cold start, never a crash loop.
+
+* `pool_sizing_telemetry` — reads the process metrics registry
+  (`serving_kv_pages_in_use` / `serving_kv_pages_total` /
+  `serving_prefix_cache_*`) so `resolve_num_pages` can size the next
+  engine's pool from the last engine's observed pressure instead of the
+  static 3/4 heuristic alone.
+
+Parity contract: both round trips (evict→spill→re-admit, and
+persist→restart→preload) reproduce page bytes exactly — uploads place
+the identical K/V (and scale) values the pages held, so greedy decode
+output is BITWISE the always-resident engine's (tests/test_kv_tiers.py).
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import shutil
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..checkpointing import layout
+
+logger = logging.getLogger("kubeflow_tpu.serving.kv_tiers")
+
+# Token-tuple key for one page-aligned prefix chain.
+TokenKey = Tuple[int, ...]
+
+STORE_KIND = "kv-prefix-store"
+
+
+def _tree_host_arrays(tree) -> Dict[str, np.ndarray]:
+    """Flatten a page tree to {'/'-joined leaf path: host ndarray}."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {
+        layout.path_str(path): np.asarray(leaf) for path, leaf in leaves
+    }
+
+
+def tree_from_flat(template, flat: Dict[str, np.ndarray]):
+    """Rebuild a page tree shaped like `template` from a flat leaf dict.
+
+    Raises KeyError/ValueError on any missing leaf or shape/dtype
+    mismatch — callers treat that as a torn entry and fall back cold.
+    """
+    import jax
+
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    rebuilt = []
+    for path, leaf in paths_and_leaves:
+        key = layout.path_str(path)
+        arr = flat[key]
+        want = np.dtype(leaf.dtype)
+        if arr.dtype.kind == "V" and arr.dtype.itemsize == want.itemsize:
+            # npz stores extension dtypes (bfloat16) as raw void bytes;
+            # the bit pattern survives, only the dtype tag is lost.
+            arr = arr.view(want)
+        if tuple(arr.shape) != tuple(leaf.shape) or arr.dtype != want:
+            raise ValueError(
+                f"leaf {key!r}: stored {arr.shape}/{arr.dtype} does not "
+                f"match engine {tuple(leaf.shape)}/{leaf.dtype}"
+            )
+        rebuilt.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, rebuilt)
+
+
+class PageEntry:
+    """One spilled page: host copies of the target-pool leaves for a
+    single page index (and the draft pool's, when the engine drafts).
+
+    `target`/`draft` are pytrees of host ndarrays shaped like one page
+    of the respective pool with the page axis dropped; int8 pools carry
+    their `*_scale` siblings as ordinary leaves, so quantized pages
+    round-trip with their scales by construction.
+    """
+
+    __slots__ = ("target", "draft", "hits", "nbytes")
+
+    def __init__(self, target, draft=None, hits: int = 0):
+        import jax
+
+        self.target = target
+        self.draft = draft
+        self.hits = int(hits)
+        self.nbytes = sum(
+            int(np.asarray(leaf).nbytes)
+            for tree in (target, draft)
+            if tree is not None
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+
+
+class HostKVTier:
+    """Bounded LRU pool of spilled page contents on host RAM.
+
+    Keys are page-aligned token tuples — the same identity the radix
+    index uses — so admission can probe tier chunks exactly where the
+    radix match ran out. `budget_bytes` bounds the sum of entry sizes;
+    inserting past the budget evicts least-recently-used entries, and an
+    entry larger than the whole budget is rejected outright (a tier that
+    thrashes one oversized page is worse than no tier).
+
+    Thread-safety: all methods take the tier lock. The engine calls
+    `put` from the scheduler thread (inside radix eviction) and `take`
+    from the same thread (admission), but stats()/statusz readers peek
+    concurrently.
+    """
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes < 0:
+            raise ValueError(f"budget_bytes must be >= 0, got {budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self._entries: "OrderedDict[TokenKey, PageEntry]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.spilled_pages_total = 0
+        self.hit_pages_total = 0
+        self.evicted_pages_total = 0
+        self.rejected_pages_total = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key: TokenKey) -> bool:
+        with self._lock:
+            return tuple(key) in self._entries
+
+    @property
+    def bytes_in_use(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def put(self, key: TokenKey, entry: PageEntry) -> bool:
+        """Park one page. Returns False when the entry cannot fit even
+        after evicting everything else (rejected, not stored)."""
+        key = tuple(int(t) for t in key)
+        with self._lock:
+            if entry.nbytes > self.budget_bytes:
+                self.rejected_pages_total += 1
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            while self._bytes + entry.nbytes > self.budget_bytes:
+                _, victim = self._entries.popitem(last=False)
+                self._bytes -= victim.nbytes
+                self.evicted_pages_total += 1
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            self.spilled_pages_total += 1
+            return True
+
+    def take(self, key: TokenKey) -> Optional[PageEntry]:
+        """Remove and return the entry for `key` (admission promotes the
+        page back into the pool + radix index, so the host copy's job is
+        done)."""
+        key = tuple(int(t) for t in key)
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return None
+            self._bytes -= entry.nbytes
+            self.hit_pages_total += 1
+            return entry
+
+    def get(self, key: TokenKey) -> Optional[PageEntry]:
+        """Peek (LRU-refreshing) without removing — used for the COW
+        boundary page, whose upload is a private copy and must leave the
+        shared entry parked for other requests."""
+        key = tuple(int(t) for t in key)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hit_pages_total += 1
+            return entry
+
+    def keys(self) -> List[TokenKey]:
+        with self._lock:
+            return list(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes_in_use": self._bytes,
+                "budget_bytes": self.budget_bytes,
+                "spilled_pages_total": self.spilled_pages_total,
+                "hit_pages_total": self.hit_pages_total,
+                "evicted_pages_total": self.evicted_pages_total,
+                "rejected_pages_total": self.rejected_pages_total,
+            }
+
+
+class PersistentPrefixStore:
+    """On-disk store of hot prefix chains, committed two-phase.
+
+    Layout mirrors the checkpoint subsystem's (one generation == one
+    `step_NNNNNNNN` directory; committed iff `manifest.json` exists):
+
+        <directory>/
+          step_00000003/
+            e00000.npz        # one page: target (+draft) leaves by path
+            e00001.npz
+            manifest.json     # written LAST — the commit record
+
+    `persist` prunes older committed generations and torn/in-flight
+    directories after committing, so the store holds exactly one
+    committed generation. `load` reads the latest committed generation
+    and returns None on ANY defect — the caller starts cold.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = layout.local_checkpoint_dir(directory)
+
+    # -- write path ---------------------------------------------------
+
+    def persist(
+        self,
+        entries: Sequence[Tuple[TokenKey, Any, Any, int]],
+        page_size: int,
+        quantize: str,
+        model: str = "",
+    ) -> int:
+        """Commit one generation of (tokens, target_tree, draft_tree|None,
+        hits) entries. Returns the new generation number."""
+        prior = layout.committed_steps(self.directory)
+        generation = (prior[-1] + 1) if prior else 1
+        gen_dir = layout.step_dir(self.directory, generation)
+        os.makedirs(gen_dir, exist_ok=True)
+
+        manifest_entries = []
+        for i, (tokens, target, draft, hits) in enumerate(entries):
+            flat = {f"t/{k}": v for k, v in _tree_host_arrays(target).items()}
+            if draft is not None:
+                flat.update(
+                    {f"d/{k}": v for k, v in _tree_host_arrays(draft).items()}
+                )
+            buf = io.BytesIO()
+            np.savez(buf, **flat)
+            fname = f"e{i:05d}.npz"
+            layout.atomic_write_bytes(os.path.join(gen_dir, fname), buf.getvalue())
+            manifest_entries.append(
+                {
+                    "file": fname,
+                    "tokens": [int(t) for t in tokens],
+                    "hits": int(hits),
+                    "draft": draft is not None,
+                }
+            )
+        # Phase boundary: every entry rename durable BEFORE the manifest
+        # can commit the generation (same ordering argument as layout.py).
+        layout.fsync_dir(gen_dir)
+        layout.write_manifest(
+            gen_dir,
+            {
+                "format": layout.FORMAT,
+                "kind": STORE_KIND,
+                "page_size": int(page_size),
+                "quantize": str(quantize),
+                "model": str(model),
+                "entries": manifest_entries,
+            },
+        )
+        self._prune(keep=generation)
+        return generation
+
+    def _prune(self, keep: int) -> None:
+        for step in layout.committed_steps(self.directory):
+            if step != keep:
+                shutil.rmtree(
+                    layout.step_dir(self.directory, step), ignore_errors=True
+                )
+        for path in layout.uncommitted_step_dirs(self.directory):
+            name = os.path.basename(path)
+            step = layout.parse_step(name)
+            if step == keep:
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+
+    # -- read path ----------------------------------------------------
+
+    def load(
+        self, page_size: int, quantize: str
+    ) -> Optional[List[Dict[str, Any]]]:
+        """Read the latest committed generation.
+
+        Returns a list of {"tokens": tuple, "target": {path: ndarray},
+        "draft": {path: ndarray}|None, "hits": int} sorted by chain
+        length (parents before children), or None when there is nothing
+        usable — missing store, wrong page geometry, torn entry, corrupt
+        manifest. Never raises: a defective store must degrade to a cold
+        start, not crash-loop the replica.
+        """
+        try:
+            steps = layout.committed_steps(self.directory)
+            if not steps:
+                return None
+            gen_dir = layout.step_dir(self.directory, steps[-1])
+            manifest = layout.read_manifest(gen_dir)
+            if manifest.get("kind") != STORE_KIND:
+                raise ValueError(
+                    f"manifest kind {manifest.get('kind')!r} is not "
+                    f"{STORE_KIND!r}"
+                )
+            if int(manifest.get("page_size", -1)) != int(page_size):
+                raise ValueError(
+                    f"stored page_size {manifest.get('page_size')} does not "
+                    f"match engine page_size {page_size}"
+                )
+            if str(manifest.get("quantize")) != str(quantize):
+                raise ValueError(
+                    f"stored quantize {manifest.get('quantize')!r} does not "
+                    f"match engine quantize {quantize!r}"
+                )
+            out = []
+            for ent in manifest["entries"]:
+                with np.load(os.path.join(gen_dir, ent["file"])) as z:
+                    flat = {k: z[k] for k in z.files}
+                target = {
+                    k[2:]: v for k, v in flat.items() if k.startswith("t/")
+                }
+                draft = {
+                    k[2:]: v for k, v in flat.items() if k.startswith("d/")
+                }
+                if not target:
+                    raise ValueError(f"entry {ent['file']} holds no target leaves")
+                out.append(
+                    {
+                        "tokens": tuple(int(t) for t in ent["tokens"]),
+                        "target": target,
+                        "draft": draft if ent.get("draft") else None,
+                        "hits": int(ent.get("hits", 0)),
+                    }
+                )
+            out.sort(key=lambda e: len(e["tokens"]))
+            return out
+        except Exception as e:  # noqa: BLE001 — cold start beats crash loop
+            logger.warning(
+                "persistent prefix store at %s unusable (%s); starting cold",
+                self.directory,
+                e,
+            )
+            return None
+
+
+def pool_sizing_telemetry(registry=None) -> Optional[Dict[str, float]]:
+    """Live pool-pressure signals for `resolve_num_pages`.
+
+    Reads the process metrics registry (the previous engine incarnation
+    in this process wrote them): returns {"pages_utilization",
+    "prefix_hit_rate"} or None when no engine has reported yet — the
+    caller falls back to the static heuristic.
+    """
+    from ..utils.metrics import default_registry
+
+    reg = registry if registry is not None else default_registry()
+    in_use = reg.get("serving_kv_pages_in_use")
+    total = reg.get("serving_kv_pages_total")
+    if in_use is None or total is None:
+        return None
+    with total._lock:
+        totals = dict(total._values)
+    with in_use._lock:
+        uses = dict(in_use._values)
+    utils = [
+        uses.get(k, 0.0) / v for k, v in totals.items() if v > 0
+    ]
+    if not utils:
+        return None
+    hit_rate = 0.0
+    hits = reg.get("serving_prefix_cache_hit_tokens_total")
+    lookups = reg.get("serving_prefix_cache_lookups_total")
+    if hits is not None and lookups is not None:
+        with hits._lock:
+            h = sum(hits._values.values())
+        with lookups._lock:
+            n = sum(lookups._values.values())
+        # hit tokens per lookup, squashed to [0, 1] against a nominal
+        # 64-token prefix (CHUNK_MIN_TOKENS) — a coarse reuse signal,
+        # not an exact ratio.
+        if n > 0:
+            hit_rate = min(1.0, (h / n) / 64.0)
+    return {
+        "pages_utilization": max(utils),
+        "prefix_hit_rate": hit_rate,
+    }

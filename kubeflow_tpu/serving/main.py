@@ -83,6 +83,16 @@ def engine_knobs_from_env():
         "drain_deadline_s": _env_float(
             "KFT_SERVING_DRAIN_DEADLINE_S", DEFAULT_DRAIN_DEADLINE_S
         ),
+        # tiered KV (serving/kv_tiers.py): host-RAM spill budget + the
+        # on-disk persistent prefix store a warm restart preloads
+        "kv_host_bytes": _env_int("KFT_SERVING_KV_HOST_BYTES", 0),
+        "kv_persist_dir": os.environ.get(
+            "KFT_SERVING_KV_PERSIST_DIR", ""
+        ).strip(),
+        "kv_persist_interval_s": _env_float(
+            "KFT_SERVING_KV_PERSIST_INTERVAL_S", 0.0
+        ),
+        "kv_persist_chains": _env_int("KFT_SERVING_KV_PERSIST_CHAINS", 64),
     }
 
 
@@ -120,6 +130,10 @@ def build_server(
     trace_buffer_spans: int = None,
     statusz_enabled: bool = None,
     drain_deadline_s: float = None,
+    kv_host_bytes: int = None,
+    kv_persist_dir: str = None,
+    kv_persist_interval_s: float = None,
+    kv_persist_chains: int = None,
 ):
     """Assemble the ModelServer for one registry model (testable core of
     the entrypoint): causal families serve :generate via the
@@ -208,6 +222,21 @@ def build_server(
             num_draft_tokens = env["num_draft_tokens"]
         if draft_checkpoint_dir is None:
             draft_checkpoint_dir = env["draft_checkpoint_dir"]
+        if kv_host_bytes is None:
+            kv_host_bytes = env["kv_host_bytes"]
+        if kv_persist_dir is None:
+            kv_persist_dir = env["kv_persist_dir"]
+        if kv_persist_interval_s is None:
+            kv_persist_interval_s = env["kv_persist_interval_s"]
+        if kv_persist_chains is None:
+            kv_persist_chains = env["kv_persist_chains"]
+        if (kv_host_bytes or kv_persist_dir) and not prefix_cache:
+            raise ValueError(
+                "KFT_SERVING_KV_HOST_BYTES / KFT_SERVING_KV_PERSIST_DIR "
+                "need the prefix cache: both KV tiers key off the radix "
+                "index's committed chains — enable "
+                "KFT_SERVING_PREFIX_CACHE or drop the tier knobs"
+            )
         if num_draft_tokens > 0 and not draft_model:
             raise ValueError(
                 "num_draft_tokens > 0 needs a draft model "
@@ -245,6 +274,7 @@ def build_server(
         server.add_lm(lm)
         if num_slots > 0:
             from kubeflow_tpu.serving.engine import DecodeEngine
+            from kubeflow_tpu.serving.kv_tiers import pool_sizing_telemetry
 
             draft = None
             if num_draft_tokens > 0:
@@ -296,6 +326,16 @@ def build_server(
                     draft_model=draft,
                     draft_params=draft_params,
                     num_draft_tokens=num_draft_tokens,
+                    kv_host_bytes=kv_host_bytes or 0,
+                    kv_persist_dir=kv_persist_dir or None,
+                    kv_persist_interval_s=kv_persist_interval_s or 0.0,
+                    kv_persist_chains=kv_persist_chains or 64,
+                    # auto-sized pools consult the previous engine
+                    # incarnation's live pressure (None on a fresh
+                    # process — the static heuristic applies)
+                    pool_telemetry=(
+                        None if num_pages else pool_sizing_telemetry()
+                    ),
                 )
             )
     else:

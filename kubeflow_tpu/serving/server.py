@@ -370,6 +370,22 @@ class ModelServer:
                 f"cow={st['cow_copies']} "
                 f"first_page_hashes={st['first_page_hashes']}"
             )
+            tier = state.get("kv_host_tier")
+            if tier is not None or state.get("kv_persist_dir"):
+                lines.append(
+                    "    kv tiers: host="
+                    + (
+                        f"{tier['entries']} entries "
+                        f"{tier['bytes_in_use']}/{tier['budget_bytes']} B"
+                        if tier is not None
+                        else "off"
+                    )
+                    + f" spilled={st['kv_spill_pages']} "
+                    f"spill_hits={st['kv_spill_hits']} | "
+                    "store="
+                    + (state.get("kv_persist_dir") or "off")
+                    + f" persisted_chains={st['kv_persisted_chains']}"
+                )
             for s in state["slots"]:
                 if s is not None:
                     lines.append(

@@ -779,6 +779,45 @@ def serving_kv_pool_bytes_per_chip_gauge() -> Gauge:
     )
 
 
+# Tiered KV (serving/kv_tiers.py): the host-RAM spill tier under the
+# pool and the on-disk persistent prefix store under that. Spill pages
+# vs spill hits is the tier's economy — pages parked at eviction against
+# pages whose re-admission skipped a re-prefill.
+
+
+def serving_kv_spill_pages_counter() -> Counter:
+    """Pages parked in the host-RAM tier at radix eviction (contents
+    copied device→host instead of freed) — the spill tier's write
+    side."""
+    return default_registry().counter(
+        "serving_kv_spill_pages_total",
+        "KV pages spilled to the host tier at eviction",
+        ["model"],
+    )
+
+
+def serving_kv_spill_hits_counter() -> Counter:
+    """Pages re-admitted from the host tier (host→device upload +
+    refcount map instead of chunk-prefill compute) — every hit is an
+    eviction whose cost the tier refunded."""
+    return default_registry().counter(
+        "serving_kv_spill_hits_total",
+        "KV pages re-admitted from the host tier",
+        ["model"],
+    )
+
+
+def serving_kv_persisted_chains_gauge() -> Gauge:
+    """Prefix pages in this engine's last committed on-disk generation
+    (or preloaded at startup) — the warm-restart working set a replica
+    hands its successor."""
+    return default_registry().gauge(
+        "serving_kv_persisted_chains",
+        "prefix pages in the last persisted generation",
+        ["model"],
+    )
+
+
 # ---------------------------------------------------------------------------
 # Observability-derived metrics (kubeflow_tpu/observability/; docs/
 # OBSERVABILITY.md): per-phase request accounting on the serving path and

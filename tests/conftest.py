@@ -76,6 +76,11 @@ _COMPILE_CACHE_MODULES = frozenset({
     # same engine-program family (the r15 propagation fleet rides the
     # session gpt_and_params engines at test_observability's geometry)
     "test_tracing",
+    # engine-program family only (spill/upload ride the engine's own jit
+    # block on the session gpt_and_params model); the persistent prefix
+    # store serializes npz PAGE BYTES, never programs — the PR-7
+    # checkpoint-program segfault class cannot reach it
+    "test_kv_tiers",
 })
 
 # One persistent dir shared with bench.py's battery cache: the workspace

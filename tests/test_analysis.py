@@ -1076,7 +1076,8 @@ class TestServingPlansClean:
         bad = [f for f in findings if f.severity >= Severity.ERROR]
         assert bad == [], "\n".join(f.render() for f in bad)
         assert stats["programs"] == [
-            "prefill@8", "prefill@16", "insert", "chunk", "cow", "step",
+            "prefill@8", "prefill@16", "insert", "chunk", "cow",
+            "spill", "upload", "step",
         ]
         assert stats["hbm"]["budget_bytes"] == 16 << 30
         assert stats["hbm"]["components_bytes"]["kv page pool"] > 0
@@ -1087,6 +1088,30 @@ class TestServingPlansClean:
         assert stats["num_pages"] == auto_num_pages(
             4, 128, stats["page_size"]
         )
+
+    def test_host_tier_budget_priced(self):
+        """serve-host-tier: a spill budget smaller than one page's host
+        footprint is a silently-dead knob (every spill rejected) and
+        must ERROR; a real budget reports its page capacity on
+        stats["host"]."""
+        from kubeflow_tpu.analysis.serving import analyze_serving_plan
+
+        findings, stats = analyze_serving_plan(
+            self._tiny(name="tiny:tier", kv_host_bytes=64 << 20)
+        )
+        bad = [f for f in findings if f.severity >= Severity.ERROR]
+        assert bad == [], "\n".join(f.render() for f in bad)
+        assert stats["host"]["pages"] > 0
+        assert stats["host"]["page_entry_bytes"] > 0
+
+        findings, stats = analyze_serving_plan(
+            self._tiny(name="tiny:starved", kv_host_bytes=1)
+        )
+        tier = [f for f in findings if f.analyzer == "serve-host-tier"]
+        assert len(tier) == 1
+        assert tier[0].severity == Severity.ERROR
+        assert tier[0].symbol == "kv_host_bytes"
+        assert stats["host"]["pages"] == 0
 
     def test_tiny_quantized_pallas_plan_lowers_clean(self):
         """The r13 int8+pallas family: int8 pools (value leaves

@@ -1,0 +1,392 @@
+"""Tiered KV: host-RAM spill tier + on-disk persistent prefix store
+(serving/kv_tiers.py; engine integration in serving/engine.py).
+
+The tier contract is the prefix cache's, one level down: moving a page's
+BYTES between tiers (HBM -> host numpy -> npz on disk -> back) never
+changes what is computed — greedy output after an evict->spill->re-admit
+round trip, and after a persist->restart->preload round trip, must be
+BITWISE the always-resident engine's. On top of parity this file pins
+the tier machinery itself: the host pool is a bounded LRU (never exceeds
+its byte budget, rejects entries larger than it), the persistent store
+rides the checkpoint two-phase manifest (a corrupt or partial generation
+means a cold start, never a crash loop), and int8 page envelopes
+round-trip WITH their bf16 scale siblings bit-for-bit.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.engine import DecodeEngine
+from kubeflow_tpu.serving.generate import generate
+from kubeflow_tpu.serving.kv_tiers import (
+    HostKVTier,
+    PageEntry,
+    PersistentPrefixStore,
+    tree_from_flat,
+)
+
+# gpt_and_params comes from conftest.py: the ONE session-scoped tiny-gpt
+# shared by every engine-family suite (tier-1 time-budget tranche)
+
+
+def _rows(*lens):
+    return [
+        (np.arange(n) * (3 + 2 * i) + i + 1).astype(np.int32) % 512
+        for i, n in enumerate(lens)
+    ]
+
+
+def _ref_tokens(model, params, row, n):
+    out = generate(model, params, jnp.asarray(row, jnp.int32)[None, :], n)
+    return np.asarray(out)[0, len(row):].tolist()
+
+
+def _engine(model, params, name, **kw):
+    """The tier test geometry: a 24-page pool at page_size=8 is small
+    enough that a handful of committed chains forces radix eviction (the
+    spill trigger) without slow-test-scale traffic."""
+    return DecodeEngine(
+        name, model, params, num_slots=2, page_size=8, num_pages=24,
+        prefill_buckets=(8, 32), **kw,
+    )
+
+
+def _entry(nbytes, fill=0):
+    """A PageEntry holding exactly `nbytes` of target payload."""
+    return PageEntry(
+        {"k": np.full((nbytes,), fill, np.uint8)}, None, hits=1,
+    )
+
+
+class TestHostKVTier:
+    def test_lru_bound_enforced(self):
+        """The pool never exceeds its byte budget: admitting past it
+        evicts from the LRU end, and a get() refreshes recency."""
+        tier = HostKVTier(budget_bytes=3 * 100)
+        for i in range(3):
+            assert tier.put((i,), _entry(100, i))
+        assert tier.bytes_in_use == 300
+        tier.get((0,))  # refresh: (1,) is now the LRU entry
+        assert tier.put((3,), _entry(100, 3))
+        assert tier.bytes_in_use <= 300
+        assert (1,) not in tier
+        assert (0,) in tier and (2,) in tier and (3,) in tier
+        st = tier.stats()
+        assert st["evicted_pages_total"] == 1
+        assert st["entries"] == 3
+
+    def test_oversize_entry_rejected(self):
+        """An entry larger than the whole budget is rejected (returns
+        False, counted) — it could only evict everything and still not
+        fit, so the tier must not thrash."""
+        tier = HostKVTier(budget_bytes=64)
+        assert not tier.put((1,), _entry(65))
+        assert len(tier) == 0
+        assert tier.stats()["rejected_pages_total"] == 1
+
+    def test_take_removes_and_counts_hit(self):
+        tier = HostKVTier(budget_bytes=1024)
+        tier.put((1, 2), _entry(64))
+        entry = tier.take((1, 2))
+        assert entry is not None
+        assert (1, 2) not in tier
+        assert tier.take((1, 2)) is None
+        assert tier.stats()["hit_pages_total"] == 1
+
+
+class TestTelemetrySizing:
+    def test_resolve_num_pages_uses_telemetry_below_ceiling(self):
+        """Live pool telemetry shrinks an auto pool toward 1/2 the
+        slot-row footprint under low observed pressure, restores the
+        full 3/4 under high pressure, and NEVER exceeds the static
+        ceiling the mem-budget lint priced."""
+        from kubeflow_tpu.serving.engine import resolve_num_pages
+        from kubeflow_tpu.utils.metrics import MetricsRegistry
+        from kubeflow_tpu.serving.kv_tiers import pool_sizing_telemetry
+
+        class Cfg:
+            max_len = 256
+            hidden_size = 64
+            num_heads = 4
+            dtype = "float32"
+
+        static = resolve_num_pages(0, 8, Cfg, 16)
+        assert static == 96  # 3/4 of 8 slots x 16 pages/slot
+
+        reg = MetricsRegistry()
+        total = reg.gauge("serving_kv_pages_total", "", ["model"])
+        in_use = reg.gauge("serving_kv_pages_in_use", "", ["model"])
+        total.set(96, model="m")
+        in_use.set(10, model="m")  # ~10% utilization, no prefix reuse
+        tele = pool_sizing_telemetry(reg)
+        assert tele is not None
+        low = resolve_num_pages(0, 8, Cfg, 16, telemetry=tele)
+        assert low == 64  # clamped at the 1/2 floor
+
+        in_use.set(90, model="m")  # near-saturated
+        high = resolve_num_pages(
+            0, 8, Cfg, 16, telemetry=pool_sizing_telemetry(reg)
+        )
+        assert high == static  # ceiling: never above the lint's bound
+        # explicit num_pages always wins over telemetry
+        assert resolve_num_pages(40, 8, Cfg, 16, telemetry=tele) == 40
+
+    def test_telemetry_none_without_metrics(self):
+        from kubeflow_tpu.utils.metrics import MetricsRegistry
+        from kubeflow_tpu.serving.kv_tiers import pool_sizing_telemetry
+
+        assert pool_sizing_telemetry(MetricsRegistry()) is None
+
+
+class TestSpillReadmitParity:
+    def test_evict_spill_readmit_bitwise(self, gpt_and_params):
+        """Pool pressure evicts a committed chain into the host tier;
+        re-requesting its prefix re-admits the spilled pages (host ->
+        device upload) — output stays bitwise the always-resident
+        oracle's, and the spill/hit counters prove the tier path ran."""
+        model, params = gpt_and_params
+        rng = np.random.default_rng(0)
+        vocab = model.cfg.vocab_size
+        shared = rng.integers(0, vocab, 24)
+        row_a = np.concatenate([shared, rng.integers(0, vocab, 8)])
+        row_b = np.concatenate([shared, rng.integers(0, vocab, 8)])
+        fills = [rng.integers(0, vocab, 32) for _ in range(6)]
+
+        ref = _engine(model, params, "kvt-ref")
+        try:
+            ref_a = ref.generate_row(row_a, 6, timeout=120)["tokens"]
+            ref_b = ref.generate_row(row_b, 6, timeout=120)["tokens"]
+        finally:
+            ref.close()
+
+        eng = _engine(model, params, "kvt-tier", kv_host_bytes=64 << 20)
+        try:
+            out_a = eng.generate_row(row_a, 6, timeout=120)["tokens"]
+            # 6 distinct 32-token prompts through a 24-page pool: the
+            # radix MUST evict — and with the tier attached, evict means
+            # spill, not drop
+            for fill in fills:
+                eng.generate_row(fill, 4, timeout=120)
+            out_b = eng.generate_row(row_b, 6, timeout=120)["tokens"]
+            st = eng.stats()
+        finally:
+            eng.close()
+        assert out_a == ref_a
+        assert out_b == ref_b  # bitwise THROUGH the spill round trip
+        assert st["kv_spill_pages"] > 0
+        assert st["kv_spill_hits"] > 0
+        assert st["kv_host_tier"]["bytes_in_use"] >= 0
+
+    @pytest.mark.slow
+    def test_int8_pages_spill_with_scales(self, gpt_and_params):
+        """int8 engines spill TWO siblings per pool leaf — the int8
+        envelope and its bf16 scales — and both must survive the host
+        round trip for the quantized read path to stay deterministic:
+        the re-admitted output must equal the same engine's pre-evict
+        output for the same prompt."""
+        model, params = gpt_and_params
+        rng = np.random.default_rng(2)
+        vocab = model.cfg.vocab_size
+        shared = rng.integers(0, vocab, 24)
+        row = np.concatenate([shared, rng.integers(0, vocab, 8)])
+        fills = [rng.integers(0, vocab, 32) for _ in range(6)]
+
+        eng = _engine(
+            model, params, "kvt-int8", quantize="int8",
+            kv_host_bytes=64 << 20,
+        )
+        try:
+            first = eng.generate_row(row, 6, timeout=120)["tokens"]
+            for fill in fills:
+                eng.generate_row(fill, 4, timeout=120)
+            again = eng.generate_row(row, 6, timeout=120)["tokens"]
+            st = eng.stats()
+        finally:
+            eng.close()
+        assert again == first
+        assert st["kv_spill_pages"] > 0
+        assert st["kv_spill_hits"] > 0
+
+
+class TestPersistentStore:
+    def test_persist_restart_preload_bitwise(
+        self, gpt_and_params, tmp_path
+    ):
+        """Engine 1 commits a shared prefix and persists its hot chains
+        at close (the drain-path final persist); engine 2 points at the
+        same store, preloads BEFORE taking traffic, and serves a
+        prefix-sharing request with radix hits and bitwise the oracle's
+        output — the restart-warm contract."""
+        model, params = gpt_and_params
+        rng = np.random.default_rng(1)
+        vocab = model.cfg.vocab_size
+        shared = rng.integers(0, vocab, 24)
+        warm_row = np.concatenate([shared, rng.integers(0, vocab, 4)])
+        row = np.concatenate([shared, rng.integers(0, vocab, 8)])
+        ref_toks = _ref_tokens(model, params, row, 6)
+        store = str(tmp_path / "kvstore")
+
+        e1 = _engine(model, params, "kvt-seed", kv_persist_dir=store)
+        try:
+            e1.generate_row(warm_row, 4, timeout=120)
+        finally:
+            e1.close()  # final persist writes the committed generation
+
+        e2 = _engine(model, params, "kvt-warm", kv_persist_dir=store)
+        try:
+            preloaded = e2.stats()["kv_persisted_chains"]
+            out = e2.generate_row(row, 6, timeout=120)["tokens"]
+            st = e2.stats()
+        finally:
+            e2.close()
+        assert preloaded > 0
+        assert st["prefix_hit_tokens"] > 0  # preload fed the radix
+        assert out == ref_toks  # bitwise THROUGH persist->restart
+
+    def test_corrupt_manifest_cold_start(self, gpt_and_params, tmp_path):
+        """A corrupt manifest (half-written JSON, torn disk, version
+        skew) means a COLD start: zero chains preloaded, a warning, and
+        a correct first response — never a crash loop. A restarting
+        replica must always be able to take traffic."""
+        model, params = gpt_and_params
+        rng = np.random.default_rng(1)
+        vocab = model.cfg.vocab_size
+        row = np.concatenate(
+            [rng.integers(0, vocab, 24), rng.integers(0, vocab, 8)]
+        )
+        ref_toks = _ref_tokens(model, params, row, 6)
+        store = str(tmp_path / "kvstore")
+
+        e1 = _engine(model, params, "kvt-seed2", kv_persist_dir=store)
+        try:
+            e1.generate_row(row, 4, timeout=120)
+        finally:
+            e1.close()
+        gen = sorted(os.listdir(store))[-1]
+        with open(os.path.join(store, gen, "manifest.json"), "w") as f:
+            f.write("{not json")
+
+        e2 = _engine(model, params, "kvt-cold", kv_persist_dir=store)
+        try:
+            assert e2.stats()["kv_persisted_chains"] == 0
+            out = e2.generate_row(row, 6, timeout=120)["tokens"]
+        finally:
+            e2.close()
+        assert out == ref_toks
+
+    def test_partial_generation_cold_start(self, gpt_and_params, tmp_path):
+        """A manifest that names a missing entry file (a generation
+        pruned mid-read, a torn copy) is as unusable as a corrupt one:
+        load() returns None and the engine starts cold."""
+        model, params = gpt_and_params
+        rng = np.random.default_rng(1)
+        vocab = model.cfg.vocab_size
+        row = np.concatenate(
+            [rng.integers(0, vocab, 24), rng.integers(0, vocab, 8)]
+        )
+        store = str(tmp_path / "kvstore")
+
+        e1 = _engine(model, params, "kvt-seed3", kv_persist_dir=store)
+        try:
+            e1.generate_row(row, 4, timeout=120)
+        finally:
+            e1.close()
+        gen = sorted(os.listdir(store))[-1]
+        gen_dir = os.path.join(store, gen)
+        for name in os.listdir(gen_dir):
+            if name.endswith(".npz"):
+                os.unlink(os.path.join(gen_dir, name))
+
+        assert PersistentPrefixStore(store).load(8, "none") is None
+        e2 = _engine(model, params, "kvt-cold2", kv_persist_dir=store)
+        try:
+            assert e2.stats()["kv_persisted_chains"] == 0
+        finally:
+            e2.close()
+
+    def test_int8_npz_round_trip_with_scales(self, tmp_path):
+        """Store-level dtype fidelity: int8 envelopes and their bf16
+        scale siblings must come back BIT-identical (np.savez drops the
+        ml_dtypes bfloat16 tag — load() re-views the raw bytes), and the
+        geometry guards (page_size / quantize) must refuse a mismatched
+        store rather than feed wrong-shaped pages to the upload."""
+        rng = np.random.default_rng(7)
+        env = rng.integers(-128, 128, (2, 8, 4, 16), np.int8)
+        scales = jnp.asarray(
+            rng.standard_normal((2, 8, 4, 1)), jnp.bfloat16
+        )
+        target = {"layer/k": env, "layer/k_scale": np.asarray(scales)}
+        store = PersistentPrefixStore(str(tmp_path / "s"))
+        store.persist(
+            [(tuple(range(8)), target, None, 3)],
+            page_size=8, quantize="int8",
+        )
+        loaded = store.load(8, "int8")
+        assert loaded is not None and len(loaded) == 1
+        # the engine rebuilds pages against its pool template — that is
+        # where the raw npz bytes get their dtype tag back
+        got = tree_from_flat(target, loaded[0]["target"])
+        assert got["layer/k"].dtype == np.int8
+        np.testing.assert_array_equal(got["layer/k"], env)
+        back = got["layer/k_scale"]
+        assert back.dtype == np.asarray(scales).dtype  # bf16 tag restored
+        assert back.tobytes() == np.asarray(scales).tobytes()  # bit-exact
+        assert loaded[0]["hits"] == 3
+        # geometry guards: wrong page size or quantize mode -> unusable
+        assert store.load(16, "int8") is None
+        assert store.load(8, "none") is None
+
+    def test_persist_prunes_old_generations(self, tmp_path):
+        """Each persist writes a NEW committed generation and prunes the
+        old ones — the store must not grow without bound across the
+        periodic persist cadence."""
+        store = PersistentPrefixStore(str(tmp_path / "s"))
+        entry = ((1, 2, 3, 4), {"k": np.zeros(4, np.int8)}, None, 1)
+        for _ in range(3):
+            store.persist([entry], page_size=4, quantize="none")
+        gens = [
+            d for d in os.listdir(str(tmp_path / "s"))
+            if not d.endswith(".tmp")
+        ]
+        assert len(gens) == 1
+        assert store.load(4, "none") is not None
+
+
+class TestStatusz:
+    def test_statusz_renders_tier_line(self, gpt_and_params, tmp_path):
+        """The tier surface is operator-visible: a tiered engine renders
+        its host-pool occupancy, spill counters, and store location on
+        /statusz; an untiered engine renders no tier line at all."""
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model, params = gpt_and_params
+        store = str(tmp_path / "store")
+        eng = _engine(
+            model, params, "kvsz", autostart=False,
+            kv_host_bytes=32 << 20, kv_persist_dir=store,
+        )
+        server = ModelServer()
+        server.add_engine(eng)
+        try:
+            status, resp, _ = server.app.handle_full("GET", "/statusz")
+        finally:
+            server.close()
+        assert status == 200
+        text = resp.body.decode()
+        assert "kv tiers: host=0 entries" in text
+        assert "spilled=0 spill_hits=0" in text
+        assert f"store={store}" in text
+        assert "persisted_chains=0" in text
+
+        plain = _engine(model, params, "kvsz0", autostart=False)
+        server = ModelServer()
+        server.add_engine(plain)
+        try:
+            status, resp, _ = server.app.handle_full("GET", "/statusz")
+        finally:
+            server.close()
+        assert status == 200
+        assert "kv tiers:" not in resp.body.decode()

@@ -234,6 +234,12 @@ class TestInferenceServiceController:
             # draining-shutdown budget (docs/ROBUSTNESS.md drain
             # contract; consumed by serving/main.py's SIGTERM path)
             "KFT_SERVING_DRAIN_DEADLINE_S": "30",
+            # tiered KV (r17): host spill budget + persistent prefix
+            # store, both off by default (docs/SERVING.md "Tiered KV")
+            "KFT_SERVING_KV_HOST_BYTES": "0",
+            "KFT_SERVING_KV_PERSIST_DIR": "",
+            "KFT_SERVING_KV_PERSIST_INTERVAL_S": "0",
+            "KFT_SERVING_KV_PERSIST_CHAINS": "64",
             # kft-trace contract (observability defaults: tracing on,
             # docs/OBSERVABILITY.md; knob-flow coverage lives in
             # tests/test_observability.py)
@@ -272,6 +278,10 @@ class TestInferenceServiceController:
         monkeypatch.setenv("KFT_SERVING_MESH_TENSOR", "2")
         monkeypatch.setenv("KFT_SERVING_MESH_FSDP", "4")
         monkeypatch.setenv("KFT_SERVING_DRAIN_DEADLINE_S", "12")
+        monkeypatch.setenv("KFT_SERVING_KV_HOST_BYTES", "1048576")
+        monkeypatch.setenv("KFT_SERVING_KV_PERSIST_DIR", "/kv/store")
+        monkeypatch.setenv("KFT_SERVING_KV_PERSIST_INTERVAL_S", "90")
+        monkeypatch.setenv("KFT_SERVING_KV_PERSIST_CHAINS", "32")
         assert engine_knobs_from_env() == {
             "num_slots": 4,
             "max_queue": 16,
@@ -287,6 +297,10 @@ class TestInferenceServiceController:
             "num_draft_tokens": 0,
             "draft_checkpoint_dir": "",
             "drain_deadline_s": 12.0,
+            "kv_host_bytes": 1048576,
+            "kv_persist_dir": "/kv/store",
+            "kv_persist_interval_s": 90.0,
+            "kv_persist_chains": 32,
         }
         monkeypatch.setenv("KFT_SERVING_PREFILL_BUCKETS", "")
         monkeypatch.setenv("KFT_SERVING_NUM_SLOTS", "")
@@ -307,6 +321,14 @@ class TestInferenceServiceController:
         assert knobs["mesh_tensor"] == 1  # default: unmeshed engine
         assert knobs["mesh_fsdp"] == 1
         assert knobs["drain_deadline_s"] == 30.0  # default budget
+        monkeypatch.setenv("KFT_SERVING_KV_HOST_BYTES", "")
+        monkeypatch.setenv("KFT_SERVING_KV_PERSIST_DIR", "")
+        monkeypatch.setenv("KFT_SERVING_KV_PERSIST_INTERVAL_S", "")
+        monkeypatch.setenv("KFT_SERVING_KV_PERSIST_CHAINS", "")
+        knobs = engine_knobs_from_env()
+        assert knobs["kv_host_bytes"] == 0  # default: spill tier off
+        assert knobs["kv_persist_dir"] == ""  # default: no disk store
+        assert knobs["kv_persist_chains"] == 64
 
 
 class TestNpyFastPath:

@@ -88,6 +88,11 @@ class TestSuggestions:
 
 
 class TestStudyLifecycle:
+    # tier-1 keeps test_invalid_algorithm_fails_study (the cheap
+    # controller-reconcile representative) + the whole TestSuggestions
+    # pure-logic suite; the ~20s run_until_idle lifecycle drives below
+    # are @slow and run unfiltered in CI's control-plane step
+    @pytest.mark.slow
     def test_fan_out_respects_parallelism(self):
         store, cm, executor = make_harness()
         study = new_study_job(
@@ -110,6 +115,7 @@ class TestStudyLifecycle:
         }
         assert lrs <= {0.1, 0.01, 0.001, 0.0001}
 
+    @pytest.mark.slow  # see the tier note on test_fan_out above
     def test_completes_with_best_trial_fake_metrics(self):
         """Scripted metrics: verify objective selection logic."""
         store, cm, executor = make_harness()
@@ -149,7 +155,7 @@ class TestStudyLifecycle:
         assert best["metric"]["final_loss"] == 1.5
         assert done["status"]["trialsSucceeded"] == 3
 
-    @pytest.mark.slow  # real-training study; unit lifecycle tests stay tier-1
+    @pytest.mark.slow  # real-training study; spec-validation stays tier-1
     def test_real_training_study_end_to_end(self, devices8):
         """Trials run REAL XLA training; study optimizes items/sec."""
         runner = InProcessTrainerRunner(steps_override=2)
@@ -182,7 +188,7 @@ class TestStudyLifecycle:
         assert best["metric"]["items_per_sec"] > 0
         assert done["status"]["trialsSucceeded"] == 2
 
-    @pytest.mark.slow  # real-training study; unit lifecycle tests stay tier-1
+    @pytest.mark.slow  # real-training study; spec-validation stays tier-1
     def test_failed_trials_fail_study(self):
         runner = FakePodRunner()
         store, cm, executor = make_harness(runner)
