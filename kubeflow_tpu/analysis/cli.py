@@ -53,6 +53,15 @@ def main(argv=None) -> int:
         "pass)",
     )
     ap.add_argument(
+        "--concurrency",
+        choices=("on", "off", "only"),
+        default="on",
+        help="interprocedural concurrency lint (guarded-attr / lock-order "
+        "/ thread-lifecycle / bare-ignore); `only` runs just this pass "
+        "tree-wide and skips everything else (the CI concurrency-lint "
+        "step, gated at WARNING via --strict)",
+    )
+    ap.add_argument(
         "--spmd",
         choices=("off", "lower", "full"),
         default="full",
@@ -72,8 +81,9 @@ def main(argv=None) -> int:
         "--list-ignores",
         action="store_true",
         help="inventory every inline `# kft-analyze: ignore[rule]` with "
-        "file:line and rule, then exit 0 (the repo ships with zero; "
-        "tests/test_analysis.py enforces it)",
+        "file:line, rule and reason, then exit 0 (every shipped ignore "
+        "must carry a reason; the bare-ignore lint and "
+        "tests/test_analysis.py enforce it)",
     )
     ap.add_argument(
         "--devices", type=int, default=8,
@@ -107,14 +117,29 @@ def main(argv=None) -> int:
         rows = sources.suppression_inventory()
         if args.format == "json":
             print(json.dumps([
-                {"location": f"{p}:{ln}", "rule": rule}
-                for p, ln, rule in rows
+                {"location": f"{p}:{ln}", "rule": rule, "reason": reason}
+                for p, ln, rule, reason in rows
             ], indent=1))
         else:
-            for p, ln, rule in rows:
-                print(f"{p}:{ln}: ignore[{rule}]")
+            for p, ln, rule, reason in rows:
+                tail = f" — {reason}" if reason else " — (BARE: no reason)"
+                print(f"{p}:{ln}: ignore[{rule}]{tail}")
             print(f"kft-analyze: {len(rows)} inline ignore(s)")
         return 0
+
+    if args.concurrency == "only":
+        from kubeflow_tpu.analysis.concurrency import run_concurrency
+
+        sources = SourceSet(root)
+        findings.extend(run_concurrency(sources))
+        if args.format == "json":
+            print(json.dumps({
+                "findings": [f.to_dict() for f in findings],
+                "plans": [],
+            }, indent=1))
+        else:
+            print(render_report(findings))
+        return exit_code(findings, strict=args.strict)
 
     if args.ast == "on":
         from kubeflow_tpu.analysis.consistency import run_consistency
@@ -129,6 +154,10 @@ def main(argv=None) -> int:
         # the AST half of serve-host-transfer (the scheduler hot loop);
         # the jaxpr half rides the per-plan serving sweep below
         findings.extend(check_hot_loop_host_transfer(sources))
+        if args.concurrency == "on":
+            from kubeflow_tpu.analysis.concurrency import run_concurrency
+
+            findings.extend(run_concurrency(sources))
 
     if args.spmd != "off":
         from kubeflow_tpu.analysis.plans import (

@@ -1,0 +1,929 @@
+"""Whole-tree concurrency lint: races, deadlocks, thread lifecycle.
+
+The platform is a genuinely concurrent system — the decode engine's
+scheduler thread, the router's probe thread, the fleet collector's scrape
+pool, drain workers, the host KV tier's LRU — and its worst historical
+bugs were concurrency bugs (the PR-11 `_admitting` drain/admission race,
+the PR-15 thread-local trace bleed). This pass replaces the shallow
+per-method `lock-discipline` / `thread-hygiene` rules with one
+interprocedural concurrency namespace:
+
+- **guarded-attr** — per class, infer the guarded attribute set: an
+  attribute MUTATED under `with self._lock:` in any non-__init__ method
+  (following self-method calls one level: a private helper whose every
+  in-class call site holds the lock analyzes as lock-held) is guarded by
+  that lock. Multi-thread entry points are identified per class — thread
+  targets, executor `submit()`/`map()` callables, registered callbacks,
+  and (for a lock-owning class) every public method, which any thread may
+  call. An access to a guarded attribute outside every guarding lock
+  scope, reachable from an entry point, races when the attribute is
+  touched from ≥2 entry points (or from one reentrant entry point, e.g. a
+  public method that can run on two request threads at once): ERROR for
+  writes, WARNING for reads, with the guard-inferring method cited.
+  Reads under a lock never *establish* guardedness — snapshotting
+  unrelated state while a lock happens to be held is common; a write
+  under the lock is the declaration of intent.
+- **lock-order** — the global lock-acquisition graph: nodes are
+  `Class.attr` locks (plus module-level locks), edges come from nested
+  `with` blocks and from calls-that-acquire (self-method calls and calls
+  through attributes whose class is statically known, followed
+  transitively). Cycles are potential deadlocks and report the full
+  witness chain; re-acquisition of a non-reentrant lock on a path that
+  already holds it is a self-deadlock. `static_lock_graph()` exports this
+  graph for the runtime sanitizer (utils/audit_lock.py): the audited
+  suites assert every *observed* edge is a subset of the static ones.
+- **thread-lifecycle** — non-daemon threads with no reachable `.join()`
+  (the conftest leak-guard class, moved to before commit time), executors
+  that are neither context-managed nor `.shutdown()`, and thread-target
+  closures/lambdas that mutate state captured from the enclosing scope.
+
+Suppressions use the standard `# kft-analyze: ignore[rule] — reason`
+contract; the **bare-ignore** rule (also in this module) makes a
+reason-less ignore itself a finding, so every shipped exception is
+documented at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from kubeflow_tpu.analysis.findings import Finding, Severity
+from kubeflow_tpu.analysis.sources import (
+    SourceSet,
+    call_name,
+    keyword,
+    walk_with_parents,
+)
+
+RULE_GUARDED = "guarded-attr"
+RULE_ORDER = "lock-order"
+RULE_LIFECYCLE = "thread-lifecycle"
+RULE_BARE_IGNORE = "bare-ignore"
+
+# Lock constructors: threading primitives plus the audit wrappers
+# (utils/audit_lock.py) the instrumented modules use — the analyzer must
+# keep seeing a lock after a module opts into runtime auditing.
+_LOCK_FACTORIES = {
+    "Lock": False,
+    "RLock": True,
+    "Condition": True,          # wraps an RLock by default
+    "audit_lock": False,
+    "audit_rlock": True,
+    "audit_condition": True,
+}
+
+# Container/deque/dict/set methods that mutate the receiver: calling one
+# on `self.attr` is a WRITE to the guarded object, not a read.
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "rotate", "sort", "reverse",
+}
+
+# Condition methods that run their callable argument WHILE HOLDING the
+# condition (wait_for re-checks the predicate with the lock held).
+_CV_PREDICATE_METHODS = {"wait_for"}
+
+# Attrs initialized to intrinsically thread-safe primitives never infer
+# guardedness: an Event cleared inside a start() lock or a Queue drained
+# under a scheduler lock is incidental — every method on these objects
+# is already safe to call bare from any thread.
+_THREADSAFE_FACTORIES = {
+    "Event", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "Semaphore", "BoundedSemaphore", "Barrier",
+}
+
+_EXECUTOR_FACTORIES = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+
+# Call names that DEFER their callable argument to another thread or a
+# later tick — these mint entry points. Everything else that takes a
+# `self.m` argument (an evaluator, a predicate, a sort key) runs it
+# synchronously on the caller's thread under the caller's locks.
+_CALLBACK_REGISTRARS = {
+    "Timer", "register", "add_done_callback", "call_soon",
+    "call_later", "schedule", "subscribe", "on_commit",
+}
+
+# Public container-protocol dunders: callable from any thread, entry
+# points like any public method on a lock-owning class.
+_PUBLIC_DUNDERS = {
+    "__len__", "__contains__", "__getitem__", "__setitem__", "__delitem__",
+    "__iter__", "__enter__", "__exit__", "__call__",
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.X` -> "X" (else None)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Per-class model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    line: int
+    write: bool
+    held: Set[str]            # lock attrs held via enclosing `with` blocks
+    method: str
+    in_init: bool
+
+
+@dataclasses.dataclass
+class _SelfCall:
+    callee: str
+    line: int
+    held: Set[str]
+
+
+@dataclasses.dataclass
+class _AttrCall:
+    attr: str                 # self.<attr>.<method>(...)
+    method: str
+    line: int
+    held: Set[str]
+
+
+@dataclasses.dataclass
+class _Method:
+    name: str
+    node: ast.AST
+    accesses: List[_Access]
+    self_calls: List[_SelfCall]
+    attr_calls: List[_AttrCall]
+    acquires: Dict[str, int]  # lock attr -> first `with` line in this body
+
+
+@dataclasses.dataclass
+class _Class:
+    name: str
+    path: str
+    node: ast.ClassDef
+    locks: Dict[str, bool]             # lock attr -> reentrant?
+    methods: Dict[str, _Method]
+    entry_points: Dict[str, Tuple[str, bool]]  # method -> (kind, reentrant)
+    attr_types: Dict[str, str]         # self.X = ClassName(...) -> ClassName
+    safe_attrs: Set[str]               # intrinsically thread-safe attrs
+
+
+def _callable_ref(node: ast.expr) -> Optional[str]:
+    """`self.m` passed as a value -> "m" (a bound-method reference)."""
+    return _self_attr(node)
+
+
+def _lock_factory(node: ast.expr) -> Optional[bool]:
+    """Reentrancy of a lock-constructor call, or None if not a lock."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node).rsplit(".", 1)[-1]
+    if name in _LOCK_FACTORIES:
+        return _LOCK_FACTORIES[name]
+    return None
+
+
+def _held_at(ancestors: List[ast.AST], node: ast.AST,
+             locks: Dict[str, bool]) -> Set[str]:
+    """Lock attrs held at `node`, from enclosing `with self.X:` blocks and
+    from being the predicate argument of `self.X.wait_for(...)` (the
+    condition re-evaluates the predicate while holding itself)."""
+    held: Set[str] = set()
+    for i, anc in enumerate(ancestors):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                attr = _self_attr(item.context_expr)
+                if attr in locks:
+                    held.add(attr)
+        elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and i > 0:
+            # a nested def/lambda runs LATER, possibly on another thread:
+            # locks held at definition time do not apply inside it. The
+            # one exception is a `self.X.wait_for(<closure>)` predicate —
+            # the condition re-evaluates it while holding itself, so the
+            # immediately-enclosing Call restores that lock.
+            held = set()
+            parent = ancestors[i - 1]
+            if (
+                isinstance(parent, ast.Call)
+                and isinstance(parent.func, ast.Attribute)
+                and parent.func.attr in _CV_PREDICATE_METHODS
+            ):
+                cv = _self_attr(parent.func.value)
+                if cv in locks:
+                    held.add(cv)
+    return held
+
+
+def _is_write(node: ast.Attribute, ancestors: List[ast.AST]) -> bool:
+    """An attribute access that mutates: a Store/Del of the attribute, a
+    Store/Del through a subscript of it, an augmented assignment, or a
+    mutating container-method call on it."""
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return True
+    parent = ancestors[-1] if ancestors else None
+    if isinstance(parent, ast.Subscript) and parent.value is node:
+        if isinstance(parent.ctx, (ast.Store, ast.Del)):
+            return True
+        grand = ancestors[-2] if len(ancestors) >= 2 else None
+        if isinstance(grand, ast.AugAssign) and grand.target is parent:
+            return True
+    if (
+        isinstance(parent, ast.Attribute)
+        and parent.value is node
+        and parent.attr in _MUTATORS
+        and len(ancestors) >= 2
+        and isinstance(ancestors[-2], ast.Call)
+        and ancestors[-2].func is parent
+    ):
+        return True
+    return False
+
+
+def _collect_class(cls: ast.ClassDef, path: str) -> Optional[_Class]:
+    locks: Dict[str, bool] = {}
+    attr_types: Dict[str, str] = {}
+    safe_attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        attr = _self_attr(node.targets[0])
+        if attr is None:
+            continue
+        reentrant = _lock_factory(node.value)
+        if reentrant is not None:
+            locks[attr] = reentrant
+        elif isinstance(node.value, ast.Call):
+            cname = call_name(node.value)
+            if cname.rsplit(".", 1)[-1] in _THREADSAFE_FACTORIES:
+                safe_attrs.add(attr)
+            elif cname and "." not in cname and cname[:1].isupper():
+                attr_types[attr] = cname
+
+    methods: Dict[str, _Method] = {}
+    for fn in cls.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        in_init = fn.name == "__init__"
+        m = _Method(fn.name, fn, [], [], [], {})
+        for node, ancestors in walk_with_parents(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr in locks and attr not in m.acquires:
+                        m.acquires[attr] = node.lineno
+            if isinstance(node, ast.Call):
+                held = _held_at(ancestors, node, locks)
+                fattr = _self_attr(node.func)
+                if fattr is not None:
+                    m.self_calls.append(_SelfCall(fattr, node.lineno, held))
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and _self_attr(node.func.value) is not None
+                ):
+                    m.attr_calls.append(_AttrCall(
+                        _self_attr(node.func.value), node.func.attr,
+                        node.lineno, held,
+                    ))
+            attr = _self_attr(node)
+            if attr is None or attr in locks:
+                continue
+            # `self.m(...)` is a call, not a data access; `self.X.put()` IS
+            # a (read) access of X plus an attr_call.
+            parent = ancestors[-1] if ancestors else None
+            if (
+                isinstance(parent, ast.Call) and parent.func is node
+            ):
+                continue
+            held = _held_at(ancestors, node, locks)
+            m.accesses.append(_Access(
+                attr, node.lineno, _is_write(node, ancestors), held,
+                fn.name, in_init,
+            ))
+        methods[fn.name] = m
+
+    entry_points: Dict[str, Tuple[str, bool]] = {}
+    # methods passed as callables anywhere in the class body
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node)
+        tail = cname.rsplit(".", 1)[-1]
+        candidates: List[ast.expr] = list(node.args) + [
+            kw.value for kw in node.keywords
+        ]
+        for arg in candidates:
+            ref = _callable_ref(arg)
+            if ref is None or ref not in methods:
+                continue
+            if tail == "Thread":
+                entry_points.setdefault(ref, ("thread target", False))
+            elif tail in ("submit", "map"):
+                entry_points.setdefault(ref, ("executor callable", True))
+            elif tail == "signal":
+                entry_points.setdefault(ref, ("signal handler", True))
+            elif tail in _CALLBACK_REGISTRARS:
+                entry_points.setdefault(ref, ("registered callback", False))
+            # any other callable-passing is a synchronous use (a predicate,
+            # a sort key, an evaluator argument): it runs on the caller's
+            # thread under the caller's locks, not as a new entry point
+    if locks:
+        for name in methods:
+            if name == "__init__":
+                continue
+            if not name.startswith("_") or name in _PUBLIC_DUNDERS:
+                entry_points.setdefault(name, ("public method", True))
+
+    if not locks and not entry_points:
+        return None
+    return _Class(cls.name, path, cls, locks, methods, entry_points,
+                  attr_types, safe_attrs)
+
+
+def _effective_held_map(c: _Class) -> Dict[str, Set[str]]:
+    """Call following to a fixpoint: a non-entry-point method whose EVERY
+    in-class call site holds lock L (directly via `with`, or itself
+    effectively — callers of callers count) analyzes as holding L
+    throughout. An entry point can be invoked bare, so it never inherits
+    held locks from its call sites."""
+    sites: Dict[str, List[Tuple[str, Set[str]]]] = {}
+    for m in c.methods.values():
+        for sc in m.self_calls:
+            sites.setdefault(sc.callee, []).append((m.name, sc.held))
+    eff: Dict[str, Set[str]] = {}
+    for name in c.methods:
+        if name in c.entry_points or not sites.get(name):
+            eff[name] = set()
+        else:
+            eff[name] = set(c.locks)  # optimistic; narrows to fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for name, slist in sites.items():
+            if name not in eff or not eff[name] or name in c.entry_points:
+                continue
+            new: Optional[Set[str]] = None
+            for caller, held in slist:
+                h = held | eff.get(caller, set())
+                new = set(h) if new is None else (new & h)
+            new = new or set()
+            if new != eff[name]:
+                eff[name] = new
+                changed = True
+    return eff
+
+
+def _reaching_entries(c: _Class) -> Dict[str, Set[str]]:
+    """method -> set of entry-point method names that reach it through the
+    in-class self-call graph."""
+    reach: Dict[str, Set[str]] = {m: set() for m in c.methods}
+    for ep in c.entry_points:
+        if ep not in c.methods:
+            continue
+        seen: Set[str] = set()
+        stack = [ep]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            reach[cur].add(ep)
+            for sc in c.methods[cur].self_calls:
+                if sc.callee in c.methods:
+                    stack.append(sc.callee)
+    return reach
+
+
+# ---------------------------------------------------------------------------
+# guarded-attr
+# ---------------------------------------------------------------------------
+
+
+def check_guarded_attr(sources: SourceSet) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in sources:
+        if sf.tree is None:
+            continue
+        for cls in [n for n in ast.walk(sf.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            c = _collect_class(cls, sf.path)
+            if c is None or not c.locks:
+                continue
+            eff = _effective_held_map(c)
+            # guard inference: attr mutated while holding L (directly or
+            # via the one-level effective held) outside __init__
+            guards: Dict[str, Set[str]] = {}
+            inferred_in: Dict[str, str] = {}
+            for m in c.methods.values():
+                for a in m.accesses:
+                    if a.in_init or not a.write or a.attr in c.safe_attrs:
+                        continue
+                    for lk in a.held | eff[m.name]:
+                        guards.setdefault(a.attr, set()).add(lk)
+                        inferred_in.setdefault(f"{a.attr}:{lk}", a.method)
+            if not guards:
+                continue
+            reach = _reaching_entries(c)
+            # which entry points touch each guarded attr at all
+            attr_entries: Dict[str, Set[str]] = {}
+            for m in c.methods.values():
+                for a in m.accesses:
+                    if a.attr in guards and not a.in_init:
+                        attr_entries.setdefault(a.attr, set()).update(
+                            reach[m.name]
+                        )
+            for m in c.methods.values():
+                held_extra = eff[m.name]
+                for a in m.accesses:
+                    need = guards.get(a.attr)
+                    if not need or a.in_init:
+                        continue
+                    if need & (a.held | held_extra):
+                        continue
+                    entries = reach[m.name]
+                    if not entries:
+                        continue  # unreachable from any entry point
+                    touching = attr_entries.get(a.attr, set())
+                    concurrent = len(touching) >= 2 or any(
+                        c.entry_points[e][1] for e in touching
+                        if e in c.entry_points
+                    )
+                    if not concurrent:
+                        continue
+                    if sources.suppressed(sf.path, a.line, RULE_GUARDED):
+                        continue
+                    lk = sorted(need)[0]
+                    origin = inferred_in.get(f"{a.attr}:{lk}", "?")
+                    vias = sorted(
+                        f"{c.name}.{e} ({c.entry_points[e][0]})"
+                        for e in entries if e in c.entry_points
+                    )
+                    findings.append(Finding(
+                        analyzer=RULE_GUARDED,
+                        severity=(Severity.ERROR if a.write
+                                  else Severity.WARNING),
+                        location=f"{sf.path}:{a.line}",
+                        symbol=f"{c.name}.{a.attr}",
+                        message=(
+                            f"self.{a.attr} is guarded by self.{lk} "
+                            f"(mutated under the lock in {c.name}.{origin}) "
+                            f"but {'written' if a.write else 'read'} here "
+                            f"without it; reachable from "
+                            f"{', '.join(vias) or 'an entry point'} — "
+                            f"concurrent threads race on it"
+                        ),
+                    ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Edge:
+    src: str
+    dst: str
+    witness: str   # "path:line (context)"
+
+
+def _class_index(sources: SourceSet) -> Dict[str, _Class]:
+    """Unambiguous class name -> model (duplicated names are dropped:
+    cross-class edges must never guess between two definitions)."""
+    seen: Dict[str, Optional[_Class]] = {}
+    for sf in sources:
+        if sf.tree is None:
+            continue
+        for cls in [n for n in ast.walk(sf.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            c = _collect_class(cls, sf.path)
+            if cls.name in seen:
+                seen[cls.name] = None
+            else:
+                seen[cls.name] = c
+    return {k: v for k, v in seen.items() if v is not None}
+
+
+def _transitive_acquires(
+    index: Dict[str, _Class],
+) -> Dict[Tuple[str, str], Set[str]]:
+    """(class, method) -> lock NODE names ("Class.attr") the method may
+    acquire, following self-calls and known-attr-type calls to a fixpoint.
+    This is what makes a runtime-observed edge explainable even when the
+    acquisition is two helper calls deep."""
+    acq: Dict[Tuple[str, str], Set[str]] = {}
+    for c in index.values():
+        for m in c.methods.values():
+            direct = {f"{c.name}.{lk}" for lk in m.acquires}
+            acq[(c.name, m.name)] = direct
+    changed = True
+    while changed:
+        changed = False
+        for c in index.values():
+            for m in c.methods.values():
+                cur = acq[(c.name, m.name)]
+                for sc in m.self_calls:
+                    callee = acq.get((c.name, sc.callee))
+                    if callee and not callee <= cur:
+                        cur |= callee
+                        changed = True
+                for ac in m.attr_calls:
+                    tname = c.attr_types.get(ac.attr)
+                    if tname is None:
+                        continue
+                    callee = acq.get((tname, ac.method))
+                    if callee and not callee <= cur:
+                        cur |= callee
+                        changed = True
+    return acq
+
+
+def build_lock_graph(sources: SourceSet) -> List[_Edge]:
+    """All statically-derivable acquisition-order edges: `A -> B` means
+    some path acquires B while holding A."""
+    index = _class_index(sources)
+    acq = _transitive_acquires(index)
+    edges: Dict[Tuple[str, str], _Edge] = {}
+
+    def add(src: str, dst: str, witness: str) -> None:
+        if src != dst:
+            edges.setdefault((src, dst), _Edge(src, dst, witness))
+
+    for c in index.values():
+        eff = _effective_held_map(c)
+        for m in c.methods.values():
+            base = eff.get(m.name, set())
+            # nested `with` blocks within one body
+            for node, ancestors in walk_with_parents(m.node):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                inner = [
+                    _self_attr(i.context_expr) for i in node.items
+                ]
+                inner = [a for a in inner if a in c.locks]
+                if not inner:
+                    continue
+                held = _held_at(ancestors, node, c.locks) | base
+                for h in held:
+                    for i in inner:
+                        add(f"{c.name}.{h}", f"{c.name}.{i}",
+                            f"{c.path}:{node.lineno} "
+                            f"({c.name}.{m.name})")
+            # calls that acquire, while holding
+            for sc in m.self_calls:
+                held = sc.held | base
+                if not held:
+                    continue
+                for dst in acq.get((c.name, sc.callee), set()):
+                    for h in held:
+                        add(f"{c.name}.{h}", dst,
+                            f"{c.path}:{sc.line} ({c.name}.{m.name} -> "
+                            f"self.{sc.callee}())")
+            for ac in m.attr_calls:
+                held = ac.held | base
+                if not held:
+                    continue
+                tname = c.attr_types.get(ac.attr)
+                if tname is None:
+                    continue
+                for dst in acq.get((tname, ac.method), set()):
+                    for h in held:
+                        add(f"{c.name}.{h}", dst,
+                            f"{c.path}:{ac.line} ({c.name}.{m.name} -> "
+                            f"self.{ac.attr}.{ac.method}())")
+    return list(edges.values())
+
+
+def static_lock_graph(sources: SourceSet) -> Dict[str, Set[str]]:
+    """Adjacency view of build_lock_graph for the runtime sanitizer's
+    subset assertion (node names match AuditLock names: "Class.attr")."""
+    adj: Dict[str, Set[str]] = {}
+    for e in build_lock_graph(sources):
+        adj.setdefault(e.src, set()).add(e.dst)
+        adj.setdefault(e.dst, set())
+    return adj
+
+
+def _find_cycles(edges: List[_Edge]) -> List[List[_Edge]]:
+    """One representative cycle per strongly-connected component."""
+    adj: Dict[str, List[_Edge]] = {}
+    for e in edges:
+        adj.setdefault(e.src, []).append(e)
+    cycles: List[List[_Edge]] = []
+    seen_sccs: Set[frozenset] = set()
+    for start in sorted(adj):
+        # DFS looking for a path back to `start`
+        stack: List[Tuple[str, List[_Edge]]] = [(start, [])]
+        visited: Set[str] = set()
+        found: Optional[List[_Edge]] = None
+        while stack and found is None:
+            node, path = stack.pop()
+            for e in adj.get(node, []):
+                if e.dst == start:
+                    found = path + [e]
+                    break
+                if e.dst not in visited:
+                    visited.add(e.dst)
+                    stack.append((e.dst, path + [e]))
+        if found:
+            members = frozenset(e.src for e in found)
+            if members not in seen_sccs:
+                seen_sccs.add(members)
+                cycles.append(found)
+    return cycles
+
+
+def check_lock_order(sources: SourceSet) -> List[Finding]:
+    findings: List[Finding] = []
+    index = _class_index(sources)
+    acq = _transitive_acquires(index)
+
+    # self-deadlock: a call made while holding a NON-reentrant lock
+    # reaches a re-acquisition of that same lock
+    for c in index.values():
+        eff = _effective_held_map(c)
+        for m in c.methods.values():
+            for sc in m.self_calls:
+                for h in sc.held | eff.get(m.name, set()):
+                    if c.locks.get(h):
+                        continue  # reentrant: nested acquire is legal
+                    node = f"{c.name}.{h}"
+                    if node in acq.get((c.name, sc.callee), set()):
+                        if sources.suppressed(c.path, sc.line, RULE_ORDER):
+                            continue
+                        findings.append(Finding(
+                            analyzer=RULE_ORDER,
+                            severity=Severity.ERROR,
+                            location=f"{c.path}:{sc.line}",
+                            symbol=node,
+                            message=(
+                                f"{c.name}.{m.name} calls "
+                                f"self.{sc.callee}() while holding "
+                                f"non-reentrant self.{h}, and the callee "
+                                f"re-acquires it — guaranteed "
+                                f"self-deadlock"
+                            ),
+                        ))
+
+    edges = build_lock_graph(sources)
+    for cycle in _find_cycles(edges):
+        loc = cycle[0].witness.split(" ", 1)[0]
+        path, _, line = loc.rpartition(":")
+        if sources.suppressed(path, int(line or 0), RULE_ORDER):
+            continue
+        chain = "; ".join(
+            f"{e.src} -> {e.dst} at {e.witness}" for e in cycle
+        )
+        findings.append(Finding(
+            analyzer=RULE_ORDER,
+            severity=Severity.ERROR,
+            location=loc,
+            symbol=" -> ".join([e.src for e in cycle] + [cycle[0].src]),
+            message=(
+                f"lock-acquisition cycle (potential deadlock): {chain} — "
+                f"two threads taking these locks in opposite order hang "
+                f"forever"
+            ),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _assign_target(ancestors: List[ast.AST]) -> Optional[str]:
+    for anc in reversed(ancestors):
+        if isinstance(anc, ast.Assign) and len(anc.targets) == 1:
+            tgt = anc.targets[0]
+            attr = _self_attr(tgt)
+            if attr:
+                return f"self.{attr}"
+            if isinstance(tgt, ast.Name):
+                return tgt.id
+            break
+    return None
+
+
+def _enclosing_function(ancestors: List[ast.AST]) -> Optional[ast.AST]:
+    for anc in reversed(ancestors):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _closure_mutations(fn: ast.AST, outer: Optional[ast.AST]) -> List[str]:
+    """Names from the ENCLOSING scope that the closure/lambda mutates:
+    `nonlocal` writes, subscript stores, and mutating container-method
+    calls on captured names."""
+    if isinstance(fn, ast.Lambda):
+        params = {a.arg for a in fn.args.args}
+        body: List[ast.AST] = [fn.body]
+    else:
+        params = {a.arg for a in fn.args.args}
+        body = list(fn.body)
+    local_stores: Set[str] = set()
+    nonlocals: Set[str] = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Nonlocal):
+                nonlocals.update(node.names)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                local_stores.add(node.id)
+    outer_names: Set[str] = set()
+    if outer is not None:
+        for node in ast.walk(outer):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                outer_names.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                outer_names.add(node.name)
+    mutated: Set[str] = set(nonlocals)
+    for stmt in body:
+        for node, ancestors in walk_with_parents(stmt):
+            if not isinstance(node, ast.Name):
+                continue
+            name = node.id
+            if name in params or (
+                name in local_stores and name not in nonlocals
+            ):
+                continue
+            if outer is not None and name not in outer_names:
+                continue  # a global/builtin, not a captured local
+            parent = ancestors[-1] if ancestors else None
+            if isinstance(parent, ast.Subscript) and parent.value is node \
+                    and isinstance(parent.ctx, (ast.Store, ast.Del)):
+                mutated.add(name)
+            elif (
+                isinstance(parent, ast.Attribute)
+                and parent.value is node
+                and parent.attr in _MUTATORS
+                and len(ancestors) >= 2
+                and isinstance(ancestors[-2], ast.Call)
+                and ancestors[-2].func is parent
+            ):
+                mutated.add(name)
+    return sorted(mutated)
+
+
+def check_thread_lifecycle(sources: SourceSet) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in sources:
+        if sf.tree is None:
+            continue
+        # local function defs by name, for target=<name> resolution
+        local_defs: Dict[str, ast.AST] = {}
+        parents_of: Dict[int, Optional[ast.AST]] = {}
+        for node, ancestors in walk_with_parents(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs[node.name] = node
+                parents_of[id(node)] = _enclosing_function(ancestors)
+        for node, ancestors in walk_with_parents(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            tail = cname.rsplit(".", 1)[-1]
+
+            if tail == "Thread" and cname in ("threading.Thread", "Thread"):
+                daemon = keyword(node, "daemon")
+                is_daemon = (
+                    isinstance(daemon, ast.Constant)
+                    and daemon.value is True
+                )
+                target = _assign_target(ancestors)
+                if not is_daemon:
+                    joined = False
+                    if target is not None:
+                        joined = re.search(
+                            rf"{re.escape(target)}\s*\.\s*join\s*\(",
+                            sf.text,
+                        ) is not None
+                    if not joined and not sources.suppressed(
+                        sf.path, node.lineno, RULE_LIFECYCLE
+                    ):
+                        what = target or "the created thread"
+                        findings.append(Finding(
+                            analyzer=RULE_LIFECYCLE,
+                            severity=Severity.ERROR,
+                            location=f"{sf.path}:{node.lineno}",
+                            symbol=target or "threading.Thread",
+                            message=(
+                                f"threading.Thread without daemon=True "
+                                f"and no .join() on {what} in this module "
+                                f"— a leaked non-daemon thread hangs "
+                                f"interpreter exit (conftest leak-guard "
+                                f"class)"
+                            ),
+                        ))
+                # closure-capture check on the target
+                tnode = keyword(node, "target")
+                closure: Optional[ast.AST] = None
+                if isinstance(tnode, ast.Lambda):
+                    closure = tnode
+                elif isinstance(tnode, ast.Name) and tnode.id in local_defs:
+                    cand = local_defs[tnode.id]
+                    if parents_of.get(id(cand)) is not None:
+                        closure = cand  # nested def only: module-level
+                        # functions share no enclosing frame
+                if closure is not None:
+                    outer = _enclosing_function(ancestors)
+                    mutated = _closure_mutations(closure, outer)
+                    if mutated and not sources.suppressed(
+                        sf.path, node.lineno, RULE_LIFECYCLE
+                    ):
+                        findings.append(Finding(
+                            analyzer=RULE_LIFECYCLE,
+                            severity=Severity.WARNING,
+                            location=f"{sf.path}:{node.lineno}",
+                            symbol=", ".join(mutated),
+                            message=(
+                                f"thread-target closure mutates state "
+                                f"captured from the enclosing scope "
+                                f"({', '.join(mutated)}) — unsynchronized "
+                                f"cross-thread mutation; guard it with a "
+                                f"lock or hand results over a queue"
+                            ),
+                        ))
+
+            elif tail in _EXECUTOR_FACTORIES:
+                managed = any(
+                    isinstance(anc, (ast.With, ast.AsyncWith))
+                    and any(i.context_expr is node for i in anc.items)
+                    for anc in ancestors
+                )
+                if managed:
+                    continue
+                target = _assign_target(ancestors)
+                shut = False
+                if target is not None:
+                    shut = re.search(
+                        rf"{re.escape(target)}\s*\.\s*shutdown\s*\(",
+                        sf.text,
+                    ) is not None
+                if shut or sources.suppressed(
+                    sf.path, node.lineno, RULE_LIFECYCLE
+                ):
+                    continue
+                findings.append(Finding(
+                    analyzer=RULE_LIFECYCLE,
+                    severity=Severity.WARNING,
+                    location=f"{sf.path}:{node.lineno}",
+                    symbol=target or tail,
+                    message=(
+                        f"{tail} is neither context-managed (`with ... as "
+                        f"pool:`) nor .shutdown() anywhere in this module "
+                        f"— leaked worker threads keep the process alive "
+                        f"and pile up under restarts"
+                    ),
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# bare-ignore
+# ---------------------------------------------------------------------------
+
+
+def check_bare_ignores(sources: SourceSet) -> List[Finding]:
+    """A suppression without a reason is itself a finding: the inline
+    ignore contract is `# kft-analyze: ignore[rule] — why it is safe`."""
+    findings: List[Finding] = []
+    for path, line, rule, reason in sources.suppression_inventory():
+        if reason:
+            continue
+        findings.append(Finding(
+            analyzer=RULE_BARE_IGNORE,
+            severity=Severity.ERROR,
+            location=f"{path}:{line}",
+            symbol=rule,
+            message=(
+                f"bare inline ignore[{rule}] with no reason — every "
+                f"suppression must document why the flagged code is safe "
+                f"(`# kft-analyze: ignore[{rule}] — reason`)"
+            ),
+        ))
+    return findings
+
+
+def run_concurrency(sources: SourceSet) -> List[Finding]:
+    out: List[Finding] = []
+    out.extend(check_guarded_attr(sources))
+    out.extend(check_lock_order(sources))
+    out.extend(check_thread_lifecycle(sources))
+    out.extend(check_bare_ignores(sources))
+    return out

@@ -38,6 +38,10 @@ _OBSERVE_METHODS = {
     "inc": 1, "dec": 1, "set": 1, "observe": 1,
     "time": 0, "value": 0, "count": 0, "sum": 0,
 }
+# methods that WRITE a sample (the dead-series check: a declared,
+# policy-covered metric nobody ever calls one of these on is a series
+# that scrapes as permanently absent). value/count/sum are reads.
+_EMIT_METHODS = {"inc", "dec", "set", "observe", "time"}
 _CONFIG_MODULE = "kubeflow_tpu/config/platform.py"
 _FLEET_MODULE = "kubeflow_tpu/observability/fleet.py"
 _POLICY_TABLE = "AGGREGATION_POLICY"
@@ -272,6 +276,155 @@ def check_metrics_consistency(
     return findings
 
 
+def _return_metric_names(
+    fn: ast.FunctionDef, path: str, helpers: Dict[str, List[str]]
+) -> List[str]:
+    """The metric name(s) a helper function's single return statement
+    declares: a registry call, a call to an already-known helper, or a
+    tuple of those resolved element-wise (trace.py's _sampling_counters
+    returns `trace_kept_counter(), trace_sampled_out_counter()`)."""
+    rets = [
+        n for n in ast.walk(fn)
+        if isinstance(n, ast.Return) and n.value is not None
+    ]
+    if len(rets) != 1:
+        return []
+    val = rets[0].value
+    elts = val.elts if isinstance(val, ast.Tuple) else [val]
+    names: List[str] = []
+    for e in elts:
+        if not isinstance(e, ast.Call):
+            return []
+        d = _metric_decl(e, path)
+        if d is not None:
+            names.append(d.name)
+            continue
+        h = helpers.get(call_name(e).rsplit(".", 1)[-1])
+        if not h or len(h) != 1:
+            return []
+        names.append(h[0])
+    return names
+
+
+def _helper_metric_names(sources: SourceSet) -> Dict[str, List[str]]:
+    """utils/metrics.py helper-function name -> the metric name(s) its one
+    return's registry call declares (`def router_requests_counter(): return
+    reg.counter("router_requests_total", ...)`), so call sites that go
+    through the helper still count as touching the metric."""
+    out: Dict[str, List[str]] = {}
+    for sf in sources:
+        if sf.tree is None or not sf.path.endswith("utils/metrics.py"):
+            continue
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            names = _return_metric_names(fn, sf.path, {})
+            if names:
+                out[fn.name] = names
+    return out
+
+
+def _emitted_metric_names(sources: SourceSet) -> Set[str]:
+    """Metric names with at least one statically-visible WRITE site
+    (.inc/.dec/.set/.observe/.time) anywhere in the tree.
+
+    Resolution is deliberately coarse — per FILE, any assignment binding
+    a name or self-attribute to a metric declaration (or to a
+    utils/metrics.py helper call) links later writes through that
+    receiver to the metric. Coarseness only ever marks MORE metrics as
+    emitted, which keeps the dead-series check conservative: it flags a
+    series only when no write site is findable under any binding."""
+    helpers = _helper_metric_names(sources)
+    emitted: Set[str] = set()
+
+    def bind_key(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return node.id
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return f"self.{node.attr}"
+        return None
+
+    for sf in sources:
+        if sf.tree is None:
+            continue
+        # helpers local to THIS file (trace.py's _sampling_counters) —
+        # resolved against the global utils/metrics.py helper map
+        local: Dict[str, List[str]] = {}
+        for _ in range(2):  # helpers may chain through other local helpers
+            for fn in ast.walk(sf.tree):
+                if not isinstance(fn, ast.FunctionDef) or fn.name in local:
+                    continue
+                names = _return_metric_names(
+                    fn, sf.path, {**helpers, **local}
+                )
+                if names:
+                    local[fn.name] = names
+        bound: Dict[str, List[str]] = {}  # receiver key -> metric name(s)
+
+        def resolve_call(node: ast.Call) -> List[str]:
+            d = _metric_decl(node, sf.path)
+            if d is not None:
+                return [d.name]
+            helper = call_name(node).rsplit(".", 1)[-1]
+            return local.get(helper) or helpers.get(helper) or []
+
+        def value_names(v: ast.AST) -> List[str]:
+            if isinstance(v, ast.Call):
+                return resolve_call(v)
+            if isinstance(v, ast.Tuple):
+                out: List[str] = []
+                for e in v.elts:
+                    r = value_names(e)
+                    if len(r) != 1:
+                        return []
+                    out.append(r[0])
+                return out
+            k = bind_key(v)  # Name / self-attr READ: propagate its binding
+            return bound.get(k, []) if k else []
+
+        # two passes: chaos/core.py's `faults = self._faults` reads a
+        # binding made in a method ast.walk may visit later
+        for _ in range(2):
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Tuple):
+                    # `kept, dropped = _sampling_counters()` — element-wise
+                    names = value_names(node.value)
+                    if len(names) == len(tgt.elts):
+                        for t, n in zip(tgt.elts, names):
+                            k = bind_key(t)
+                            if k is not None:
+                                bound[k] = [n]
+                    continue
+                k = bind_key(tgt)
+                if k is None:
+                    continue
+                names = value_names(node.value)
+                if names:
+                    bound[k] = names
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in _EMIT_METHODS:
+                continue
+            recv = node.func.value
+            if isinstance(recv, ast.Call):
+                emitted.update(resolve_call(recv))
+            else:
+                k = bind_key(recv)
+                if k is not None:
+                    emitted.update(bound.get(k, ()))
+    return emitted
+
+
 # ---------------------------------------------------------------------------
 # fleet aggregation-policy table (rides the metrics-consistency rule)
 # ---------------------------------------------------------------------------
@@ -428,6 +581,29 @@ def check_aggregation_policy(
                     ),
                 )
             )
+    # the reverse direction (dead series): a policy-covered, declared
+    # metric with NO write site anywhere scrapes as permanently absent —
+    # the table and declaration promise a series the fleet never sees
+    emitted = _emitted_metric_names(sources)
+    for name, (policy, line) in sorted(policies.items()):
+        if name not in kinds or name in emitted:
+            continue
+        if sources.suppressed(_FLEET_MODULE, line, rule):
+            continue
+        findings.append(
+            Finding(
+                analyzer=rule,
+                severity=Severity.WARNING,
+                location=decl_loc.get(name, f"{_FLEET_MODULE}:{line}"),
+                symbol=name,
+                message=(
+                    f"metric {name!r} is declared and policy-covered but "
+                    f"never emitted (.inc/.set/.observe) anywhere — a dead "
+                    f"series: drop the declaration+policy or wire up the "
+                    f"write site"
+                ),
+            )
+        )
     for name, loc in sorted(decl_loc.items()):
         if name.startswith(_FLEET_PRODUCED_PREFIX) or name in policies:
             continue

@@ -1,211 +1,30 @@
 """Control-plane invariant lint — AST passes over the Python sources.
 
 The `jscheck` idiom (static reference checks instead of an engine) applied
-to the 18k-LoC Python control plane, aimed at the bug classes the advisor
-rounds actually hit:
+to the 18k-LoC Python control plane:
 
-- **lock-discipline**: an attribute that is written under `with self._lock:`
-  in any method is lock-guarded; reading or writing it outside that lock in
-  the same class is the PR-2 serving-header race class (a handler read
-  `last_device_decomp` written by a concurrent request's locked device
-  call). ThreadSanitizer-style, but static and scoped to the class.
-- **thread-hygiene**: every `threading.Thread(...)` must either be
-  `daemon=True` or be joined somewhere in its module — the conftest
-  non-daemon leak-guard, moved to before commit time.
 - **shard-map-vma**: `shard_map(..., check_vma=False)` (or the pre-vma
   spelling `check_rep=False`) disables the varying-mesh-axes checker for
   the whole call; the one audited exception lives in
   kubeflow_tpu/parallel/shard_map.py::shard_map_pallas and every other
   call site must go through it (advisor round-5; VERDICT next-round #9).
+
+The former shallow `lock-discipline` / `thread-hygiene` rules moved into
+the interprocedural concurrency pass (analysis/concurrency.py: the
+guarded-attr and thread-lifecycle rules subsume them with entry-point
+reachability and one-level call following).
 """
 
 from __future__ import annotations
 
 import ast
-import re
-from typing import Dict, List, Optional, Set, Tuple
+from typing import List
 
 from kubeflow_tpu.analysis.findings import Finding, Severity
-from kubeflow_tpu.analysis.sources import (
-    SourceSet,
-    call_name,
-    keyword,
-    walk_with_parents,
-)
+from kubeflow_tpu.analysis.sources import SourceSet, call_name, keyword
 
 # The single module allowed to spell check_vma/check_rep directly.
 VMA_HELPER_PATH = "kubeflow_tpu/parallel/shard_map.py"
-
-_LOCK_FACTORIES = ("Lock", "RLock", "Condition")
-
-
-# ---------------------------------------------------------------------------
-# lock-discipline
-# ---------------------------------------------------------------------------
-
-
-def _self_attr(node: ast.AST) -> Optional[str]:
-    """`self.X` -> "X" (else None)."""
-    if (
-        isinstance(node, ast.Attribute)
-        and isinstance(node.value, ast.Name)
-        and node.value.id == "self"
-    ):
-        return node.attr
-    return None
-
-
-def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
-    """Attributes assigned from threading.Lock()/RLock()/Condition()."""
-    out: Set[str] = set()
-    for node in ast.walk(cls):
-        if not isinstance(node, ast.Assign):
-            continue
-        if not isinstance(node.value, ast.Call):
-            continue
-        name = call_name(node.value)
-        if not any(name.endswith(f".{f}") or name == f for f in _LOCK_FACTORIES):
-            continue
-        for tgt in node.targets:
-            attr = _self_attr(tgt)
-            if attr:
-                out.add(attr)
-    return out
-
-
-def _with_locks(ancestors: List[ast.AST], locks: Set[str]) -> Set[str]:
-    """Lock attrs held at this point, from enclosing `with self.X:` blocks."""
-    held: Set[str] = set()
-    for anc in ancestors:
-        if isinstance(anc, (ast.With, ast.AsyncWith)):
-            for item in anc.items:
-                attr = _self_attr(item.context_expr)
-                if attr in locks:
-                    held.add(attr)
-    return held
-
-
-def check_lock_discipline(sources: SourceSet) -> List[Finding]:
-    rule = "lock-discipline"
-    findings: List[Finding] = []
-    for sf in sources:
-        if sf.tree is None:
-            continue
-        for cls in [n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)]:
-            locks = _lock_attrs(cls)
-            if not locks:
-                continue
-            # pass 1: attrs stored while holding each lock (outside __init__;
-            # construction happens before the object is shared)
-            guarded: Dict[str, Set[str]] = {}
-            accesses: List[Tuple[str, str, int, bool, Set[str]]] = []
-            # (attr, ctx, line, in_init, held_locks)
-            for fn in cls.body:
-                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    continue
-                in_init = fn.name == "__init__"
-                for node, ancestors in walk_with_parents(fn):
-                    attr = _self_attr(node)
-                    if attr is None or attr in locks:
-                        continue
-                    held = _with_locks(ancestors, locks)
-                    is_store = isinstance(node.ctx, (ast.Store, ast.Del))
-                    accesses.append(
-                        (attr, "store" if is_store else "load",
-                         node.lineno, in_init, held)
-                    )
-                    if is_store and not in_init:
-                        for lk in held:
-                            guarded.setdefault(lk, set()).add(attr)
-            if not guarded:
-                continue
-            attr_to_locks: Dict[str, Set[str]] = {}
-            for lk, attrs in guarded.items():
-                for a in attrs:
-                    attr_to_locks.setdefault(a, set()).add(lk)
-            for attr, ctx, line, in_init, held in accesses:
-                need = attr_to_locks.get(attr)
-                if not need or in_init:
-                    continue
-                if need & held:
-                    continue
-                if sources.suppressed(sf.path, line, rule):
-                    continue
-                lock_names = "/".join(sorted(f"self.{lk}" for lk in need))
-                findings.append(
-                    Finding(
-                        analyzer=rule,
-                        severity=Severity.ERROR,
-                        location=f"{sf.path}:{line}",
-                        symbol=f"{cls.name}.{attr}",
-                        message=(
-                            f"self.{attr} is written under `with {lock_names}` "
-                            f"elsewhere in {cls.name} but {ctx} here without "
-                            f"the lock (concurrent callers race)"
-                        ),
-                    )
-                )
-    return findings
-
-
-# ---------------------------------------------------------------------------
-# thread-hygiene
-# ---------------------------------------------------------------------------
-
-
-def check_thread_hygiene(sources: SourceSet) -> List[Finding]:
-    rule = "thread-hygiene"
-    findings: List[Finding] = []
-    for sf in sources:
-        if sf.tree is None:
-            continue
-        for node, ancestors in walk_with_parents(sf.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            name = call_name(node)
-            if name not in ("threading.Thread", "Thread"):
-                continue
-            daemon = keyword(node, "daemon")
-            if isinstance(daemon, ast.Constant) and daemon.value is True:
-                continue
-            # non-daemon (explicit False or defaulted): require a .join on
-            # the assignment target somewhere in the module
-            target = None
-            for anc in reversed(ancestors):
-                if isinstance(anc, ast.Assign) and len(anc.targets) == 1:
-                    tgt = anc.targets[0]
-                    attr = _self_attr(tgt)
-                    if attr:
-                        target = f"self.{attr}"
-                    elif isinstance(tgt, ast.Name):
-                        target = tgt.id
-                    break
-            joined = False
-            if target is not None:
-                joined = re.search(
-                    rf"{re.escape(target)}\s*\.\s*join\s*\(", sf.text
-                ) is not None
-            if joined:
-                continue
-            if sources.suppressed(sf.path, node.lineno, rule):
-                continue
-            what = target or "the created thread"
-            findings.append(
-                Finding(
-                    analyzer=rule,
-                    severity=Severity.ERROR,
-                    location=f"{sf.path}:{node.lineno}",
-                    symbol=target or "threading.Thread",
-                    message=(
-                        f"threading.Thread without daemon=True and no "
-                        f".join() on {what} in this module — a leaked "
-                        f"non-daemon thread hangs interpreter exit "
-                        f"(conftest leak-guard class)"
-                    ),
-                )
-            )
-    return findings
-
 
 # ---------------------------------------------------------------------------
 # shard-map-vma
@@ -261,7 +80,5 @@ def run_control_plane(sources: SourceSet) -> List[Finding]:
                     message=f"syntax error: {sf.parse_error}",
                 )
             )
-    out.extend(check_lock_discipline(sources))
-    out.extend(check_thread_hygiene(sources))
     out.extend(check_shard_map_vma(sources))
     return out

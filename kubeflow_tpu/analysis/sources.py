@@ -3,9 +3,10 @@
 The `jscheck` idiom applied to Python: analyzers never re-read or re-parse
 files themselves — they consume one `SourceSet` so every pass agrees on
 which files exist, what their ASTs are, and which lines carry inline
-suppressions (`# kft-analyze: ignore[rule]`, the escape hatch for the rare
-deliberate exception; CI greps for these in review, they are not a silent
-baseline).
+suppressions (`# kft-analyze: ignore[rule] — reason`, the escape hatch for
+the rare deliberate exception; the reason text is MANDATORY — the
+bare-ignore lint fails on a reason-less ignore — and `--list-ignores`
+inventories every one, so they are never a silent baseline).
 """
 
 from __future__ import annotations
@@ -23,7 +24,9 @@ _SKIP_DIRS = {
     ".venv", "venv", ".tox", ".eggs", ".mypy_cache", ".pytest_cache",
 }
 
-_SUPPRESS_RE = re.compile(r"#\s*kft-analyze:\s*ignore\[([a-z0-9_,\- ]+)\]")
+_SUPPRESS_RE = re.compile(
+    r"#\s*kft-analyze:\s*ignore\[([a-z0-9_,\- ]+)\]\s*[—:-]?\s*(.*)"
+)
 
 
 @dataclasses.dataclass
@@ -33,33 +36,39 @@ class SourceFile:
     tree: Optional[ast.AST]          # None when the file fails to parse
     parse_error: Optional[str]
     suppressions: Dict[int, Set[str]]  # line -> suppressed rule names
+    suppression_reasons: Dict[int, str]  # line -> reason text ("" = bare)
 
 
-def _scan_suppressions(text: str) -> Dict[int, Set[str]]:
+def _scan_suppressions(text: str) -> Tuple[Dict[int, Set[str]], Dict[int, str]]:
     """Real COMMENT tokens only: a docstring QUOTING the ignore syntax
     (sources.py's own docs, the catalog in findings.py) is not a
     suppression. Tokenize decides what is a comment; unparseable files
-    fall back to the line scan (their parse error is reported anyway)."""
+    fall back to the line scan (their parse error is reported anyway).
+
+    The text after the closing bracket is the suppression's REASON; the
+    bare-ignore lint (analysis/concurrency.py) requires it to be
+    non-empty, so every shipped exception documents why it is safe."""
     out: Dict[int, Set[str]] = {}
+    reasons: Dict[int, str] = {}
+
+    def note(lineno: int, m: "re.Match") -> None:
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out[lineno] = rules
+        reasons[lineno] = m.group(2).strip()
+
     try:
         for tok in tokenize.generate_tokens(io.StringIO(text).readline):
             if tok.type != tokenize.COMMENT:
                 continue
             m = _SUPPRESS_RE.search(tok.string)
             if m:
-                rules = {
-                    r.strip() for r in m.group(1).split(",") if r.strip()
-                }
-                out[tok.start[0]] = rules
+                note(tok.start[0], m)
     except (tokenize.TokenError, IndentationError, SyntaxError):
         for i, line in enumerate(text.splitlines(), start=1):
             m = _SUPPRESS_RE.search(line)
             if m:
-                rules = {
-                    r.strip() for r in m.group(1).split(",") if r.strip()
-                }
-                out[i] = rules
-    return out
+                note(i, m)
+    return out, reasons
 
 
 class SourceSet:
@@ -92,35 +101,45 @@ class SourceSet:
             tree = ast.parse(text, filename=rel)
         except SyntaxError as e:
             err = f"line {e.lineno}: {e.msg}"
+        suppressions, reasons = _scan_suppressions(text)
         self.files[rel] = SourceFile(
             path=rel,
             text=text,
             tree=tree,
             parse_error=err,
-            suppressions=_scan_suppressions(text),
+            suppressions=suppressions,
+            suppression_reasons=reasons,
         )
 
     def __iter__(self) -> Iterator[SourceFile]:
         return iter(self.files.values())
 
     def suppressed(self, path: str, line: int, rule: str) -> bool:
+        """True when `line` carries (or the line directly above carries —
+        multi-line expressions leave no room on the flagged line itself)
+        an ignore for `rule`."""
         sf = self.files.get(path)
         if sf is None:
             return False
-        rules = sf.suppressions.get(line, set())
-        return rule in rules or "all" in rules
+        for ln in (line, line - 1):
+            rules = sf.suppressions.get(ln, set())
+            if rule in rules or "all" in rules:
+                return True
+        return False
 
-    def suppression_inventory(self) -> List[Tuple[str, int, str]]:
-        """Every inline ignore in the tree as (path, line, rule) — the
-        `--list-ignores` CLI inventory. The repo's clean-pass discipline
-        says this ships EMPTY (tests/test_analysis.py enforces it); the
+    def suppression_inventory(self) -> List[Tuple[str, int, str, str]]:
+        """Every inline ignore in the tree as (path, line, rule, reason) —
+        the `--list-ignores` CLI inventory. The repo's clean-pass
+        discipline says every row carries a non-empty reason
+        (tests/test_analysis.py and the bare-ignore lint enforce it); the
         inventory exists so a reviewed exception is one command away
         from an audit, never a silent baseline."""
-        rows: List[Tuple[str, int, str]] = []
+        rows: List[Tuple[str, int, str, str]] = []
         for sf in self:
             for line, rules in sorted(sf.suppressions.items()):
+                reason = sf.suppression_reasons.get(line, "")
                 for rule in sorted(rules):
-                    rows.append((sf.path, line, rule))
+                    rows.append((sf.path, line, rule, reason))
         return sorted(rows)
 
 

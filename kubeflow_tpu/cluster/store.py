@@ -128,7 +128,12 @@ class StateStore:
             self._normalizers.setdefault(kind, []).append(fn)
 
     def _normalize(self, obj: Dict[str, Any]) -> None:
-        for fn in self._normalizers.get(obj.get("kind", ""), []):
+        # snapshot under the lock (add_normalizer appends concurrently);
+        # the callbacks themselves run OUTSIDE it — a conversion hook must
+        # not serialize every write path behind user code
+        with self._lock:
+            fns = list(self._normalizers.get(obj.get("kind", ""), []))
+        for fn in fns:
             fn(obj)
 
     # -- internals -------------------------------------------------------

@@ -219,7 +219,12 @@ class NotebookController(Controller):
 
         sts = self._generate_statefulset(nb, replicas)
         set_owner(sts, nb)
+        # created-vs-updated must be decided BEFORE the apply — apply()
+        # is create-or-update and does not report which one happened
+        created = store.try_get("StatefulSet", name, namespace) is None
         store.apply(sts)
+        if created:
+            self._create_total.inc()
         svc = self._generate_service(nb)
         set_owner(svc, nb)
         store.apply(svc)

@@ -264,6 +264,18 @@ class TPUTrainJobController(Controller):
             [],
         )
         self._running = reg.gauge("tpujob_running", "jobs currently running", [])
+        # (ns, job) gangs currently in the Running condition — the gauge's
+        # backing set (a reconcile sees one job; the gauge is fleet-wide)
+        self._running_jobs: set = set()
+
+    def _set_running(self, job: Dict[str, Any], running: bool) -> None:
+        m = job["metadata"]
+        key = (m["namespace"], m["name"])
+        if running:
+            self._running_jobs.add(key)
+        else:
+            self._running_jobs.discard(key)
+        self._running.set(float(len(self._running_jobs)))
 
     # -- reconcile --------------------------------------------------------
 
@@ -418,6 +430,7 @@ class TPUTrainJobController(Controller):
             changed |= set_condition(
                 job, COND_RUNNING, "True", "GangRunning", "all workers running"
             )
+            self._set_running(job, True)
         if changed:
             self._write_status(store, job)
         # periodic deadline check while non-terminal
@@ -969,6 +982,7 @@ class TPUTrainJobController(Controller):
         job["status"]["completionTime"] = now_iso()
         m = job["metadata"]
         self._drop_straggler_state((m["namespace"], m["name"]))
+        self._set_running(job, False)
         self._jobs_total.inc(outcome=cond.lower())
         store.record_event(
             job, reason, message, type="Normal" if cond == COND_SUCCEEDED else "Warning"
@@ -996,6 +1010,7 @@ class TPUTrainJobController(Controller):
     def _handle_deletion(self, store: StateStore, job: Dict[str, Any]) -> Result:
         m = job["metadata"]
         self._drop_straggler_state((m["namespace"], m["name"]))
+        self._set_running(job, False)
         for kind in ("Pod", "Service"):
             for obj in list_owned(store, job, kind):
                 try:

@@ -231,6 +231,11 @@ class Router:
                 if now - srv.created_at > self.max_lifetime_s:
                     expired.append(srv)
                     del self._servers[name]
+            # snapshot the survivors INSIDE the critical section — the
+            # record scan below must not read the dict while a concurrent
+            # /create mutates it (fresh records themselves are safe either
+            # way: reaping is age-gated on updated_at)
+            live = set(self._servers)
         for srv in expired:
             srv.shutdown()
             self._gc_total.inc()
@@ -238,7 +243,6 @@ class Router:
         # expired durable records (recovered or live) leave the disk too —
         # the GC contract covers the app dirs (gcServer.go expiry)
         if self.records is not None:
-            live = set(self._servers)
             for name in self.records.list_names():
                 if name in live:
                     continue

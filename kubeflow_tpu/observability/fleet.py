@@ -71,6 +71,7 @@ from kubeflow_tpu.observability.slo import (
     check_signal_kinds,
     parse_rules,
 )
+from kubeflow_tpu.utils.audit_lock import audit_lock
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import (
     HistogramState,
@@ -398,7 +399,7 @@ class FleetCollector:
         # the first 3am sweep
         check_signal_kinds(rules, AGGREGATION_POLICY)
         self._slo = SloEngine(rules, burn_window=burn_window)
-        self._lock = threading.Lock()
+        self._lock = audit_lock("FleetCollector._lock")
         self._state: Dict[ScrapeTarget, _TargetState] = {}
         self._merged: Dict[str, ParsedMetric] = {}
         self._groups: Dict[Tuple[str, str, str], Dict[str, ParsedMetric]] = {}
@@ -443,20 +444,27 @@ class FleetCollector:
     # -- scrape loop -------------------------------------------------------
 
     def start(self) -> None:
-        """Run the scrape loop on a daemon thread until stop()."""
-        if self._thread is not None:
-            return
-        self._thread = threading.Thread(
-            target=self._run, daemon=True, name="fleet-collector"
-        )
-        self._thread.start()
+        """Run the scrape loop on a daemon thread until stop().
+        Restartable: a start() after stop() scrapes again."""
+        # check-then-act under the lock: two racing start() calls must not
+        # both observe _thread is None and spawn duplicate scrape loops
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            t = threading.Thread(
+                target=self._run, daemon=True, name="fleet-collector"
+            )
+            self._thread = t
+        t.start()
 
     def stop(self) -> None:
         self._stop.set()
-        t = self._thread
+        with self._lock:
+            t = self._thread
+            self._thread = None
         if t is not None:
             t.join(timeout=5)
-        self._thread = None
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -55,6 +55,7 @@ from kubeflow_tpu.observability.trace import (
     parse_traceparent,
 )
 from kubeflow_tpu.routing.affinity import first_page_key, rendezvous_rank
+from kubeflow_tpu.utils.audit_lock import audit_lock
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import (
     router_affinity_hits_counter,
@@ -302,7 +303,7 @@ class FleetRouter:
                 )
             )
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = audit_lock("FleetRouter._lock")
         self._replicas: Dict[str, Replica] = {}
         self._states: Dict[str, _ReplicaState] = {}
         self._inflight: Dict[str, int] = {}
@@ -470,20 +471,25 @@ class FleetRouter:
     def start(self) -> None:
         """Run the probe loop on a daemon thread until stop().
         Restartable: a start() after stop() probes again."""
-        if self._thread is not None:
-            return
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, daemon=True, name="router-probe"
-        )
-        self._thread.start()
+        # check-then-act under the lock: two racing start() calls must not
+        # both observe _thread is None and spawn duplicate probe loops
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            t = threading.Thread(
+                target=self._run, daemon=True, name="router-probe"
+            )
+            self._thread = t
+        t.start()
 
     def stop(self) -> None:
         self._stop.set()
-        t = self._thread
+        with self._lock:
+            t = self._thread
+            self._thread = None
         if t is not None:
             t.join(timeout=5)
-        self._thread = None
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -106,6 +106,7 @@ from kubeflow_tpu.serving.sampling import (
     slot_filtered_logits,
     speculative_accept,
 )
+from kubeflow_tpu.utils.audit_lock import audit_condition, audit_lock
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.routing.affinity import first_page_key
 from kubeflow_tpu.utils.metrics import (
@@ -1629,7 +1630,7 @@ class DecodeEngine:
         self._topp_np = np.ones((num_slots,), np.float32)
 
         # -- shared state (condition-lock-guarded) ----------------------
-        self._cv = threading.Condition()
+        self._cv = audit_condition("DecodeEngine._cv")
         self._queue: deque = deque()
         self._stop = False
         # draining shutdown (docs/ROBUSTNESS.md drain contract): once
@@ -1643,7 +1644,7 @@ class DecodeEngine:
         self._draining = False
         self._admitting = 0
 
-        self._stats_lock = threading.Lock()
+        self._stats_lock = audit_lock("DecodeEngine._stats_lock")
         self._admitted = 0
         self._steps = 0
         self._emitted = 0
@@ -2393,8 +2394,10 @@ class DecodeEngine:
         self._attn_calls.inc(
             model=self.name, variant=self.paged_attention
         )
-        if window not in self._attn_windows:
-            with self._stats_lock:
+        # membership test and insert under ONE lock hold: the unlocked
+        # check-then-act raced stats()' locked iteration of the map
+        with self._stats_lock:
+            if window not in self._attn_windows:
                 self._attn_windows[window] = self.paged_attention
 
     def _admit(self, slot_idx: int, req: _Request) -> None:

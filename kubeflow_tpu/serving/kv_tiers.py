@@ -48,6 +48,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..checkpointing import layout
+from ..utils.audit_lock import audit_lock
 
 logger = logging.getLogger("kubeflow_tpu.serving.kv_tiers")
 
@@ -142,7 +143,7 @@ class HostKVTier:
         self.budget_bytes = int(budget_bytes)
         self._entries: "OrderedDict[TokenKey, PageEntry]" = OrderedDict()
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = audit_lock("HostKVTier._lock")
         self.spilled_pages_total = 0
         self.hit_pages_total = 0
         self.evicted_pages_total = 0
@@ -395,10 +396,10 @@ def pool_sizing_telemetry(registry=None) -> Optional[Dict[str, float]]:
     total = reg.get("serving_kv_pages_total")
     if in_use is None or total is None:
         return None
-    with total._lock:
-        totals = dict(total._values)
-    with in_use._lock:
-        uses = dict(in_use._values)
+    # public locked snapshots — reaching into metric._values while the
+    # engine's scheduler thread updates them was a torn-read race
+    totals = total.values_snapshot()
+    uses = in_use.values_snapshot()
     utils = [
         uses.get(k, 0.0) / v for k, v in totals.items() if v > 0
     ]
@@ -408,10 +409,8 @@ def pool_sizing_telemetry(registry=None) -> Optional[Dict[str, float]]:
     hits = reg.get("serving_prefix_cache_hit_tokens_total")
     lookups = reg.get("serving_prefix_cache_lookups_total")
     if hits is not None and lookups is not None:
-        with hits._lock:
-            h = sum(hits._values.values())
-        with lookups._lock:
-            n = sum(lookups._values.values())
+        h = sum(hits.values_snapshot().values())
+        n = sum(lookups.values_snapshot().values())
         # hit tokens per lookup, squashed to [0, 1] against a nominal
         # 64-token prefix (CHUNK_MIN_TOKENS) — a coarse reuse signal,
         # not an exact ratio.

@@ -461,7 +461,8 @@ class ModelServer:
 
             workers = [
                 # joined below; daemon=True so even an interpreter
-                # teardown racing a wedged drain cannot hang exit
+                # teardown racing a wedged drain cannot hang exit.
+                # kft-analyze: ignore[thread-lifecycle] — each worker writes a distinct results[n] key and results is only read after every join() below
                 threading.Thread(
                     target=_drain_one,
                     args=(name, engine),

@@ -1,0 +1,355 @@
+"""Runtime lock-order sanitizer — the dynamic half of `kft-analyze
+concurrency` (analysis/concurrency.py is the static half).
+
+The static analyzer proves properties about the lock graph it can SEE;
+this module records the lock graph that actually HAPPENS.  Product
+modules construct their locks through the `audit_lock` / `audit_rlock` /
+`audit_condition` factories (the analyzer's `_LOCK_FACTORIES` table
+knows these names, so a converted module still reads as lock-owning).
+Disarmed — the default — every wrapper method is a single bool check
+plus a delegate call into the real `threading` primitive; the test suite
+budget-asserts this stays noise (`tests/test_concurrency_lint.py`,
+modeled on the disarmed-chaos microbench).
+
+Armed (``KFT_CONCURRENCY_AUDIT=1``, or ``default_auditor().enable()``),
+every acquisition:
+
+- checks for SELF-DEADLOCK: re-acquiring a non-reentrant lock already
+  held by this thread would block forever, so the auditor raises
+  ``LockAuditError`` at the exact call site instead of hanging CI;
+- records an ORDER EDGE ``held -> acquired`` for every distinct lock the
+  thread already holds, with a witness (thread name + held stack), into
+  a process-global graph.
+
+After a run, the conftest hook (and any test) can assert the observed
+graph is acyclic (`find_cycle()`) and that every observed edge is
+explainable by the static analyzer's graph (`unexplained_edges()` — an
+observed edge must be a PATH in the static graph, not necessarily a
+direct edge, because runtime collapses helper-call chains).  Lock names
+follow the static node format ``ClassName._attr`` so the two graphs join
+without translation.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+ENV_AUDIT = "KFT_CONCURRENCY_AUDIT"
+
+
+class LockAuditError(RuntimeError):
+    """A would-be deadlock caught at the acquisition site."""
+
+
+class LockAuditor:
+    """Process-global recorder of real lock-acquisition order.
+
+    Thread-compatible by construction: the per-thread held stack lives in
+    a ``threading.local`` (no sharing), and the shared edge/violation
+    tables are guarded by a plain internal mutex that is only ever taken
+    as the innermost lock (the auditor acquires nothing else while
+    holding it, so it can never participate in an ordering cycle).
+    """
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self._tls = threading.local()
+        self._mu = threading.Lock()
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._violations: List[str] = []
+
+    # -- arming ------------------------------------------------------------
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._violations.clear()
+
+    # -- recording (called by the wrappers, only when enabled) -------------
+
+    def _stack(self) -> List[str]:
+        try:
+            return self._tls.stack
+        except AttributeError:
+            st: List[str] = []
+            self._tls.stack = st
+            return st
+
+    def pre_acquire(self, name: str, reentrant: bool) -> None:
+        """Self-deadlock check — runs BEFORE the blocking acquire so the
+        failure is a raise at the call site, not a hung worker."""
+        if not reentrant and name in self._stack():
+            msg = (
+                f"self-deadlock: thread {threading.current_thread().name!r} "
+                f"re-acquired non-reentrant {name} while holding "
+                f"{self._stack()!r}"
+            )
+            with self._mu:
+                self._violations.append(msg)
+            raise LockAuditError(msg)
+
+    def note_acquired(self, name: str) -> None:
+        stack = self._stack()
+        if stack:
+            witness = (
+                f"thread {threading.current_thread().name!r} held "
+                f"{stack!r} then took {name}"
+            )
+            with self._mu:
+                for held in stack:
+                    if held != name:
+                        self._edges.setdefault((held, name), witness)
+        stack.append(name)
+
+    def note_released(self, name: str) -> None:
+        stack = self._stack()
+        # remove the LAST occurrence: reentrant locks nest
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # -- post-run queries --------------------------------------------------
+
+    def violations(self) -> List[str]:
+        with self._mu:
+            return list(self._violations)
+
+    def observed_edges(self) -> Dict[Tuple[str, str], str]:
+        with self._mu:
+            return dict(self._edges)
+
+    def observed_graph(self) -> Dict[str, Set[str]]:
+        graph: Dict[str, Set[str]] = {}
+        for (src, dst) in self.observed_edges():
+            graph.setdefault(src, set()).add(dst)
+        return graph
+
+    def find_cycle(self) -> Optional[List[str]]:
+        """A lock-order cycle in the observed graph (as a node list with
+        the start repeated at the end), or None when acyclic."""
+        graph = self.observed_graph()
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in graph}
+        path: List[str] = []
+
+        def visit(n: str) -> Optional[List[str]]:
+            color[n] = GRAY
+            path.append(n)
+            for m in sorted(graph.get(n, ())):
+                c = color.get(m, WHITE)
+                if c == GRAY:
+                    return path[path.index(m):] + [m]
+                if c == WHITE:
+                    found = visit(m)
+                    if found:
+                        return found
+            path.pop()
+            color[n] = BLACK
+            return None
+
+        for node in sorted(graph):
+            if color[node] == WHITE:
+                found = visit(node)
+                if found:
+                    return found
+        return None
+
+    def unexplained_edges(
+        self, static_graph: Dict[str, Set[str]]
+    ) -> List[Tuple[str, str, str]]:
+        """Observed edges with no corresponding PATH in the static graph
+        (runtime collapses helper-call chains, so reachability — not
+        direct adjacency — is the consistency contract). Each row is
+        (src, dst, witness)."""
+        out: List[Tuple[str, str, str]] = []
+        for (src, dst), witness in sorted(self.observed_edges().items()):
+            seen: Set[str] = set()
+            frontier = [src]
+            reachable = False
+            while frontier:
+                cur = frontier.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                nxt = static_graph.get(cur, set())
+                if dst in nxt:
+                    reachable = True
+                    break
+                frontier.extend(nxt)
+            if not reachable:
+                out.append((src, dst, witness))
+        return out
+
+
+_AUDITOR = LockAuditor()
+
+
+def default_auditor() -> LockAuditor:
+    return _AUDITOR
+
+
+def configure_from_env(environ: Optional[Dict[str, str]] = None) -> bool:
+    """Arm the default auditor when KFT_CONCURRENCY_AUDIT=1. Anything
+    else disarms (the env is the whole truth, like the chaos chain).
+    Returns the resulting armed state."""
+    env = os.environ if environ is None else environ
+    if env.get(ENV_AUDIT, "") == "1":
+        _AUDITOR.enable()
+    else:
+        _AUDITOR.disable()
+    return _AUDITOR.enabled
+
+
+class AuditLock:
+    """Drop-in for ``threading.Lock`` with order auditing. Disarmed cost:
+    one bool read + delegation."""
+
+    _reentrant = False
+
+    def __init__(self, name: str,
+                 auditor: Optional[LockAuditor] = None) -> None:
+        self.name = name
+        self._auditor = auditor if auditor is not None else _AUDITOR
+        self._inner = self._make_inner()
+
+    @staticmethod
+    def _make_inner():
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        a = self._auditor
+        if not a.enabled:
+            return self._inner.acquire(blocking, timeout)
+        a.pre_acquire(self.name, self._reentrant)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            a.note_acquired(self.name)
+        return ok
+
+    def release(self) -> None:
+        a = self._auditor
+        if a.enabled:
+            a.note_released(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "AuditLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name}>"
+
+
+class AuditRLock(AuditLock):
+    """Drop-in for ``threading.RLock`` (reentrant re-acquisition is legal
+    and records no self-edge)."""
+
+    _reentrant = True
+
+    @staticmethod
+    def _make_inner():
+        return threading.RLock()
+
+    def locked(self) -> bool:  # RLock has no locked(); mirror 3.12's
+        if self._inner.acquire(blocking=False):
+            self._inner.release()
+            return False
+        return True
+
+
+class AuditCondition:
+    """Drop-in for ``threading.Condition()`` (default-RLock flavor) with
+    order auditing on the underlying lock. ``wait`` releases the lock for
+    its duration, so the held stack drops the name across the block and
+    re-records it on wake — a lock still held across a wait() correctly
+    keeps its ordering edges into the re-acquisition."""
+
+    _reentrant = True
+
+    def __init__(self, name: str,
+                 auditor: Optional[LockAuditor] = None) -> None:
+        self.name = name
+        self._auditor = auditor if auditor is not None else _AUDITOR
+        self._cond = threading.Condition()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        a = self._auditor
+        if not a.enabled:
+            return self._cond.acquire(blocking, timeout)
+        a.pre_acquire(self.name, self._reentrant)
+        ok = self._cond.acquire(blocking, timeout)
+        if ok:
+            a.note_acquired(self.name)
+        return ok
+
+    def release(self) -> None:
+        a = self._auditor
+        if a.enabled:
+            a.note_released(self.name)
+        self._cond.release()
+
+    def __enter__(self) -> "AuditCondition":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        a = self._auditor
+        if not a.enabled:
+            return self._cond.wait(timeout)
+        a.note_released(self.name)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            a.note_acquired(self.name)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        a = self._auditor
+        if not a.enabled:
+            return self._cond.wait_for(predicate, timeout)
+        a.note_released(self.name)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            a.note_acquired(self.name)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<AuditCondition {self.name}>"
+
+
+# -- factories (the names analysis/concurrency.py's _LOCK_FACTORIES knows) --
+
+
+def audit_lock(name: str) -> AuditLock:
+    return AuditLock(name)
+
+
+def audit_rlock(name: str) -> AuditRLock:
+    return AuditRLock(name)
+
+
+def audit_condition(name: str) -> AuditCondition:
+    return AuditCondition(name)

@@ -79,6 +79,14 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def values_snapshot(self) -> Dict[LabelValues, float]:
+        """Consistent point-in-time copy of every labelled series, taken
+        under the metric's lock — the supported way for telemetry readers
+        (kv_tiers.pool_sizing_telemetry) to scan series without reaching
+        into `_values` privates mid-update."""
+        with self._lock:
+            return dict(self._values)
+
     def _render_series(self) -> Iterable[str]:
         with self._lock:
             items = sorted(self._values.items())
@@ -115,6 +123,12 @@ class Gauge(_Metric):
         key = _validate_labels(self.label_names, labels)
         with self._lock:
             return self._values.get(key, 0.0)
+
+    def values_snapshot(self) -> Dict[LabelValues, float]:
+        """Locked point-in-time copy of every labelled series (see
+        Counter.values_snapshot)."""
+        with self._lock:
+            return dict(self._values)
 
     def _render_series(self) -> Iterable[str]:
         with self._lock:

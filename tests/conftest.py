@@ -49,6 +49,56 @@ def pytest_configure(config):
     )
 
 
+# ---------------------------------------------------------------------------
+# Concurrency audit (KFT_CONCURRENCY_AUDIT=1): arm the lock-order
+# sanitizer for the whole session and cross-check what the product
+# threads actually did against the static analyzer's lock graph. CI's
+# static-analysis workflow re-runs the engine/router/fleet drain suites
+# under this hook; any other run can opt in with the same env.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _concurrency_audit():
+    from kubeflow_tpu.utils.audit_lock import configure_from_env
+
+    auditor = None
+    if configure_from_env():
+        from kubeflow_tpu.utils.audit_lock import default_auditor
+
+        auditor = default_auditor()
+        auditor.reset()
+    yield
+    if auditor is None:
+        return
+    try:
+        violations = auditor.violations()
+        assert not violations, (
+            "runtime lock violations (would-be deadlocks):\n  "
+            + "\n  ".join(violations)
+        )
+        cycle = auditor.find_cycle()
+        assert cycle is None, (
+            f"observed lock-order cycle: {' -> '.join(cycle)}\n"
+            f"edges: {auditor.observed_edges()}"
+        )
+        # every edge real threads produced must be a PATH in the graph
+        # the static analyzer computed — an unexplained edge means the
+        # analyzer is blind to a real acquisition chain
+        from kubeflow_tpu.analysis.concurrency import static_lock_graph
+        from kubeflow_tpu.analysis.sources import SourceSet
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        static = static_lock_graph(SourceSet(repo))
+        unexplained = auditor.unexplained_edges(static)
+        assert not unexplained, (
+            "observed lock-order edges with no static-graph explanation:\n  "
+            + "\n  ".join(f"{s} -> {d}  ({w})" for s, d, w in unexplained)
+        )
+    finally:
+        auditor.disable()
+
+
 # Modules whose XLA programs are safe to serialize on this jaxlib AND
 # whose compile cost dominates their runtime — the tier-1 time-budget
 # lever (ROADMAP "do this first"): warm runs restore the engine/trainer

@@ -24,11 +24,7 @@ from kubeflow_tpu.analysis.consistency import (
     check_env_reachability,
     check_metrics_consistency,
 )
-from kubeflow_tpu.analysis.control_plane import (
-    check_lock_discipline,
-    check_shard_map_vma,
-    check_thread_hygiene,
-)
+from kubeflow_tpu.analysis.control_plane import check_shard_map_vma
 from kubeflow_tpu.analysis.findings import (
     apply_baseline,
     exit_code,
@@ -52,120 +48,11 @@ def _tree(tmp_path, files):
 # ---------------------------------------------------------------------------
 
 
-class TestSeededLockDiscipline:
-    def test_read_outside_lock_detected(self, tmp_path):
-        src = _tree(tmp_path, {"kubeflow_tpu/bad.py": '''
-            """seed"""
-            import threading
-
-            class Server:
-                def __init__(self):
-                    self._lock = threading.Lock()
-                    self.stats = {}
-
-                def update(self, d):
-                    with self._lock:
-                        self.stats = d
-
-                def handler(self):
-                    return self.stats["x"]  # the PR-2 race class
-        '''})
-        findings = check_lock_discipline(src)
-        assert len(findings) == 1
-        f = findings[0]
-        assert f.analyzer == "lock-discipline"
-        assert f.symbol == "Server.stats"
-        assert "without the lock" in f.message
-
-    def test_write_outside_lock_detected(self, tmp_path):
-        src = _tree(tmp_path, {"kubeflow_tpu/bad.py": '''
-            """seed"""
-            import threading
-
-            class Server:
-                def __init__(self):
-                    self._lock = threading.Lock()
-                    self.state = 0
-
-                def locked(self):
-                    with self._lock:
-                        self.state = 1
-
-                def unlocked(self):
-                    self.state = 2
-        '''})
-        assert [f.symbol for f in check_lock_discipline(src)] == ["Server.state"]
-
-    def test_disciplined_class_clean(self, tmp_path):
-        src = _tree(tmp_path, {"kubeflow_tpu/good.py": '''
-            """seed"""
-            import threading
-
-            class Server:
-                def __init__(self):
-                    self._lock = threading.Lock()
-                    self.stats = {}
-
-                def update(self, d):
-                    with self._lock:
-                        self.stats = d
-
-                def read(self):
-                    with self._lock:
-                        return dict(self.stats)
-        '''})
-        assert check_lock_discipline(src) == []
-
-    def test_suppression_comment(self, tmp_path):
-        src = _tree(tmp_path, {"kubeflow_tpu/sup.py": '''
-            """seed"""
-            import threading
-
-            class S:
-                def __init__(self):
-                    self._lock = threading.Lock()
-
-                def w(self):
-                    with self._lock:
-                        self.v = 1
-
-                def r(self):
-                    return self.v  # kft-analyze: ignore[lock-discipline]
-        '''})
-        assert check_lock_discipline(src) == []
-
-
-class TestSeededThreadHygiene:
-    def test_bare_thread_detected(self, tmp_path):
-        src = _tree(tmp_path, {"kubeflow_tpu/bad.py": '''
-            """seed"""
-            import threading
-
-            def go():
-                t = threading.Thread(target=print)
-                t.start()
-        '''})
-        findings = check_thread_hygiene(src)
-        assert len(findings) == 1
-        assert findings[0].analyzer == "thread-hygiene"
-
-    def test_daemon_and_joined_clean(self, tmp_path):
-        src = _tree(tmp_path, {"kubeflow_tpu/good.py": '''
-            """seed"""
-            import threading
-
-            def daemonized():
-                threading.Thread(target=print, daemon=True).start()
-
-            class W:
-                def start(self):
-                    self._t = threading.Thread(target=print, daemon=False)
-                    self._t.start()
-
-                def close(self):
-                    self._t.join(timeout=2)
-        '''})
-        assert check_thread_hygiene(src) == []
+# The seeded lock-misuse / thread-leak coverage that lived here moved to
+# tests/test_concurrency_lint.py with the rules themselves: the shallow
+# lock-discipline / thread-hygiene passes folded into the
+# interprocedural `kft-analyze concurrency` namespace (guarded-attr /
+# lock-order / thread-lifecycle).
 
 
 class TestSeededVma:
@@ -281,6 +168,11 @@ class TestSeededAggregationPolicy:
 
                 def c(reg):
                     return reg.histogram("lat_seconds", "h", ["model"])
+
+                def use(reg):
+                    a(reg).inc(model="m")
+                    b(reg).set(1.0, model="m")
+                    c(reg).observe(0.1, model="m")
                 {extra}
             ''',
         })
@@ -347,6 +239,97 @@ class TestSeededAggregationPolicy:
             '{"reqs_total": "sum", "depth": "max", "lat_seconds": "merge"}',
         )
         assert findings == []
+
+    def test_dead_series_detected(self, tmp_path):
+        from kubeflow_tpu.analysis.consistency import (
+            check_aggregation_policy,
+        )
+
+        # policy-covered and declared, but NO write site anywhere: the
+        # fleet would scrape a series that can never move
+        src = _tree(tmp_path, {
+            self.FLEET: '''
+                """seed"""
+                AGGREGATION_POLICY = {"reqs_total": "sum", "depth": "max"}
+            ''',
+            "kubeflow_tpu/m.py": '''
+                """seed"""
+                def a(reg):
+                    return reg.counter("reqs_total", "h", ["model"])
+
+                def b(reg):
+                    return reg.gauge("depth", "h", ["model"])
+
+                def use(reg):
+                    b(reg).set(1.0, model="m")
+            ''',
+        })
+        (f,) = [
+            x for x in check_aggregation_policy(src)
+            if x.symbol == "reqs_total"
+        ]
+        assert f.severity == Severity.WARNING
+        assert "never emitted" in f.message and "dead" in f.message
+
+    def test_emission_through_tuple_helper_is_not_dead(self, tmp_path):
+        from kubeflow_tpu.analysis.consistency import (
+            check_aggregation_policy,
+        )
+
+        # trace.py's shape: a local helper returning a TUPLE of metrics,
+        # unpacked at the write site — both series count as emitted
+        src = _tree(tmp_path, {
+            self.FLEET: '''
+                """seed"""
+                AGGREGATION_POLICY = {"reqs_total": "sum", "depth": "max"}
+            ''',
+            "kubeflow_tpu/m.py": '''
+                """seed"""
+                def a(reg):
+                    return reg.counter("reqs_total", "h")
+
+                def b(reg):
+                    return reg.gauge("depth", "h")
+
+                def pair(reg):
+                    return a(reg), b(reg)
+
+                def use(reg):
+                    kept, depth = pair(reg)
+                    kept.inc()
+                    depth.set(1.0)
+            ''',
+        })
+        assert [
+            x for x in check_aggregation_policy(src) if "dead" in x.message
+        ] == []
+
+    def test_emission_through_rebound_local_is_not_dead(self, tmp_path):
+        from kubeflow_tpu.analysis.consistency import (
+            check_aggregation_policy,
+        )
+
+        # chaos/core.py's shape: metric bound to self in one method, read
+        # into a local in another (to emit outside the lock)
+        src = _tree(tmp_path, {
+            self.FLEET: '''
+                """seed"""
+                AGGREGATION_POLICY = {"reqs_total": "sum"}
+            ''',
+            "kubeflow_tpu/m.py": '''
+                """seed"""
+                class C:
+                    def emit(self):
+                        faults = self._faults
+                        faults.inc()
+
+                    def arm(self, reg):
+                        self._faults = reg.counter("reqs_total", "h")
+            ''',
+        })
+        assert [
+            x for x in check_aggregation_policy(src) if "dead" in x.message
+        ] == []
 
     def test_missing_table_is_an_error(self, tmp_path):
         from kubeflow_tpu.analysis.consistency import (
@@ -549,12 +532,12 @@ class TestRepoClean:
         assert "0 error(s)" in out
 
 
-class TestEngineUnderControlPlanePasses:
+class TestEngineUnderConcurrencyPass:
     """The continuous-batching engine (serving/engine.py) is control-plane
     concurrency machinery — a scheduler thread plus a condition-guarded
-    admission queue — and it must sit UNDER the existing thread-hygiene /
-    lock-discipline passes, not beside them: covered by the repo sweep,
-    with no inline ignores."""
+    admission queue — and it must sit UNDER the interprocedural
+    concurrency pass, not beside it: covered by the repo sweep, with no
+    inline ignores."""
 
     ENGINE = "kubeflow_tpu/serving/engine.py"
 
@@ -563,21 +546,20 @@ class TestEngineUnderControlPlanePasses:
         assert len(src) == 1, "engine module missing from the repo sweep"
         assert src[0].tree is not None
         assert not src[0].suppressions, (
-            "engine.py must pass the control-plane passes without "
+            "engine.py must pass the concurrency pass without "
             "kft-analyze ignores"
         )
-        assert "threading.Condition" in src[0].text  # the slot-state lock
+        # the slot-state lock, now the AUDITED condition (the runtime
+        # sanitizer's graph joins the static one on this node name)
+        assert 'audit_condition("DecodeEngine._cv")' in src[0].text
         assert "threading.Thread" in src[0].text  # the scheduler thread
 
     def test_engine_shaped_violations_are_caught(self, tmp_path):
         """A stripped-down engine with its two canonical mistakes — the
         stop flag read without the condition lock, a non-daemon unjoined
-        scheduler thread — fires BOTH passes (proof the analyzers see the
-        engine's constructs, Condition included)."""
-        from kubeflow_tpu.analysis.control_plane import (
-            check_lock_discipline,
-            check_thread_hygiene,
-        )
+        scheduler thread — fires BOTH concurrency rules (proof the
+        analyzer sees the engine's constructs, Condition included)."""
+        from kubeflow_tpu.analysis.concurrency import run_concurrency
 
         src = _tree(tmp_path, {"kubeflow_tpu/serving/bad_engine.py": '''
             """seed"""
@@ -597,10 +579,14 @@ class TestEngineUnderControlPlanePasses:
                     while not self._stop:  # racy read, no lock
                         pass
         '''})
-        locks = check_lock_discipline(src)
-        assert any(f.symbol == "Engine._stop" for f in locks), locks
-        threads = check_thread_hygiene(src)
-        assert len(threads) == 1 and threads[0].analyzer == "thread-hygiene"
+        findings = run_concurrency(src)
+        assert any(
+            f.analyzer == "guarded-attr" and f.symbol == "Engine._stop"
+            for f in findings
+        ), findings
+        assert any(
+            f.analyzer == "thread-lifecycle" for f in findings
+        ), findings
 
 
 class TestShippedPlansClean:
@@ -1370,31 +1356,47 @@ class TestServingPlansClean:
 
 
 class TestInlineIgnoreInventory:
-    def test_repo_ships_zero_inline_ignores(self):
-        """The PR 3/5/7 clean-pass discipline, now enforced: no inline
-        `# kft-analyze: ignore[...]` anywhere in the shipped tree."""
+    def test_every_shipped_ignore_carries_a_reason(self):
+        """The PR 3/5/7 zero-ignore discipline evolved with the
+        concurrency pass: a shipped ignore is legal ONLY when it
+        documents why the flagged pattern is safe (the bare-ignore lint
+        errors otherwise), so the inventory is an audit log, never a
+        silent baseline."""
         inventory = SourceSet(REPO).suppression_inventory()
-        assert inventory == [], inventory
+        bare = [row for row in inventory if not row[3].strip()]
+        assert bare == [], bare
+        # every shipped row names a real rule the concurrency pass owns
+        from kubeflow_tpu.analysis.concurrency import (
+            RULE_GUARDED,
+            RULE_LIFECYCLE,
+            RULE_ORDER,
+        )
+
+        known = {RULE_GUARDED, RULE_ORDER, RULE_LIFECYCLE}
+        for _, _, rule, _ in inventory:
+            assert rule in known, f"ignore for unknown rule {rule!r}"
 
     def test_docstring_mention_is_not_an_ignore(self, tmp_path):
         """Docs QUOTING the ignore syntax (sources.py's own docstring)
         are not suppressions — only real comment tokens count."""
         src = _tree(tmp_path, {"kubeflow_tpu/a.py": '''
-            """Docs: use `# kft-analyze: ignore[lock-discipline]` sparingly."""
-            X = 1  # kft-analyze: ignore[thread-hygiene]
+            """Docs: use `# kft-analyze: ignore[lock-order]` sparingly."""
+            X = 1  # kft-analyze: ignore[thread-lifecycle] — seeded
         '''})
         inv = src.suppression_inventory()
-        assert inv == [("kubeflow_tpu/a.py", 3, "thread-hygiene")]
+        assert inv == [("kubeflow_tpu/a.py", 3, "thread-lifecycle", "seeded")]
 
-    def test_cli_list_ignores_clean_repo(self, capsys):
+    def test_cli_list_ignores_prints_reasons(self, capsys):
         from kubeflow_tpu.analysis.cli import main
 
         rc = main(["--root", REPO, "--list-ignores"])
         out = capsys.readouterr().out
         assert rc == 0
-        assert "0 inline ignore(s)" in out
+        assert "(BARE: no reason)" not in out
+        # the one reviewed exception ships with its reason visible
+        assert "kubeflow_tpu/serving/server.py" in out
 
-    def test_cli_list_ignores_inventories_seeded_tree(self, tmp_path, capsys):
+    def test_cli_list_ignores_marks_bare_rows(self, tmp_path, capsys):
         from kubeflow_tpu.analysis.cli import main
 
         _tree(tmp_path, {"kubeflow_tpu/b.py": '''
@@ -1402,13 +1404,14 @@ class TestInlineIgnoreInventory:
             import threading
 
             def f():
-                t = threading.Thread(target=print)  # kft-analyze: ignore[thread-hygiene]
+                t = threading.Thread(target=print)  # kft-analyze: ignore[thread-lifecycle]
                 t.start()
         '''})
         rc = main(["--root", str(tmp_path), "--list-ignores"])
         out = capsys.readouterr().out
         assert rc == 0
-        assert "kubeflow_tpu/b.py:6: ignore[thread-hygiene]" in out
+        assert "kubeflow_tpu/b.py:6: ignore[thread-lifecycle]" in out
+        assert "(BARE: no reason)" in out
         assert "1 inline ignore(s)" in out
 
 

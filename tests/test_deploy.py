@@ -493,3 +493,28 @@ class TestGkeProvider:
         provider.apply_platform(p)
         provider.delete_platform(p)
         assert api.get_cluster("proj", "us-central2-b", "kf-test") is None
+
+
+class TestGcSnapshotScope:
+    """Regression coverage for the gc() fix: the live-server snapshot the
+    record scan consults is taken INSIDE the critical section, after the
+    expiry sweep — so a server expired in this sweep is not still
+    'live', and its durable record is reaped in the SAME sweep instead
+    of leaking until the next one."""
+
+    def test_expired_servers_record_reaped_in_same_sweep(self, tmp_path):
+        from kubeflow_tpu.deploy.server import Router
+
+        app_dir = str(tmp_path / "apps")
+        router = Router(
+            shared_store=StateStore(), app_dir=app_dir, max_lifetime_s=0.5
+        )
+        try:
+            TestDeployServerAndRouter._deploy_and_wait(router, "kf-sweep")
+            assert (tmp_path / "apps/kf-sweep").exists()
+            # one sweep, far past the lifetime: the in-memory server AND
+            # its on-disk record both expire now
+            assert router.gc(now=time.time() + 10) == 2
+            assert not (tmp_path / "apps/kf-sweep").exists()
+        finally:
+            router.shutdown()

@@ -756,3 +756,47 @@ class TestDraining:
         # what two sequential full-deadline waits could reach (loose
         # bound — this asserts the concurrency plumbing, not perf)
         assert wall < 120.0
+
+
+class TestStatsLockScope:
+    """Regression coverage for the _note_attn fix: the per-window
+    membership test and insert happen under ONE _stats_lock hold — the
+    unlocked check-then-act raced stats()' locked iteration of the
+    window map (dict-changed-size during the sorted() walk)."""
+
+    def test_note_attn_concurrent_with_stats(self, gpt_and_params):
+        model, params = gpt_and_params
+        eng = DecodeEngine("g", model, params, num_slots=1, autostart=False)
+        try:
+            stop = threading.Event()
+            errors = []
+
+            def noter():
+                w = 0
+                while not stop.is_set():
+                    w += 1
+                    eng._note_attn(w % 257)
+
+            def reader():
+                while not stop.is_set():
+                    try:
+                        eng.stats()
+                    except RuntimeError as e:  # dict changed size
+                        errors.append(e)
+                        return
+
+            threads = [
+                threading.Thread(target=noter, daemon=True),
+                threading.Thread(target=reader, daemon=True),
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            assert errors == []
+            windows = eng.stats()["paged_attention_windows"]
+            assert windows and all(isinstance(k, int) for k in windows)
+        finally:
+            eng.close()

@@ -1083,3 +1083,64 @@ class TestRealSocketScrape:
         finally:
             for s in servers:
                 s.stop()
+
+
+class TestScrapeLoopLifecycle:
+    """start()/stop() regression coverage for the two bugs the
+    concurrency pass surfaced: the unguarded `_thread is None`
+    check-then-act let concurrent start() calls spawn duplicate scrape
+    loops, and start() never cleared `_stop`, so a restart after stop()
+    spawned a thread whose loop exited immediately."""
+
+    def test_concurrent_starts_spawn_one_scrape_thread(self):
+        import threading
+
+        fleet = _FakeFleet()
+        collector = fleet.collector(scrape_interval_s=60.0)
+        try:
+            gate = threading.Barrier(8)
+
+            def go():
+                gate.wait(timeout=5)
+                collector.start()
+
+            starters = [
+                threading.Thread(target=go, daemon=True) for _ in range(8)
+            ]
+            for t in starters:
+                t.start()
+            for t in starters:
+                t.join(timeout=5)
+            loops = [
+                t for t in threading.enumerate()
+                if t.name == "fleet-collector" and t.is_alive()
+            ]
+            assert len(loops) == 1, loops
+        finally:
+            collector.stop()
+
+    def test_restart_after_stop_scrapes_again(self):
+        import time
+
+        fleet = _FakeFleet()
+        fleet.add("serving", "svc", "r0", _replica_registry(
+            queue=0, occupancy=0.0, ttfts=[0.1], tokens=1
+        ))
+        collector = fleet.collector(scrape_interval_s=0.01)
+        try:
+            collector.start()
+            collector.stop()
+            collector.start()
+            t = collector._thread
+            assert t is not None
+            # the restarted loop must actually RUN (the stale set event
+            # made it exit before its first sweep): wait for a sweep
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if collector.fleet_series():
+                    break
+                time.sleep(0.01)
+            assert collector.fleet_series(), "restarted loop never swept"
+            assert t.is_alive()
+        finally:
+            collector.stop()

@@ -69,6 +69,22 @@ class TestNotebookController:
         conds = {c["type"]: c["status"] for c in nb["status"]["conditions"]}
         assert conds["Ready"] == "True"
 
+    def test_create_metric_counts_first_reconcile_only(self):
+        # regression for the dead-series finding: notebook_create_total
+        # was declared + policy-covered but never incremented
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        c = default_registry().counter("notebook_create_total")
+        before = c.value()
+        store, cm = make_harness()
+        store.create(new_notebook("wb", "team-a"))
+        cm.run_until_idle(max_seconds=5)
+        assert c.value() == before + 1
+        # steady-state reconciles are apply-updates, not creations
+        store.update(store.get("Notebook", "wb", "team-a"))
+        cm.run_until_idle(max_seconds=5)
+        assert c.value() == before + 1
+
     def test_stop_annotation_scales_to_zero(self):
         store, cm = make_harness()
         store.create(new_notebook("wb", "team-a"))

@@ -104,6 +104,11 @@ class TestPipelinedBert:
             want = stage.apply({"params": stage_params}, want, mask, True)
         np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.slow  # r18 tier-1 tranche: two full bert train-step
+    # compiles; runs unfiltered in the unit-tests CI training step.
+    # Tier-1 keeps the pipeline==sequential math claim through
+    # test_pipelined_encoder_equals_sequential_stages above (forward-
+    # level equality, no trainer compile) and test_1f1b_matches_gpipe
     def test_loss_invariant_to_pipeline_mesh(self, devices8):
         """Same model + seed: training on (data=4) and (data=2, pipeline=2)
         meshes produces the same losses — the pipeline axis changes layout,
@@ -163,6 +168,8 @@ class TestPipelinedBert:
             losses["flat"], losses["pp"], rtol=1e-5, atol=0.0
         )
 
+    @pytest.mark.slow  # r18 tier-1 tranche: init_state pays the bert
+    # init compile; the plan-level twin below keeps the claim in tier-1
     def test_pipeline_params_sharded_over_pipeline_axis(self, devices8):
         """Stage-stacked params actually land sharded on the pipeline axis."""
         from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
@@ -193,6 +200,45 @@ class TestPipelinedBert:
         ]["kernel"]
         assert kernel.shape[0] == 2  # stacked stage dim
         spec = kernel.sharding.spec
+        assert spec and spec[0] == "pipeline"
+
+    def test_pipeline_sharding_plan_puts_stage_dim_on_pipeline_axis(
+        self, devices8
+    ):
+        """Cheap tier-1 representative (r18 tranche) of the @slow
+        device-level test above: the trainer's sharding PLAN
+        (eval_shape, no compile) lands the stacked stage dim on the
+        pipeline axis."""
+        from kubeflow_tpu.config.platform import MeshConfig, TrainingConfig
+        from kubeflow_tpu.parallel.mesh import mesh_from_config
+        from kubeflow_tpu.training.tasks import MlmTask
+        from kubeflow_tpu.training.trainer import Trainer
+
+        cfg = TrainingConfig(
+            model="bert_tiny",
+            global_batch_size=8,
+            steps=1,
+            warmup_steps=1,
+            dtype="float32",
+            mesh=MeshConfig(data=2, pipeline=2),
+            checkpoint={"enabled": False},
+        )
+        mesh = mesh_from_config(cfg.mesh, devices=jax.devices()[:4])
+        task = MlmTask(cfg, seq_len=32, vocab_size=128)
+        trainer = Trainer(
+            cfg,
+            mesh=mesh,
+            task=task,
+            model_kwargs={"pipeline_stages": 2, "num_layers": 2},
+        )
+        shapes, shardings = trainer.abstract_state()
+        path = ("encoder", "stages", "layer_0", "attention", "query")
+        kshape = shapes.params
+        ksharding = shardings.params
+        for k in path:
+            kshape, ksharding = kshape[k], ksharding[k]
+        assert kshape["kernel"].shape[0] == 2  # stacked stage dim
+        spec = ksharding["kernel"].spec
         assert spec and spec[0] == "pipeline"
 
     def test_deep_schedule_compiles_fast(self, devices8):

@@ -783,3 +783,53 @@ class TestTwoReplicaAffinityE2E:
                 srv.stop()
             for _, ms in engines:
                 ms.close()
+
+
+class TestProbeLoopLifecycle:
+    """start()/stop() regression coverage: the probe thread's
+    check-then-act is lock-guarded (concurrent start() calls raced past
+    `_thread is None` and spawned duplicate probe loops) and the pair is
+    restartable."""
+
+    def test_concurrent_starts_spawn_one_probe_thread(self):
+        fleet = FakeFleet()
+        router = FleetRouter((), transport=fleet.transport,
+                             probe_interval_s=60.0)
+        try:
+            gate = threading.Barrier(8)
+
+            def go():
+                gate.wait(timeout=5)
+                router.start()
+
+            starters = [
+                threading.Thread(target=go, daemon=True) for _ in range(8)
+            ]
+            for t in starters:
+                t.start()
+            for t in starters:
+                t.join(timeout=5)
+            probes = [
+                t for t in threading.enumerate()
+                if t.name == "router-probe" and t.is_alive()
+            ]
+            assert len(probes) == 1, probes
+        finally:
+            router.stop()
+        assert not any(
+            t.name == "router-probe" and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    def test_restart_after_stop_probes_again(self):
+        fleet = FakeFleet()
+        router = FleetRouter((), transport=fleet.transport,
+                             probe_interval_s=60.0)
+        try:
+            router.start()
+            router.stop()
+            router.start()
+            t = router._thread
+            assert t is not None and t.is_alive()
+        finally:
+            router.stop()

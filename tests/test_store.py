@@ -152,3 +152,55 @@ class TestConditionsAndEvents:
         pod = new_object("Pod", "j1-w0")
         set_owner(pod, job)
         assert pod["metadata"]["ownerReferences"][0]["kind"] == "TPUJob"
+
+
+class TestNormalizerLockScope:
+    """Regression coverage for the _normalize fix: the registered
+    callback list is SNAPSHOTTED under the store lock (add_normalizer
+    appends concurrently), but the callbacks themselves run OUTSIDE it —
+    a conversion hook must not serialize every write path behind user
+    code, and it may call back into the store freely."""
+
+    def test_normalizer_runs_outside_the_store_lock(self, store):
+        import threading
+
+        result = {}
+
+        def probe():
+            # from ANOTHER thread: if create() still held the store lock
+            # while running normalizers, this acquire would time out
+            ok = store._lock.acquire(timeout=2)
+            if ok:
+                store._lock.release()
+            result["acquired"] = ok
+
+        def normalizer(obj):
+            t = threading.Thread(target=probe, daemon=True)
+            t.start()
+            t.join(timeout=5)
+
+        store.add_normalizer("TPUJob", normalizer)
+        store.create(new_object("TPUJob", "j-norm", "team-a"))
+        assert result.get("acquired") is True
+
+    def test_registration_during_write_storm_is_safe(self, store):
+        import threading
+
+        stop = threading.Event()
+        registered = 0
+
+        def register():
+            nonlocal registered
+            while not stop.is_set() and registered < 500:
+                store.add_normalizer("TPUJob", lambda obj: None)
+                registered += 1
+
+        t = threading.Thread(target=register, daemon=True)
+        t.start()
+        try:
+            for i in range(100):
+                store.create(new_object("TPUJob", f"j-storm-{i}", "team-a"))
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert len(store.list("TPUJob", "team-a")) == 100

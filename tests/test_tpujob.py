@@ -157,6 +157,25 @@ class TestGangLifecycle:
         conds = {c["type"]: c["status"] for c in job["status"]["conditions"]}
         assert conds[COND_RUNNING] == "True"
 
+    def test_running_gauge_tracks_gang_lifecycle(self):
+        # regression for the dead-series finding: tpujob_running was
+        # declared + policy-covered but never written
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        g = default_registry().gauge("tpujob_running")
+        store, cm, executor = make_harness()
+        submit(store)
+        cm.run_until_idle(max_seconds=5)
+        executor.tick()  # Pending -> Running
+        cm.run_until_idle(max_seconds=5)
+        assert g.value() == 1
+        drive(cm, executor)
+        wait_for_condition(
+            store, "TPUTrainJob", "train1", "team-a", COND_SUCCEEDED,
+            timeout_s=5,
+        )
+        assert g.value() == 0
+
     def test_gang_restart_on_single_pod_failure(self):
         runner = FakePodRunner()
         store, cm, executor = make_harness(runner)

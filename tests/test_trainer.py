@@ -80,6 +80,10 @@ class TestTaskAdapters:
 
 
 class TestTrainerDP(object):
+    @pytest.mark.slow  # r18 tier-1 tranche: runs unfiltered in the
+    # unit-tests CI training step; tier-1 keeps the DP loss-decrease
+    # claim through test_gpt.py's test_loss_decreases (one shared
+    # gpt_dp8_trainer compile) — this is the resnet train-step compile
     def test_loss_decreases(self, image_dp8_trainer):
         tr = image_dp8_trainer
         data = tr.task.synthetic_data()
@@ -95,11 +99,22 @@ class TestTrainerDP(object):
             losses.append(float(jax.device_get(m["loss"])))
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow  # r18 tier-1 tranche: the device-level twin of
+    # test_pure_dp_replication_plan below (init_state pays the resnet
+    # init compile); runs unfiltered in the unit-tests CI training step
     def test_params_replicated_under_pure_dp(self, image_dp8_trainer):
         tr = image_dp8_trainer
         state = tr.init_state()
         leaf = jax.tree.leaves(state.params)[0]
         assert leaf.sharding.spec == P()
+
+    def test_pure_dp_replication_plan(self, image_dp8_trainer):
+        """Cheap tier-1 representative (r18 tranche) of the @slow
+        device-level replication test: the resnet DP sharding PLAN
+        (eval_shape, no compile, no devices) replicates every param."""
+        _, shardings = image_dp8_trainer.abstract_state()
+        specs = {sh.spec for sh in jax.tree.leaves(shardings.params)}
+        assert specs == {P()}
 
 
 class TestTrainerFSDP:
@@ -179,9 +194,9 @@ class TestDivergenceAndTaskClamp:
 class TestCheckpoint:
     @pytest.mark.slow  # r16 tier-1 tranche: runs unfiltered in the
     # unit-tests CI training step; tier-1 keeps the trainer-level
-    # restore claim through test_resume_continues_training and the
-    # subsystem's own roundtrip/resharding coverage in
-    # test_checkpointing.py
+    # restore claim through test_checkpointing.py's
+    # test_full_state_roundtrip_through_trainer and the subsystem's
+    # roundtrip/resharding coverage there
     def test_save_restore_roundtrip(self, image_dp8_trainer, tmp_path):
         tr = image_dp8_trainer
         state = tr.init_state()
@@ -200,6 +215,10 @@ class TestCheckpoint:
             mgr.restore({})
         mgr.close()
 
+    @pytest.mark.slow  # r18 tier-1 tranche (resnet train-step compile);
+    # tier-1 keeps save→restore→step-counts-advance through
+    # test_checkpointing.py's test_full_state_roundtrip_through_trainer
+    # and test_preempt_event_saves_and_resumes
     def test_resume_continues_training(self, image_dp8_trainer, tmp_path):
         tr = image_dp8_trainer
         mgr = CheckpointManager(str(tmp_path / "c2"), async_save=False)

@@ -50,6 +50,58 @@ class TestCounter:
         assert "# TYPE req counter" in out
         assert 'req{code="200"} 1' in out
 
+    def test_values_snapshot_is_a_locked_copy(self):
+        """The public consistent-read API (regression for kv_tiers'
+        pool-sizing telemetry, which reached into metric._values
+        unlocked): a snapshot is taken under the metric's own lock and
+        is a COPY — mutating it never touches the live series."""
+        c = m.Counter("req", "r", ["code"])
+        c.inc(code="200")
+        c.inc(2, code="500")
+        snap = c.values_snapshot()
+        assert snap == {("200",): 1.0, ("500",): 2.0}
+        snap[("200",)] = 99.0
+        assert c.value(code="200") == 1
+
+    def test_values_snapshot_concurrent_with_incs(self):
+        import threading
+
+        c = m.Counter("req", "r", ["code"])
+        stop = threading.Event()
+        errors = []
+
+        def inc():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                c.inc(code=str(i % 61))
+
+        def snapshot():
+            try:
+                while not stop.is_set():
+                    sum(c.values_snapshot().values())
+            except RuntimeError as e:  # dict changed size during iter
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=inc, daemon=True),
+            threading.Thread(target=snapshot, daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        import time
+
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert errors == []
+
+    def test_gauge_values_snapshot(self):
+        g = m.Gauge("depth", "d", ["role"])
+        g.set(3.0, role="serving")
+        assert g.values_snapshot() == {("serving",): 3.0}
+
 
 class TestGauge:
     def test_set_inc_dec(self):
