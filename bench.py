@@ -1971,6 +1971,409 @@ def bench_serving_router(
     }
 
 
+def bench_serving_disagg(
+    num_requests: int = 24,
+    num_decode: int = 2,
+    num_templates: int = 4,
+    mean_interarrival_ms: float = 40.0,
+    new_tokens: int = 4,
+) -> dict:
+    """Disaggregated prefill/decode fleet vs unified at MATCHED chips
+    (docs/SERVING.md "Disaggregated fleet"): the same Poisson trace —
+    2/3 warm template extensions, 1/3 cold fully-random prompts, rates
+    that saturate one replica — through (a) 1 prefill + N decode
+    replicas behind a disagg-steering router and (b) N+1 unified
+    replicas behind the same router with steering off. The question the
+    tier split exists to answer: when cold prefills stop running on the
+    replicas that hold the warm radix chains, what happens to the MIX's
+    TTFT tail? Reported: `disagg_ttft_p99_ratio` and
+    `disagg_tokens_per_sec_ratio` (disagg over unified — the tail ratio
+    under 1 is the acceptance headline), plus the scale-down rescue on
+    a fresh condemned + two-survivor mini-fleet (measure_rescue below):
+    the condemned replica's `/v1/kv/handoff` ships its hottest
+    committed chains to each key's NEW rendezvous home, and
+    `handoff_warm_ttft_ratio` compares extending a handed-off template
+    there against extending an un-rescued one (cold controls measured
+    FIRST, at the same homes). Plus the parity gate: greedy output
+    through the steered split path is bitwise a direct unified
+    replica's.
+
+    CPU-mesh caveat (docs/PERF.md): prefill/decode cost ratios here are
+    the CPU backend's, not a TPU's — the ratios demonstrate the
+    mechanism (placement + handoff), not production-calibrated wins."""
+    import json as _json
+    import threading
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from kubeflow_tpu.api.wsgi import Server
+    from kubeflow_tpu.routing import FleetRouter, Replica
+    from kubeflow_tpu.routing.affinity import (
+        first_page_key,
+        rendezvous_rank,
+    )
+    from kubeflow_tpu.serving.engine import DecodeEngine
+    from kubeflow_tpu.serving.server import ModelServer
+
+    num_requests = _budget_scaled(num_requests, sized_for_s=480, floor=12)
+    prompt_len = BENCH_PREFIX_PROMPT_LEN
+    shared_len = BENCH_SHARED_PREFIX_LEN
+    model, params = _gpt_small_with_params(BENCH_PREFIX_MAX_LEN)
+
+    trng = np.random.default_rng(12)
+    templates = [
+        trng.integers(0, 50257, (shared_len,)) for _ in range(num_templates)
+    ]
+    prng = np.random.default_rng(14)
+    prompts = []
+    for i in range(num_requests):
+        if i % 3 == 2:
+            # the cold third: first-page keys the router has never seen
+            prompts.append(prng.integers(0, 50257, (prompt_len,)))
+        else:
+            tail = prng.integers(0, 50257, (prompt_len - shared_len,))
+            prompts.append(
+                np.concatenate([templates[i % num_templates], tail])
+            )
+    payloads = [
+        _json.dumps({
+            "prompt_ids": [p.tolist()],
+            "max_new_tokens": new_tokens,
+        }).encode()
+        for p in prompts
+    ]
+    offsets = np.cumsum(
+        np.random.default_rng(13).exponential(
+            mean_interarrival_ms / 1e3, num_requests
+        )
+    )
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url, data=payload, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return _json.loads(resp.read()), resp.headers
+
+    def ttft_of(url, prompt) -> float:
+        body = _json.dumps({
+            "prompt_ids": [prompt.tolist()], "max_new_tokens": 2,
+        }).encode()
+        _, hdr = post(url, body)
+        return float(hdr["X-TTFT-Ms"])
+
+    def run_arm(disagg: bool) -> dict:
+        """One full fleet (fresh engines — cold caches): 1 prefill +
+        num_decode decode when disagg, num_decode+1 unified otherwise —
+        the same chip count either way."""
+        engines, servers, replicas = [], [], []
+        if disagg:
+            roles = ["prefill"] + ["decode"] * num_decode
+        else:
+            roles = ["unified"] * (num_decode + 1)
+        wrng = np.random.default_rng(15)
+        try:
+            for r, role in enumerate(roles):
+                eng = DecodeEngine(
+                    "gpt_fleet", model, params,
+                    num_slots=DEFAULT_NUM_SLOTS,
+                    prefill_buckets=list(BENCH_PREFIX_BUCKETS),
+                    max_queue=max(64, num_requests),
+                    page_size=BENCH_PREFIX_PAGE_SIZE, prefix_cache=True,
+                    # explicit pool: the auto 3/4-slot-row pool (96
+                    # pages here) is saturated by the trace's committed
+                    # chains, so LRU eviction starts cannibalizing the
+                    # warm template prefixes mid-arm and the placement
+                    # signal drowns in eviction noise. Both arms share
+                    # the geometry, so the comparison stays fair.
+                    num_pages=256,
+                )
+                ms = ModelServer()
+                ms.add_engine(eng)
+                srv = Server(ms.app, port=0)
+                srv.start()
+                engines.append((eng, ms))
+                servers.append(srv)
+                replicas.append(Replica(
+                    f"{role}-{r}", f"http://127.0.0.1:{srv.port}", role
+                ))
+            router = FleetRouter(
+                tuple(replicas), affinity=True,
+                page_size=BENCH_PREFIX_PAGE_SIZE,
+                # the arms measure PLACEMENT: the CPU mesh's slow
+                # prefill would trip the in-flight spill fallback and
+                # scatter the warm chains the comparison is about
+                spill_queue_per_slot=1e9,
+                disagg=disagg,
+            )
+            rsrv = Server(router.app, port=0)
+            rsrv.start()
+            servers.append(rsrv)
+            url = (
+                f"http://127.0.0.1:{rsrv.port}/v1/models/gpt_fleet:generate"
+            )
+            # warm 1: compile every reachable program on EVERY replica
+            # directly (miss prefill + insert + step + hit/chunk path,
+            # and the :prefill route the steering hop rides) — this
+            # measures placement, not XLA compiles
+            for srv in servers[:-1]:
+                base = f"http://127.0.0.1:{srv.port}"
+                wp = wrng.integers(0, 50257, (prompt_len,))
+                wtail = wrng.integers(0, 50257, (prompt_len - shared_len,))
+                for p in (wp, np.concatenate([wp[:shared_len], wtail])):
+                    post(base + "/v1/models/gpt_fleet:generate", _json.dumps({
+                        "prompt_ids": [p.tolist()],
+                        "max_new_tokens": new_tokens,
+                    }).encode())
+                post(base + "/v1/models/gpt_fleet:prefill", _json.dumps({
+                    "prompt_ids": [
+                        wrng.integers(0, 50257, (prompt_len,)).tolist()
+                    ],
+                }).encode())
+            # warm 2: commit the templates THROUGH the router — under
+            # disagg each detours via the prefill tier (its first-page
+            # key is unseen) and lands as pages on its decode home
+            for t in templates:
+                post(url, _json.dumps({
+                    "prompt_ids": [t.tolist()], "max_new_tokens": 2,
+                }).encode())
+
+            lat = [None] * num_requests
+            ttft = [None] * num_requests
+            done_at = [None] * num_requests
+            errors = []
+            lock = threading.Lock()
+            t0 = time.monotonic() + 0.05
+
+            def fire(i):
+                time.sleep(max(0.0, t0 + offsets[i] - time.monotonic()))
+                t_send = time.monotonic()
+                try:
+                    body, hdr = post(url, payloads[i])
+                    assert len(body["sequences"][0]) >= new_tokens
+                except Exception as e:  # noqa: BLE001 - recorded, not lost
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    return
+                t_done = time.monotonic()
+                with lock:
+                    lat[i] = t_done - t_send
+                    done_at[i] = t_done
+                    ttft[i] = (
+                        float(hdr["X-TTFT-Ms"]) / 1e3
+                        if hdr.get("X-TTFT-Ms")
+                        else t_done - t_send
+                    )
+
+            threads = [
+                threading.Thread(target=fire, args=(i,))
+                for i in range(num_requests)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            ok = [x for x in lat if x is not None]
+            if not ok:
+                raise RuntimeError(
+                    f"all {num_requests} routed requests failed; first: "
+                    f"{errors[0] if errors else 'unknown'}"
+                )
+            wall = max(x for x in done_at if x is not None) - t0
+            tfs = sorted(t for t in ttft if t is not None)
+            pct = lambda xs, q: xs[min(len(xs) - 1, int(len(xs) * q))]  # noqa: E731
+            out = {
+                "failed_requests": len(errors),
+                "tokens_per_sec": round(len(ok) * new_tokens / wall, 1),
+                "ttft_p50_ms": round(pct(tfs, 0.5) * 1e3, 2),
+                "ttft_p99_ms": round(pct(tfs, 0.99) * 1e3, 2),
+            }
+            if not disagg:
+                return out
+
+            # steering observability: where did the router send things
+            out["steer_counts"] = {
+                f"{t}/{r}": n
+                for (t, r), n in sorted(router._steer_counts.items())
+            }
+            # parity gate: a fresh cold prompt through the steered split
+            # path vs the same greedy request DIRECT on a replica (any
+            # replica serving :generate alone IS the unified engine)
+            pp = np.random.default_rng(17).integers(0, 50257, (prompt_len,))
+            pbody = _json.dumps({
+                "prompt_ids": [pp.tolist()], "max_new_tokens": 8,
+            }).encode()
+            via_router, _ = post(url, pbody)
+            direct, _ = post(
+                f"http://127.0.0.1:{servers[0].port}"
+                "/v1/models/gpt_fleet:generate",
+                pbody,
+            )
+            out["parity_bitwise"] = (
+                via_router["sequences"] == direct["sequences"]
+            )
+
+            return out
+        finally:
+            for srv in servers:
+                srv.stop()
+            for _, ms in engines:
+                ms.close()
+
+    def measure_rescue() -> dict:
+        """Scale-down rescue on a FRESH mini-fleet (one condemned decode
+        replica, two survivors, in-process page transport). The measured
+        trace saturates its pools by design, and import_page_entries
+        never evicts live chains to admit a shipment — the rescue is
+        only meaningful when the survivor has admission headroom. Fresh
+        engines at the auto pool isolate the mechanism: the condemned
+        replica commits (and re-heats) every template, each key's NEW
+        rendezvous home measures a cold-control extension FIRST, the
+        drain-window handoff lands, and the rescued extensions admit as
+        prefix hits at those same homes."""
+        rengines = {
+            rid: DecodeEngine(
+                "gpt_fleet", model, params,
+                num_slots=DEFAULT_NUM_SLOTS,
+                prefill_buckets=list(BENCH_PREFIX_BUCKETS),
+                page_size=BENCH_PREFIX_PAGE_SIZE, prefix_cache=True,
+            )
+            for rid in ("condemned", "s1", "s2")
+        }
+        rservers = {}
+
+        def _page_post(url, data):
+            rid = url[len("http://"):].split("/")[0]
+            st, resp, _ = rservers[rid].app.handle_full(
+                "POST", "/v1/kv/pages", body=data,
+                headers={"content-type": "application/octet-stream"},
+            )
+            raw = getattr(resp, "body", None)
+            if raw is None:
+                raw = _json.dumps(resp).encode()
+            return st, raw
+
+        for rid, eng in rengines.items():
+            ms = ModelServer(page_transport=_page_post)
+            ms.add_engine(eng)
+            rservers[rid] = ms
+        try:
+            def rgen(rid, row):
+                st, resp, _ = rservers[rid].app.handle_full(
+                    "POST", "/v1/models/gpt_fleet:generate",
+                    body={
+                        "prompt_ids": [row.tolist()],
+                        "max_new_tokens": 2,
+                    },
+                )
+                assert st == 200, resp
+
+            def rttft(rid, row):
+                fut = rengines[rid].submit(
+                    row.astype(np.int32), 2, temperature=0.0
+                )
+                fut.wait(600)
+                return fut.value["ttft_s"] * 1e3
+
+            xrng = np.random.default_rng(19)
+
+            def extend(ti):
+                tail = xrng.integers(0, 50257, (prompt_len - shared_len,))
+                return np.concatenate([templates[ti], tail])
+
+            # survivors: compile the miss AND hit paths off-measurement
+            wrng2 = np.random.default_rng(21)
+            for rid in ("s1", "s2"):
+                wp = wrng2.integers(0, 50257, (prompt_len,))
+                rgen(rid, wp)
+                rgen(rid, np.concatenate([
+                    wp[:shared_len],
+                    wrng2.integers(0, 50257, (prompt_len - shared_len,)),
+                ]))
+            # the condemned replica's warm cache: each template committed
+            # and extended once (the extension bumps the template chain's
+            # heat, so the hit-ranked export ships templates first)
+            for ti in range(num_templates):
+                rgen("condemned", templates[ti])
+                rgen("condemned", extend(ti))
+
+            survivors = ["s1", "s2"]
+            homes = {}
+            for ti, t in enumerate(templates):
+                key = first_page_key(t.tolist(), BENCH_PREFIX_PAGE_SIZE)
+                homes.setdefault(
+                    rendezvous_rank(key, survivors)[0], []
+                ).append(ti)
+            cold_pairs, warm_pairs = [], []
+            for rid, owned in homes.items():
+                half = len(owned) // 2
+                cold_pairs += [(rid, ti) for ti in owned[:half]]
+                warm_pairs += [(rid, ti) for ti in owned[half:]]
+            if not cold_pairs:
+                cold_pairs = warm_pairs[:1]
+            cold_ms = [rttft(rid, extend(ti)) for rid, ti in cold_pairs]
+            st, hdoc, _ = rservers["condemned"].app.handle_full(
+                "POST", "/v1/kv/handoff",
+                body={
+                    "peers": {rid: f"http://{rid}" for rid in survivors},
+                },
+            )
+            assert st == 200, hdoc
+            warm_ms = [rttft(rid, extend(ti)) for rid, ti in warm_pairs]
+            med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+            return {
+                "survivors": len(survivors),
+                "templates_rescued": len(warm_pairs),
+                "pages_admitted": sum(
+                    int((v or {}).get("admitted", 0))
+                    for v in hdoc.get("peers", {}).values()
+                ),
+                "cold_ttft_ms": round(med(cold_ms), 2),
+                "warm_ttft_ms": round(med(warm_ms), 2),
+            }
+        finally:
+            for ms in rservers.values():
+                ms.close()
+
+    disagg_arm = run_arm(disagg=True)
+    unified_arm = run_arm(disagg=False)
+    disagg_arm["handoff"] = measure_rescue()
+    hand = disagg_arm.get("handoff", {})
+    return {
+        "model": "gpt_small",
+        "num_requests": num_requests,
+        "chips_per_arm": num_decode + 1,
+        "num_decode": num_decode,
+        "num_templates": num_templates,
+        "cold_fraction": round(1 / 3, 3),
+        "prompt_len": prompt_len,
+        "shared_prefix_len": shared_len,
+        "page_size": BENCH_PREFIX_PAGE_SIZE,
+        "disagg": disagg_arm,
+        "unified": unified_arm,
+        # the acceptance headlines: the mix's TTFT tail and throughput,
+        # split fleet over unified at matched chips (< 1 / >= ~1), and
+        # the drain-window rescue's warm-over-cold TTFT (< 1)
+        "disagg_ttft_p99_ratio": round(
+            disagg_arm["ttft_p99_ms"] / unified_arm["ttft_p99_ms"], 3
+        ) if unified_arm["ttft_p99_ms"] else None,
+        "disagg_ttft_p50_ratio": round(
+            disagg_arm["ttft_p50_ms"] / unified_arm["ttft_p50_ms"], 3
+        ) if unified_arm["ttft_p50_ms"] else None,
+        "disagg_tokens_per_sec_ratio": round(
+            disagg_arm["tokens_per_sec"] / unified_arm["tokens_per_sec"], 3
+        ) if unified_arm["tokens_per_sec"] else None,
+        "handoff_warm_ttft_ratio": round(
+            hand["warm_ttft_ms"] / hand["cold_ttft_ms"], 3
+        ) if hand.get("cold_ttft_ms") else None,
+        "disagg_parity_bitwise": (
+            1.0 if disagg_arm.get("parity_bitwise") else 0.0
+        ),
+    }
+
+
 def bench_generate(
     batch: int = 8,
     prompt_len: int = 64,
@@ -2873,6 +3276,10 @@ def _entry_specs(batch: int, steps: int):
         # prefix-affinity vs random spray, fleet-wide hit rate + TTFT,
         # greedy parity through the router (docs/SERVING.md fleet routing)
         ("serving_router", "bench_serving_router()", 480, None, False),
+        # disaggregated prefill/decode fleet vs unified at matched chips:
+        # TTFT-tail + throughput ratios, drain-window warm handoff, and
+        # the split-path greedy parity gate (docs/SERVING.md)
+        ("serving_disagg", "bench_serving_disagg()", 540, None, False),
         # the cache-less decode baseline the KV cache is supposed to beat;
         # one plain-forward compile, cheap at the tail
         ("generate_floor", "bench_generate_nocache()", 240, None, False),
@@ -2894,6 +3301,7 @@ _HEADLINE_KEYS = (
     "steps_per_sec",
     "items_per_sec",
     "router_hit_rate_ratio",
+    "disagg_ttft_p99_ratio",
     "p50_ms",
     "ring_flash_causal_speedup",
     "best_trial_loss",
@@ -2930,6 +3338,11 @@ _EXTRA_FINAL_KEYS = (
     "router_affinity_hit_rate",
     "router_ttft_p50_speedup",
     "router_parity_bitwise",
+    # disaggregated fleet phase (serving_disagg): split vs unified at
+    # matched chips + the drain-window rescue's warm-over-cold TTFT
+    "disagg_tokens_per_sec_ratio",
+    "handoff_warm_ttft_ratio",
+    "disagg_parity_bitwise",
 )
 
 

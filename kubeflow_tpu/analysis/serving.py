@@ -746,6 +746,49 @@ def analyze_serving_plan(
             "page_entry_bytes": int(entry_bytes),
             "pages": int(tier_pages),
         }
+
+    # -- disagg handoff envelope: price it against the drain window -------
+    # A condemned decode replica ships up to handoff_chains committed
+    # pages (target + draft trees, scale siblings included — the same
+    # full-page host footprint the spill tier prices) inside its drain
+    # deadline; the envelope rides the kv-page wire format, whose npz
+    # framing adds only headers over the raw arrays. Priced at a
+    # CONSERVATIVE 1 Gbit/s effective pod-to-pod floor (125 MB/s): a
+    # budget the floor rate cannot move inside the deadline means the
+    # drain window dies mid-shipment and the warm-restart win silently
+    # never lands — flagged as an ERROR, the silently-dead-knob class.
+    if spec.handoff_chains > 0:
+        page_bytes = tree_bytes(pool_shapes) // num_pages
+        if draft is not None:
+            page_bytes += tree_bytes(progs.pool_shapes(dcache_one)) // (
+                num_pages
+            )
+        envelope_bytes = spec.handoff_chains * page_bytes
+        wire_floor_bps = 125e6
+        ship_s = envelope_bytes / wire_floor_bps
+        if ship_s > spec.drain_deadline_s:
+            findings.append(
+                Finding(
+                    analyzer="serve-disagg-handoff",
+                    severity=Severity.ERROR,
+                    location=f"plan:{spec.name}",
+                    message=(
+                        f"handoff_chains={spec.handoff_chains} prices a "
+                        f"{envelope_bytes}-byte drain-window envelope: "
+                        f"~{ship_s:.1f}s at the 1 Gbit/s floor, over the "
+                        f"{spec.drain_deadline_s:g}s drain deadline — "
+                        "shrink the chain budget or raise the deadline"
+                    ),
+                    symbol="handoff_chains",
+                )
+            )
+        stats["handoff"] = {
+            "chains": int(spec.handoff_chains),
+            "page_entry_bytes": int(page_bytes),
+            "envelope_bytes": int(envelope_bytes),
+            "ship_floor_s": float(ship_s),
+            "drain_deadline_s": float(spec.drain_deadline_s),
+        }
     return findings, stats
 
 

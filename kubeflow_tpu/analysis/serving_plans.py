@@ -127,6 +127,17 @@ class ServingPlanSpec:
     compile: bool = False              # also XLA-compile the step program
     #                                    (adds its temp allocation to the
     #                                    HBM budget; lower-only otherwise)
+    handoff_chains: int = 0            # disaggregated drain-window page
+    #                                    shipment budget (serving.disagg.
+    #                                    handoff_chains; 0 = disagg off).
+    #                                    Host-side like the radix cache —
+    #                                    no program-set impact (export/
+    #                                    import reuse the spill/upload
+    #                                    pair) — but the lint prices the
+    #                                    envelope against the drain
+    #                                    deadline (serve-disagg-handoff)
+    drain_deadline_s: float = DEFAULT_DRAIN_DEADLINE_S  # the window the
+    #                                    handoff envelope must fit inside
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -188,6 +199,11 @@ def bench_serving_plans() -> List[ServingPlanSpec]:
             model_kwargs=dict(target, max_len=BENCH_PREFIX_MAX_LEN),
             prefill_buckets=BENCH_PREFIX_BUCKETS,
             page_size=BENCH_PREFIX_PAGE_SIZE,
+            # the disaggregated fleet's engines (bench_serving_disagg,
+            # and a disagg-on InferenceService at defaults) run THIS
+            # geometry; pricing the default handoff envelope here keeps
+            # the drain-window shipment inside the lint's coverage
+            handoff_chains=64,
         ),
         ServingPlanSpec(
             # the quantized engine (bench's quantized phase): int8

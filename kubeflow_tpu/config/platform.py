@@ -662,6 +662,102 @@ class RouterConfig(ConfigNode):
 
 
 @dataclasses.dataclass
+class DisaggConfig(ConfigNode):
+    """Disaggregated prefill/decode fleet (docs/SERVING.md
+    "Disaggregated fleet"). When enabled the InferenceService controller
+    renders TWO deployments from one spec — `<name>-prefill`
+    (prefill_replicas pods, labeled `inferenceservice-tier: prefill`)
+    and `<name>` (spec.replicas decode pods) — and the router
+    steers cold-prefix :generate requests to the prefill tier, which
+    runs chunked prefill to page completion and ships the committed
+    pages to the request's decode-tier rendezvous home over
+    `POST /v1/kv/pages` (the kv_tiers page envelope). Greedy output
+    through the split path is BITWISE the unified engine's
+    (tests/test_disagg.py). Requires serving.router.enabled (the router
+    is the steering point) and serving.prefix_cache (shipped pages are
+    admitted as radix prefix hits)."""
+
+    enabled: bool = config_field(
+        default=False,
+        help="split the fleet into a prefill tier and a decode tier "
+        "with page-granular KV handoff; off = one unified tier (every "
+        "replica prefills and decodes)",
+    )
+    prefill_replicas: int = config_field(
+        default=1,
+        help="prefill-tier pod count (the `<name>-prefill` deployment); "
+        "spec.replicas stays the decode-tier count. The per-tier "
+        "autoscaler adjusts this within min/max below.",
+    )
+    min_prefill_replicas: int = config_field(
+        default=1, help="prefill-tier autoscale floor"
+    )
+    max_prefill_replicas: int = config_field(
+        default=1, help="prefill-tier autoscale ceiling"
+    )
+    cold_hit_rate: float = config_field(
+        default=0.2,
+        help="steering threshold: a request whose first-page key the "
+        "router has not seen, or whose decode home reports a prefix "
+        "hit rate STRICTLY below this, is cold — it detours through "
+        "the prefill tier before landing on its decode home. Rendered "
+        "as KFT_ROUTER_DISAGG_COLD_HIT_RATE.",
+    )
+    scale_up_ttft_p99_s: float = config_field(
+        default=2.0,
+        help="prefill-tier scale-up pressure: tier TTFT p99 at or "
+        "above this (the prefill tier exists to bound time-to-first-"
+        "token; decode occupancy says nothing about it)",
+    )
+    scale_up_cold_per_s: float = config_field(
+        default=2.0,
+        help="prefill-tier scale-up pressure: router cold-prefix "
+        "steers per second at or above this (arrival-rate term — a "
+        "cold burst should grow the tier before TTFT degrades)",
+    )
+    handoff_chains: int = config_field(
+        default=64,
+        help="max committed radix pages a condemned decode replica "
+        "ships to the keys' new rendezvous homes inside its drain "
+        "window (hit-ranked hottest first, host tier included); also "
+        "bounds the prefill tier's per-request page shipment. The "
+        "serving lint prices this envelope against the drain "
+        "deadline. Rendered as KFT_SERVING_DISAGG_HANDOFF_CHAINS.",
+    )
+
+    def validate(self) -> None:
+        if self.prefill_replicas < 0:
+            raise ConfigError(
+                "serving.disagg.prefill_replicas must be >= 0"
+            )
+        if self.min_prefill_replicas < 0:
+            raise ConfigError(
+                "serving.disagg.min_prefill_replicas must be >= 0"
+            )
+        if self.max_prefill_replicas < max(1, self.min_prefill_replicas):
+            raise ConfigError(
+                "serving.disagg.max_prefill_replicas must be >= "
+                "max(1, min_prefill_replicas)"
+            )
+        if not 0.0 <= self.cold_hit_rate <= 1.0:
+            raise ConfigError(
+                "serving.disagg.cold_hit_rate must be in [0, 1]"
+            )
+        if self.scale_up_ttft_p99_s <= 0:
+            raise ConfigError(
+                "serving.disagg.scale_up_ttft_p99_s must be > 0"
+            )
+        if self.scale_up_cold_per_s <= 0:
+            raise ConfigError(
+                "serving.disagg.scale_up_cold_per_s must be > 0"
+            )
+        if self.handoff_chains < 1:
+            raise ConfigError(
+                "serving.disagg.handoff_chains must be >= 1"
+            )
+
+
+@dataclasses.dataclass
 class ServingMeshConfig(ConfigNode):
     """The decode engine's serving mesh (parallel/serving_mesh.py;
     docs/SERVING.md "Sharded serving"): `tensor × fsdp` chips per
@@ -837,6 +933,7 @@ class ServingConfig(ConfigNode):
         default_factory=AutoscaleConfig
     )
     router: RouterConfig = config_field(default_factory=RouterConfig)
+    disagg: DisaggConfig = config_field(default_factory=DisaggConfig)
     chaos: ChaosConfig = config_field(default_factory=ChaosConfig)
 
     def validate(self) -> None:
@@ -845,6 +942,27 @@ class ServingConfig(ConfigNode):
         # like chaos below: a programmatically built config must hit the
         # same rejection from_dict applies when the subtree key is present
         self.router.validate()
+        self.disagg.validate()
+        if self.disagg.enabled:
+            # the router is the steering point and shipped pages admit
+            # as radix hits — without either, the split would silently
+            # serve as a plain unified fleet
+            if not self.router.enabled:
+                raise ConfigError(
+                    "serving.disagg.enabled needs serving.router.enabled: "
+                    "the router steers cold-prefix requests to the "
+                    "prefill tier"
+                )
+            if not self.prefix_cache:
+                raise ConfigError(
+                    "serving.disagg.enabled needs serving.prefix_cache: "
+                    "handed-off pages are admitted as radix prefix hits"
+                )
+            if self.num_slots < 1:
+                raise ConfigError(
+                    "serving.disagg.enabled needs serving.num_slots >= 1: "
+                    "both tiers run the decode engine"
+                )
         # from_dict only validates the chaos subtree when the key is
         # present; a programmatically built config (replace(), CR merge)
         # must hit the same parse rejection here, not crash-loop the pod

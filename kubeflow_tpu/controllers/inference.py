@@ -197,17 +197,85 @@ class InferenceServiceController(Controller):
                 self.serving_defaults.autoscale
             ),
             "router": dataclasses.asdict(self.serving_defaults.router),
+            "disagg": dataclasses.asdict(self.serving_defaults.disagg),
             "chaos": dataclasses.asdict(self.serving_defaults.chaos),
         }
         overrides = dict(spec.get("serving") or {})
         for subtree in ("mesh", "observability", "autoscale", "router",
-                        "chaos"):
+                        "disagg", "chaos"):
             sub_override = overrides.pop(subtree, None) or {}
             merged[subtree].update(sub_override)
         merged.update(overrides)
         cfg = from_dict(ServingConfig, merged)
         cfg.validate()
         return cfg
+
+    def _pop_scale_state(self, namespace: str, name: str) -> None:
+        """Drop every tier's hysteresis entry for one service."""
+        for key in [k for k in self._scale_state
+                    if (k[0], k[1]) == (namespace, name)]:
+            del self._scale_state[key]
+
+    def _sweep_scale_state(self, store: StateStore) -> None:
+        """Satellite fix: hysteresis entries used to be popped only on
+        the reconcile-of-a-deleted-CR path, so a CR that vanished without
+        its own reconcile (bulk store wipe, controller pointed at a
+        rebuilt store) left stale cooldown/streak state that a recreated
+        same-name service would inherit. Sweep every entry against the
+        live CR set instead — O(services), every reconcile."""
+        if not self._scale_state:
+            return
+        live = {
+            (
+                o.get("metadata", {}).get("namespace", "default"),
+                o.get("metadata", {}).get("name", ""),
+            )
+            for o in store.list(KIND)
+            if not o.get("metadata", {}).get("deletionTimestamp")
+        }
+        for key in list(self._scale_state):
+            if (key[0], key[1]) not in live:
+                del self._scale_state[key]
+
+    @staticmethod
+    def _hysteresis(
+        st: _ScaleState,
+        fresh: bool,
+        outage: bool,
+        pressure: bool,
+        headroom: bool,
+        breach_cycles: int,
+        cooldown_cycles: int,
+        desired: int,
+        lo: int,
+        hi: int,
+    ) -> Tuple[int, str]:
+        """One hysteresis step for one tier: advance the streaks on a
+        fresh observation and emit at most a one-replica move. On a
+        signal outage the streaks RESET rather than freeze — hysteresis
+        promises CONSECUTIVE observations, and a stale pre-outage streak
+        must not let one post-recovery reading trigger a resize."""
+        reason = "Clamp"
+        if not fresh:
+            return desired, reason
+        if st.cooldown > 0:
+            st.cooldown -= 1
+            return desired, reason
+        if outage:
+            st.up_streak = st.down_streak = 0
+            return desired, reason
+        st.up_streak = st.up_streak + 1 if pressure else 0
+        st.down_streak = st.down_streak + 1 if headroom else 0
+        if st.up_streak >= breach_cycles and desired < hi:
+            desired += 1
+            reason = "ScaleUp"
+        elif st.down_streak >= breach_cycles and desired > lo:
+            desired -= 1
+            reason = "ScaleDown"
+        if reason in ("ScaleUp", "ScaleDown"):
+            st.up_streak = st.down_streak = 0
+            st.cooldown = cooldown_cycles
+        return desired, reason
 
     def _maybe_autoscale(
         self,
@@ -218,27 +286,55 @@ class InferenceServiceController(Controller):
         cfg_serving: ServingConfig,
     ) -> bool:
         """Signal-driven replica autoscaling (the ROADMAP's replicated-
-        serving loop): read the fleet collector's aggregated queue/
-        occupancy/429 signals for this service and adjust spec.replicas
-        between min/max with hysteresis — the pressure (or headroom)
-        signal must hold `breach_cycles` consecutive reconciles, and a
-        resize starts a `cooldown_cycles` quiet period so the new
-        replica's signals can land before the next decision. Pure
-        signal-driven logic: tests feed it a fake signals source.
+        serving loop), now PER TIER: the decode tier (spec.replicas)
+        scales on the fleet collector's queue/occupancy/429 signals; a
+        disaggregated service's prefill tier
+        (spec.serving.disagg.prefill_replicas) scales on fleet TTFT p99
+        and the router's cold-prefix steer arrival rate. Each tier keeps
+        its own (namespace, name, tier) hysteresis entry — the pressure
+        (or headroom) signal must hold `breach_cycles` consecutive fleet
+        sweeps, and a resize starts a `cooldown_cycles` quiet period so
+        the new replica's signals can land before the next decision.
+        Pure signal-driven logic: tests feed it a fake signals source.
         Returns True when autoscaling is active (caller keeps requeueing
         so signals are re-polled)."""
+        cfg = cfg_serving.autoscale
+        if not cfg.enabled or self.fleet is None:
+            self._pop_scale_state(namespace, name)
+            return False
+        self._autoscale_decode(store, svc_cr, namespace, name, cfg_serving)
+        if cfg_serving.disagg.enabled:
+            self._autoscale_prefill(
+                store, svc_cr, namespace, name, cfg_serving
+            )
+        return True
+
+    def _autoscale_decode(
+        self,
+        store: StateStore,
+        svc_cr: Dict[str, Any],
+        namespace: str,
+        name: str,
+        cfg_serving: ServingConfig,
+    ) -> None:
         spec = svc_cr.get("spec", {})
         cfg = cfg_serving.autoscale
-        key = (namespace, name)
-        if not cfg.enabled or self.fleet is None:
-            self._scale_state.pop(key, None)
-            return False
-        st = self._scale_state.setdefault(key, _ScaleState())
+        st = self._scale_state.setdefault(
+            (namespace, name, "decode"), _ScaleState()
+        )
         current = int(spec.get("replicas", 1))
         # the min/max clamp applies even before any signal arrives
         desired = min(max(current, cfg.min_replicas), cfg.max_replicas)
-        reason = "Clamp"
         sig = self.fleet.serving_signals(namespace, name)
+        # a disaggregated fleet's decode decision reads DECODE-TIER
+        # queue/occupancy when the collector splits tiers (idle prefill
+        # slots must not drag the mean occupancy down and mask decode
+        # pressure); the 429 rate stays fleet-wide — a prefill-tier 429
+        # still means arrivals are being refused
+        dsig = None
+        if cfg_serving.disagg.enabled:
+            src = getattr(self.fleet, "disagg_signals", None)
+            dsig = src(namespace, name) if callable(src) else None
         # hysteresis counts fleet SWEEPS, not reconciles: the controller
         # also reconciles on watch events and its 5s requeue, and
         # re-reading one sweep's snapshot several times must not fake
@@ -248,45 +344,32 @@ class InferenceServiceController(Controller):
         if sig is not None and sig.sweep >= 0:
             fresh = sig.sweep != st.last_sweep
             st.last_sweep = sig.sweep
-        if not fresh:
-            pass
-        elif st.cooldown > 0:
-            st.cooldown -= 1
-        elif sig is None:
-            # signal outage: reset the streaks rather than freeze them —
-            # hysteresis promises CONSECUTIVE observations, and a stale
-            # pre-outage streak must not let one post-recovery reading
-            # trigger a resize
-            st.up_streak = st.down_streak = 0
-        else:
-            if sig.num_slots > 0:
-                q_per_slot = sig.queue_depth / sig.num_slots
+        pressure = headroom = False
+        if sig is not None:
+            queue, slots, occ = sig.queue_depth, sig.num_slots, sig.occupancy
+            if dsig is not None and dsig.decode_replicas > 0:
+                queue = dsig.decode_queue_depth
+                slots = dsig.decode_num_slots
+                occ = dsig.decode_occupancy
+            if slots > 0:
+                q_per_slot = queue / slots
             else:
-                q_per_slot = 1.0 if sig.queue_depth > 0 else 0.0
+                q_per_slot = 1.0 if queue > 0 else 0.0
             pressure = (
-                sig.occupancy >= cfg.scale_up_occupancy
+                occ >= cfg.scale_up_occupancy
                 or q_per_slot >= cfg.scale_up_queue_per_slot
                 or sig.rate_429_per_s > 0
             )
             headroom = (
-                sig.occupancy <= cfg.scale_down_occupancy
-                and sig.queue_depth == 0
+                occ <= cfg.scale_down_occupancy
+                and queue == 0
                 and sig.rate_429_per_s == 0
             )
-            st.up_streak = st.up_streak + 1 if pressure else 0
-            st.down_streak = st.down_streak + 1 if headroom else 0
-            if st.up_streak >= cfg.breach_cycles and desired < cfg.max_replicas:
-                desired += 1
-                reason = "ScaleUp"
-            elif (
-                st.down_streak >= cfg.breach_cycles
-                and desired > cfg.min_replicas
-            ):
-                desired -= 1
-                reason = "ScaleDown"
-            if reason in ("ScaleUp", "ScaleDown"):
-                st.up_streak = st.down_streak = 0
-                st.cooldown = cfg.cooldown_cycles
+        desired, reason = self._hysteresis(
+            st, fresh, sig is None, pressure, headroom,
+            cfg.breach_cycles, cfg.cooldown_cycles,
+            desired, cfg.min_replicas, cfg.max_replicas,
+        )
         if desired != current:
             from kubeflow_tpu.observability.trace import default_tracer
 
@@ -299,7 +382,11 @@ class InferenceServiceController(Controller):
             if reason == "ScaleDown":
                 # the condemned replica drains before it dies: SIGTERM →
                 # ModelServer.close(drain=True) inside the grace period
-                # (serving/main.py; docs/ROBUSTNESS.md drain contract)
+                # (serving/main.py; docs/ROBUSTNESS.md drain contract).
+                # On a disaggregated fleet the router additionally asks
+                # the drainer to hand its hottest committed KV chains to
+                # the surviving rendezvous homes inside that window
+                # (routing/router.py _note_draining → /v1/kv/handoff)
                 detail += (
                     f"; replica drains in-flight requests for up to "
                     f"{cfg_serving.drain_deadline_s:g}s before exit"
@@ -316,7 +403,89 @@ class InferenceServiceController(Controller):
             svc_cr["spec"] = spec
             store.update(svc_cr)
             store.record_event(svc_cr, reason, detail)
-        return True
+
+    def _autoscale_prefill(
+        self,
+        store: StateStore,
+        svc_cr: Dict[str, Any],
+        namespace: str,
+        name: str,
+        cfg_serving: ServingConfig,
+    ) -> None:
+        """Prefill-tier policy (serving.disagg): fleet TTFT p99 at or
+        over `scale_up_ttft_p99_s`, or the router's cold-prefix steer
+        arrival rate at or over `scale_up_cold_per_s`, is pressure; both
+        comfortably under (half the threshold) is headroom. Needs the
+        collector's tier-aware `disagg_signals` — against a source
+        without it (plain serving_signals fakes) the prefill count stays
+        wherever the spec put it."""
+        src = getattr(self.fleet, "disagg_signals", None)
+        if not callable(src):
+            return
+        sig = src(namespace, name)
+        spec = svc_cr.get("spec", {})
+        cfg = cfg_serving.autoscale
+        dcfg = cfg_serving.disagg
+        st = self._scale_state.setdefault(
+            (namespace, name, "prefill"), _ScaleState()
+        )
+        current = int(dcfg.prefill_replicas)
+        desired = min(
+            max(current, dcfg.min_prefill_replicas),
+            dcfg.max_prefill_replicas,
+        )
+        fresh = True
+        if sig is not None and sig.sweep >= 0:
+            fresh = sig.sweep != st.last_sweep
+            st.last_sweep = sig.sweep
+        pressure = headroom = False
+        if sig is not None:
+            slow = (
+                sig.ttft_p99_s is not None
+                and sig.ttft_p99_s >= dcfg.scale_up_ttft_p99_s
+            )
+            pressure = slow or sig.cold_per_s >= dcfg.scale_up_cold_per_s
+            headroom = (
+                (
+                    sig.ttft_p99_s is None
+                    or sig.ttft_p99_s <= dcfg.scale_up_ttft_p99_s / 2
+                )
+                and sig.cold_per_s <= dcfg.scale_up_cold_per_s / 2
+            )
+        desired, reason = self._hysteresis(
+            st, fresh, sig is None, pressure, headroom,
+            cfg.breach_cycles, cfg.cooldown_cycles,
+            desired, dcfg.min_prefill_replicas, dcfg.max_prefill_replicas,
+        )
+        if desired != current:
+            from kubeflow_tpu.observability.trace import default_tracer
+
+            serving = dict(spec.get("serving") or {})
+            disagg = dict(serving.get("disagg") or {})
+            disagg["prefill_replicas"] = desired
+            serving["disagg"] = disagg
+            spec["serving"] = serving
+            svc_cr["spec"] = spec
+            # same-pass render: the caller's already-merged cfg drives
+            # this reconcile's Deployment sizes, so the resize must land
+            # there too, not only in the spec the NEXT reconcile reads
+            cfg_serving.disagg.prefill_replicas = desired
+            detail = (
+                f"prefill replicas {current} -> {desired} "
+                f"(ttft_p99={getattr(sig, 'ttft_p99_s', None)}, "
+                f"cold/s={getattr(sig, 'cold_per_s', None)})"
+            )
+            default_tracer().event(
+                "autoscale.resize",
+                service=f"{namespace}/{name}",
+                reason=reason,
+                tier="prefill",
+                replicas_from=current,
+                replicas_to=desired,
+            )
+            log.info("autoscale %s/%s: %s %s", namespace, name, reason, detail)
+            store.update(svc_cr)
+            store.record_event(svc_cr, reason, detail)
 
     def _reconcile_router(
         self,
@@ -345,10 +514,25 @@ class InferenceServiceController(Controller):
                     pass
             return
         replicas = int(spec.get("replicas", 1))
-        registry = ",".join(
-            f"{name}-{i}=http://{name}-{i}:{SERVE_PORT}"
-            for i in range(replicas)
-        )
+        if cfg.disagg.enabled:
+            # registry entries carry tier roles as `id=url#role`
+            # (routing/__main__.py parse_replicas); the prefill tier's
+            # stable pod names come from the `<name>-prefill` Deployment
+            entries = [
+                f"{name}-{i}=http://{name}-{i}:{SERVE_PORT}#decode"
+                for i in range(replicas)
+            ]
+            entries.extend(
+                f"{name}-prefill-{i}="
+                f"http://{name}-prefill-{i}:{SERVE_PORT}#prefill"
+                for i in range(int(cfg.disagg.prefill_replicas))
+            )
+            registry = ",".join(entries)
+        else:
+            registry = ",".join(
+                f"{name}-{i}=http://{name}-{i}:{SERVE_PORT}"
+                for i in range(replicas)
+            )
         env = {
             "KFT_ROUTER_AFFINITY": "1" if cfg.router.affinity else "0",
             # the affinity hash granularity IS the fleet's radix-cache
@@ -364,6 +548,18 @@ class InferenceServiceController(Controller):
             "KFT_ROUTER_REPLICA_SLOTS": str(cfg.num_slots),
             "KFT_ROUTER_REPLICAS": registry,
         }
+        if cfg.disagg.enabled:
+            # disaggregated steering contract (routing/__main__.py):
+            # cold-prefix arrivals hop through the prefill tier, and a
+            # draining decode replica is asked to hand its hottest
+            # committed chains to the survivors
+            env["KFT_ROUTER_DISAGG"] = "1"
+            env["KFT_ROUTER_DISAGG_COLD_HIT_RATE"] = (
+                f"{cfg.disagg.cold_hit_rate:g}"
+            )
+            env["KFT_SERVING_DISAGG_HANDOFF_CHAINS"] = str(
+                cfg.disagg.handoff_chains
+            )
         if cfg.observability.statusz_enabled:
             # the fleet collector scrapes router_* off the router's
             # /metrics like any serving-side surface — but the router pod
@@ -415,10 +611,12 @@ class InferenceServiceController(Controller):
 
     def reconcile(self, store: StateStore, namespace: str, name: str) -> Result:
         svc_cr = store.try_get(KIND, name, namespace)
+        # hysteresis state for services that no longer exist must not
+        # leak into later same-name services (stale cooldown/streaks) —
+        # swept against the live CR set, not just this reconcile's CR
+        self._sweep_scale_state(store)
         if svc_cr is None or svc_cr["metadata"].get("deletionTimestamp"):
-            # a deleted service's hysteresis state must not leak into a
-            # later same-name service (stale cooldown/streaks)
-            self._scale_state.pop((namespace, name), None)
+            self._pop_scale_state(namespace, name)
             return Result()
         spec = svc_cr.get("spec", {})
         serving_cfg = self._serving_cfg(spec)
@@ -476,23 +674,62 @@ class InferenceServiceController(Controller):
             container["resources"] = {"limits": slice_cfg.resource_requests()}
             pod_spec["nodeSelector"] = slice_cfg.node_selectors()
 
+        disagg = serving_cfg.disagg.enabled
+        labels = {"app": "model-server", "inferenceservice": name}
+        if disagg:
+            # tier labels are the role contract: the router's replica
+            # discovery reads `inferenceservice-tier` off the pods
+            # (routing/router.py _TIER_LABEL) and the fleet collector
+            # splits its per-tier signals on the same label
+            labels["inferenceservice-tier"] = "decode"
         dep = new_deployment(
             name,
             namespace,
             int(spec.get("replicas", 1)),
             pod_spec,
-            labels={"app": "model-server", "inferenceservice": name},
+            labels=labels,
         )
         set_owner(dep, svc_cr)
         store.apply(dep)
 
+        prefill_name = f"{name}-prefill"
+        if disagg:
+            # the prefill tier: same image/engine contract (the page
+            # envelopes it ships must be bitwise the decode tier's), its
+            # own Deployment so the two tiers scale independently
+            prefill_dep = new_deployment(
+                prefill_name,
+                namespace,
+                int(serving_cfg.disagg.prefill_replicas),
+                pod_spec,
+                labels={
+                    "app": "model-server",
+                    "inferenceservice": name,
+                    "inferenceservice-tier": "prefill",
+                },
+            )
+            set_owner(prefill_dep, svc_cr)
+            store.apply(prefill_dep)
+        else:
+            try:
+                store.delete("Deployment", prefill_name, namespace)
+            except KeyError:
+                pass
+
+        selector = {"inferenceservice": name}
+        if disagg:
+            # the Service VIP fronts DECODE capacity only: prefill pods
+            # answer router-steered :prefill hops at their stable pod
+            # addresses, and spraying VIP traffic at them would waste
+            # their chips on decode work the tier split exists to avoid
+            selector["inferenceservice-tier"] = "decode"
         svc = new_object(
             "Service",
             name,
             namespace,
             api_version="v1",
             spec={
-                "selector": {"inferenceservice": name},
+                "selector": selector,
                 "ports": [{"port": SERVE_PORT, "targetPort": SERVE_PORT}],
             },
         )

@@ -140,6 +140,10 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "router_requests_total": "sum",
     "router_retry_total": "sum",
     "router_spill_total": "sum",
+    # disaggregated steering decisions by (tier, reason) — the per-label
+    # split is the diagnosis surface: a fleet stuck on unified/tier-down
+    # means the tier registry or prefill health is broken
+    "router_tier_steer_total": "sum",
     # traceparent propagation: fresh-mint count (requests_total minus
     # this = traffic arriving already traced)
     "router_trace_minted_total": "sum",
@@ -150,6 +154,12 @@ AGGREGATION_POLICY: Dict[str, str] = {
     # read-path dispatches by variant label: summed per variant across
     # the fleet, so any "gather" samples from a pallas fleet stand out
     "serving_paged_attention_calls_total": "sum",
+    # page handoff between tiers (prefill→decode ship, drain-window
+    # rescue): pages moved and wall-clock milliseconds spent, both
+    # directions — a counter pair, not a histogram, because the fleet
+    # question is throughput (pages/ms), not a latency distribution
+    "serving_kv_handoff_ms": "sum",
+    "serving_kv_handoff_pages_total": "sum",
     "serving_kv_spill_hits_total": "sum",
     "serving_kv_spill_pages_total": "sum",
     "serving_prefix_cache_hit_tokens_total": "sum",
@@ -187,6 +197,10 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "kft_instance_info": "max",
     "kubeflow_availability": "max",
     "notebook_running": "sum",
+    # router-side distinct first-page-key cardinality (capped): the
+    # router is a singleton per service, so max = that router's value
+    # even if several services' routers merge into one fleet view
+    "router_first_page_keys": "max",
     "serving_kv_pages_in_use": "sum",
     "serving_kv_pages_total": "sum",
     # last persisted-generation size: a restart-warmth indicator, not a
@@ -197,7 +211,14 @@ AGGREGATION_POLICY: Dict[str, str] = {
     # per-chip pool bytes: the HBM-budget-limiting value — max, not sum
     # (summing per-chip bytes across replicas describes no real chip)
     "serving_kv_pool_bytes_per_chip": "max",
+    # distinct first-page keys each replica has seen (engine-side cap):
+    # summed = the fleet's total tracked key population
+    "serving_first_page_keys": "sum",
     "serving_num_slots": "sum",
+    # lifetime prefix-cache hit-token fraction per replica: ratio-like,
+    # so mean — the router's cold-steer threshold compares against the
+    # PER-REPLICA rows (replica_serving_signals), not this fleet mean
+    "serving_prefix_hit_rate": "mean",
     "serving_queue_depth": "sum",
     "serving_slot_occupancy": "mean",
     "tpujob_running": "sum",
@@ -229,6 +250,10 @@ class ScrapeTarget:
     owner: str       # InferenceService name / TPUJob name
     instance: str    # replica/host identity (pod name or rendered env)
     base_url: str    # e.g. http://pod-0.ns:9432 (no trailing slash)
+    # disaggregated serving tier (controllers/inference.py renders the
+    # `inferenceservice-tier` pod label): "prefill" | "decode" |
+    # "unified" — per-tier signal splits key on it
+    tier: str = "unified"
 
 
 def _container_env(pod: Dict[str, Any]) -> Dict[str, str]:
@@ -253,6 +278,9 @@ _SERVING_LABEL = "inferenceservice"
 # but deliberately NOT labeled `inferenceservice` — it must never count
 # as a replica in serving_signals or join the Service VIP
 _ROUTER_LABEL = "inferenceservice-router"
+# the disaggregated-tier pod label (controllers/inference.py; the router
+# reads the same one for role discovery — routing/router.py _TIER_LABEL)
+_TIER_LABEL = "inferenceservice-tier"
 
 
 def discover_targets(store) -> List[ScrapeTarget]:
@@ -281,6 +309,9 @@ def discover_targets(store) -> List[ScrapeTarget]:
             continue
         ns = meta.get("namespace", "default")
         host = pod_host(pod)
+        tier = labels.get(_TIER_LABEL, "").strip()
+        if role != "serving" or tier not in ("prefill", "decode"):
+            tier = "unified"
         out.append(
             ScrapeTarget(
                 role=role,
@@ -289,6 +320,7 @@ def discover_targets(store) -> List[ScrapeTarget]:
                 instance=env.get(ENV_FLEET_INSTANCE)
                 or meta.get("name", host),
                 base_url=f"http://{host}:{port}",
+                tier=tier,
             )
         )
     return out
@@ -320,6 +352,24 @@ class FleetSignals:
 
 
 @dataclasses.dataclass
+class DisaggSignals:
+    """Per-tier autoscaler input for one DISAGGREGATED InferenceService
+    (controllers/inference.py _autoscale_prefill / _autoscale_decode).
+    TTFT is fleet-wide — the user-visible latency the prefill tier
+    exists to protect — while queue/occupancy are decode-tier-only so
+    idle prefill slots cannot mask decode pressure."""
+
+    prefill_replicas: int    # prefill-tier replicas scraped OK
+    decode_replicas: int     # decode/unified-tier replicas scraped OK
+    ttft_p99_s: Optional[float]  # fleet TTFT p99 (merged histogram)
+    cold_per_s: float        # router cold-prefix steers/sec
+    decode_queue_depth: float
+    decode_num_slots: float
+    decode_occupancy: float
+    sweep: int = -1
+
+
+@dataclasses.dataclass
 class _TargetState:
     """Per-target scrape bookkeeping (guarded by the collector lock)."""
 
@@ -329,6 +379,12 @@ class _TargetState:
     prev_429: Optional[float] = None
     prev_429_t: float = 0.0
     rate_429: float = 0.0
+    # router cold-prefix steer rate (router_tier_steer_total
+    # {tier=prefill,reason=cold} deltas between sweeps) — the prefill
+    # autoscaler's arrival signal
+    prev_steer: Optional[float] = None
+    prev_steer_t: float = 0.0
+    rate_steer: float = 0.0
     # straggler inputs: previous (sum, count) of training_step_seconds
     prev_step: Optional[Tuple[float, float]] = None
     step_means: Deque[float] = dataclasses.field(
@@ -404,6 +460,7 @@ class FleetCollector:
         self._merged: Dict[str, ParsedMetric] = {}
         self._groups: Dict[Tuple[str, str, str], Dict[str, ParsedMetric]] = {}
         self._group_429: Dict[Tuple[str, str, str], float] = {}
+        self._group_steer: Dict[Tuple[str, str, str], float] = {}
         self._group_replicas: Dict[Tuple[str, str, str], int] = {}
         self._stragglers: Dict[Tuple[str, str, str], bool] = {}
         self._straggler_means: Dict[Tuple[str, str, str], float] = {}
@@ -524,6 +581,7 @@ class FleetCollector:
         ok_snapshots: List[Dict[str, ParsedMetric]] = []
         group_snaps: Dict[Tuple[str, str, str], List[Dict]] = {}
         self._group_429 = {}
+        self._group_steer = {}
         self._group_replicas = {}
         for t in targets:
             st = self._state.setdefault(t, _TargetState())
@@ -535,6 +593,7 @@ class FleetCollector:
             st.parsed = parsed
             st.last_ok_t = now
             self._update_429(st, parsed, now)
+            self._update_cold_steer(st, parsed, now)
             self._update_step_stats(st, parsed)
             ok_snapshots.append(parsed)
             key = (t.role, t.namespace, t.owner)
@@ -542,6 +601,10 @@ class FleetCollector:
             self._group_429[key] = (
                 self._group_429.get(key, 0.0) + st.rate_429
             )
+            if st.rate_steer:
+                self._group_steer[key] = (
+                    self._group_steer.get(key, 0.0) + st.rate_steer
+                )
             self._group_replicas[key] = (
                 self._group_replicas.get(key, 0) + 1
             )
@@ -568,6 +631,24 @@ class FleetCollector:
             st.rate_429 = delta / (now - st.prev_429_t)
         st.prev_429 = total
         st.prev_429_t = now
+
+    @staticmethod
+    def _update_cold_steer(st: _TargetState, parsed, now: float) -> None:
+        """Cold-prefix steer arrivals/sec off the router's
+        router_tier_steer_total{tier=prefill,reason=cold} — same
+        delta-between-sweeps shape as the 429 rate."""
+        pm = parsed.get("router_tier_steer_total")
+        if pm is None:
+            return
+        total = 0.0
+        for key, v in pm.samples.items():
+            if ("tier", "prefill") in key and ("reason", "cold") in key:
+                total += float(v)
+        if st.prev_steer is not None and now > st.prev_steer_t:
+            delta = max(0.0, total - st.prev_steer)
+            st.rate_steer = delta / (now - st.prev_steer_t)
+        st.prev_steer = total
+        st.prev_steer_t = now
 
     @staticmethod
     def _update_step_stats(st: _TargetState, parsed) -> None:
@@ -730,6 +811,62 @@ class FleetCollector:
                 sweep=self._sweeps,
             )
 
+    def disagg_signals(
+        self, namespace: str, name: str
+    ) -> Optional[DisaggSignals]:
+        """Per-tier autoscaler input for one disaggregated
+        InferenceService, or None when no serving replica of it was
+        reachable at the last sweep. The tier split keys on each scrape
+        target's pod label (discover_targets); unified replicas count as
+        decode capacity — they serve decode traffic."""
+        key = ("serving", namespace, name)
+        with self._lock:
+            prefill_snaps: List[Dict[str, ParsedMetric]] = []
+            decode_snaps: List[Dict[str, ParsedMetric]] = []
+            for t, st in self._state.items():
+                if (t.role, t.namespace, t.owner) != key:
+                    continue
+                if st.parsed is None or st.error:
+                    continue
+                if t.tier == "prefill":
+                    prefill_snaps.append(st.parsed)
+                else:
+                    decode_snaps.append(st.parsed)
+            if not prefill_snaps and not decode_snaps:
+                return None
+            decode = merge_rendered(decode_snaps, AGGREGATION_POLICY)
+
+            def val(metric: str) -> float:
+                pm = decode.get(metric)
+                if pm is None:
+                    return 0.0
+                v = _collapse(pm, AGGREGATION_POLICY.get(metric, "sum"))
+                return 0.0 if v is None else v
+
+            # TTFT stays FLEET-wide (the service-level latency the tier
+            # split protects); the merged service group already holds
+            # every tier's histogram
+            ttft = None
+            pm = (self._groups.get(key) or {}).get(
+                "serving_time_to_first_token_seconds"
+            )
+            if pm is not None:
+                hs = _merged_histogram(pm)
+                if hs is not None and hs.count > 0:
+                    ttft = hs.quantile(0.99)
+            return DisaggSignals(
+                prefill_replicas=len(prefill_snaps),
+                decode_replicas=len(decode_snaps),
+                ttft_p99_s=ttft,
+                cold_per_s=self._group_steer.get(
+                    ("router", namespace, name), 0.0
+                ),
+                decode_queue_depth=val("serving_queue_depth"),
+                decode_num_slots=val("serving_num_slots"),
+                decode_occupancy=val("serving_slot_occupancy"),
+                sweep=self._sweeps,
+            )
+
     def replica_serving_signals(
         self, namespace: str, name: str, instance: Optional[str] = None
     ) -> Dict[str, Dict[str, float]]:
@@ -754,17 +891,29 @@ class FleetCollector:
                 if st.parsed is None or st.error:
                     continue
 
-                def val(metric: str) -> float:
+                def opt(metric: str) -> Optional[float]:
                     pm = st.parsed.get(metric)
                     if pm is None:
-                        return 0.0
-                    v = _collapse(pm, AGGREGATION_POLICY.get(metric, "sum"))
-                    return 0.0 if v is None else v
+                        return None
+                    return _collapse(
+                        pm, AGGREGATION_POLICY.get(metric, "sum")
+                    )
 
-                out[t.instance] = {
-                    "queue_depth": val("serving_queue_depth"),
-                    "num_slots": val("serving_num_slots"),
+                row = {
+                    "queue_depth": opt("serving_queue_depth") or 0.0,
+                    "num_slots": opt("serving_num_slots") or 0.0,
                 }
+                # disagg steering heat (routing/router.py _steer): keys
+                # present only when the replica exports them, so the
+                # router can tell "cold cache (0.0)" from "unknown"
+                for field, metric in (
+                    ("prefix_hit_rate", "serving_prefix_hit_rate"),
+                    ("first_page_keys", "serving_first_page_keys"),
+                ):
+                    v = opt(metric)
+                    if v is not None:
+                        row[field] = v
+                out[t.instance] = row
         return out
 
     # -- merged cross-host Perfetto export ---------------------------------
@@ -1085,6 +1234,19 @@ class FleetCollector:
                 f"slots={sig.num_slots:g} "
                 f"429/s={g429.get((role, ns, owner), 0.0):.3f}"
             )
+            dsig = self.disagg_signals(ns, owner)
+            if dsig is not None and dsig.prefill_replicas > 0:
+                ttft = (
+                    "n/a" if dsig.ttft_p99_s is None
+                    else f"{dsig.ttft_p99_s:.3f}s"
+                )
+                lines.append(
+                    f"    tiers: prefill={dsig.prefill_replicas} "
+                    f"ttft_p99={ttft} cold/s={dsig.cold_per_s:.3f} | "
+                    f"decode={dsig.decode_replicas} "
+                    f"queue={dsig.decode_queue_depth:g} "
+                    f"occupancy={dsig.decode_occupancy:.3f}"
+                )
         if not served:
             lines.append("  <none>")
         lines.append("")

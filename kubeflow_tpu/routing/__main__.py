@@ -20,6 +20,13 @@ controller on every scale event so the registry tracks the fleet:
   in-flight spill signal when no fleet collector is wired.
 - KFT_ROUTER_RETRY_BUDGET — extra replica attempts after a 429/failure
   before the router answers 503.
+- KFT_ROUTER_DISAGG — "1" enables disaggregated prefill/decode steering
+  (serving.disagg; registry entries carry roles as `id=url#role`).
+- KFT_ROUTER_DISAGG_COLD_HIT_RATE — decode-home prefix-cache hit rate
+  under which arrivals steer through the prefill tier.
+- KFT_SERVING_DISAGG_HANDOFF_CHAINS — hottest-chain budget one
+  scale-down drain window ships (the same knob the replicas' handoff
+  endpoint defaults to, rendered from one ServingConfig).
 """
 
 from __future__ import annotations
@@ -31,6 +38,8 @@ from typing import Any, Dict, List, Optional
 
 from kubeflow_tpu.analysis.serving_plans import DEFAULT_PAGE_SIZE
 from kubeflow_tpu.routing.router import (
+    DEFAULT_COLD_HIT_RATE,
+    DEFAULT_HANDOFF_CHAINS,
     DEFAULT_RETRY_BUDGET,
     DEFAULT_SPILL_QUEUE_PER_SLOT,
     FleetRouter,
@@ -43,17 +52,24 @@ DEFAULT_ROUTER_PORT = 8600
 
 
 def parse_replicas(raw: str) -> List[Replica]:
-    """`id=url[,id=url...]` (a bare url doubles as its own id)."""
+    """`id=url[#role][,id=url[#role]...]` (a bare url doubles as its
+    own id; role is prefill|decode, anything else — including absent —
+    is unified)."""
     out: List[Replica] = []
     for part in raw.split(","):
         part = part.strip()
         if not part:
             continue
+        role = "unified"
+        if "#" in part:
+            part, tier = part.rsplit("#", 1)
+            if tier.strip() in ("prefill", "decode"):
+                role = tier.strip()
         if "=" in part:
             rid, url = part.split("=", 1)
         else:
             rid, url = part, part
-        out.append(Replica(rid.strip(), url.strip().rstrip("/")))
+        out.append(Replica(rid.strip(), url.strip().rstrip("/"), role))
     return out
 
 
@@ -79,6 +95,13 @@ def knobs_from_env(environ: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
         "retry_budget": _i("KFT_ROUTER_RETRY_BUDGET", DEFAULT_RETRY_BUDGET),
         "replica_slots": _i("KFT_ROUTER_REPLICA_SLOTS", 0),
         "replicas": parse_replicas(env.get("KFT_ROUTER_REPLICAS", "")),
+        "disagg": env.get("KFT_ROUTER_DISAGG", "").strip() == "1",
+        "cold_hit_rate": _f(
+            "KFT_ROUTER_DISAGG_COLD_HIT_RATE", DEFAULT_COLD_HIT_RATE
+        ),
+        "handoff_chains": _i(
+            "KFT_SERVING_DISAGG_HANDOFF_CHAINS", DEFAULT_HANDOFF_CHAINS
+        ),
     }
 
 
@@ -93,6 +116,9 @@ def build_router(replicas: Optional[List[Replica]] = None) -> FleetRouter:
         spill_queue_per_slot=knobs["spill_queue_per_slot"],
         retry_budget=knobs["retry_budget"],
         replica_slots=knobs["replica_slots"],
+        disagg=knobs["disagg"],
+        cold_hit_rate=knobs["cold_hit_rate"],
+        handoff_chains=knobs["handoff_chains"],
     )
 
 

@@ -59,10 +59,12 @@ from kubeflow_tpu.utils.audit_lock import audit_lock
 from kubeflow_tpu.utils.logging import get_logger
 from kubeflow_tpu.utils.metrics import (
     router_affinity_hits_counter,
+    router_first_page_keys_gauge,
     router_request_seconds_histogram,
     router_requests_counter,
     router_retries_counter,
     router_spills_counter,
+    router_tier_steer_counter,
     router_trace_minted_counter,
 )
 
@@ -83,10 +85,27 @@ RETRY_AFTER_CAP_S = 3600.0
 # ENGINE_WAIT_S generosity)
 UPSTREAM_TIMEOUT_S = 600.0
 
+# Disaggregated-fleet knob defaults (serving.disagg in config/
+# platform.py documents the same numbers; docs/SERVING.md
+# "Disaggregated fleet"): a decode home whose prefix-cache hit rate
+# sits under cold_hit_rate treats arrivals as cold (steer through the
+# prefill tier); handoff_chains bounds what one drain window ships.
+DEFAULT_COLD_HIT_RATE = 0.2
+DEFAULT_HANDOFF_CHAINS = 64
+# cold/warm memory: first-page keys the router has steered through the
+# prefill tier — capped like the engine's first-page cardinality so
+# all-unique traffic saturates the verdict instead of leaking host
+# memory (past the cap every new key still steers cold, which is the
+# honest verdict for a key space that large)
+_SEEN_KEYS_CAP = 65536
+
 # the serving-replica pod label (controllers/inference.py deployment
 # labels); duplicated as a string so this module never imports the
 # controller layer — the same pairing fleet.py documents for discovery
 _SERVING_LABEL = "inferenceservice"
+# the tier label the controller stamps on disaggregated pods
+# (prefill|decode; absent = unified)
+_TIER_LABEL = "inferenceservice-tier"
 _SERVE_PORT = 8500
 
 # response headers the router passes through from the replica (the
@@ -105,6 +124,11 @@ class Replica:
 
     id: str         # stable identity (pod name / bench label) — the HRW key
     base_url: str   # e.g. http://pod-0:8500 (no trailing slash)
+    # disaggregated tier: "prefill" (cold-prefix chunked prefill + page
+    # handoff), "decode" (steady-state decode, the rendezvous homes), or
+    # "unified" (both — every pre-disagg fleet). The controller stamps
+    # the role from serving.disagg; discovery reads the tier pod label.
+    role: str = "unified"
 
 
 @dataclasses.dataclass
@@ -141,10 +165,12 @@ def discover_replicas(
         if meta.get("namespace", "default") != namespace:
             continue
         host = pod_host(pod)
+        tier = labels.get(_TIER_LABEL, "") or "unified"
         out.append(
             Replica(
                 id=meta.get("name", host),
                 base_url=f"http://{host}:{port}",
+                role=tier if tier in ("prefill", "decode") else "unified",
             )
         )
     return sorted(out, key=lambda r: r.id)
@@ -268,6 +294,9 @@ class FleetRouter:
         probe_interval_s: float = DEFAULT_PROBE_INTERVAL_S,
         clock: Callable[[], float] = time.monotonic,
         statusz_enabled: bool = True,
+        disagg: bool = False,
+        cold_hit_rate: float = DEFAULT_COLD_HIT_RATE,
+        handoff_chains: int = DEFAULT_HANDOFF_CHAINS,
     ) -> None:
         if page_size < 1:
             raise ValueError("page_size must be >= 1")
@@ -277,7 +306,18 @@ class FleetRouter:
             raise ValueError("retry_budget must be >= 0")
         if probe_interval_s <= 0:
             raise ValueError("probe_interval_s must be > 0")
+        if not 0.0 <= cold_hit_rate <= 1.0:
+            raise ValueError("cold_hit_rate must be in [0, 1]")
+        if handoff_chains < 1:
+            raise ValueError("handoff_chains must be >= 1")
         self.affinity = bool(affinity)
+        # disaggregated steering (docs/SERVING.md "Disaggregated
+        # fleet"): cold-prefix :generate requests take a prefill-tier
+        # hop that ships the committed pages to the decode home; a
+        # draining decode replica gets a warm-handoff request
+        self.disagg = bool(disagg)
+        self.cold_hit_rate = float(cold_hit_rate)
+        self.handoff_chains = int(handoff_chains)
         self.page_size = int(page_size)
         self.spill_queue_per_slot = float(spill_queue_per_slot)
         self.retry_budget = int(retry_budget)
@@ -322,6 +362,14 @@ class FleetRouter:
             self._replicas[r.id] = r
             self._states[r.id] = _ReplicaState()
         self._rr = 0  # round-robin cursor for the no-affinity spray path
+        # disagg state (all under _lock): keys already steered through
+        # the prefill tier (warm thereafter), per-(tier, reason) steer
+        # counts for /statusz, drainers whose warm handoff already
+        # fired this window, and the last handoff verdict
+        self._seen_keys: set = set()
+        self._steer_counts: Dict[Tuple[str, str], int] = {}
+        self._handoff_fired: set = set()
+        self._handoff_last: Dict[str, Any] = {}
         self._tracer = default_tracer()
         self._requests = router_requests_counter()
         self._affinity_hits = router_affinity_hits_counter()
@@ -329,6 +377,9 @@ class FleetRouter:
         self._retries = router_retries_counter()
         self._request_seconds = router_request_seconds_histogram()
         self._trace_minted = router_trace_minted_counter()
+        self._tier_steer = router_tier_steer_counter()
+        self._first_page_keys_g = router_first_page_keys_gauge()
+        self._first_page_keys_g.set(0)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.app = self._build()
@@ -374,6 +425,7 @@ class FleetRouter:
             return {
                 rid: {
                     "base_url": self._replicas[rid].base_url,
+                    "role": self._replicas[rid].role,
                     "healthy": st.healthy,
                     "draining": st.draining,
                     "demoted": not st.available(now),
@@ -402,6 +454,9 @@ class FleetRouter:
             if clear_demotion or self._clock() >= st.demoted_until:
                 st.draining = False
                 st.demoted_until = 0.0
+                # drain over: a future drain of this replica gets a
+                # fresh warm-handoff window
+                self._handoff_fired.discard(rid)
 
     def _note_failure(self, rid: str, err: str) -> None:
         with self._lock:
@@ -420,7 +475,17 @@ class FleetRouter:
         OFFERING traffic to the drainer, not just this one request.
         `draining=False` is the queue-full 429 (no Retry-After header):
         the replica is merely BUSY — it backs off the same way but must
-        not show as a phantom drain on healthz/statusz."""
+        not show as a phantom drain on healthz/statusz.
+
+        Disagg warm handoff (docs/SERVING.md): the first REAL drain
+        signal for a decode/unified replica fires one background
+        POST /v1/kv/handoff at the drainer — its hottest committed
+        chains ship to each key's NEW rendezvous home among the
+        surviving decode tier, so post-scale-down traffic re-admits
+        as prefix hits instead of re-prefilling. Once per drain
+        window: a recovered replica (probe ok) re-arms."""
+        peers: Dict[str, str] = {}
+        fire = False
         with self._lock:
             st = self._states.get(rid)
             if st is None:
@@ -429,6 +494,79 @@ class FleetRouter:
             st.demoted_until = max(
                 st.demoted_until, self._clock() + max(0.0, retry_after_s)
             )
+            drainer = self._replicas.get(rid)
+            if (
+                draining
+                and self.disagg
+                and drainer is not None
+                and drainer.role in ("decode", "unified")
+                and rid not in self._handoff_fired
+            ):
+                peers = {
+                    r.id: r.base_url
+                    for r in self._replicas.values()
+                    if r.id != rid and r.role in ("decode", "unified")
+                }
+                if peers:
+                    fire = True
+                    self._handoff_fired.add(rid)
+        if fire:
+            # daemon + fire-and-forget: the handoff rides the drainer's
+            # own grace window; a router shutdown mid-handoff only costs
+            # warmth, never correctness.
+            # kft-analyze: ignore[thread-lifecycle] — one short-lived worker per drain window; it only POSTs to the drainer and writes _handoff_last under _lock, and losing it at process exit loses nothing but cache warmth
+            threading.Thread(
+                target=self._request_handoff,
+                args=(rid, peers),
+                daemon=True,
+                name=f"kv-handoff-{rid}",
+            ).start()
+
+    def _request_handoff(self, rid: str, peers: Dict[str, str]) -> None:
+        """Ask draining replica `rid` to ship its hottest committed
+        chains to `peers` (its surviving decode-tier siblings), each
+        chain to its first-page key's rendezvous home. Best-effort: the
+        drain window is a race against the socket dying, and a lost
+        handoff only costs cache warmth."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+        if rep is None:
+            return
+        payload = json.dumps(
+            {"peers": peers, "chains": self.handoff_chains}
+        ).encode()
+        try:
+            status, data, _ = self._transport(
+                "POST",
+                rep.base_url + "/v1/kv/handoff",
+                payload,
+                {"Content-Type": "application/json"},
+            )
+            doc = json.loads(data) if data else {}
+        except Exception as e:  # noqa: BLE001 - handoff is best-effort
+            log.warning("warm handoff from %s failed: %s", rid, e)
+            with self._lock:
+                self._handoff_last = {
+                    "from": rid, "error": str(e), "at": self._clock(),
+                }
+            return
+        verdicts = doc.get("peers", {}) if isinstance(doc, dict) else {}
+        pages = sum(int(v.get("pages", 0)) for v in verdicts.values())
+        admitted = sum(int(v.get("admitted", 0)) for v in verdicts.values())
+        log.info(
+            "warm handoff from %s: %d page(s) shipped, %d admitted "
+            "across %d peer(s) (status %d)",
+            rid, pages, admitted, len(verdicts), status,
+        )
+        with self._lock:
+            self._handoff_last = {
+                "from": rid,
+                "status": status,
+                "pages": pages,
+                "admitted": admitted,
+                "peers": len(verdicts),
+                "at": self._clock(),
+            }
 
     # -- probing -----------------------------------------------------------
 
@@ -527,8 +665,8 @@ class FleetRouter:
 
     # -- selection ---------------------------------------------------------
 
-    def _affinity_key(self, body: Dict[str, Any]) -> Optional[str]:
-        """The first row's first-page key, or None when the body has no
+    def _affinity_row(self, body: Dict[str, Any]) -> Optional[list]:
+        """The request's first prompt row, or None when the body has no
         usable prompt (the replica's own validation will 400 it)."""
         prompt = body.get("prompt_ids")
         row = None
@@ -537,6 +675,12 @@ class FleetRouter:
                 row = prompt[0]
             elif all(isinstance(t, int) for t in prompt):
                 row = prompt  # tolerate a flat row
+        return row or None
+
+    def _affinity_key(self, body: Dict[str, Any]) -> Optional[str]:
+        """The first row's first-page key, or None when the body has no
+        usable prompt (the replica's own validation will 400 it)."""
+        row = self._affinity_row(body)
         if not row:
             return None
         try:
@@ -545,13 +689,17 @@ class FleetRouter:
             return None
 
     def _order_for(
-        self, key: Optional[str]
+        self, key: Optional[str], pool: Optional[List[str]] = None
     ) -> Tuple[List[Replica], bool]:
         """Candidate replicas in attempt order plus the spill verdict.
         Affinity keys rank by HRW (first = the prefix's home); keyless
-        requests spray round-robin. When every replica is demoted the
-        full registry is offered anyway — a stale demotion must degrade
-        to a retry, not a hard 503 while the fleet is actually fine."""
+        requests spray round-robin. `pool` restricts candidates to the
+        named replica ids (the disagg decode tier) — an empty
+        intersection falls back to the whole registry, because serving
+        somewhere beats 503ing over tier bookkeeping. When every
+        replica is demoted the full registry is offered anyway — a
+        stale demotion must degrade to a retry, not a hard 503 while
+        the fleet is actually fine."""
         with self._lock:
             now = self._clock()
             live = [
@@ -561,6 +709,10 @@ class FleetRouter:
             ]
             if not live:
                 live = list(self._replicas.values())
+            if pool is not None:
+                pooled = [r for r in live if r.id in pool]
+                if pooled:
+                    live = pooled
             if key is None and live:
                 start = self._rr % len(live)
                 self._rr += 1
@@ -604,6 +756,112 @@ class FleetRouter:
             "num_slots": float(self.replica_slots) or 1.0,
         }
 
+    # -- disaggregated steering (docs/SERVING.md "Disaggregated fleet") ----
+
+    def _count_steer(self, tier: str, reason: str) -> None:
+        self._tier_steer.inc(tier=tier, reason=reason)
+        with self._lock:
+            self._steer_counts[(tier, reason)] = (
+                self._steer_counts.get((tier, reason), 0) + 1
+            )
+
+    def _steer(
+        self, name: str, key: str, body: Dict[str, Any]
+    ) -> Optional[List[str]]:
+        """The disagg steering verdict for one :generate request.
+
+        Returns the decode-tier replica-id pool to pin the forward to
+        (None = unified path, no restriction). COLD keys — never
+        steered before, or whose decode home reports a prefix-cache hit
+        rate under `cold_hit_rate` — take a synchronous prefill-tier
+        hop first: the prefill replica runs chunked prefill to page
+        completion and ships the committed pages to the decode home's
+        /v1/kv/pages, so the forwarded request admits there as a prefix
+        hit (bitwise the unified output — prefill is deterministic and
+        the pages move bit-for-bit). Any tier gap or prefill failure
+        falls back to the unified path with the tier-down counter —
+        steering is an optimization, never an availability dependency.
+        """
+        with self._lock:
+            now = self._clock()
+            prefill = [
+                r for r in self._replicas.values()
+                if r.role == "prefill"
+                and self._states[r.id].available(now)
+            ]
+            decode = [
+                r for r in self._replicas.values()
+                if r.role in ("decode", "unified")
+                and self._states[r.id].available(now)
+            ]
+            seen = key in self._seen_keys
+        if not prefill or not decode:
+            self._count_steer("unified", "tier-down")
+            return None
+        pool = [r.id for r in decode]
+        home = next(
+            r for r in decode
+            if r.id == rendezvous_rank(key, pool)[0]
+        )
+        cold = not seen
+        if not cold and self._signals is not None:
+            sig = self._signals(home.id) or {}
+            rate = sig.get("prefix_hit_rate")
+            if rate is not None and float(rate) < self.cold_hit_rate:
+                cold = True
+        if not cold:
+            self._count_steer("decode", "page-complete")
+            return pool
+        pf = next(
+            r for r in prefill
+            if r.id == rendezvous_rank(key, [p.id for p in prefill])[0]
+        )
+        row = self._affinity_row(body)
+        payload = json.dumps(
+            {
+                "prompt_ids": row,
+                "handoff_url": home.base_url + "/v1/kv/pages",
+            }
+        ).encode()
+        self._tracer.event(
+            "router.steer", tier="prefill", replica=pf.id, home=home.id
+        )
+        try:
+            status, _, hdrs = self._transport(
+                "POST",
+                pf.base_url + f"/v1/models/{name}:prefill",
+                payload,
+                {"Content-Type": "application/json"},
+            )
+        except Exception as e:  # noqa: BLE001 - fall back to unified
+            self._note_failure(pf.id, f"prefill hop: {type(e).__name__}: {e}")
+            self._count_steer("unified", "tier-down")
+            return None
+        if status == 429:
+            self._note_draining(
+                pf.id, _parse_retry_after(hdrs),
+                draining="retry-after" in hdrs,
+            )
+            self._count_steer("unified", "tier-down")
+            return None
+        if status >= 500:
+            self._note_failure(pf.id, f"prefill hop: upstream {status}")
+            self._count_steer("unified", "tier-down")
+            return None
+        if status >= 400:
+            # the replica's own 4xx verdict: the forwarded request will
+            # get the same one — don't mask it behind a steering retry
+            self._count_steer("unified", "tier-down")
+            return None
+        self._note_ok(pf.id, clear_demotion=False)
+        self._count_steer("prefill", "cold")
+        with self._lock:
+            if len(self._seen_keys) < _SEEN_KEYS_CAP:
+                self._seen_keys.add(key)
+            keys = len(self._seen_keys)
+        self._first_page_keys_g.set(keys)
+        return pool
+
     # -- the routed request ------------------------------------------------
 
     def _forward(
@@ -612,6 +870,7 @@ class FleetRouter:
         method: str,
         path: str,
         key: Optional[str],
+        pool: Optional[List[str]] = None,
     ) -> Tuple[Any, int]:
         """The attempt loop shared by every proxied route: walk the
         candidate order, demoting on 429/connect-failure/5xx and
@@ -632,7 +891,7 @@ class FleetRouter:
             req.response_headers.append(("Retry-After", "1"))
             raise HttpError(429, "router is draining for shutdown")
         try:
-            return self._forward_traced(req, method, path, key)
+            return self._forward_traced(req, method, path, key, pool)
         finally:
             with self._lock:
                 self._proxying -= 1
@@ -643,6 +902,7 @@ class FleetRouter:
         method: str,
         path: str,
         key: Optional[str],
+        pool: Optional[List[str]] = None,
     ) -> Tuple[Any, int]:
         """Distributed-tracing envelope around the attempt loop: continue
         a client-sent W3C `traceparent` (or mint one), run the loop under
@@ -677,7 +937,7 @@ class FleetRouter:
                     affinity=key is not None,
                 ):
                     return self._forward_admitted(
-                        req, method, path, key, trace_id
+                        req, method, path, key, trace_id, pool
                     )
         except HttpError as e:
             # a replica's own 4xx verdict is the CLIENT's problem; 5xx
@@ -704,9 +964,10 @@ class FleetRouter:
         path: str,
         key: Optional[str],
         trace_id: Optional[str] = None,
+        pool: Optional[List[str]] = None,
     ) -> Tuple[Any, int]:
         with self._tracer.span("router.order", affinity=key is not None):
-            order, spilled = self._order_for(key)
+            order, spilled = self._order_for(key, pool)
         if spilled and len(order) > 1:
             # the spill decision, queryable per request: who was hot,
             # where the request went instead
@@ -830,8 +1091,17 @@ class FleetRouter:
             if not isinstance(body, dict):
                 raise BadRequest("request body must be a JSON object")
             key = self._affinity_key(body) if self.affinity else None
+            pool = None
+            if self.disagg and key is not None:
+                with self._lock:
+                    draining = self._draining
+                if not draining:
+                    # tier steering (may run the prefill hop) — skipped
+                    # while draining: _forward's gate 429s anyway
+                    pool = self._steer(req.params["name"], key, body)
             return self._forward(
-                req, "POST", f"/v1/models/{req.params['name']}:generate", key
+                req, "POST", f"/v1/models/{req.params['name']}:generate",
+                key, pool,
             )
 
         @app.post("/v1/models/<name>:predict")
@@ -880,8 +1150,35 @@ class FleetRouter:
             f"  affinity={'on' if self.affinity else 'off'} "
             f"page_size={self.page_size} "
             f"spill_queue_per_slot={self.spill_queue_per_slot:g} "
-            f"retry_budget={self.retry_budget}"
+            f"retry_budget={self.retry_budget} "
+            f"disagg={'on' if self.disagg else 'off'}"
         ]
+        if self.disagg:
+            with self._lock:
+                counts = dict(self._steer_counts)
+                seen = len(self._seen_keys)
+                handoff = dict(self._handoff_last)
+                now = self._clock()
+            steers = " ".join(
+                f"{tier}/{reason}={n}"
+                for (tier, reason), n in sorted(counts.items())
+            ) or "<none>"
+            lines.append(
+                f"  steering: cold_hit_rate={self.cold_hit_rate:g} "
+                f"seen_keys={seen} steers: {steers}"
+            )
+            if handoff:
+                verdict = (
+                    f"error={handoff['error']}"
+                    if "error" in handoff
+                    else f"pages={handoff.get('pages', 0)} "
+                    f"admitted={handoff.get('admitted', 0)} "
+                    f"peers={handoff.get('peers', 0)}"
+                )
+                lines.append(
+                    f"  last handoff: from={handoff.get('from')} "
+                    f"{verdict} age={now - handoff.get('at', now):.0f}s"
+                )
         states = self.replica_states()
         for rid in sorted(states):
             s = states[rid]
@@ -891,8 +1188,8 @@ class FleetRouter:
             )
             err = f" ({s['last_error']})" if s["last_error"] else ""
             lines.append(
-                f"  {rid:<24}{s['base_url']:<32}{verdict:<10}"
-                f"fails={s['fails']}{err}"
+                f"  {rid:<24}{s['base_url']:<32}{s['role']:<9}"
+                f"{verdict:<10}fails={s['fails']}{err}"
             )
         if not states:
             lines.append("  <no replicas>")

@@ -116,6 +116,9 @@ from kubeflow_tpu.utils.metrics import (
     serving_draft_proposed_counter,
     serving_drain_histogram,
     serving_engine_recoveries_counter,
+    serving_first_page_keys_gauge,
+    serving_kv_handoff_ms_counter,
+    serving_kv_handoff_pages_counter,
     serving_kv_pages_in_use_gauge,
     serving_kv_pages_total_gauge,
     serving_kv_persisted_chains_gauge,
@@ -126,6 +129,7 @@ from kubeflow_tpu.utils.metrics import (
     serving_num_slots_gauge,
     serving_paged_attention_calls_counter,
     serving_phase_histogram,
+    serving_prefix_hit_rate_gauge,
     serving_prefix_hit_tokens_counter,
     serving_prefix_lookups_counter,
     serving_queue_depth_gauge,
@@ -1632,6 +1636,12 @@ class DecodeEngine:
         # -- shared state (condition-lock-guarded) ----------------------
         self._cv = audit_condition("DecodeEngine._cv")
         self._queue: deque = deque()
+        # control jobs (disaggregated handoff): closures that must run
+        # ON the scheduler thread because they touch scheduler-owned
+        # state (pool, radix index, slot table) — the page export/import
+        # surface enqueues here via _run_on_scheduler and the loop
+        # drains between iterations. Guarded by _cv like the queue.
+        self._control: deque = deque()
         self._stop = False
         # draining shutdown (docs/ROBUSTNESS.md drain contract): once
         # set, NEW submits are rejected with EngineDrainingError (429 +
@@ -1711,6 +1721,16 @@ class DecodeEngine:
         self._spill_pages_m = serving_kv_spill_pages_counter()
         self._spill_hits_m = serving_kv_spill_hits_counter()
         self._persisted_chains_g = serving_kv_persisted_chains_gauge()
+        # disaggregated-fleet heat + handoff series (docs/SERVING.md
+        # "Disaggregated fleet"): the two per-replica heat gauges the
+        # tier-aware router and per-tier autoscaler read, and the page/
+        # millisecond economy of cross-replica handoff
+        self._prefix_hit_rate_g = serving_prefix_hit_rate_gauge()
+        self._first_page_keys_g = serving_first_page_keys_gauge()
+        self._handoff_pages_m = serving_kv_handoff_pages_counter()
+        self._handoff_ms_m = serving_kv_handoff_ms_counter()
+        self._prefix_hit_rate_g.set(0.0, model=name)
+        self._first_page_keys_g.set(0, model=name)
         self._persisted_chains_g.set(0, model=name)
         self._queue_depth.set(0, model=name)
         self._occupancy.set(0.0, model=name)
@@ -1830,6 +1850,8 @@ class DecodeEngine:
                     self._first_page_keys.add(
                         first_page_key(req.prompt, self.page_size)
                     )
+            keys = len(self._first_page_keys)
+        self._first_page_keys_g.set(keys, model=self.name)
 
     def submit(
         self,
@@ -2383,6 +2405,226 @@ class DecodeEngine:
             self._persisted_chains = len(entries)
         self._persisted_chains_g.set(len(entries), model=self.name)
 
+    # -- disaggregated handoff (docs/SERVING.md "Disaggregated fleet") -----
+    # Committed pages move between replicas: a prefill-tier replica
+    # exports the prompt's committed chain to the request's decode-tier
+    # rendezvous home, and a draining decode replica exports its hottest
+    # chains to each key's NEW home. Everything below runs ON the
+    # scheduler thread via _run_on_scheduler — export reads the pool
+    # through the (donating) spill programs and import mutates pool +
+    # radix state, both scheduler-owned.
+
+    def _run_on_scheduler(self, fn, timeout_s: float = 600.0):
+        """Run `fn` on the scheduler thread and return its result (or
+        re-raise its exception). Runs inline when the scheduler thread
+        is not alive (autostart=False engines, post-close exports)."""
+        if not self._thread.is_alive():
+            return fn()
+        job = {"fn": fn, "done": threading.Event(),
+               "result": None, "error": None}
+        with self._cv:
+            self._control.append(job)
+            self._cv.notify_all()
+        if not job["done"].wait(timeout_s):
+            raise TimeoutError(
+                f"engine {self.name}: scheduler control job did not "
+                f"complete within {timeout_s}s"
+            )
+        if job["error"] is not None:
+            raise job["error"]
+        return job["result"]
+
+    def _drain_control(self) -> None:
+        """Run every pending control job (scheduler thread only). A job
+        that raises fails ITS caller, never the loop — but a failed
+        import can leave a donated pool tombstoned, so the same recover
+        check as _iterate's admit-failure path applies."""
+        while True:
+            with self._cv:
+                if not self._control:
+                    return
+                job = self._control.popleft()
+            try:
+                job["result"] = job["fn"]()
+            except BaseException as e:  # noqa: BLE001 - per-job
+                job["error"] = e
+                leaves = list(jax.tree_util.tree_leaves(self._pool))
+                if self.num_draft_tokens > 0:
+                    leaves += jax.tree_util.tree_leaves(self._draft_pool)
+                if any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in leaves
+                ):
+                    self._recover(e)
+            finally:
+                job["done"].set()
+
+    def _export_node_entry(self, key: tuple, page: int, hits: int):
+        """Read one committed page out of the pool(s) — the same
+        device→host gather as the spill/persist paths, so a handed-off
+        page re-uploads the identical bits (the bitwise-parity
+        contract)."""
+        target = jax.device_get(
+            self.programs.spill(self._pool, jnp.int32(page))
+        )
+        draft = None
+        if self._draft_pool is not None:
+            draft = jax.device_get(
+                self.programs.draft_spill(self._draft_pool, jnp.int32(page))
+            )
+        return (key, target, draft, hits)
+
+    def _radix_walk(self, tokens):
+        """The node chain committing the page-aligned `tokens` prefix,
+        or None where it breaks — WITHOUT bumping hits/last_used (an
+        export is not traffic heat)."""
+        ps = self.page_size
+        node = self._radix.root
+        chain = []
+        for i in range(0, len(tokens), ps):
+            node = node.children.get(tuple(tokens[i : i + ps]))
+            if node is None:
+                return None
+            chain.append(node)
+        return chain
+
+    def export_prefix_entries(self, prompt_ids) -> list:
+        """Export the committed chain covering `prompt_ids`' full pages
+        as (tokens, target, draft, hits) entries, parents first — the
+        prefill tier's side of the handoff (encode_page_entries ships
+        them). Empty when nothing is committed."""
+        prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+
+        def job():
+            if self._radix is None:
+                return []
+            ps = self.page_size
+            out = []
+            node = self._radix.root
+            key: list = []
+            for i in range(0, (prompt.size // ps) * ps, ps):
+                chunk = tuple(int(t) for t in prompt[i : i + ps])
+                node = node.children.get(chunk)
+                if node is None:
+                    break
+                key.extend(chunk)
+                out.append(
+                    self._export_node_entry(tuple(key), node.page, node.hits)
+                )
+            return out
+
+        return self._run_on_scheduler(job)
+
+    def export_hot_entries(self, limit: int) -> list:
+        """Export the hit-ranked hottest committed chains — HBM-resident
+        (radix) first, then host-tier spill entries — as (tokens,
+        target, draft, hits) entries. The scale-down drain window ships
+        these to each key's new rendezvous home."""
+        limit = int(limit)
+
+        def job():
+            out = []
+            seen = set()
+            if self._radix is not None:
+                for key, page, hits in self._radix.hot_chains(limit):
+                    out.append(self._export_node_entry(key, page, hits))
+                    seen.add(key)
+            if self._host_tier is not None:
+                for key in self._host_tier.keys():
+                    if len(out) >= limit or key in seen:
+                        continue
+                    ent = self._host_tier.get(key)
+                    if ent is not None:
+                        out.append((key, ent.target, ent.draft, ent.hits))
+            return out[:limit]
+
+        return self._run_on_scheduler(job)
+
+    def import_page_entries(self, entries) -> int:
+        """Admit decoded wire entries (decode_page_entries output) into
+        the pool + radix index as committed, evictable chains — the
+        decode tier's side of the handoff. Mirrors _preload_persisted's
+        admit discipline, but runtime-tolerant: orphans and duplicates
+        are skipped (a duplicate only merges heat), pool headroom stops
+        admission early, and a shape/dtype mismatch raises (the server
+        400s the shipment). Returns the number of pages admitted."""
+        return self._run_on_scheduler(lambda: self._import_entries(entries))
+
+    def _import_entries(self, entries) -> int:
+        from kubeflow_tpu.serving.kv_tiers import tree_from_flat
+
+        if self._radix is None:
+            raise RuntimeError(
+                f"engine {self.name} has prefix_cache disabled; "
+                f"handed-off pages have nowhere to land"
+            )
+        t0 = time.monotonic()
+        ps = self.page_size
+        template = self._page_template(self._pool)
+        dtemplate = (
+            self._page_template(self._draft_pool)
+            if self._draft_pool is not None
+            else None
+        )
+        admitted = 0
+        # entries arrive parents-first; a parent chain may live in this
+        # shipment OR already be committed here — both resolve
+        path_pages: Dict[tuple, List[int]] = {(): []}
+        for ent in entries:
+            tokens = ent["tokens"]
+            if len(tokens) < ps or len(tokens) % ps:
+                continue
+            parent_chain = path_pages.get(tokens[:-ps])
+            if parent_chain is None:
+                nodes = self._radix_walk(tokens[:-ps])
+                if nodes is None:
+                    continue  # orphan: parent neither shipped nor local
+                parent_chain = [n.page for n in nodes]
+            here = self._radix_walk(tokens)
+            if here is not None:
+                # already committed: keep the local page, merge heat
+                here[-1].hits = max(here[-1].hits, int(ent["hits"]))
+                path_pages[tokens] = parent_chain + [here[-1].page]
+                continue
+            if self._draft_pool is not None and ent["draft"] is None:
+                continue  # sender ran no draft model: unusable here
+            # keep one full request's worth of pages free — handoff
+            # never starves admission (same gate as the preload)
+            if self._pagepool.free_count <= self._max_pages:
+                break
+            target = tree_from_flat(template, ent["target"])
+            draft = (
+                tree_from_flat(dtemplate, ent["draft"])
+                if dtemplate is not None
+                else None
+            )
+            pg = self._alloc_pages(1)[0]
+            self._pool = self.programs.upload(
+                self._pool, target, jnp.int32(pg)
+            )
+            if draft is not None:
+                self._draft_pool = self.programs.draft_upload(
+                    self._draft_pool, draft, jnp.int32(pg)
+                )
+            chain = parent_chain + [pg]
+            self._radix.insert(np.asarray(tokens, np.int32), chain)
+            # drop the alloc reference: the tree's reference keeps the
+            # page; it frees under eviction like any committed chain
+            self._pagepool.release([pg])
+            self._radix_walk(tokens)[-1].hits = int(ent["hits"])
+            path_pages[tokens] = chain
+            admitted += 1
+        if admitted:
+            self._update_page_gauges()
+            self._handoff_pages_m.inc(
+                admitted, model=self.name, direction="in"
+            )
+        self._handoff_ms_m.inc(
+            (time.monotonic() - t0) * 1000.0,
+            model=self.name, direction="in",
+        )
+        return admitted
+
     # -- scheduler loop ----------------------------------------------------
 
     def _note_attn(self, window: int) -> None:
@@ -2677,6 +2919,9 @@ class DecodeEngine:
         with self._stats_lock:
             self._admitted += 1
             self._prefill_compute_tokens += computed
+            seen = self._prefix_hit_tokens + self._prefill_compute_tokens
+            rate = self._prefix_hit_tokens / seen if seen else 0.0
+        self._prefix_hit_rate_g.set(rate, model=self.name)
         self._update_page_gauges()
 
     def _finish(self, slot_idx: int) -> None:
@@ -2801,11 +3046,16 @@ class DecodeEngine:
                 while (
                     not self._stop
                     and not self._queue
+                    and not self._control
                     and not any(s is not None for s in self._slots)
                 ):
                     if not self._cv.wait(timeout=wait_s):
                         break  # idle persist tick
                 stop = self._stop
+            # control jobs (handoff export/import) run between
+            # iterations — and once more on the way out, so a job that
+            # raced the stop flag still completes instead of timing out
+            self._drain_control()
             if stop:
                 # shutdown snapshot: drain()→close() lands here with the
                 # radix still warm — exactly the hot set a restarted

@@ -381,6 +381,127 @@ class PersistentPrefixStore:
             return None
 
 
+# -- wire envelope (disaggregated fleet) --------------------------------
+#
+# One npz carrying N page entries: the transfer unit of the prefill->
+# decode handoff and the scale-down warm handoff (docs/SERVING.md
+# "Disaggregated fleet", POST /v1/kv/pages). Array keys are
+# "e{i}/t/<leaf path>" (target pool leaves) and "e{i}/d/<leaf path>"
+# (draft pool leaves), exactly the store's per-entry layout with an
+# entry index prefixed; "__manifest__" is the JSON header as uint8
+# bytes. Geometry (page_size/quantize/model) rides the manifest so the
+# receiver can refuse a mismatched shipment instead of feeding
+# wrong-shaped pages to its upload program. bf16 leaves survive the
+# same way the persistent store's do: np.savez drops the ml_dtypes tag
+# (void bytes), and tree_from_flat re-views them against the receiving
+# engine's pool template.
+
+WIRE_KIND = "kv-page-envelope"
+_MANIFEST_KEY = "__manifest__"
+
+
+def encode_page_entries(
+    entries: Sequence[Tuple[TokenKey, Any, Any, int]],
+    page_size: int,
+    quantize: str,
+    model: str = "",
+) -> bytes:
+    """Pack (tokens, target_tree, draft_tree|None, hits) entries into
+    one npz byte envelope for `POST /v1/kv/pages`."""
+    import json
+
+    flat: Dict[str, np.ndarray] = {}
+    manifest_entries = []
+    for i, (tokens, target, draft, hits) in enumerate(entries):
+        for k, v in _tree_host_arrays(target).items():
+            flat[f"e{i}/t/{k}"] = v
+        if draft is not None:
+            for k, v in _tree_host_arrays(draft).items():
+                flat[f"e{i}/d/{k}"] = v
+        manifest_entries.append(
+            {
+                "tokens": [int(t) for t in tokens],
+                "hits": int(hits),
+                "draft": draft is not None,
+            }
+        )
+    manifest = {
+        "kind": WIRE_KIND,
+        "page_size": int(page_size),
+        "quantize": str(quantize),
+        "model": str(model),
+        "entries": manifest_entries,
+    }
+    flat[_MANIFEST_KEY] = np.frombuffer(
+        json.dumps(manifest).encode(), np.uint8
+    )
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    return buf.getvalue()
+
+
+def decode_page_entries(
+    data: bytes,
+) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Unpack an `encode_page_entries` envelope.
+
+    Returns (manifest, entries) where each entry is {"tokens": tuple,
+    "target": {path: ndarray}, "draft": {path: ndarray}|None, "hits":
+    int} sorted by chain length (parents before children — the same
+    admit order the persistent store's load() guarantees). Raises
+    ValueError on any defect — the receiving endpoint 400s a torn or
+    mismatched shipment rather than admitting it.
+    """
+    import json
+
+    try:
+        with np.load(io.BytesIO(data), allow_pickle=False) as z:
+            flat = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise ValueError(f"unreadable page envelope: {e}")
+    raw = flat.pop(_MANIFEST_KEY, None)
+    if raw is None:
+        raise ValueError("page envelope has no manifest")
+    try:
+        manifest = json.loads(bytes(raw.tobytes()).decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"corrupt page-envelope manifest: {e}")
+    if manifest.get("kind") != WIRE_KIND:
+        raise ValueError(
+            f"envelope kind {manifest.get('kind')!r} is not {WIRE_KIND!r}"
+        )
+    out: List[Dict[str, Any]] = []
+    for i, ent in enumerate(manifest.get("entries", [])):
+        t_prefix, d_prefix = f"e{i}/t/", f"e{i}/d/"
+        target = {
+            k[len(t_prefix):]: v
+            for k, v in flat.items()
+            if k.startswith(t_prefix)
+        }
+        draft = {
+            k[len(d_prefix):]: v
+            for k, v in flat.items()
+            if k.startswith(d_prefix)
+        }
+        if not target:
+            raise ValueError(f"envelope entry {i} holds no target leaves")
+        if bool(ent.get("draft")) != bool(draft):
+            raise ValueError(
+                f"envelope entry {i}: manifest draft flag does not "
+                f"match shipped leaves"
+            )
+        out.append(
+            {
+                "tokens": tuple(int(t) for t in ent["tokens"]),
+                "target": target,
+                "draft": draft or None,
+                "hits": int(ent.get("hits", 0)),
+            }
+        )
+    out.sort(key=lambda e: len(e["tokens"]))
+    return manifest, out
+
+
 def pool_sizing_telemetry(registry=None) -> Optional[Dict[str, float]]:
     """Live pool-pressure signals for `resolve_num_pages`.
 

@@ -34,6 +34,33 @@ log = get_logger(__name__)
 
 BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
+# one POST of committed pages to a peer replica (disaggregated handoff,
+# docs/SERVING.md "Disaggregated fleet"): bounded well under the drain
+# deadline so a failed peer cannot eat the whole drain window
+PAGE_SHIP_TIMEOUT_S = 60.0
+
+
+def _default_page_transport(url: str, data: bytes):
+    """POST one page envelope to a peer replica's /v1/kv/pages.
+    Returns (status, body_bytes). Injectable on ModelServer for tests
+    and in-process fleets (same seam as the router's Transport)."""
+    import urllib.error
+    import urllib.request
+
+    request = urllib.request.Request(
+        url,
+        data=data,
+        headers={"Content-Type": "application/octet-stream"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(
+            request, timeout=PAGE_SHIP_TIMEOUT_S
+        ) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
 
 def bucket_for(n: int) -> int:
     for b in BATCH_BUCKETS:
@@ -279,10 +306,25 @@ class ModelServer:
     `statusz_enabled=False` (the ObservabilityConfig knob, rendered as
     KFT_TRACE_STATUSZ) leaves the wire surface model-endpoints-only."""
 
-    def __init__(self, statusz_enabled: bool = True) -> None:
+    def __init__(
+        self,
+        statusz_enabled: bool = True,
+        page_transport: Optional[Callable[[str, bytes], Any]] = None,
+    ) -> None:
         self._models: Dict[str, ServedModel] = {}
         self._lms: Dict[str, Any] = {}  # ServedLm (serving/generate.py)
         self._engines: Dict[str, Any] = {}  # DecodeEngine (serving/engine.py)
+        # disaggregated handoff (docs/SERVING.md "Disaggregated fleet"):
+        # how this replica POSTs page envelopes to a peer's
+        # /v1/kv/pages — injectable for in-process fleets and tests
+        self._page_transport = page_transport or _default_page_transport
+        from kubeflow_tpu.utils.metrics import (
+            serving_kv_handoff_ms_counter,
+            serving_kv_handoff_pages_counter,
+        )
+
+        self._handoff_pages_m = serving_kv_handoff_pages_counter()
+        self._handoff_ms_m = serving_kv_handoff_ms_counter()
         # draining-shutdown budget used when close(drain=True) is called
         # without an explicit deadline; build_server overrides it from
         # the controller-rendered KFT_SERVING_DRAIN_DEADLINE_S (one
@@ -623,6 +665,54 @@ class ModelServer:
         )
         return {"sequences": sequences}
 
+    # -- disaggregated page handoff (docs/SERVING.md) ----------------------
+
+    def _engine_for_handoff(self, model: str):
+        """Resolve a page shipment's destination engine: the manifest's
+        model name when loaded, else the server's only engine (single-
+        model replicas — the common fleet shape — need no name match)."""
+        engine = self._engines.get(model)
+        if engine is None and len(self._engines) == 1:
+            engine = next(iter(self._engines.values()))
+        if engine is None:
+            raise NotFoundError(
+                f"no decode engine for handed-off model {model!r}"
+            )
+        return engine
+
+    def _ship_pages(self, engine, entries, url: str) -> Dict[str, Any]:
+        """Encode `entries` and POST them to a peer's /v1/kv/pages.
+        Returns the peer's parsed verdict; raises HttpError(502) when
+        the peer is unreachable or rejects the shipment. Counts pages
+        out — the caller owns the ms span (export + every ship)."""
+        import json
+
+        from kubeflow_tpu.serving.kv_tiers import encode_page_entries
+
+        data = encode_page_entries(
+            entries, engine.page_size, engine.quantize, model=engine.name
+        )
+        try:
+            status, raw = self._page_transport(url, data)
+        except Exception as e:  # noqa: BLE001 — peer death is a 502
+            raise HttpError(502, f"page handoff to {url} failed: {e}")
+        try:
+            doc = json.loads(
+                raw.decode() if isinstance(raw, (bytes, bytearray)) else raw
+            )
+        except (ValueError, AttributeError):
+            doc = {}
+        if status >= 400:
+            raise HttpError(
+                502,
+                f"peer {url} rejected page handoff: "
+                f"{status} {doc.get('log', '')}".strip(),
+            )
+        self._handoff_pages_m.inc(
+            len(entries), model=engine.name, direction="out"
+        )
+        return doc
+
     def _build(self) -> App:
         app = App("model-server")
 
@@ -804,6 +894,175 @@ class ModelServer:
             except (ValueError, TypeError) as e:
                 raise BadRequest(f"bad generate request: {e}")
             return {"sequences": sequences.tolist()}
+
+        @app.post("/v1/kv/pages", binary=True)
+        def kv_pages(req):
+            """Disaggregated handoff, receiving side: body is one
+            encode_page_entries envelope (application/octet-stream);
+            the pages are admitted into the engine's pool + radix index
+            as committed prefix chains, so the NEXT request sharing the
+            prefix admits as a cache hit. Geometry (page_size/quantize)
+            must match the engine — a mismatched shipment 400s whole,
+            never half-admits."""
+            from kubeflow_tpu.serving.kv_tiers import decode_page_entries
+
+            if not isinstance(req.body, (bytes, bytearray)):
+                raise BadRequest(
+                    "send an encode_page_entries envelope with "
+                    "Content-Type: application/octet-stream"
+                )
+            try:
+                manifest, entries = decode_page_entries(bytes(req.body))
+            except ValueError as e:
+                raise BadRequest(f"bad page envelope: {e}")
+            engine = self._engine_for_handoff(str(manifest.get("model", "")))
+            if int(manifest.get("page_size", 0)) != engine.page_size:
+                raise BadRequest(
+                    f"envelope page_size {manifest.get('page_size')} does "
+                    f"not match engine page_size {engine.page_size}"
+                )
+            if str(manifest.get("quantize")) != str(engine.quantize):
+                raise BadRequest(
+                    f"envelope quantize {manifest.get('quantize')!r} does "
+                    f"not match engine quantize {engine.quantize!r}"
+                )
+            try:
+                admitted = engine.import_page_entries(entries)
+            except ValueError as e:
+                raise BadRequest(f"page envelope does not fit engine: {e}")
+            return {
+                "model": engine.name,
+                "entries": len(entries),
+                "admitted": admitted,
+            }
+
+        @app.post("/v1/models/<name>:prefill")
+        def prefill(req):
+            """Disaggregated handoff, prefill-tier side: body
+            {"prompt_ids": [...]} plus optional "handoff_url" (the
+            decode home's /v1/kv/pages). Runs chunked prefill to page
+            completion (greedy, one committed token — prefill is
+            sampling-independent, so the committed pages are the SAME
+            BITS any engine would compute), exports the prompt's
+            committed chain and ships it to the handoff target. The
+            router then forwards the real request to the decode home,
+            where it admits as a prefix hit."""
+            from kubeflow_tpu.serving.engine import (
+                EngineDrainingError,
+                QueueFullError,
+            )
+
+            name = req.params["name"]
+            engine = self._engines.get(name)
+            if engine is None:
+                raise NotFoundError(f"no decode engine for model {name}")
+            body = req.body or {}
+            if not isinstance(body, dict):
+                raise BadRequest("request body must be a JSON object")
+            prompt = body.get("prompt_ids")
+            if prompt is None:
+                raise BadRequest("request body must contain 'prompt_ids'")
+            try:
+                row = np.asarray(prompt, dtype=np.int32)
+            except (ValueError, TypeError) as e:
+                raise BadRequest(f"bad prefill request: {e}")
+            if row.ndim == 2 and row.shape[0] == 1:
+                row = row[0]  # routers forward the :generate row shape
+            if row.ndim != 1:
+                raise BadRequest(
+                    "bad prefill request: prompt_ids must be one row"
+                )
+            t0 = time.monotonic()
+            try:
+                future = engine.submit(
+                    row, 1, temperature=0.0,
+                    trace_id=req.headers.get("x-request-id"),
+                )
+            except EngineDrainingError as e:
+                import math
+
+                req.response_headers.append(
+                    ("Retry-After", str(max(1, math.ceil(e.retry_after_s))))
+                )
+                raise HttpError(429, str(e))
+            except QueueFullError as e:
+                raise HttpError(429, str(e))
+            except (ValueError, TypeError) as e:
+                raise BadRequest(f"bad prefill request: {e}")
+            future.wait(self.ENGINE_WAIT_S)
+            entries = engine.export_prefix_entries(row)
+            shipped: Dict[str, Any] = {}
+            url = body.get("handoff_url")
+            if url and entries:
+                shipped = self._ship_pages(engine, entries, str(url))
+                self._handoff_ms_m.inc(
+                    (time.monotonic() - t0) * 1e3,
+                    model=engine.name, direction="out",
+                )
+            return {
+                "model": engine.name,
+                "pages": len(entries),
+                "handoff": shipped,
+            }
+
+        @app.post("/v1/kv/handoff")
+        def kv_handoff(req):
+            """Disaggregated handoff, scale-down side: body {"peers":
+            {replica_id: base_url}, "chains": N?}. Exports each engine's
+            hottest committed chains (HBM radix + host tier) and ships
+            every chain to its first-page key's rendezvous home among
+            `peers` — the same HRW ranking the router shards on, so the
+            chains land exactly where post-scale-down traffic for those
+            keys will be routed. Per-peer failures are reported, never
+            fatal: a drain window ships what it can."""
+            from kubeflow_tpu.routing.affinity import (
+                first_page_key as _fpk,
+                rendezvous_rank,
+            )
+
+            body = req.body or {}
+            if not isinstance(body, dict):
+                raise BadRequest("request body must be a JSON object")
+            peers = body.get("peers")
+            if not isinstance(peers, dict) or not peers:
+                raise BadRequest(
+                    "request body must carry 'peers': {replica_id: url}"
+                )
+            try:
+                chains = int(body.get("chains", 0))
+            except (ValueError, TypeError) as e:
+                raise BadRequest(f"bad handoff request: {e}")
+            if chains <= 0:
+                from kubeflow_tpu.config.platform import DisaggConfig
+
+                chains = DisaggConfig().handoff_chains
+            verdicts: Dict[str, Any] = {}
+            for engine in self._engines.values():
+                t0 = time.monotonic()
+                entries = engine.export_hot_entries(chains)
+                groups: Dict[str, list] = {}
+                for ent in entries:
+                    key = _fpk(ent[0], engine.page_size)
+                    home = rendezvous_rank(key, list(peers))[0]
+                    groups.setdefault(home, []).append(ent)
+                for rid, ents in groups.items():
+                    url = str(peers[rid]).rstrip("/") + "/v1/kv/pages"
+                    slot = verdicts.setdefault(
+                        rid, {"pages": 0, "admitted": 0}
+                    )
+                    try:
+                        doc = self._ship_pages(engine, ents, url)
+                    except HttpError as e:
+                        slot["error"] = e.message
+                        continue
+                    slot["pages"] += len(ents)
+                    slot["admitted"] += int(doc.get("admitted", 0))
+                if entries:
+                    self._handoff_ms_m.inc(
+                        (time.monotonic() - t0) * 1e3,
+                        model=engine.name, direction="out",
+                    )
+            return {"peers": verdicts}
 
         @app.get("/v1/models")
         def list_models(req):

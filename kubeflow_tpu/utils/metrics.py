@@ -832,6 +832,62 @@ def serving_kv_persisted_chains_gauge() -> Gauge:
     )
 
 
+# Disaggregated prefill/decode fleet (routing/ + serving/; docs/
+# SERVING.md "Disaggregated fleet"): committed pages move between
+# replicas over POST /v1/kv/pages — prefill→decode after a cold-prefix
+# prefill, drainer→new-home during a scale-down drain window. Pages vs
+# milliseconds is the handoff's economy: what moved against what the
+# wire + upload path cost.
+
+
+def serving_kv_handoff_pages_counter() -> Counter:
+    """KV pages moved across replicas, by direction: "out" (exported +
+    shipped to a peer) and "in" (decoded off the wire and admitted into
+    the pool + radix index as a prefix hit)."""
+    return default_registry().counter(
+        "serving_kv_handoff_pages_total",
+        "KV pages handed off between replicas",
+        ["model", "direction"],
+    )
+
+
+def serving_kv_handoff_ms_counter() -> Counter:
+    """Milliseconds spent in page handoff, by direction: "out" covers
+    export (device→host spill reads) + the POST to the peer; "in"
+    covers wire decode + host→device upload + radix admission. A sum
+    (not a histogram): handoffs are rare, bulk transfers — ms/page from
+    the two sums is the per-page cost the serving lint prices."""
+    return default_registry().counter(
+        "serving_kv_handoff_ms",
+        "milliseconds spent handing off KV pages",
+        ["model", "direction"],
+    )
+
+
+def serving_prefix_hit_rate_gauge() -> Gauge:
+    """Fraction of prompt tokens served from the radix prefix cache
+    (hit / (hit + prefilled)) — the per-replica HEAT signal the
+    disaggregated router's cold-prefix steering and the per-tier
+    autoscaler read through FleetCollector.replica_serving_signals."""
+    return default_registry().gauge(
+        "serving_prefix_hit_rate",
+        "fraction of prompt tokens served from the prefix cache",
+        ["model"],
+    )
+
+
+def serving_first_page_keys_gauge() -> Gauge:
+    """Distinct first-page affinity keys this replica has admitted
+    (capped; routing/affinity.py) — per-replica key-space cardinality,
+    the second heat signal behind tier-aware routing and prefill-tier
+    autoscaling."""
+    return default_registry().gauge(
+        "serving_first_page_keys",
+        "distinct first-page affinity keys admitted (capped)",
+        ["model"],
+    )
+
+
 # ---------------------------------------------------------------------------
 # Observability-derived metrics (kubeflow_tpu/observability/; docs/
 # OBSERVABILITY.md): per-phase request accounting on the serving path and
@@ -993,6 +1049,32 @@ def router_retries_counter() -> Counter:
     return default_registry().counter(
         "router_retry_total",
         "replica attempts retried against another replica",
+    )
+
+
+def router_tier_steer_counter() -> Counter:
+    """Disaggregated-fleet steering decisions, by destination tier and
+    reason: tier="prefill" reason="cold" (cold-prefix request sent
+    through the prefill tier first), tier="decode" reason="page-complete"
+    (warm prefix — straight to its decode-tier rendezvous home),
+    tier="unified" reason="tier-down" (a tier was empty or the prefill
+    hop failed; the request fell back to the unified path)."""
+    return default_registry().counter(
+        "router_tier_steer_total",
+        "disaggregated-fleet steering decisions by tier and reason",
+        ["tier", "reason"],
+    )
+
+
+def router_first_page_keys_gauge() -> Gauge:
+    """Distinct first-page affinity keys the ROUTER has seen (capped) —
+    the fleet-wide cold-prefix arrival cardinality. Divergence between
+    this and the per-replica serving_first_page_keys sum is the
+    key-space-sharding evidence; its growth RATE is the prefill tier's
+    scale-up signal."""
+    return default_registry().gauge(
+        "router_first_page_keys",
+        "distinct first-page affinity keys seen by the router (capped)",
     )
 
 

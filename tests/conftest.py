@@ -131,6 +131,10 @@ _COMPILE_CACHE_MODULES = frozenset({
     # store serializes npz PAGE BYTES, never programs — the PR-7
     # checkpoint-program segfault class cannot reach it
     "test_kv_tiers",
+    # engine-program family only (the disagg fleets ride the same
+    # gpt_and_params engines at test_kv_tiers' geometry); the page
+    # envelope moves npz bytes, never programs
+    "test_disagg",
 })
 
 # One persistent dir shared with bench.py's battery cache: the workspace

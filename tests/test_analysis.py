@@ -523,7 +523,15 @@ class TestRepoClean:
                             hits.append(fname)
         assert hits == ["shard_map.py", "shard_map.py"], hits
 
+    @pytest.mark.slow
     def test_cli_ast_only_clean(self, capsys):
+        """@slow (r19 tier-1 tranche: re-runs every AST pass the two
+        direct clean tests above already ran): runs unfiltered in the
+        static-analysis CI workflow's analysis-tests step, and the CLI
+        itself is what the control-plane-lint step executes; tier-1
+        keeps the passes through test_control_plane_clean /
+        test_consistency_clean and the concurrency sweep through
+        test_concurrency_lint.py."""
         from kubeflow_tpu.analysis.cli import main
 
         rc = main(["--root", REPO, "--spmd", "off"])

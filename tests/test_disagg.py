@@ -1,0 +1,780 @@
+"""Disaggregated prefill/decode fleet (docs/SERVING.md "Disaggregated
+fleet"): the page-envelope wire contract is BITWISE (bf16 and
+int8+scales), greedy output through the steered split path is bitwise
+the unified engine's, the router's steering table lands every case on
+the promised tier, the per-tier autoscaler moves on per-tier signal
+math, and a condemned replica's drain-window handoff lands its chains
+at each key's NEW rendezvous home."""
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.observability.fleet import DisaggSignals, FleetSignals
+from kubeflow_tpu.routing import FleetRouter, Replica
+from kubeflow_tpu.routing.affinity import first_page_key, rendezvous_rank
+from kubeflow_tpu.serving.engine import DecodeEngine
+from kubeflow_tpu.serving.generate import generate
+from kubeflow_tpu.serving.kv_tiers import (
+    decode_page_entries,
+    encode_page_entries,
+    tree_from_flat,
+)
+from kubeflow_tpu.serving.server import ModelServer
+
+PS = 8  # the tier test geometry's page size (test_kv_tiers)
+OCTET = {"content-type": "application/octet-stream"}
+
+
+def _engine(model, params, name, **kw):
+    """The tier test geometry test_kv_tiers soaks: big enough for
+    multi-page chains, small enough to stay fast on the CPU mesh."""
+    return DecodeEngine(
+        name, model, params, num_slots=2, page_size=PS, num_pages=24,
+        prefill_buckets=(8, 32), **kw,
+    )
+
+
+def _ref_tokens(model, params, row, n):
+    out = generate(model, params, jnp.asarray(row, jnp.int32)[None, :], n)
+    return np.asarray(out)[0, len(row):].tolist()
+
+
+def _bits(a) -> bytes:
+    return np.asarray(a).view(np.uint8).tobytes()
+
+
+def _as_bytes(resp) -> bytes:
+    """Normalize a handle_full result body for a fake wire transport."""
+    if isinstance(resp, (bytes, bytearray)):
+        return bytes(resp)
+    body = getattr(resp, "body", None)
+    if body is not None:
+        return body
+    return json.dumps(resp).encode()
+
+
+def _assert_trees_bitwise(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.asarray(x).dtype == np.asarray(y).dtype
+        assert _bits(x) == _bits(y)
+
+
+# -- the wire envelope -----------------------------------------------------
+
+
+class TestPageEnvelopeWire:
+    def _tree(self, seed, dtype):
+        r = np.random.default_rng(seed)
+        return {
+            "k": jnp.asarray(r.standard_normal((2, PS, 4)), dtype),
+            "v": jnp.asarray(r.standard_normal((2, PS, 4)), dtype),
+        }
+
+    def test_bf16_round_trip_bitwise(self):
+        """npz stores bfloat16 as raw void bytes; the decode side must
+        view them back to bf16 with the exact bit pattern."""
+        entries = [
+            (tuple(range(PS)), self._tree(1, jnp.bfloat16), None, 3),
+            (
+                tuple(range(2 * PS)),
+                self._tree(2, jnp.bfloat16),
+                self._tree(3, jnp.bfloat16),
+                7,
+            ),
+        ]
+        data = encode_page_entries(entries, PS, "none", model="m")
+        manifest, dec = decode_page_entries(data)
+        assert manifest["page_size"] == PS
+        assert manifest["quantize"] == "none"
+        assert manifest["model"] == "m"
+        assert [tuple(d["tokens"]) for d in dec] == [
+            tuple(range(PS)), tuple(range(2 * PS)),
+        ]
+        for (tokens, target, draft, hits), d in zip(entries, dec):
+            assert int(d["hits"]) == hits
+            template = jax.tree_util.tree_map(np.asarray, target)
+            _assert_trees_bitwise(
+                target, tree_from_flat(template, d["target"])
+            )
+            if draft is None:
+                assert d["draft"] is None
+            else:
+                dtemplate = jax.tree_util.tree_map(np.asarray, draft)
+                _assert_trees_bitwise(
+                    draft, tree_from_flat(dtemplate, d["draft"])
+                )
+
+    def test_int8_scales_round_trip_bitwise(self):
+        """An int8 page carries int8 values AND their bf16 scale
+        siblings; both must survive the wire bit-for-bit."""
+        r = np.random.default_rng(4)
+        target = {
+            "k": jnp.asarray(
+                r.integers(-128, 128, (2, PS, 4)), jnp.int8
+            ),
+            "k_scale": jnp.asarray(
+                r.standard_normal((2, PS, 1)), jnp.bfloat16
+            ),
+        }
+        data = encode_page_entries(
+            [(tuple(range(PS)), target, None, 1)], PS, "int8", model="m"
+        )
+        manifest, dec = decode_page_entries(data)
+        assert manifest["quantize"] == "int8"
+        template = jax.tree_util.tree_map(np.asarray, target)
+        _assert_trees_bitwise(
+            target, tree_from_flat(template, dec[0]["target"])
+        )
+
+    def test_engine_wire_round_trip_int8(self, gpt_and_params):
+        """int8 engines end-to-end: export from one engine, ship the
+        envelope through POST /v1/kv/pages on a second, and the admitted
+        pages (values + scales) are bitwise the sender's."""
+        model, params = gpt_and_params
+        src = _engine(model, params, "wiresrc", quantize="int8")
+        dst = _engine(model, params, "wiredst", quantize="int8")
+        server = ModelServer()
+        server.add_engine(dst)
+        try:
+            row = np.random.default_rng(5).integers(
+                0, 512, (3 * PS,)
+            ).astype(np.int32)
+            src.submit(row, 2).wait(120)
+            entries = src.export_prefix_entries(row)
+            assert len(entries) == 3
+            dtypes = {
+                np.asarray(leaf).dtype
+                for e in entries
+                for leaf in jax.tree_util.tree_leaves(e[1])
+            }
+            assert np.dtype(np.int8) in dtypes  # values
+            assert len(dtypes) > 1              # plus scale siblings
+            data = encode_page_entries(
+                entries, src.page_size, src.quantize, model=dst.name
+            )
+            status, doc, _ = server.app.handle_full(
+                "POST", "/v1/kv/pages", body=data, headers=dict(OCTET)
+            )
+            assert status == 200
+            assert doc["admitted"] == 3
+            back = dst.export_prefix_entries(row)
+            assert len(back) == 3
+            for sent, landed in zip(entries, back):
+                assert tuple(sent[0]) == tuple(landed[0])
+                _assert_trees_bitwise(sent[1], landed[1])
+        finally:
+            server.close()
+            src.close()
+            dst.close()
+
+    def test_mismatched_geometry_rejected_whole(self, gpt_and_params):
+        """A shipment whose quantize (or page_size) does not match the
+        receiving engine 400s whole — never half-admits."""
+        model, params = gpt_and_params
+        src = _engine(model, params, "wiresrc8", quantize="int8")
+        dst = _engine(model, params, "wiredstf")  # quantize="none"
+        server = ModelServer()
+        server.add_engine(dst)
+        try:
+            row = np.random.default_rng(6).integers(
+                0, 512, (PS,)
+            ).astype(np.int32)
+            src.submit(row, 2).wait(120)
+            entries = src.export_prefix_entries(row)
+            assert entries
+            data = encode_page_entries(
+                entries, src.page_size, src.quantize, model=dst.name
+            )
+            status, _, _ = server.app.handle_full(
+                "POST", "/v1/kv/pages", body=data, headers=dict(OCTET)
+            )
+            assert status == 400
+            assert dst.export_prefix_entries(row) == []
+        finally:
+            server.close()
+            src.close()
+            dst.close()
+
+
+# -- split-path parity -----------------------------------------------------
+
+
+class TestSplitPathParity:
+    def _parity(self, model, params, quantize):
+        kw = {} if quantize == "none" else {"quantize": quantize}
+        pre = _engine(model, params, "pf", **kw)
+        dec = _engine(model, params, "pf", **kw)
+        uni = _engine(model, params, "pf", **kw)
+        sd = ModelServer()
+        sd.add_engine(dec)
+
+        def transport(url, data):
+            assert url.endswith("/v1/kv/pages")
+            status, resp, _ = sd.app.handle_full(
+                "POST", "/v1/kv/pages", body=data, headers=dict(OCTET)
+            )
+            return status, _as_bytes(resp)
+
+        sp = ModelServer(page_transport=transport)
+        sp.add_engine(pre)
+        su = ModelServer()
+        su.add_engine(uni)
+        try:
+            row = np.random.default_rng(7).integers(
+                0, 512, (2 * PS + 4,)
+            ).astype(np.int32).tolist()
+            # the prefill hop: chunked prefill to page completion, pages
+            # shipped straight to the decode home
+            status, doc, _ = sp.app.handle_full(
+                "POST", "/v1/models/pf:prefill",
+                body={
+                    "prompt_ids": [row],
+                    "handoff_url": "http://decode/v1/kv/pages",
+                },
+            )
+            assert status == 200
+            assert doc["pages"] == 2
+            assert doc["handoff"]["admitted"] == 2
+            gen = {"prompt_ids": [row], "max_new_tokens": 8}
+            status, split, _ = sd.app.handle_full(
+                "POST", "/v1/models/pf:generate", body=gen
+            )
+            assert status == 200
+            status, unified, _ = su.app.handle_full(
+                "POST", "/v1/models/pf:generate", body=gen
+            )
+            assert status == 200
+            # the decode home admitted the shipped pages as a PREFIX HIT
+            # (the handoff's whole point), and the split path's greedy
+            # output is bitwise the unified engine's
+            assert dec.stats()["prefix_cache_hit_rate"] > 0
+            assert split["sequences"] == unified["sequences"]
+            return row, split["sequences"]
+        finally:
+            sp.close()
+            sd.close()
+            su.close()
+            for e in (pre, dec, uni):
+                e.close()
+
+    def test_split_path_greedy_bitwise(self, gpt_and_params):
+        model, params = gpt_and_params
+        row, sequences = self._parity(model, params, "none")
+        # and the unified engine itself matches the reference decoder
+        assert sequences[0][len(row):] == _ref_tokens(model, params, row, 8)
+
+    @pytest.mark.slow
+    def test_split_path_greedy_bitwise_int8(self, gpt_and_params):
+        """Same parity gate at quantize=int8 (pages ship values+scales);
+        the cheap representative above keeps the class in tier-1."""
+        model, params = gpt_and_params
+        self._parity(model, params, "int8")
+
+
+# -- the steering table ----------------------------------------------------
+
+
+PAGE = list(range(100, 116))  # one full page at the router's page_size=16
+
+
+def _gen_body(extra=0):
+    return {
+        "prompt_ids": [PAGE + list(range(extra))],
+        "max_new_tokens": 2,
+    }
+
+
+class _TierFleet:
+    """Scripted tiered fleet behind an injected router transport: every
+    call is recorded as (replica_id, path); prefill hops answer the
+    :prefill contract, everything else answers a healthy :generate."""
+
+    def __init__(self, fail=()):
+        self.calls = []
+        self.fail = set(fail)
+        self.lock = threading.Lock()
+
+    def transport(self, method, url, body, headers):
+        rest = url[len("http://"):]
+        rid, _, path = rest.partition("/")
+        path = "/" + path
+        with self.lock:
+            self.calls.append((rid, path))
+        if rid in self.fail:
+            return 500, b"{}", {}
+        if path.endswith(":prefill"):
+            doc = json.loads(body) if body else {}
+            row = doc.get("prompt_ids") or []
+            return 200, json.dumps({
+                "model": "m",
+                "pages": len(row) // 16,
+                "handoff": {"admitted": len(row) // 16},
+            }).encode(), {}
+        return 200, json.dumps({
+            "sequences": [[1, 2]],
+        }).encode(), {"x-ttft-ms": "1.00"}
+
+    def hops(self, path_suffix):
+        with self.lock:
+            return [
+                (rid, p) for rid, p in self.calls
+                if p.endswith(path_suffix)
+            ]
+
+
+def _tier_router(fleet, replicas, **kw):
+    return FleetRouter(
+        tuple(replicas), transport=fleet.transport, page_size=16,
+        disagg=True, **kw,
+    )
+
+
+class TestSteeringTable:
+    REPS = (
+        Replica("p1", "http://p1", "prefill"),
+        Replica("d1", "http://d1", "decode"),
+        Replica("d2", "http://d2", "decode"),
+    )
+
+    def _home(self):
+        key = first_page_key(PAGE, 16)
+        return rendezvous_rank(key, ["d1", "d2"])[0]
+
+    def test_cold_key_detours_through_prefill(self):
+        fleet = _TierFleet()
+        router = _tier_router(fleet, self.REPS)
+        status, _ = router.app.handle(
+            "POST", "/v1/models/m:generate", body=_gen_body()
+        )
+        assert status == 200
+        # the prefill hop went to the prefill tier, the forward to the
+        # key's decode home — and the reason counter says why
+        assert fleet.hops(":prefill") == [("p1", "/v1/models/m:prefill")]
+        assert fleet.hops(":generate") == [
+            (self._home(), "/v1/models/m:generate")
+        ]
+        assert router._steer_counts == {("prefill", "cold"): 1}
+
+    def test_seen_key_goes_straight_to_decode(self):
+        fleet = _TierFleet()
+        router = _tier_router(fleet, self.REPS)
+        for _ in range(2):
+            status, _ = router.app.handle(
+                "POST", "/v1/models/m:generate", body=_gen_body()
+            )
+            assert status == 200
+        # one prefill hop total: the second request's key is warm
+        assert len(fleet.hops(":prefill")) == 1
+        assert [r for r, _ in fleet.hops(":generate")] == [self._home()] * 2
+        assert router._steer_counts == {
+            ("prefill", "cold"): 1,
+            ("decode", "page-complete"): 1,
+        }
+
+    def test_low_home_hit_rate_re_steers_cold(self):
+        """A seen key whose decode home reports a prefix hit rate under
+        cold_hit_rate is COLD again (the home was evicted/restarted)."""
+        fleet = _TierFleet()
+        router = _tier_router(
+            fleet, self.REPS,
+            signals=lambda rid: {"prefix_hit_rate": 0.0},
+        )
+        for _ in range(2):
+            router.app.handle(
+                "POST", "/v1/models/m:generate", body=_gen_body()
+            )
+        assert router._steer_counts == {("prefill", "cold"): 2}
+        assert len(fleet.hops(":prefill")) == 2
+
+    def test_no_prefill_tier_falls_back_unified(self):
+        fleet = _TierFleet()
+        router = _tier_router(fleet, self.REPS[1:])  # decode only
+        status, _ = router.app.handle(
+            "POST", "/v1/models/m:generate", body=_gen_body()
+        )
+        assert status == 200
+        assert fleet.hops(":prefill") == []
+        assert router._steer_counts == {("unified", "tier-down"): 1}
+
+    def test_prefill_failure_falls_back_unified(self):
+        """Steering is an optimization, never an availability
+        dependency: a dead prefill tier must not fail the request."""
+        fleet = _TierFleet(fail={"p1"})
+        router = _tier_router(fleet, self.REPS)
+        status, _ = router.app.handle(
+            "POST", "/v1/models/m:generate", body=_gen_body()
+        )
+        assert status == 200
+        assert router._steer_counts == {("unified", "tier-down"): 1}
+        assert len(fleet.hops(":generate")) == 1
+
+    def test_prefill_never_serves_generate(self):
+        """The forward pool excludes the prefill tier even under load:
+        spray many distinct keys and p1 only ever sees :prefill."""
+        fleet = _TierFleet()
+        router = _tier_router(fleet, self.REPS)
+        for i in range(8):
+            body = {
+                "prompt_ids": [[1000 * (i + 1) + t for t in range(16)]],
+                "max_new_tokens": 2,
+            }
+            status, _ = router.app.handle(
+                "POST", "/v1/models/m:generate", body=body
+            )
+            assert status == 200
+        assert all(rid != "p1" for rid, _ in fleet.hops(":generate"))
+        assert router._steer_counts == {("prefill", "cold"): 8}
+
+    def test_drain_fires_one_handoff_per_window(self):
+        """The first REAL drain signal for a decode replica fires ONE
+        background /v1/kv/handoff carrying the surviving decode peers;
+        re-noting the same drain does not re-fire, a recovery re-arms."""
+        fired = []
+        ev = threading.Event()
+
+        class _F(_TierFleet):
+            def transport(self, method, url, body, headers):
+                if url.endswith("/v1/kv/handoff"):
+                    fired.append(json.loads(body))
+                    ev.set()
+                    return 200, json.dumps({
+                        "peers": {"d2": {"pages": 1, "admitted": 1}},
+                    }).encode(), {}
+                return super().transport(method, url, body, headers)
+
+        fleet = _F()
+        router = _tier_router(fleet, self.REPS, handoff_chains=7)
+        router._note_draining("d1", 5.0, draining=True)
+        assert ev.wait(10)
+        assert fired[0]["peers"] == {"d2": "http://d2"}
+        assert fired[0]["chains"] == 7
+        router._note_draining("d1", 5.0, draining=True)
+        time.sleep(0.2)
+        assert len(fired) == 1  # same window: armed once
+        router._note_ok("d1")  # probe says recovered: window re-arms
+        ev.clear()
+        router._note_draining("d1", 5.0, draining=True)
+        assert ev.wait(10)
+        assert len(fired) == 2
+
+
+# -- the per-tier autoscaler -----------------------------------------------
+
+
+class _TieredFleet:
+    """serving_signals + disagg_signals scripted per reconcile — the
+    per-tier autoscaler's entire input surface."""
+
+    def __init__(self, sigs, dsigs):
+        self.sigs = list(sigs)
+        self.dsigs = list(dsigs)
+        self.i = self.j = 0
+
+    def serving_signals(self, namespace, name):
+        sig = self.sigs[min(self.i, len(self.sigs) - 1)]
+        self.i += 1
+        return sig
+
+    def disagg_signals(self, namespace, name):
+        sig = self.dsigs[min(self.j, len(self.dsigs) - 1)]
+        self.j += 1
+        return sig
+
+
+def _calm(replicas=1):
+    return FleetSignals(
+        replicas=replicas, queue_depth=0.0, occupancy=0.5,
+        num_slots=8.0 * replicas, rate_429_per_s=0.0,
+    )
+
+
+def _dsig(ttft=None, cold=0.0, queue=0.0, occ=0.5, decode=1):
+    return DisaggSignals(
+        prefill_replicas=1, decode_replicas=decode, ttft_p99_s=ttft,
+        cold_per_s=cold, decode_queue_depth=queue,
+        decode_num_slots=8.0 * decode, decode_occupancy=occ,
+    )
+
+
+class TestPerTierAutoscale:
+    def _make(self, fleet, serving=None, replicas=1):
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.inference import (
+            InferenceServiceController,
+            new_inference_service,
+        )
+
+        base = {
+            "autoscale": {
+                "enabled": True, "min_replicas": 1, "max_replicas": 3,
+                "breach_cycles": 1, "cooldown_cycles": 0,
+            },
+            "router": {"enabled": True},
+            "disagg": {
+                "enabled": True, "min_prefill_replicas": 1,
+                "max_prefill_replicas": 3,
+            },
+        }
+        for k, v in (serving or {}).items():
+            base.setdefault(k, {}).update(v)
+        store = StateStore()
+        ctrl = InferenceServiceController(fleet=fleet)
+        cr = new_inference_service(
+            "svc1", model="gpt_tiny", replicas=replicas, serving=base,
+        )
+        store.create(cr)
+        return store, ctrl
+
+    def _prefill_replicas(self, store):
+        spec = store.get("InferenceService", "svc1")["spec"]
+        return spec["serving"]["disagg"].get("prefill_replicas", 1)
+
+    def _replicas(self, store):
+        return store.get("InferenceService", "svc1")["spec"]["replicas"]
+
+    def test_prefill_scales_up_on_ttft_pressure(self):
+        fleet = _TieredFleet([_calm()] * 5, [_dsig(ttft=5.0)] * 5)
+        store, ctrl = self._make(fleet)
+        ctrl.reconcile(store, "default", "svc1")
+        assert self._prefill_replicas(store) == 2
+        assert self._replicas(store) == 1  # decode tier is calm
+        # same-pass render: THIS reconcile's prefill Deployment already
+        # carries the resized count
+        dep = store.get("Deployment", "svc1-prefill")
+        assert dep["spec"]["replicas"] == 2
+
+    def test_prefill_scales_up_on_cold_arrival_rate(self):
+        """The arrival-rate term: a cold-prefix burst grows the tier
+        before TTFT degrades (ttft itself still healthy here)."""
+        fleet = _TieredFleet([_calm()] * 5, [_dsig(ttft=0.5, cold=9.0)] * 5)
+        store, ctrl = self._make(fleet)
+        ctrl.reconcile(store, "default", "svc1")
+        assert self._prefill_replicas(store) == 2
+
+    def test_prefill_scales_down_on_headroom(self):
+        fleet = _TieredFleet([_calm()] * 5, [_dsig(ttft=0.1, cold=0.0)] * 5)
+        store, ctrl = self._make(
+            fleet, serving={"disagg": {"prefill_replicas": 2}},
+        )
+        ctrl.reconcile(store, "default", "svc1")
+        assert self._prefill_replicas(store) == 1
+
+    def test_prefill_holds_between_pressure_and_headroom(self):
+        """ttft over half the threshold but under it: neither pressure
+        nor headroom — the tier must hold, not flap."""
+        fleet = _TieredFleet([_calm()] * 5, [_dsig(ttft=1.5, cold=0.0)] * 5)
+        store, ctrl = self._make(
+            fleet, serving={"disagg": {"prefill_replicas": 2}},
+        )
+        for _ in range(3):
+            ctrl.reconcile(store, "default", "svc1")
+        assert self._prefill_replicas(store) == 2
+
+    def test_decode_reads_decode_tier_occupancy(self):
+        """Idle prefill slots must not mask decode pressure: the fleet
+        mean looks calm, the decode tier is saturated — decode scales."""
+        fleet = _TieredFleet(
+            [_calm()] * 5, [_dsig(queue=30.0, occ=1.0)] * 5,
+        )
+        store, ctrl = self._make(fleet)
+        ctrl.reconcile(store, "default", "svc1")
+        assert self._replicas(store) == 2
+
+    def test_prefill_noop_without_disagg_signals(self):
+        """Against a collector without disagg_signals (plain
+        serving_signals fakes) the prefill count stays put."""
+
+        class _Plain:
+            def serving_signals(self, namespace, name):
+                return _calm()
+
+        store, ctrl = self._make(_Plain())
+        ctrl.reconcile(store, "default", "svc1")
+        assert self._prefill_replicas(store) == 1
+
+    def test_stale_scale_state_swept_without_delete_reconcile(self):
+        """Regression (this PR's small fix): _scale_state entries were
+        only popped on the reconcile-of-a-deleted-CR path — a CR that
+        vanished without one (bulk store wipe) left stale cooldown state
+        behind. Any reconcile now sweeps against the live CR set."""
+        from kubeflow_tpu.controllers.inference import new_inference_service
+
+        fleet = _TieredFleet([_calm()] * 9, [_dsig(ttft=5.0)] * 9)
+        store, ctrl = self._make(fleet)
+        ctrl.reconcile(store, "default", "svc1")
+        assert any(k[1] == "svc1" for k in ctrl._scale_state)
+        # svc1 vanishes with NO reconcile of its own; svc2's next
+        # reconcile must still sweep svc1's entries
+        store.delete("InferenceService", "svc1")
+        store.create(new_inference_service("svc2", model="gpt_tiny"))
+        ctrl.reconcile(store, "default", "svc2")
+        assert not any(k[1] == "svc1" for k in ctrl._scale_state)
+
+
+class TestDisaggRender:
+    def test_two_deployments_one_vip_and_router_contract(self):
+        """One disaggregated CR renders the decode Deployment (tier
+        label), the `<name>-prefill` Deployment, a VIP that selects ONLY
+        decode pods, and a router wired with the disagg env contract."""
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.inference import (
+            InferenceServiceController,
+            new_inference_service,
+        )
+
+        store = StateStore()
+        ctrl = InferenceServiceController()
+        cr = new_inference_service(
+            "svc1", model="gpt_tiny", replicas=2,
+            serving={
+                "router": {"enabled": True},
+                "disagg": {"enabled": True, "prefill_replicas": 2},
+            },
+        )
+        store.create(cr)
+        ctrl.reconcile(store, "default", "svc1")
+
+        dec = store.get("Deployment", "svc1")
+        labels = dec["spec"]["template"]["metadata"]["labels"]
+        assert labels["inferenceservice-tier"] == "decode"
+        pre = store.get("Deployment", "svc1-prefill")
+        assert pre["spec"]["replicas"] == 2
+        plabels = pre["spec"]["template"]["metadata"]["labels"]
+        assert plabels["inferenceservice-tier"] == "prefill"
+        assert plabels["inferenceservice"] == "svc1"
+        svc = store.get("Service", "svc1")
+        assert svc["spec"]["selector"]["inferenceservice-tier"] == "decode"
+
+        router = store.get("Deployment", "svc1-router")
+        env = {
+            e["name"]: e["value"]
+            for e in router["spec"]["template"]["spec"]["containers"][0][
+                "env"
+            ]
+        }
+        assert env["KFT_ROUTER_DISAGG"] == "1"
+        assert "KFT_ROUTER_DISAGG_COLD_HIT_RATE" in env
+        assert "KFT_SERVING_DISAGG_HANDOFF_CHAINS" in env
+        registry = env["KFT_ROUTER_REPLICAS"]
+        assert "svc1-0=http://svc1-0:8500#decode" in registry
+        assert "svc1-prefill-1=http://svc1-prefill-1:8500#prefill" in (
+            registry
+        )
+
+    def test_disabling_disagg_tears_down_prefill(self):
+        from kubeflow_tpu.cluster.store import StateStore
+        from kubeflow_tpu.controllers.inference import (
+            InferenceServiceController,
+            new_inference_service,
+        )
+
+        store = StateStore()
+        ctrl = InferenceServiceController()
+        cr = new_inference_service(
+            "svc1", model="gpt_tiny",
+            serving={
+                "router": {"enabled": True},
+                "disagg": {"enabled": True},
+            },
+        )
+        store.create(cr)
+        ctrl.reconcile(store, "default", "svc1")
+        assert store.get("Deployment", "svc1-prefill")
+        cr = store.get("InferenceService", "svc1")
+        cr["spec"]["serving"]["disagg"]["enabled"] = False
+        store.update(cr)
+        ctrl.reconcile(store, "default", "svc1")
+        with pytest.raises(KeyError):
+            store.get("Deployment", "svc1-prefill")
+        svc = store.get("Service", "svc1")
+        assert "inferenceservice-tier" not in svc["spec"]["selector"]
+
+
+# -- the drain-window handoff ----------------------------------------------
+
+
+class TestDrainHandoff:
+    def test_chains_land_at_new_rendezvous_homes(self, gpt_and_params):
+        """A condemned replica's /v1/kv/handoff ships each committed
+        chain to its first-page key's rendezvous home among the
+        surviving peers — the same HRW ranking the router shards on —
+        and the landed pages are bitwise the drainer's."""
+        model, params = gpt_and_params
+        drain = _engine(model, params, "hd0")
+        survivors = {
+            "s1": _engine(model, params, "hd1"),
+            "s2": _engine(model, params, "hd2"),
+        }
+        servers = {rid: ModelServer() for rid in survivors}
+        for rid, eng in survivors.items():
+            servers[rid].add_engine(eng)
+
+        def transport(url, data):
+            rid = url[len("http://"):].split("/")[0]
+            status, resp, _ = servers[rid].app.handle_full(
+                "POST", "/v1/kv/pages", body=data, headers=dict(OCTET)
+            )
+            return status, _as_bytes(resp)
+
+        msd = ModelServer(page_transport=transport)
+        msd.add_engine(drain)
+        try:
+            # one committed chain per survivor: scan seeds until the two
+            # first-page keys home on DIFFERENT peers
+            rows = {}
+            seed = 0
+            while len(rows) < 2:
+                row = np.random.default_rng(seed).integers(
+                    0, 512, (2 * PS,)
+                ).astype(np.int32)
+                key = first_page_key(row.tolist(), PS)
+                home = rendezvous_rank(key, list(survivors))[0]
+                rows.setdefault(home, row)
+                seed += 1
+            for row in rows.values():
+                drain.submit(row, 2).wait(120)
+            exported = {
+                rid: drain.export_prefix_entries(row)
+                for rid, row in rows.items()
+            }
+            assert all(len(e) == 2 for e in exported.values())
+
+            status, doc, _ = msd.app.handle_full(
+                "POST", "/v1/kv/handoff",
+                body={
+                    "peers": {
+                        rid: f"http://{rid}" for rid in survivors
+                    },
+                    "chains": 8,
+                },
+            )
+            assert status == 200
+            for rid in survivors:
+                assert doc["peers"][rid]["admitted"] == 2
+
+            for rid, row in rows.items():
+                other = next(o for o in survivors if o != rid)
+                landed = survivors[rid].export_prefix_entries(row)
+                assert len(landed) == 2
+                for (_, ta, _, _), (_, tb, _, _) in zip(
+                    exported[rid], landed
+                ):
+                    _assert_trees_bitwise(ta, tb)
+                # the OTHER survivor is not this key's home: nothing
+                # landed there
+                assert survivors[other].export_prefix_entries(row) == []
+        finally:
+            msd.close()
+            for srv in servers.values():
+                srv.close()
+            drain.close()
+            for eng in survivors.values():
+                eng.close()

@@ -44,7 +44,14 @@ class TestGreedyGenerate:
         got = greedy_generate(model, params, prompt, 8)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    @pytest.mark.slow
     def test_batched_prompts(self, gpt_and_params):
+        """@slow (r19 tier-1 tranche: compiles the naive reference AND
+        the fused path at a second batch shape): runs unfiltered in the
+        unit-tests CI training step; tier-1 keeps the oracle claim
+        through test_matches_full_recompute and batched decode through
+        TestPaddedPrompts::test_ragged_batch_matches_per_row_unpadded
+        (the stronger, ragged variant of this uniform batch)."""
         model, params = gpt_and_params
         prompts = jnp.stack(
             [jnp.arange(5) % 512, (jnp.arange(5) * 11 + 2) % 512]

@@ -120,11 +120,19 @@ class TestMultiProcessGang:
         # SPMD: every process computed the same replicated loss
         assert losses[0] == pytest.approx(losses[1], rel=1e-6)
 
+    @pytest.mark.slow
     @pytest.mark.skipif(not have_toolchain(), reason="no C++ toolchain")
     def test_gang_under_slice_agent_barrier(self, tmp_path):
         """The compiled sidecar's barrier spans real processes via a shared
         dir; payloads only start once the whole gang arrived, and each
-        member's terminal phase is recorded."""
+        member's terminal phase is recorded.
+
+        @slow (r19 tier-1 tranche: a second full 2-process gang run —
+        the agent wrapper is the only delta): runs unfiltered in the
+        e2e CI workflow's platform-e2e step; tier-1 keeps the bare gang
+        through test_two_process_gang_trains_and_agrees and the
+        sidecar's barrier semantics through test_slice_agent.py's
+        TcpBarrier suite."""
         agent = slice_agent_path()
         outs = run_gang(2, agent=agent, shared=tmp_path)
         for rc, out, err in outs:
