@@ -1690,6 +1690,228 @@ def bench_serving_continuous(
     }
 
 
+def bench_serving_moe(
+    num_requests: int = 10,
+    mean_interarrival_ms: float = 25.0,
+    num_slots: int = DEFAULT_NUM_SLOTS,
+    new_tokens: int = 16,
+) -> dict:
+    """Expert-parallel MoE serving (r20): sparse gpt_small_moe vs dense
+    gpt_small at MATCHED per-token FLOPs on the same Poisson arrival
+    trace, plus the expert-mesh engine (mesh_expert=2, the
+    bench:gpt_moe_ep plan geometry) against its ep=1 twin.
+
+    The FLOPs matching is by construction, not normalization: top-1
+    routing activates exactly ONE expert per token, and every expert is
+    the dense model's mlp_dim-3072 MLP — so the sparse forward's
+    per-token MLP compute equals the dense forward's, and the throughput
+    ratio isolates what the router + dispatch machinery costs (the 8x
+    parameter capacity is what the ratio buys). On this CPU mesh the
+    ratio is the honest overhead floor — the per-chip capacity win
+    (expert stacks at 1/ep bytes, priced by the mem-budget lint) and the
+    bitwise ep parity are the architectural evidence; TPU numbers are
+    where sparse capacity pays (docs/PERF.md r20 caveats).
+
+    Reports `moe_tokens_per_sec_per_chip` (the ep=2 engine over its 2
+    chips), `moe_dense_flops_matched_ratio` (sparse/dense, both 1x1),
+    expert load balance (max/mean occupancy from the engine's moe stats
+    — the router-health gauge /statusz and fleet aggregation carry), and
+    the ep=2-vs-ep=1 greedy parity bit."""
+    import json as _json
+    import threading
+    import time
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.api.wsgi import Server
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.engine import DecodeEngine
+    from kubeflow_tpu.serving.server import ModelServer
+
+    num_requests = _budget_scaled(num_requests, sized_for_s=420, floor=4)
+    import jax.numpy as jnp
+
+    max_len = BENCH_MAX_LEN
+    vocab = BENCH_SPEC_VOCAB
+    kwargs = dict(
+        dtype=jnp.bfloat16, scan_layers=True, max_len=max_len,
+        vocab_size=vocab,
+    )
+    moe_model = get_model("gpt_small_moe", **kwargs)
+    dense_model = get_model("gpt_small", **kwargs)
+
+    def init_params(model):
+        return jax.jit(
+            lambda rng: model.init(
+                rng, jnp.zeros((1, 8), jnp.int32), deterministic=True
+            )
+        )(jax.random.PRNGKey(0))["params"]
+
+    moe_params = init_params(moe_model)
+    dense_params = init_params(dense_model)
+
+    buckets = list(BENCH_PREFILL_BUCKETS)
+    prompt_lens = list(BENCH_PROMPT_LENS)
+    moe_1x = DecodeEngine(
+        "gpt_moe", moe_model, moe_params, num_slots=num_slots,
+        prefill_buckets=buckets, max_queue=max(64, num_requests),
+    )
+    dense_eng = DecodeEngine(
+        "gpt_dense", dense_model, dense_params, num_slots=num_slots,
+        prefill_buckets=buckets, max_queue=max(64, num_requests),
+    )
+    model_server = ModelServer()
+    model_server.add_engine(moe_1x)
+    model_server.add_engine(dense_eng)
+    # the expert-mesh engine needs the entry's 2 virtual CPU devices
+    # (skipped gracefully on a 1-device process, like the r14 phase)
+    moe_ep = None
+    if len(jax.devices()) >= 2:
+        moe_ep = DecodeEngine(
+            "gpt_moe_ep", moe_model, moe_params, num_slots=num_slots,
+            prefill_buckets=buckets, max_queue=max(64, num_requests),
+            mesh_expert=2,
+        )
+        model_server.add_engine(moe_ep)
+    server = Server(model_server.app, port=0)
+    server.start()
+
+    rng = np.random.default_rng(0)
+    offsets = np.cumsum(
+        rng.exponential(mean_interarrival_ms / 1e3, num_requests)
+    )
+    prng = np.random.default_rng(1)
+    payloads = [
+        _json.dumps({
+            "prompt_ids": prng.integers(
+                0, vocab, (1, prompt_lens[i % len(prompt_lens)])
+            ).tolist(),
+            "max_new_tokens": new_tokens,
+        }).encode()
+        for i in range(num_requests)
+    ]
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url, data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return _json.loads(resp.read())
+
+    def run_phase(name: str) -> dict:
+        url = f"http://127.0.0.1:{server.port}/v1/models/{name}:generate"
+        for p in prompt_lens:  # warm every bucket + step before timing
+            post(url, _json.dumps({
+                "prompt_ids": rng.integers(0, vocab, (1, p)).tolist(),
+                "max_new_tokens": new_tokens,
+            }).encode())
+        lat = [None] * num_requests
+        done_at = [None] * num_requests
+        errors = []
+        lock = threading.Lock()
+        t0 = time.monotonic() + 0.05
+
+        def fire(i):
+            time.sleep(max(0.0, t0 + offsets[i] - time.monotonic()))
+            t_send = time.monotonic()
+            try:
+                body = post(url, payloads[i])
+                assert len(body["sequences"][0]) >= new_tokens
+            except Exception as e:  # noqa: BLE001 - recorded, not lost
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+            t_done = time.monotonic()
+            with lock:
+                lat[i] = t_done - t_send
+                done_at[i] = t_done
+        threads = [
+            threading.Thread(target=fire, args=(i,))
+            for i in range(num_requests)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ok = [x for x in lat if x is not None]
+        if not ok:
+            raise RuntimeError(
+                f"all {num_requests} requests failed; first: "
+                f"{errors[0] if errors else 'unknown'}"
+            )
+        wall = max(x for x in done_at if x is not None) - t0
+        return {
+            "failed_requests": len(errors),
+            "tokens_per_sec": round(len(ok) * new_tokens / wall, 1),
+        }
+
+    try:
+        moe_phase = run_phase("gpt_moe")
+        dense_phase = run_phase("gpt_dense")
+        moe_stats = moe_1x.stats()["moe"]
+        parity = None
+        ep_phase = {"skipped": "needs >= 2 jax devices"}
+        chips = 1
+        ep_tps = moe_phase["tokens_per_sec"]
+        if moe_ep is not None:
+            # greedy parity gate first: the ep=2 engine must be BITWISE
+            # the ep=1 engine on fresh prompts (top-1 exact-zero combine
+            # identity; tests/test_moe_serving.py is the exhaustive gate)
+            parity_rows = [
+                np.random.default_rng(7).integers(
+                    0, vocab, (p,)
+                ).astype(np.int32)
+                for p in prompt_lens
+            ]
+            parity = all(
+                moe_1x.generate_row(r, 8, timeout=600)["tokens"]
+                == moe_ep.generate_row(r, 8, timeout=600)["tokens"]
+                for r in parity_rows
+            )
+            ep_phase = run_phase("gpt_moe_ep")
+            chips = 2
+            ep_tps = ep_phase["tokens_per_sec"]
+    finally:
+        server.stop()
+        model_server.close()
+    occupancy = moe_stats["expert_tokens"]
+    mean_occ = (
+        sum(occupancy) / len(occupancy) if occupancy else 0.0
+    )
+    return {
+        "model": "gpt_small_moe",
+        "num_experts": int(moe_model.cfg.num_experts),
+        "num_requests": num_requests,
+        "new_tokens": new_tokens,
+        "vocab": vocab,
+        "moe": moe_phase,
+        "dense": dense_phase,
+        "expert_parallel": ep_phase,
+        "mesh_expert": chips,
+        # the headline: sparse throughput normalized to the expert
+        # mesh's chip count (1 when the ep phase is skipped)
+        "moe_tokens_per_sec_per_chip": round(ep_tps / chips, 1),
+        # sparse/dense at matched per-token FLOPs, both unmeshed: the
+        # router+dispatch overhead floor on this backend
+        "moe_dense_flops_matched_ratio": round(
+            moe_phase["tokens_per_sec"]
+            / dense_phase["tokens_per_sec"], 3
+        ) if dense_phase["tokens_per_sec"] else 0.0,
+        # router health over the measured trace: max/mean expert
+        # occupancy (1.0 = perfectly balanced) — the same statistic the
+        # serving_moe_load_imbalance gauge exports
+        "moe_load_imbalance": round(
+            max(occupancy) / mean_occ, 3
+        ) if mean_occ else 0.0,
+        "moe_expert_tokens": [round(v, 1) for v in occupancy],
+        "moe_dropped": moe_stats["dropped"],
+        "moe_parity_bitwise": parity,
+    }
+
+
 def bench_serving_router(
     num_requests: int = 20,
     num_replicas: int = 3,
@@ -3272,6 +3494,21 @@ def _entry_specs(batch: int, steps: int):
             },
             False,
         ),
+        # sparse MoE vs dense at matched per-token FLOPs + the ep=2
+        # expert-mesh engine vs its ep=1 twin (bitwise parity gated);
+        # 2 virtual devices for the expert axis, like the r14 phase
+        (
+            "serving_moe",
+            "bench_serving_moe()",
+            480,
+            {
+                "XLA_FLAGS": (
+                    os.environ.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count=2"
+                ).strip()
+            },
+            False,
+        ),
         # the 80%-shared-prefix trace through a routed 3-replica fleet:
         # prefix-affinity vs random spray, fleet-wide hit rate + TTFT,
         # greedy parity through the router (docs/SERVING.md fleet routing)
@@ -3293,6 +3530,8 @@ _HEADLINE_KEYS = (
     "tokens_per_sec_per_chip",
     "generate_tokens_per_sec",
     "engine_tokens_per_sec",
+    # expert-parallel MoE serving (bench_serving_moe, r20)
+    "moe_tokens_per_sec_per_chip",
     "rest_generate_tokens_per_sec",
     "steps_per_sec_ratio_async_vs_sync",
     "speedup_vs_sync",
@@ -3334,6 +3573,11 @@ _EXTRA_FINAL_KEYS = (
     # tiered KV (serving_continuous restart-warm phase): preloaded vs
     # cold TTFT p50 — < 1.0 means the store makes restarts warm
     "restart_warm_ttft_ratio",
+    # expert-parallel MoE phase (serving_moe, r20): sparse/dense at
+    # matched per-token FLOPs, router balance, ep=2-vs-ep=1 parity
+    "moe_dense_flops_matched_ratio",
+    "moe_load_imbalance",
+    "moe_parity_bitwise",
     # kft-router fleet phase (serving_router): affinity vs spray
     "router_affinity_hit_rate",
     "router_ttft_p50_speedup",

@@ -111,6 +111,7 @@ def max_gather_unit_bytes(
     shapes,
     stacked_keys=("layers",),
     dequant_dtype=None,
+    skip_path=None,
 ) -> int:
     """Dispatch high-water of per-layer weight gathering (r16): the
     LARGEST single gather unit of a params tree. Under the sharded
@@ -125,7 +126,13 @@ def max_gather_unit_bytes(
     `shapes` may be the plain params tree or the int8 envelope
     ({"qvalues", "qscales"}); with `dequant_dtype` set, a quantized
     leaf's unit adds its post-gather dequantized compute-dtype copy on
-    top of the gathered int8 bytes (both live at dispatch)."""
+    top of the gathered int8 bytes (both live at dispatch).
+
+    `skip_path` (path -> bool) excludes leaves that never gather: on an
+    expert-parallel plan the MoE wi/wo stacks stay sharded at compute
+    (resident layout == compute layout), so they contribute nothing to
+    the dispatch high-water — their per-chip 1/ep bytes are already
+    priced in the params-at-rest term."""
     import jax
     import numpy as np
 
@@ -138,6 +145,8 @@ def max_gather_unit_bytes(
         return tree_bytes(tree)
     units: Dict[str, int] = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if skip_path is not None and skip_path(path):
+            continue
         top = getattr(path[0], "key", str(path[0]))
         nbytes = _leaf_nbytes(leaf)
         if top in stacked_keys and leaf.shape:

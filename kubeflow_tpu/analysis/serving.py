@@ -538,6 +538,7 @@ def analyze_serving_plan(
         page_size=page_size, num_pages=num_pages,
         paged_attention=spec.paged_attention, quantize=spec.quantize,
         mesh_tensor=spec.mesh_tensor, mesh_fsdp=spec.mesh_fsdp,
+        mesh_expert=spec.mesh_expert,
     )
     # the mesh axes a sharded plan's programs actually run over — what
     # turns the pre-wired spmd passes live: shard-capable axis sizes for
@@ -548,6 +549,7 @@ def analyze_serving_plan(
     # num_slices > 1 must fail the sweep, not lint around it.
     mesh_axis_sizes = {
         "tensor": int(spec.mesh_tensor), "fsdp": int(spec.mesh_fsdp),
+        "expert": int(spec.mesh_expert),
     }
     # a serving replica's mesh has NO DCN-capable layout: its only axes
     # are tensor/fsdp (data=1), both of which collect on every decode
@@ -590,6 +592,7 @@ def analyze_serving_plan(
     stats["quantize"] = spec.quantize
     stats["mesh"] = {
         "tensor": spec.mesh_tensor, "fsdp": spec.mesh_fsdp,
+        "expert": spec.mesh_expert,
     }
 
     step_temp_bytes: Optional[int] = None
@@ -679,11 +682,23 @@ def analyze_serving_plan(
         # high-water is params-at-rest (sharded, above) PLUS one
         # replicated gather unit — the largest single layer (its
         # dequantized copy included on int8 plans) — NOT the whole
-        # gathered tree the pre-r16 `gather_replicated` body held live
+        # gathered tree the pre-r16 `gather_replicated` body held live.
+        # Expert-parallel plans (r20) exclude the MoE wi/wo stacks from
+        # the unit: those kernels compute IN their sharded layout (the
+        # shard_map all-to-all, never gathered), so their only cost is
+        # the 1/ep per-chip bytes the params-at-rest term already holds.
+        from kubeflow_tpu.parallel.serving_mesh import (
+            is_moe_expert_kernel_path,
+        )
+
         components["gathered layer (dispatch)"] = max_gather_unit_bytes(
             params,
             dequant_dtype=(
                 model.cfg.dtype if spec.quantize == "int8" else None
+            ),
+            skip_path=(
+                is_moe_expert_kernel_path
+                if spec.mesh_expert > 1 else None
             ),
         )
     if draft is not None:
@@ -803,7 +818,11 @@ def analyze_serving_plan_subprocess(
     payload = json.dumps({"spec": spec.to_dict()})
     # sharded plans lower on a real (virtual CPU) mesh: the child gets
     # exactly the plan's device count so build_serving_mesh can place it
-    devices = max(1, int(spec.mesh_tensor) * int(spec.mesh_fsdp))
+    devices = max(
+        1,
+        int(spec.mesh_tensor) * int(spec.mesh_fsdp)
+        * int(spec.mesh_expert),
+    )
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "kubeflow_tpu.analysis.serving"],

@@ -117,6 +117,14 @@ class ServingPlanSpec:
     #                                    a silently-dead knob)
     mesh_tensor: int = 1               # serving mesh: heads-sharded pools
     mesh_fsdp: int = 1                 # serving mesh: fsdp-sharded weights
+    mesh_expert: int = 1               # serving mesh: expert-sharded MoE
+    #                                    kernel stacks ([E, ...] wi/wo,
+    #                                    resident == compute layout, never
+    #                                    gathered — mem-budget prices them
+    #                                    at 1/expert); requires a MoE
+    #                                    model, expert | num_experts, and
+    #                                    top-1 routing (validate_serving_
+    #                                    mesh rejects the rest)
     num_slices: int = 1                # slices a replica spans: ALWAYS 1
     #                                    (tensor/fsdp collectives run every
     #                                    step and must ride ICI); >1 makes
@@ -233,6 +241,22 @@ def bench_serving_plans() -> List[ServingPlanSpec]:
             model_kwargs=dict(spec_target),
             prefill_buckets=BENCH_PREFILL_BUCKETS,
             mesh_tensor=2,
+        ),
+        ServingPlanSpec(
+            # the r20 expert-parallel MoE engine (bench's MoE phase):
+            # gpt_small_moe on an expert=2 mesh — the 8 expert stacks'
+            # wi/wo kernels live sharded on dim 0 AND compute sharded
+            # (shard_map all-to-all dispatch inside every pool program;
+            # per-layer gathering skips them), so mem-budget's params
+            # term prices per-chip expert bytes at 1/2 and the gather
+            # unit excludes the expert stacks entirely. Top-1 routing
+            # is load-bearing: it is what makes the ep>1 combine
+            # bitwise the ep=1 einsum (≤1 nonzero term per output).
+            name="bench:gpt_moe_ep",
+            model="gpt_small_moe",
+            model_kwargs=dict(spec_target),
+            prefill_buckets=BENCH_PREFILL_BUCKETS,
+            mesh_expert=2,
         ),
         ServingPlanSpec(
             name="bench:gpt_spec_k0",

@@ -760,15 +760,18 @@ class DisaggConfig(ConfigNode):
 @dataclasses.dataclass
 class ServingMeshConfig(ConfigNode):
     """The decode engine's serving mesh (parallel/serving_mesh.py;
-    docs/SERVING.md "Sharded serving"): `tensor × fsdp` chips per
-    replica. 1×1 (the default) is the unmeshed single-chip engine —
-    the bitwise baseline. `tensor` shards the KV pools on the heads
+    docs/SERVING.md "Sharded serving"): `tensor × fsdp × expert` chips
+    per replica. 1×1×1 (the default) is the unmeshed single-chip engine
+    — the bitwise baseline. `tensor` shards the KV pools on the heads
     axis (per-chip pool bytes divide by it — the decode-bandwidth and
     pool-capacity axis); `fsdp` shards the resident weights on the
     embed dim, all-gathered at use (the weight-capacity axis — a model
-    too big for one chip serves sharded). Model-shape divisibility
-    (heads/mlp by tensor, hidden by fsdp) is validated where the model
-    is known: engine construction and the serving lint."""
+    too big for one chip serves sharded); `expert` shards a MoE model's
+    expert stacks, never gathered (per-chip expert weight bytes divide
+    by it — the sparse-model capacity axis). Model-shape divisibility
+    (heads/mlp by tensor, hidden by fsdp, num_experts by expert, top-1
+    routing for expert>1) is validated where the model is known: engine
+    construction and the serving lint."""
 
     tensor: int = config_field(
         default=1,
@@ -782,9 +785,16 @@ class ServingMeshConfig(ConfigNode):
         "(all-gathered inside each program — FSDP serving); must "
         "divide the model's hidden_size",
     )
+    expert: int = config_field(
+        default=1,
+        help="chips sharding a MoE model's expert stacks ([E, ...] "
+        "wi/wo kernels, never gathered — per-chip expert bytes drop "
+        "by 1/expert); must divide num_experts, requires top-1 "
+        "routing, and rejects dense served models",
+    )
 
     def validate(self) -> None:
-        for axis in ("tensor", "fsdp"):
+        for axis in ("tensor", "fsdp", "expert"):
             v = getattr(self, axis)
             if not isinstance(v, int) or v < 1:
                 raise ConfigError(

@@ -129,10 +129,12 @@ class InferenceServiceController(Controller):
             "KFT_SERVING_PAGED_ATTENTION": cfg.paged_attention,
             "KFT_SERVING_QUANTIZE": cfg.quantize,
             # serving mesh (r14 sharded serving: tensor shards the KV
-            # pools on heads, fsdp shards the resident weights; 1/1 =
-            # the unmeshed bitwise baseline)
+            # pools on heads, fsdp shards the resident weights; r20
+            # expert shards a MoE model's expert stacks; 1/1/1 = the
+            # unmeshed bitwise baseline)
             "KFT_SERVING_MESH_TENSOR": str(cfg.mesh.tensor),
             "KFT_SERVING_MESH_FSDP": str(cfg.mesh.fsdp),
+            "KFT_SERVING_MESH_EXPERT": str(cfg.mesh.expert),
             "KFT_SERVING_DRAFT_MODEL": cfg.draft_model,
             "KFT_SERVING_DRAFT_TOKENS": str(cfg.num_draft_tokens),
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": cfg.draft_checkpoint_dir,

@@ -121,6 +121,19 @@ def shrink_mesh(
                 break
         else:
             return None
+    # Postcondition, asserted rather than implied by the loop above: the
+    # model-partitioning axes come out exactly as they went in. The
+    # expert axis carries the sharpest version of the contract (r20): a
+    # MoE gang's [E, ...] expert stacks are sharded along it in training
+    # AND serving, and a degraded reshape that halved it would change
+    # how experts map to chips mid-job — a repartition the resharding
+    # restore cannot make bitwise. tests/test_tpujob.py pins this with
+    # a degraded v5e-8 MoE gang resuming on the intact expert axis.
+    assert all(
+        out.get(a, 1) == size
+        for a, size in axes.items()
+        if a not in _SHRINK_AXES
+    ), f"degraded reshape touched a non-data axis: {axes} -> {out}"
     return out
 
 

@@ -152,17 +152,44 @@ def _param_gather_transform(mesh, dtype):
     `dequantize_params`' exact arithmetic, so the dequantized layer is
     bitwise the full-tree dequant's slice. Under nn.scan the transform
     runs INSIDE the scan body on the already-sliced layer subtree, which
-    is what caps the dispatch high-water at one layer's weights."""
+    is what caps the dispatch high-water at one layer's weights.
+
+    On an expert-carrying mesh the MoE expert kernels (…/moe/wi|wo) are
+    the one exception: they NEVER gather. Their resident layout is their
+    compute layout (parallel/serving_mesh.py expert_kernel_spec — dim 0
+    split E/ep), and the expert shard_map in models/layers.py consumes
+    them in place, so the transform pins them to the expert spec instead
+    of replicated. int8 expert qvalues keep the expert sharding through
+    the dequant (the [out]-channel qscale vector is replicated; the
+    elementwise multiply broadcasts, so the dequantized kernel stays
+    expert-sharded and bitwise the full-tree dequant's shard)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
+    from kubeflow_tpu.parallel.serving_mesh import (
+        expert_kernel_spec,
+        mesh_expert_size,
+    )
+
     rep = NamedSharding(mesh, PartitionSpec())
+    ep = mesh_expert_size(mesh)
+
+    def _leaf_sharding(path, ndim):
+        if (
+            ep > 1
+            and len(path) >= 2
+            and path[-2] == "moe"
+            and path[-1] in ("wi", "wo")
+        ):
+            return NamedSharding(mesh, expert_kernel_spec(ndim))
+        return rep
 
     def trans_in(cols):
-        def walk(node):
+        def walk(node, path=()):
             if isinstance(node, dict):
                 if set(node.keys()) == {"qvalue", "qscale"}:
                     q = jax.lax.with_sharding_constraint(
-                        node["qvalue"], rep
+                        node["qvalue"],
+                        _leaf_sharding(path, node["qvalue"].ndim),
                     )
                     s = jax.lax.with_sharding_constraint(
                         node["qscale"], rep
@@ -170,8 +197,12 @@ def _param_gather_transform(mesh, dtype):
                     return (
                         q.astype(jnp.float32) * s.astype(jnp.float32)
                     ).astype(dtype)
-                return {k: walk(v) for k, v in node.items()}
-            return jax.lax.with_sharding_constraint(node, rep)
+                return {
+                    k: walk(v, path + (k,)) for k, v in node.items()
+                }
+            return jax.lax.with_sharding_constraint(
+                node, _leaf_sharding(path, node.ndim)
+            )
 
         return walk(cols)
 
@@ -589,6 +620,9 @@ class DecoderBlock(nn.Module):
                 aux_weight=cfg.moe_aux_weight,
                 dtype=cfg.dtype,
                 dropout_rate=cfg.dropout_rate,
+                # the serving mesh (when set) carries the expert axis the
+                # MoeMlp shard_map dispatches over; None everywhere else
+                expert_mesh=cfg.param_gather_mesh,
                 name="moe",
             )(h.astype(cfg.dtype), deterministic)
         else:
@@ -1034,7 +1068,15 @@ class Gpt(nn.Module):
         elif cfg.scan_layers:
             scan = nn.scan(
                 ScanDecoderBlock,
-                variable_axes={"params": 0, "cache": 0, "losses": 0},
+                variable_axes={
+                    "params": 0,
+                    "cache": 0,
+                    "losses": 0,
+                    # MoE serving stats (models/layers.py MoeMlp): stacked
+                    # per layer like losses; a no-op unless the caller
+                    # makes the collection mutable (the MoE engine does)
+                    "moe_stats": 0,
+                },
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast,) * 5,
                 length=cfg.num_layers,

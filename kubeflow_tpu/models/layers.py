@@ -40,6 +40,19 @@ class MoeMlp(nn.Module):
 
     top_k=1 is Switch routing, 2 is GShard top-2; tokens dropped by expert
     capacity pass through on the residual unchanged either way.
+
+    `expert_mesh` (a jax.sharding.Mesh carrying an `expert` axis — the
+    serving engine's tensor×fsdp×expert mesh, parallel/serving_mesh.py)
+    switches the expert compute to an EXPLICIT shard_map: routing runs
+    replicated, each shard slices its contiguous E/ep block out of the
+    replicated dispatch/combine tensors (the engine serves data=1, so
+    the general all_to_all degenerates to a local slice), computes only
+    its local experts against its resident kernel shard, and a psum over
+    the expert axis combines the partial outputs. Greedy output is
+    BITWISE the ep=1 path's for top-1 routing: every combine contraction
+    output element has at most ONE nonzero term (one-hot dispatch), and
+    exact-zero identities survive any reduction order — which is also
+    why serving_mesh.validate_serving_mesh rejects ep>1 with top_k>1.
     """
 
     mlp_dim: int
@@ -49,10 +62,15 @@ class MoeMlp(nn.Module):
     aux_weight: float = 0.01
     dtype: Any = jnp.bfloat16
     dropout_rate: float = 0.0
+    # jax.sharding.Mesh with an `expert` axis of size >1 activates the
+    # expert-parallel shard_map; None (every training path and the ep=1
+    # engine) is byte-for-byte the pre-r20 module
+    expert_mesh: Any = None
 
     @nn.compact
     def __call__(self, x, deterministic: bool):
         from kubeflow_tpu.parallel.moe import expert_capacity, topk_route
+        from kubeflow_tpu.parallel.serving_mesh import mesh_expert_size
 
         b, s, d = x.shape
         e = self.num_experts
@@ -75,16 +93,45 @@ class MoeMlp(nn.Module):
         wo = self.param("wo", init, (e, self.mlp_dim, d), jnp.float32)
 
         dispatch = route.dispatch.astype(self.dtype)
-        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
-        expert_in = shard_constraint(
-            expert_in, ("act_expert", "batch", None, None)
+        combine = route.combine.astype(self.dtype)
+        ep = mesh_expert_size(self.expert_mesh)
+        if ep > 1:
+            y = self._expert_parallel(x, dispatch, combine, wi, wo, ep)
+        else:
+            expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+            expert_in = shard_constraint(
+                expert_in, ("act_expert", "batch", None, None)
+            )
+            h = jnp.einsum(
+                "ebcd,edf->ebcf", expert_in, wi.astype(self.dtype)
+            )
+            h = nn.gelu(h, approximate=True)
+            out_e = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(self.dtype))
+            out_e = shard_constraint(
+                out_e, ("act_expert", "batch", None, None)
+            )
+            y = jnp.einsum("bsec,ebcd->bsd", combine, out_e)
+
+        # serving observability (the MoE engine makes "moe_stats" mutable;
+        # everywhere else these sows are no-ops and the stats compute is
+        # dead code): per-expert routed-slot occupancy and the
+        # capacity-dropped count. Counts are over POSITIONS fed to the
+        # router — idle decode slots and prefill pad tails route too — so
+        # this is the load-balance signal, not token billing.
+        f_disp = route.dispatch.astype(jnp.float32)
+        self.sow(
+            "moe_stats",
+            "expert_tokens",
+            f_disp.sum(axis=(0, 1, 3)),
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((e,), jnp.float32),
         )
-        h = jnp.einsum("ebcd,edf->ebcf", expert_in, wi.astype(self.dtype))
-        h = nn.gelu(h, approximate=True)
-        out_e = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(self.dtype))
-        out_e = shard_constraint(out_e, ("act_expert", "batch", None, None))
-        y = jnp.einsum(
-            "bsec,ebcd->bsd", route.combine.astype(self.dtype), out_e
+        self.sow(
+            "moe_stats",
+            "dropped",
+            jnp.float32(b * s * self.top_k) - f_disp.sum(),
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
         )
 
         # weighted load-balance loss, summed into the task loss via the
@@ -99,6 +146,68 @@ class MoeMlp(nn.Module):
         if self.dropout_rate > 0:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
         return y
+
+    def _expert_parallel(self, x, dispatch, combine, wi, wo, ep: int):
+        """The expert-sharded compute: an explicit shard_map over the
+        serving mesh. wi/wo arrive resident in their compute layout
+        (dim 0 split E/ep — parallel/serving_mesh.py expert_kernel_spec;
+        per-layer gathering skips them), so each shard's kernel block is
+        already local. The replicated dispatch/combine tensors are
+        sliced to the shard's contiguous E/ep expert block via
+        axis_index — the data=1 degenerate form of the token all_to_all
+        — and one psum over the expert axis combines the per-shard
+        partial outputs. The expert batch dim of every einsum is merely
+        sliced (contraction dims s/d/f keep their full lengths), and the
+        top-1 combine has ≤1 nonzero term per output element, so the
+        psum'd result is bitwise the unsharded einsum chain's.
+
+        The body's values are device-varying over `expert` by
+        construction (axis_index slices), which the rep/vma checker
+        can't see through — the escape rides the audited
+        shard_map_pallas wrapper (parallel/shard_map.py), whose legacy
+        path is this exact shard_map with the specs passed verbatim
+        (widen_batch=False: dispatch/combine are replicated, NOT
+        batch-sharded — each shard slices the GLOBAL expert dim)."""
+        from kubeflow_tpu.parallel.serving_mesh import (
+            MOE_EXPERT_AXIS,
+            expert_kernel_spec,
+        )
+        from kubeflow_tpu.parallel.shard_map import shard_map_pallas
+
+        e = self.num_experts
+        local_e = e // ep
+        dt = self.dtype
+
+        def local_experts(x_, disp_, comb_, wi_, wo_):
+            idx = jax.lax.axis_index(MOE_EXPERT_AXIS)
+            start = idx * local_e
+            disp_l = jax.lax.dynamic_slice_in_dim(
+                disp_, start, local_e, axis=2
+            )
+            comb_l = jax.lax.dynamic_slice_in_dim(
+                comb_, start, local_e, axis=2
+            )
+            expert_in = jnp.einsum("bsec,bsd->ebcd", disp_l, x_)
+            h = jnp.einsum("ebcd,edf->ebcf", expert_in, wi_.astype(dt))
+            h = nn.gelu(h, approximate=True)
+            out_e = jnp.einsum("ebcf,efd->ebcd", h, wo_.astype(dt))
+            part = jnp.einsum("bsec,ebcd->bsd", comb_l, out_e)
+            return jax.lax.psum(part, MOE_EXPERT_AXIS)
+
+        return shard_map_pallas(
+            local_experts,
+            in_specs=(
+                P(),
+                P(),
+                P(),
+                expert_kernel_spec(3),
+                expert_kernel_spec(3),
+            ),
+            out_specs=P(),
+            axis_names=(MOE_EXPERT_AXIS,),
+            mesh=self.expert_mesh,
+            widen_batch=False,
+        )(x, dispatch, combine, wi, wo)
 
 
 def _constrain(x, spec: Optional[P]):

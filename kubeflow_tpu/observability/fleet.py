@@ -162,6 +162,11 @@ AGGREGATION_POLICY: Dict[str, str] = {
     "serving_kv_handoff_pages_total": "sum",
     "serving_kv_spill_hits_total": "sum",
     "serving_kv_spill_pages_total": "sum",
+    # expert-parallel MoE routing (serving/engine.py on MoE targets;
+    # dense engines emit none of these): per-expert routed positions
+    # and capacity drops sum across the fleet
+    "serving_moe_capacity_overflow_total": "sum",
+    "serving_moe_expert_tokens_total": "sum",
     "serving_prefix_cache_hit_tokens_total": "sum",
     "serving_prefix_cache_lookups_total": "sum",
     "serving_requests_total": "sum",
@@ -219,6 +224,10 @@ AGGREGATION_POLICY: Dict[str, str] = {
     # so mean — the router's cold-steer threshold compares against the
     # PER-REPLICA rows (replica_serving_signals), not this fleet mean
     "serving_prefix_hit_rate": "mean",
+    # per-replica max/mean expert occupancy: ratio-like, so mean — the
+    # fleet-level router-health verdict; a single hot replica still
+    # shows in its own /statusz moe line
+    "serving_moe_load_imbalance": "mean",
     "serving_queue_depth": "sum",
     "serving_slot_occupancy": "mean",
     "tpujob_running": "sum",

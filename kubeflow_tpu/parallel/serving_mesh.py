@@ -50,47 +50,99 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # [..., H, 1] int8 scale siblings alike — H sits at -2 in both)
 POOL_HEAD_AXIS = "tensor"
 
+# the mesh axis the MoE expert stack shards on: dim 0 of the [E, D, F]
+# wi / [E, F, D] wo kernels (models/layers.py MoeMlp). Expert kernels
+# shard on THIS AXIS ONLY and are never gathered: the resident layout is
+# the compute layout (each chip holds and runs its E/ep block), so the
+# per-chip expert bytes the mem-budget lint prices are exactly 1/ep of
+# the replicated layout — the capacity claim expert parallelism exists
+# for.
+MOE_EXPERT_AXIS = "expert"
+
+
+def expert_kernel_spec(ndim: int = 3) -> P:
+    """Expert-stack spec for one MoE kernel leaf: E sits at dim 0,
+    everything else replicated (the compute layout — never gathered)."""
+    return P(MOE_EXPERT_AXIS, *([None] * (ndim - 1)))
+
+
+def mesh_expert_size(mesh: Optional[Mesh]) -> int:
+    """The expert-axis extent of a serving mesh (1 when unmeshed or the
+    mesh carries no expert axis)."""
+    if mesh is None:
+        return 1
+    return int(dict(mesh.shape).get(MOE_EXPERT_AXIS, 1))
+
+
+def is_moe_expert_kernel_path(path) -> bool:
+    """True for the MoE expert-stack kernel leaves (…/moe/wi, …/moe/wo —
+    int8 envelope members included): the leaves that shard on the expert
+    axis and are skipped by per-layer gathering. The router stays on the
+    ordinary rules (replicated at compute like every other small leaf:
+    routing is computed identically on every shard)."""
+    keys = [getattr(k, "key", str(k)) for k in path]
+    for i, k in enumerate(keys[:-1]):
+        if k == "moe" and keys[i + 1] in ("wi", "wo"):
+            return True
+    return False
+
 
 def build_serving_mesh(
-    tensor: int, fsdp: int, devices=None
+    tensor: int, fsdp: int, expert: int = 1, devices=None
 ) -> Optional[Mesh]:
-    """The engine's mesh: `tensor × fsdp` over the first tensor*fsdp
-    local devices (data=1 — scale-out across replicas is the router's
-    job, not the engine's). 1×1 returns None: the unmeshed engine is the
-    bitwise baseline and must not even construct a Mesh."""
-    t, f = int(tensor), int(fsdp)
-    if t < 1 or f < 1:
+    """The engine's mesh: `tensor × fsdp × expert` over the first
+    tensor*fsdp*expert local devices (data=1 — scale-out across replicas
+    is the router's job, not the engine's). 1×1×1 returns None: the
+    unmeshed engine is the bitwise baseline and must not even construct
+    a Mesh."""
+    t, f, e = int(tensor), int(fsdp), int(expert)
+    if t < 1 or f < 1 or e < 1:
         raise ValueError(
-            f"serving mesh axes must be >= 1, got tensor={t} fsdp={f}"
+            f"serving mesh axes must be >= 1, got tensor={t} fsdp={f} "
+            f"expert={e}"
         )
-    if t * f == 1:
+    if t * f * e == 1:
         return None
     from kubeflow_tpu.config.platform import MeshConfig
     from kubeflow_tpu.parallel.mesh import mesh_from_config
 
     if devices is None:
         devices = jax.devices()
-    need = t * f
+    need = t * f * e
     if len(devices) < need:
         raise ValueError(
-            f"serving mesh tensor={t} x fsdp={f} needs {need} devices, "
-            f"this process has {len(devices)}"
+            f"serving mesh tensor={t} x fsdp={f} x expert={e} needs "
+            f"{need} devices, this process has {len(devices)}"
         )
     return mesh_from_config(
-        MeshConfig(data=1, fsdp=f, tensor=t), devices=list(devices)[:need]
+        MeshConfig(data=1, fsdp=f, tensor=t, expert=e),
+        devices=list(devices)[:need],
     )
 
 
 def validate_serving_mesh(
-    model_cfg, tensor: int, fsdp: int, role: str = "model"
+    model_cfg, tensor: int, fsdp: int, expert: int = 1,
+    role: str = "model",
 ) -> None:
     """The divisibility contract: tensor must divide the head count (the
     KV pool shards on heads — there is no degraded fallback for the
     engine's dominant buffer) and the mlp dim; fsdp must divide the
     hidden (embed) dim. Other weight dims (e.g. an odd vocab) degrade to
     replicated exactly as training's `logical_axes_for` does — visible
-    to the spmd-replicated-param lint, never a silent wrong answer."""
-    t, f = int(tensor), int(fsdp)
+    to the spmd-replicated-param lint, never a silent wrong answer.
+
+    The expert axis shards the MoE expert stack ([E, ...] wi/wo
+    kernels): ep must divide num_experts, and the serving model itself
+    must BE MoE (ep > 1 on a dense model buys nothing and would quietly
+    replicate — a config error, not a degrade). ep > 1 also requires
+    top-1 routing: the bitwise-parity contract holds because a top-1
+    combine has at most ONE nonzero term per output element (exact-zero
+    identities survive any reduction order, FMA included); a top-2
+    combine sums two nonzero terms whose f32 addition order an expert
+    shard boundary would change. A dense DRAFT riding a MoE target's
+    mesh is fine — it has no expert stack and simply replicates over
+    the axis."""
+    t, f, e = int(tensor), int(fsdp), int(expert)
     if t > 1:
         if model_cfg.num_heads % t:
             raise ValueError(
@@ -108,6 +160,31 @@ def validate_serving_mesh(
             f"serving mesh fsdp={f} does not divide the {role}'s "
             f"hidden_size={model_cfg.hidden_size}"
         )
+    if e > 1:
+        num_experts = int(getattr(model_cfg, "num_experts", 0) or 0)
+        if num_experts == 0:
+            if role == "model":
+                raise ValueError(
+                    f"serving mesh expert={e} requires a MoE model: the "
+                    f"{role} has num_experts=0, so there is no expert "
+                    f"stack to shard"
+                )
+        else:
+            if num_experts % e:
+                raise ValueError(
+                    f"serving mesh expert={e} does not divide the "
+                    f"{role}'s num_experts={num_experts}: each shard "
+                    f"owns a contiguous E/ep block of the expert stack"
+                )
+            if int(getattr(model_cfg, "moe_top_k", 1)) != 1:
+                raise ValueError(
+                    f"serving mesh expert={e} requires top-1 routing "
+                    f"(the {role} has moe_top_k="
+                    f"{model_cfg.moe_top_k}): a top-k>1 combine sums "
+                    f"k nonzero terms whose f32 reduction order the "
+                    f"expert shard boundary would change — the bitwise "
+                    f"parity contract only holds for top-1"
+                )
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
@@ -138,7 +215,14 @@ def param_shardings(params, mesh: Mesh):
     dims, tensor on heads/mlp/vocab dims, indivisible dims degraded to
     replicated. Handles the int8 envelope ({qvalues, qscales}) —
     qvalues shard by the same rules (quantization is shape-preserving),
-    the per-channel scale vectors are a rounding error and replicate."""
+    the per-channel scale vectors are a rounding error and replicate.
+
+    On an expert-carrying mesh the MoE expert kernels (…/moe/wi|wo) are
+    pinned to `expert_kernel_spec` INSTEAD of the training rules: their
+    resident layout must equal their compute layout (dim 0 split E/ep,
+    everything else whole) because they are never gathered — per-layer
+    gathering skips them, and the expert shard_map consumes them
+    in place."""
     from kubeflow_tpu.checkpointing.quantize import is_quantized_params
     from kubeflow_tpu.parallel.sharding import param_specs
     from kubeflow_tpu.training.annotations import logical_axes_for
@@ -155,6 +239,16 @@ def param_shardings(params, mesh: Mesh):
         params, fsdp_size=sizes.get("fsdp", 1), mesh_axis_sizes=sizes
     )
     specs = param_specs(params, axes, mesh=mesh)
+    ep = mesh_expert_size(mesh)
+    if ep > 1:
+        specs = jax.tree_util.tree_map_with_path(
+            lambda path, s, leaf: (
+                expert_kernel_spec(leaf.ndim)
+                if is_moe_expert_kernel_path(path)
+                else s
+            ),
+            specs, params,
+        )
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
 
 

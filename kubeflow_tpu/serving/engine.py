@@ -126,6 +126,9 @@ from kubeflow_tpu.utils.metrics import (
     serving_kv_pool_bytes_per_chip_gauge,
     serving_kv_spill_hits_counter,
     serving_kv_spill_pages_counter,
+    serving_moe_capacity_overflow_counter,
+    serving_moe_expert_tokens_counter,
+    serving_moe_load_imbalance_gauge,
     serving_num_slots_gauge,
     serving_paged_attention_calls_counter,
     serving_phase_histogram,
@@ -630,6 +633,7 @@ class EnginePrograms:
         quantize: str = DEFAULT_QUANTIZE,
         mesh_tensor: int = 1,
         mesh_fsdp: int = 1,
+        mesh_expert: int = 1,
     ):
         from kubeflow_tpu.parallel.serving_mesh import (
             build_serving_mesh,
@@ -638,18 +642,30 @@ class EnginePrograms:
 
         cfg = model.cfg
         self.model = model
-        # -- serving mesh (parallel/serving_mesh.py): 1x1 = None = the
+        # MoE target (cfg.num_experts > 0): every target program makes
+        # the "moe_stats" collection mutable and returns one aggregated
+        # (expert occupancy [E], dropped) pair — the router load-balance
+        # evidence. Dense targets keep the pre-r20 signatures exactly.
+        self._moe = int(getattr(cfg, "num_experts", 0) or 0) > 0
+        self._mutable = ["cache", "moe_stats"] if self._moe else ["cache"]
+        # -- serving mesh (parallel/serving_mesh.py): 1x1x1 = None = the
         # unmeshed bitwise baseline; anything larger shards params at
-        # rest by the training rules and the KV pools on the heads axis
+        # rest by the training rules, the KV pools on the heads axis,
+        # and (expert > 1) the MoE expert stacks on the expert axis
         self.mesh_tensor = int(mesh_tensor or 1)
         self.mesh_fsdp = int(mesh_fsdp or 1)
-        validate_serving_mesh(cfg, self.mesh_tensor, self.mesh_fsdp)
+        self.mesh_expert = int(mesh_expert or 1)
+        validate_serving_mesh(
+            cfg, self.mesh_tensor, self.mesh_fsdp, self.mesh_expert
+        )
         if draft_model is not None and num_draft_tokens > 0:
             validate_serving_mesh(
                 draft_model.cfg, self.mesh_tensor, self.mesh_fsdp,
-                role="draft",
+                self.mesh_expert, role="draft",
             )
-        self.mesh = build_serving_mesh(self.mesh_tensor, self.mesh_fsdp)
+        self.mesh = build_serving_mesh(
+            self.mesh_tensor, self.mesh_fsdp, self.mesh_expert
+        )
         self.num_draft_tokens = int(num_draft_tokens)
         if self.num_draft_tokens < 0:
             raise ValueError("num_draft_tokens must be >= 0")
@@ -790,11 +806,16 @@ class EnginePrograms:
         # engine's dominant buffer on every admission and every one-token
         # step (undonated = 2× pool HBM + one full pool copy per token)
         rep, psh, dsh = self._rep, self._pool_sh, self._draft_pool_sh
+        # MoE targets append one (expert occupancy [E], dropped) pair to
+        # prefill/chunk/step/verify — replicated (the psum's output is),
+        # and appended AFTER the existing outputs so cache_io indices and
+        # the donation aliasing stay exactly the dense engine's
+        ms = ((rep, rep),) if self._moe else ()
         self.prefill = jax.jit(self._prefill_fn)
         self.insert = self._jit(self._insert_fn, (0,), psh)
-        self.chunk = self._jit(self._chunk_fn, (1,), (psh, rep))
+        self.chunk = self._jit(self._chunk_fn, (1,), (psh, rep) + ms)
         self.cow = self._jit(self._cow_fn, (0,), psh)
-        self.step = self._jit(self._step_fn, (1,), (psh, rep))
+        self.step = self._jit(self._step_fn, (1,), (psh, rep) + ms)
         # tier programs (serving/kv_tiers.py): spill gathers one page to
         # a replicated page tree (device→host read shape; the pool must
         # stay resident, so NO donation), upload scatters a page tree
@@ -812,7 +833,7 @@ class EnginePrograms:
                 self._draft_fn, (1,), (dsh, rep, rep)
             )
             self.verify = self._jit(
-                self._verify_fn, (1,), (psh, rep, rep)
+                self._verify_fn, (1,), (psh, rep, rep) + ms
             )
         else:
             self.draft_prefill = None
@@ -897,11 +918,32 @@ class EnginePrograms:
 
     # -- jitted program bodies ---------------------------------------------
 
+    def _moe_stats_of(self, mutated):
+        """Fold the layer-stacked "moe_stats" sows (models/layers.py
+        MoeMlp) into ONE (expert occupancy [E] f32, dropped-slots scalar)
+        pair inside the jitted program — two tiny replicated outputs the
+        scheduler fetches batched with the sampled tokens. Counts are
+        router POSITIONS (idle decode slots and pad tails route too): a
+        load-balance signal, not token billing."""
+        e = int(self.model.cfg.num_experts)
+        tokens = jnp.zeros((e,), jnp.float32)
+        dropped = jnp.zeros((), jnp.float32)
+        leaves = jax.tree_util.tree_flatten_with_path(
+            mutated["moe_stats"]
+        )[0]
+        for path, leaf in leaves:
+            name = getattr(path[-1], "key", str(path[-1]))
+            if name == "expert_tokens":
+                tokens = tokens + leaf.reshape(-1, e).sum(axis=0)
+            elif name == "dropped":
+                dropped = dropped + leaf.sum()
+        return tokens, dropped
+
     def _prefill_fn(self, params, ids, mask, key, temp, top_k, top_p):
         out, mutated = self._apply_model.apply(
             {"params": self._live_params(params)}, ids,
             attention_mask=mask, prefill=True,
-            mutable=["cache"],
+            mutable=self._mutable,
         )
         last = jnp.maximum(mask.astype(jnp.int32).sum(1) - 1, 0)
         logits = out["logits"][jnp.arange(ids.shape[0]), last]
@@ -909,6 +951,8 @@ class EnginePrograms:
             logits, key[None], jnp.zeros((1,), jnp.int32), temp[None],
             top_k[None], top_p[None],
         )
+        if self._moe:
+            return mutated["cache"], tok[0], self._moe_stats_of(mutated)
         return mutated["cache"], tok[0]
 
     def _insert_fn(self, pool, cache_one, page_ids, real_len):
@@ -935,13 +979,15 @@ class EnginePrograms:
         paged = self._paged(page_table, cursor)
         out, mutated = self._apply_model.apply(
             {"params": self._live_params(params), "cache": pool}, ids,
-            decode=True, paged=paged, mutable=["cache"],
+            decode=True, paged=paged, mutable=self._mutable,
         )
         logits = out["logits"][0, sample_idx]
         tok = _sample_slots(
             logits[None], key[None], jnp.zeros((1,), jnp.int32),
             temp[None], top_k[None], top_p[None],
         )
+        if self._moe:
+            return mutated["cache"], tok[0], self._moe_stats_of(mutated)
         return mutated["cache"], tok[0]
 
     def _step_fn(self, params, pool, tokens, page_table, cursors, keys,
@@ -950,11 +996,13 @@ class EnginePrograms:
         out, mutated = self._apply_model.apply(
             {"params": self._live_params(params), "cache": pool},
             tokens[:, None],
-            decode=True, paged=paged, mutable=["cache"],
+            decode=True, paged=paged, mutable=self._mutable,
         )
         nxt = _sample_slots(
             out["logits"][:, 0], keys, counters, temps, top_ks, top_ps
         )
+        if self._moe:
+            return mutated["cache"], nxt, self._moe_stats_of(mutated)
         return mutated["cache"], nxt
 
     # -- speculative draft-and-verify program bodies -----------------------
@@ -1055,7 +1103,7 @@ class EnginePrograms:
         paged = self._paged(page_table, cursors)
         out, mutated = self._apply_model.apply(
             {"params": self._live_params(params), "cache": pool}, window,
-            decode=True, paged=paged, mutable=["cache"],
+            decode=True, paged=paged, mutable=self._mutable,
         )
         logits = out["logits"].astype(jnp.float32)  # [S, K+1, V]
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -1122,6 +1170,9 @@ class EnginePrograms:
         out_tokens = jnp.where(
             jnp.arange(kk + 1)[None, :] < acc[:, None], padded, replacement
         )
+        if self._moe:
+            return (mutated["cache"], out_tokens, out_len,
+                    self._moe_stats_of(mutated))
         return mutated["cache"], out_tokens, out_len
 
     # -- abstract views (kft-analyze's serving lint; no device state) ------
@@ -1450,6 +1501,7 @@ class DecodeEngine:
         quantize: Optional[str] = None,
         mesh_tensor: Optional[int] = None,
         mesh_fsdp: Optional[int] = None,
+        mesh_expert: Optional[int] = None,
         kv_host_bytes: int = 0,
         kv_persist_dir: Optional[str] = None,
         kv_persist_interval_s: float = 0.0,
@@ -1490,6 +1542,7 @@ class DecodeEngine:
         self.params = params
         self.mesh_tensor = int(mesh_tensor or 1)
         self.mesh_fsdp = int(mesh_fsdp or 1)
+        self.mesh_expert = int(mesh_expert or 1)
         ps = int(page_size) if page_size else DEFAULT_PAGE_SIZE
         # one pool-sizing rule with the serving lint (resolve_num_pages):
         # auto sizing at quantize=int8 applies the capacity ratio — same
@@ -1508,6 +1561,7 @@ class DecodeEngine:
             page_size=ps, num_pages=pool_pages,
             paged_attention=self.paged_attention, quantize=self.quantize,
             mesh_tensor=self.mesh_tensor, mesh_fsdp=self.mesh_fsdp,
+            mesh_expert=self.mesh_expert,
         )
         self.mesh = self.programs.mesh
         if self.mesh is not None:
@@ -1758,6 +1812,22 @@ class DecodeEngine:
         self._pool_bytes_chip_g = serving_kv_pool_bytes_per_chip_gauge()
         self._pool_bytes_chip_g.set(self.kv_pool_bytes_per_chip, model=name)
 
+        # -- MoE router observability (MoE targets only; dense engines
+        # carry NO moe state, emit NO moe series, and show no "moe:"
+        # statusz line). Counts are router POSITIONS (idle slots and pad
+        # tails route too): the load-balance evidence, not token billing.
+        self._moe = self.programs._moe
+        self._moe_tokens_np = None
+        self._moe_dropped = 0.0
+        if self._moe:
+            self._moe_tokens_np = np.zeros(
+                (int(cfg.num_experts),), np.float64
+            )
+            self._moe_expert_tokens_m = serving_moe_expert_tokens_counter()
+            self._moe_overflow_m = serving_moe_capacity_overflow_counter()
+            self._moe_imbalance_g = serving_moe_load_imbalance_gauge()
+            self._moe_imbalance_g.set(0.0, model=name)
+
         # warm restart: preload the persisted hot chains into the pool +
         # radix index BEFORE the scheduler starts, so the first admitted
         # request already sees them as prefix hits
@@ -1988,8 +2058,58 @@ class DecodeEngine:
                 # holds of the pools
                 "mesh_tensor": self.mesh_tensor,
                 "mesh_fsdp": self.mesh_fsdp,
+                "mesh_expert": self.mesh_expert,
                 "kv_pool_bytes_per_chip": self.kv_pool_bytes_per_chip,
+                # MoE router evidence (None on dense engines): cumulative
+                # per-expert routed positions, capacity drops, and the
+                # live max/mean occupancy imbalance (1.0 = perfectly
+                # balanced routing)
+                "moe": self._moe_snapshot(),
             }
+
+    def _moe_snapshot(self) -> Optional[dict]:
+        """Caller holds _stats_lock (stats() does)."""
+        if not self._moe:
+            return None
+        total = float(self._moe_tokens_np.sum())
+        mean = float(self._moe_tokens_np.mean())
+        return {
+            "expert_tokens": [float(v) for v in self._moe_tokens_np],
+            "routed_positions": total,
+            "dropped": float(self._moe_dropped),
+            "load_imbalance": (
+                float(self._moe_tokens_np.max()) / mean if mean > 0.0
+                else 0.0
+            ),
+        }
+
+    def _note_moe(self, entries) -> None:
+        """Fold host-fetched (expert occupancy [E], dropped) pairs —
+        already device_get'd, batched with the tokens they rode with —
+        into the engine's cumulative MoE counters and the exported
+        series."""
+        if not entries:
+            return
+        with self._stats_lock:
+            for tokens_e, dropped in entries:
+                arr = np.asarray(tokens_e, np.float64)
+                self._moe_tokens_np += arr
+                d = float(dropped)
+                self._moe_dropped += d
+                for ei in range(arr.shape[0]):
+                    if arr[ei]:
+                        self._moe_expert_tokens_m.inc(
+                            float(arr[ei]), model=self.name,
+                            expert=str(ei),
+                        )
+                if d:
+                    self._moe_overflow_m.inc(d, model=self.name)
+            mean = float(self._moe_tokens_np.mean())
+            imbalance = (
+                float(self._moe_tokens_np.max()) / mean if mean > 0.0
+                else 0.0
+            )
+        self._moe_imbalance_g.set(imbalance, model=self.name)
 
     def debug_state(self) -> dict:
         """The /statusz snapshot: slot map (with page footprints), pool
@@ -2030,6 +2150,7 @@ class DecodeEngine:
             "kv_pool_bytes": self.kv_pool_bytes,
             "mesh": {
                 "tensor": self.mesh_tensor, "fsdp": self.mesh_fsdp,
+                "expert": self.mesh_expert,
             },
             "kv_pool_bytes_per_chip": self.kv_pool_bytes_per_chip,
             "prefix_cache": self.prefix_cache_enabled,
@@ -2804,6 +2925,10 @@ class DecodeEngine:
         largest = self.prefill_buckets[-1]
         first_tok = None
         computed = 0
+        # MoE targets: each prefill/chunk returns an (occupancy, dropped)
+        # stats pair — collected on device and fetched in the ONE
+        # first-token device_get below (no extra admission syncs)
+        moe_acc = []
         if matched == 0 and p <= largest:
             # fresh short prompt: one bucketed batch-1 prefill, scattered
             # into this slot's pages at the prompt's REAL length (bucket
@@ -2813,10 +2938,17 @@ class DecodeEngine:
             ids[0, :p] = prompt
             mask = np.zeros((1, bucket), bool)
             mask[0, :p] = True
-            cache_one, tok = self._prefill(
-                self.params, jnp.asarray(ids), jnp.asarray(mask), base,
-                temp, tk, tp,
-            )
+            if self._moe:
+                cache_one, tok, ms = self._prefill(
+                    self.params, jnp.asarray(ids), jnp.asarray(mask),
+                    base, temp, tk, tp,
+                )
+                moe_acc.append(ms)
+            else:
+                cache_one, tok = self._prefill(
+                    self.params, jnp.asarray(ids), jnp.asarray(mask),
+                    base, temp, tk, tp,
+                )
             self._ensure_pages(slot_idx, p)
             prow = jnp.asarray(self._pt_np[slot_idx])
             self._pool = self._insert(
@@ -2841,10 +2973,17 @@ class DecodeEngine:
                 # used to 400 / fall to the 8.55x-slower static path.
                 ids = np.asarray(prompt[:largest])[None]
                 mask = np.ones((1, largest), bool)
-                cache_one, _ = self._prefill(
-                    self.params, jnp.asarray(ids), jnp.asarray(mask),
-                    base, temp, tk, tp,
-                )
+                if self._moe:
+                    cache_one, _, ms = self._prefill(
+                        self.params, jnp.asarray(ids), jnp.asarray(mask),
+                        base, temp, tk, tp,
+                    )
+                    moe_acc.append(ms)
+                else:
+                    cache_one, _ = self._prefill(
+                        self.params, jnp.asarray(ids), jnp.asarray(mask),
+                        base, temp, tk, tp,
+                    )
                 self._ensure_pages(slot_idx, largest)
                 prow = jnp.asarray(self._pt_np[slot_idx])
                 self._pool = self._insert(
@@ -2876,10 +3015,17 @@ class DecodeEngine:
                 cur = jnp.asarray([pos], jnp.int32)
                 final = pos + nreal >= p
                 sample_idx = jnp.int32((p - 1) - pos if final else 0)
-                self._pool, tok = self._chunk(
-                    self.params, self._pool, jnp.asarray(chunk), prow,
-                    cur, sample_idx, base, temp, tk, tp,
-                )
+                if self._moe:
+                    self._pool, tok, ms = self._chunk(
+                        self.params, self._pool, jnp.asarray(chunk),
+                        prow, cur, sample_idx, base, temp, tk, tp,
+                    )
+                    moe_acc.append(ms)
+                else:
+                    self._pool, tok = self._chunk(
+                        self.params, self._pool, jnp.asarray(chunk), prow,
+                        cur, sample_idx, base, temp, tk, tp,
+                    )
                 self._note_attn(clen)
                 if self.num_draft_tokens > 0:
                     self._draft_pool = self._draft_chunk(
@@ -2892,7 +3038,12 @@ class DecodeEngine:
                 computed += nreal
                 pos += clen
             self._cur_np[slot_idx] = p
-        first = int(jax.device_get(first_tok))
+        if moe_acc:
+            first_host, moe_host = jax.device_get((first_tok, moe_acc))
+            first = int(first_host)
+            self._note_moe(moe_host)
+        else:
+            first = int(jax.device_get(first_tok))
         prefill_span.end()
         slot = _Slot(req)
         slot.ttft_s = time.monotonic() - req.t_submit
@@ -3137,14 +3288,22 @@ class DecodeEngine:
         with self._tracer.span(
             "engine.step", model=self.name, active=len(active)
         ):
-            self._pool, tok = self._step(
+            step_args = (
                 self.params, self._pool,
                 jnp.asarray(self._tok_np), jnp.asarray(self._pt_np),
                 jnp.asarray(self._cur_np), jnp.asarray(self._key_np),
                 jnp.asarray(self._cnt_np), jnp.asarray(self._temp_np),
                 jnp.asarray(self._topk_np), jnp.asarray(self._topp_np),
             )
-            toks = np.asarray(jax.device_get(tok))
+            if self._moe:
+                # one batched fetch: tokens + the step's MoE stats pair
+                self._pool, tok, ms = self._step(*step_args)
+                toks, moe_host = jax.device_get((tok, ms))
+                toks = np.asarray(toks)
+                self._note_moe([moe_host])
+            else:
+                self._pool, tok = self._step(*step_args)
+                toks = np.asarray(jax.device_get(tok))
         self._note_attn(1)
         self._decode_steps.inc(model=self.name)
         self._tokens_total.inc(len(active), model=self.name)
@@ -3192,12 +3351,24 @@ class DecodeEngine:
         with self._tracer.span(
             "engine.verify", model=self.name, active=len(active), k=kk
         ):
-            self._pool, out_tok, out_len = self._verify(
-                self.params, self._pool, window, qs, keys, draws, temps,
-                top_ks, top_ps, pt, curs,
-            )
-            out_tok = np.asarray(jax.device_get(out_tok))
-            out_len = np.asarray(jax.device_get(out_len))
+            if self._moe:
+                self._pool, out_tok, out_len, ms = self._verify(
+                    self.params, self._pool, window, qs, keys, draws,
+                    temps, top_ks, top_ps, pt, curs,
+                )
+                out_tok, out_len, moe_host = jax.device_get(
+                    (out_tok, out_len, ms)
+                )
+                out_tok = np.asarray(out_tok)
+                out_len = np.asarray(out_len)
+                self._note_moe([moe_host])
+            else:
+                self._pool, out_tok, out_len = self._verify(
+                    self.params, self._pool, window, qs, keys, draws,
+                    temps, top_ks, top_ps, pt, curs,
+                )
+                out_tok = np.asarray(jax.device_get(out_tok))
+                out_len = np.asarray(jax.device_get(out_len))
         rolled = int(sum((kk + 1) - int(out_len[i]) for i in active))
         if rolled:
             # the host cursors rewind past the rejected tails below —

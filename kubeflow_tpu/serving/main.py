@@ -48,9 +48,11 @@ def engine_knobs_from_env():
     KFT_SERVING_PREFIX_CACHE (radix prefix index on/off),
     KFT_SERVING_PAGED_ATTENTION (decode read kernel: gather | pallas) +
     KFT_SERVING_QUANTIZE (none | int8 weights-and-KV-pages),
-    KFT_SERVING_MESH_TENSOR + KFT_SERVING_MESH_FSDP (the serving mesh —
-    tensor shards the KV pools on heads, fsdp shards the resident
-    weights; 1/1 = the unmeshed single-chip engine),
+    KFT_SERVING_MESH_TENSOR + KFT_SERVING_MESH_FSDP +
+    KFT_SERVING_MESH_EXPERT (the serving mesh — tensor shards the KV
+    pools on heads, fsdp shards the resident weights, expert shards a
+    MoE model's expert stacks; 1/1/1 = the unmeshed single-chip
+    engine),
     KFT_SERVING_DRAFT_MODEL + KFT_SERVING_DRAFT_TOKENS (speculative
     decoding: registry draft model and tokens drafted per verify step; 0
     disables), KFT_SERVING_DRAIN_DEADLINE_S (SIGTERM/scale-down draining
@@ -75,6 +77,7 @@ def engine_knobs_from_env():
         ),
         "mesh_tensor": _env_int("KFT_SERVING_MESH_TENSOR", 1),
         "mesh_fsdp": _env_int("KFT_SERVING_MESH_FSDP", 1),
+        "mesh_expert": _env_int("KFT_SERVING_MESH_EXPERT", 1),
         "draft_model": os.environ.get("KFT_SERVING_DRAFT_MODEL", "").strip(),
         "num_draft_tokens": _env_int("KFT_SERVING_DRAFT_TOKENS", 0),
         "draft_checkpoint_dir": os.environ.get(
@@ -122,6 +125,7 @@ def build_server(
     quantize: str = None,
     mesh_tensor: int = None,
     mesh_fsdp: int = None,
+    mesh_expert: int = None,
     draft_model: str = None,
     num_draft_tokens: int = None,
     draft_params=None,
@@ -216,6 +220,8 @@ def build_server(
             mesh_tensor = env["mesh_tensor"]
         if mesh_fsdp is None:
             mesh_fsdp = env["mesh_fsdp"]
+        if mesh_expert is None:
+            mesh_expert = env["mesh_expert"]
         if draft_model is None:
             draft_model = env["draft_model"]
         if num_draft_tokens is None:
@@ -256,6 +262,7 @@ def build_server(
             )
         if num_slots < 1 and (
             (mesh_tensor or 1) > 1 or (mesh_fsdp or 1) > 1
+            or (mesh_expert or 1) > 1
         ):
             raise ValueError(
                 "a serving mesh needs num_slots >= 1: the mesh shards "
@@ -323,6 +330,7 @@ def build_server(
                     quantize=quantize,
                     mesh_tensor=mesh_tensor,
                     mesh_fsdp=mesh_fsdp,
+                    mesh_expert=mesh_expert,
                     draft_model=draft,
                     draft_params=draft_params,
                     num_draft_tokens=num_draft_tokens,
@@ -407,6 +415,12 @@ def main(argv=None) -> int:
         "from KFT_SERVING_MESH_FSDP, else 1)",
     )
     ap.add_argument(
+        "--mesh-expert", type=int, default=None,
+        help="serving mesh chips sharding a MoE model's expert stacks "
+        "(never gathered; must divide num_experts, top-1 routing only; "
+        "default from KFT_SERVING_MESH_EXPERT, else 1)",
+    )
+    ap.add_argument(
         "--prefix-cache", type=int, choices=(0, 1), default=None,
         help="radix prefix cache on/off (default from "
         "KFT_SERVING_PREFIX_CACHE, else on)",
@@ -442,6 +456,7 @@ def main(argv=None) -> int:
         quantize=args.quantize,
         mesh_tensor=args.mesh_tensor,
         mesh_fsdp=args.mesh_fsdp,
+        mesh_expert=args.mesh_expert,
         draft_model=args.draft_model,
         num_draft_tokens=args.num_draft_tokens,
         draft_checkpoint_dir=args.draft_checkpoint_dir,

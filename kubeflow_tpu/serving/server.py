@@ -392,6 +392,7 @@ class ModelServer:
                 f"/{state['pages_total']} pages of {state['page_size']} "
                 f"({st['kv_pool_dtype']}, {state['kv_pool_bytes']} B) | "
                 f"mesh: tensor={mesh['tensor']} fsdp={mesh['fsdp']} "
+                f"expert={mesh.get('expert', 1)} "
                 f"({state['kv_pool_bytes_per_chip']} B/chip) | "
                 f"kernel: {st['attention_kernel']} "
                 f"windows: "
@@ -412,6 +413,20 @@ class ModelServer:
                 f"cow={st['cow_copies']} "
                 f"first_page_hashes={st['first_page_hashes']}"
             )
+            # MoE router line (absent on dense engines — stats()["moe"]
+            # is None unless the target model routes experts)
+            moe = st.get("moe")
+            if moe is not None:
+                occ = " ".join(
+                    f"e{i}={v:g}"
+                    for i, v in enumerate(moe["expert_tokens"])
+                )
+                lines.append(
+                    f"    moe: routed={moe['routed_positions']:g} "
+                    f"dropped={moe['dropped']:g} "
+                    f"imbalance={moe['load_imbalance']:.3f} "
+                    f"[{occ}]"
+                )
             tier = state.get("kv_host_tier")
             if tier is not None or state.get("kv_persist_dir"):
                 lines.append(

@@ -212,6 +212,26 @@ def gpt_and_params():
 
 
 @pytest.fixture(scope="session")
+def gpt_moe_and_params():
+    """ONE shared tiny MoE-GPT (model, params) for the expert-parallel
+    serving suite (test_moe_serving) — same session-scope rationale as
+    gpt_and_params: every MoE engine variant (ep=1 reference, ep=2/4,
+    int8, speculative) keys its programs off this one model instance.
+    Tests must treat it as IMMUTABLE."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import get_model
+
+    model = get_model("gpt_tiny_moe", dtype=jnp.float32)
+    prompt = jnp.arange(6)[None, :].astype(jnp.int32) % 512
+    params = model.init(jax.random.PRNGKey(0), prompt, deterministic=True)[
+        "params"
+    ]
+    return model, params
+
+
+@pytest.fixture(scope="session")
 def image_dp8_trainer(devices8):
     """ONE shared resnet18 pure-DP Trainer for test_trainer's DP and
     checkpoint suites (r16 tier-1 tranche): each test previously built

@@ -622,7 +622,7 @@ class TestShippedPlansClean:
         from kubeflow_tpu.analysis.spmd import analyze_plan_subprocess
 
         specs = yaml_plan_specs(REPO)
-        assert len(specs) == 3
+        assert len(specs) == 4
         for spec in specs:
             findings, stats = analyze_plan_subprocess(
                 spec, REPO, timeout_s=600.0
@@ -1164,7 +1164,7 @@ class TestServingPlansClean:
         )
         bad = [f for f in findings if f.severity >= Severity.ERROR]
         assert bad == [], "\n".join(f.render() for f in bad)
-        assert stats["mesh"] == {"tensor": 2, "fsdp": 1}
+        assert stats["mesh"] == {"tensor": 2, "fsdp": 1, "expert": 1}
         _, base_stats = analyze_serving_plan(self._tiny())
         assert stats["num_pages"] == 2 * base_stats["num_pages"]
         assert (
@@ -1228,8 +1228,11 @@ class TestServingPlansClean:
         )
 
         specs = shipped_serving_plans()
-        assert len(specs) == 8
+        assert len(specs) == 9
         assert "bench:gpt_sharded" in {s.name for s in specs}
+        # r20: the expert-parallel MoE engine (mem-budget prices its
+        # wi/wo stacks at 1/ep; the gather unit excludes them)
+        assert "bench:gpt_moe_ep" in {s.name for s in specs}
         # r16: the certified multi-query pallas K>0 family
         assert "bench:gpt_mq_pallas" in {s.name for s in specs}
         for spec in specs:
@@ -1263,7 +1266,7 @@ class TestServingPlansClean:
             "KFT_SERVING_NUM_PAGES", "KFT_SERVING_PREFIX_CACHE",
             "KFT_SERVING_PAGED_ATTENTION", "KFT_SERVING_QUANTIZE",
             "KFT_SERVING_MESH_TENSOR", "KFT_SERVING_MESH_FSDP",
-            "KFT_SERVING_DRAIN_DEADLINE_S",
+            "KFT_SERVING_MESH_EXPERT", "KFT_SERVING_DRAIN_DEADLINE_S",
         ):
             monkeypatch.delenv(var, raising=False)
         knobs = sm.engine_knobs_from_env()
@@ -1278,6 +1281,7 @@ class TestServingPlansClean:
         # in the env fallback, the plan registry AND ServingConfig
         assert knobs["mesh_tensor"] == 1
         assert knobs["mesh_fsdp"] == 1
+        assert knobs["mesh_expert"] == 1
         assert knobs["drain_deadline_s"] == DEFAULT_DRAIN_DEADLINE_S
         cfg = ServingConfig()
         assert cfg.num_slots == DEFAULT_NUM_SLOTS
@@ -1289,6 +1293,7 @@ class TestServingPlansClean:
         assert cfg.quantize == DEFAULT_QUANTIZE
         assert cfg.mesh.tensor == 1
         assert cfg.mesh.fsdp == 1
+        assert cfg.mesh.expert == 1
         assert cfg.drain_deadline_s == DEFAULT_DRAIN_DEADLINE_S
 
     def test_registry_shared_with_bench(self):

@@ -1,0 +1,418 @@
+"""Expert-parallel MoE serving: the decode engine on an expert mesh axis
+(serving/engine.py mesh_expert + parallel/serving_mesh.py expert axis;
+docs/SERVING.md "Expert-parallel MoE").
+
+The load-bearing contract is the sharded-serving one carried to sparsity:
+greedy output through the EXPERT-SHARDED MoE engine is BITWISE identical
+to the ep=1 MoE engine's. The layout is constructed for that: the router
+is replicated (every chip computes identical routing), the [E, ...] wi/wo
+expert stacks shard on the leading E axis (resident == compute layout,
+never gathered), and each chip contracts only its own experts' dispatch
+slice before one psum combines — top-1 routing leaves at most one nonzero
+term per output position, so the partial-sum identity is exact in floats,
+not approximate. This file pins that across page sizes, prefix hits/COW,
+chunked prefill, K>0 speculation, int8 and tensor×expert composition,
+plus the expert-axis validation and the "moe:" operator surface.
+
+NOTE the reference is the ep=1 ENGINE, not the fused generate() oracle:
+capacity-factor routing sees the engine's padded prefill buckets (pad
+positions route too), so engine output is bucket-geometry-dependent in a
+way dense serving is not — but identical geometry across ep values, which
+is the contract sharding must keep.
+
+Runs on the conftest's 8 virtual CPU devices; the CI serving workflow's
+`moe-parity` step (deps: sharded-parity) runs it in full, @slow variants
+included.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.engine import DecodeEngine
+
+
+# gpt_moe_and_params comes from conftest.py: ONE session-scoped tiny
+# MoE-gpt (4 experts, top-1, capacity factor 1.25) shared by every
+# engine variant in this suite
+
+
+def _rows(*lens):
+    return [
+        (np.arange(n) * (3 + 2 * i) + i + 1).astype(np.int32) % 512
+        for i, n in enumerate(lens)
+    ]
+
+
+def _engine(model, params, name, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_queue", 8)
+    kw.setdefault("page_size", 8)
+    return DecodeEngine(name, model, params, **kw)
+
+
+def _ep1_tokens(model, params, row, n, **kw):
+    """The reference: the SAME engine geometry at ep=1."""
+    eng = _engine(model, params, "moeref", **kw)
+    try:
+        return eng.generate_row(row, n, timeout=180)["tokens"]
+    finally:
+        eng.close()
+
+
+class TestMoeExpertParity:
+    def test_bitwise_ep2_and_observability(self, gpt_moe_and_params):
+        """The flagship: ep=2 (2 experts per chip) bitwise vs the ep=1
+        MoE engine, and the full MoE operator surface off the same
+        decodes — stats()["moe"], the prometheus series, the imbalance
+        gauge. One engine pair, one compile bill."""
+        from kubeflow_tpu.utils.metrics import default_registry
+
+        model, params = gpt_moe_and_params
+        rows = _rows(4, 7)
+        ref_eng = _engine(model, params, "moe1x")
+        try:
+            refs = [
+                f.wait(180) for f in [ref_eng.submit(r, 6) for r in rows]
+            ]
+            ref_stats = ref_eng.stats()
+        finally:
+            ref_eng.close()
+
+        eng = _engine(model, params, "moe2x", mesh_expert=2)
+        try:
+            outs = [f.wait(180) for f in [eng.submit(r, 6) for r in rows]]
+            stats = eng.stats()
+        finally:
+            eng.close()
+
+        for ref, out in zip(refs, outs):
+            assert out["tokens"] == ref["tokens"]
+
+        # -- operator surface ------------------------------------------
+        assert stats["mesh_expert"] == 2
+        assert ref_stats["mesh_expert"] == 1
+        moe = stats["moe"]
+        assert moe is not None
+        assert len(moe["expert_tokens"]) == model.cfg.num_experts
+        assert moe["routed_positions"] > 0
+        assert moe["load_imbalance"] >= 1.0
+        # routing is replicated across the expert axis: both engines saw
+        # the SAME router decisions — the occupancy evidence agrees too
+        assert moe["expert_tokens"] == ref_stats["moe"]["expert_tokens"]
+        assert moe["dropped"] == ref_stats["moe"]["dropped"]
+        reg = default_registry()
+        routed = sum(
+            reg.get("serving_moe_expert_tokens_total").value(
+                model="moe2x", expert=str(e)
+            )
+            for e in range(model.cfg.num_experts)
+        )
+        assert routed == moe["routed_positions"]
+        assert (
+            reg.get("serving_moe_load_imbalance").value(model="moe2x")
+            == moe["load_imbalance"]
+        )
+
+    @pytest.mark.slow
+    def test_bitwise_ep4_one_expert_per_chip(self, gpt_moe_and_params):
+        """ep == num_experts: the fully-sharded endpoint (each chip owns
+        exactly ONE expert's wi/wo) — the degenerate case where the
+        local contraction is a single-expert matmul.
+
+        @slow (r20): runs unfiltered in the serving CI moe-parity step;
+        tier-1 keeps the expert-axis canary through
+        test_bitwise_ep2_and_observability."""
+        model, params = gpt_moe_and_params
+        row = _rows(7)[0]
+        eng = _engine(model, params, "moe4x", mesh_expert=4)
+        try:
+            out = eng.generate_row(row, 6, timeout=180)
+        finally:
+            eng.close()
+        assert out["tokens"] == _ep1_tokens(model, params, row, 6)
+
+    @pytest.mark.slow
+    def test_bitwise_ep2_page64(self, gpt_moe_and_params):
+        """Page geometry stays a storage-layout knob on the expert mesh.
+
+        @slow (r20): runs unfiltered in the serving CI moe-parity step;
+        tier-1 keeps page-size independence through
+        test_sharded_serving's page-size suite (the KV pool layout is
+        expert-axis-agnostic — experts shard WEIGHTS, not pages)."""
+        model, params = gpt_moe_and_params
+        row = _rows(7)[0]
+        eng = _engine(
+            model, params, "moe64", page_size=64, mesh_expert=2
+        )
+        try:
+            out = eng.generate_row(row, 6, timeout=180)
+        finally:
+            eng.close()
+        assert out["tokens"] == _ep1_tokens(
+            model, params, row, 6, page_size=64
+        )
+
+    @pytest.mark.slow
+    def test_prefix_hit_and_cow_ep2(self, gpt_moe_and_params):
+        """Prefix hits, a mid-page COW divergence and a donor re-run all
+        stay bitwise on the expert mesh — the radix index is host-global
+        scheduler state, blind to how expert weights shard.
+
+        @slow (r20): runs unfiltered in the serving CI moe-parity step;
+        tier-1 keeps prefix/COW-on-a-mesh through test_sharded_serving
+        ::test_prefix_hit_and_cow_through_mesh."""
+        model, params = gpt_moe_and_params
+        kw = dict(num_slots=1, prefix_cache=True)
+        base = _rows(20)[0]
+        div = base.copy()
+        div[18:] = (div[18:] + 101) % 512
+        ref_eng = _engine(model, params, "moepr", **kw)
+        try:
+            ref_base = ref_eng.generate_row(base, 6, timeout=180)["tokens"]
+            ref_div = ref_eng.generate_row(div, 6, timeout=180)["tokens"]
+        finally:
+            ref_eng.close()
+        eng = _engine(model, params, "moepx", mesh_expert=2, **kw)
+        try:
+            a = eng.generate_row(base, 6, timeout=180)
+            b = eng.generate_row(base, 6, timeout=180)  # prefix hit
+            c = eng.generate_row(div, 6, timeout=180)   # COW divergence
+            a2 = eng.generate_row(base, 6, timeout=180)  # donor intact
+            stats = eng.stats()
+        finally:
+            eng.close()
+        assert a["tokens"] == b["tokens"] == a2["tokens"] == ref_base
+        assert c["tokens"] == ref_div
+        assert stats["prefix_hit_tokens"] > 0
+        assert stats["cow_copies"] >= 1
+
+    @pytest.mark.slow
+    def test_chunked_prefill_ep2(self, gpt_moe_and_params):
+        """A prompt past the largest bucket rides head prefill + chunk
+        windows over the expert-sharded MLPs: every chunk routes its own
+        token group through the same replicated router.
+
+        @slow (r20): runs unfiltered in the serving CI moe-parity step;
+        tier-1 keeps chunked prefill on a mesh through
+        test_sharded_serving::test_chunked_prefill_through_mesh."""
+        model, params = gpt_moe_and_params
+        kw = dict(num_slots=1, prefill_buckets=[32], prefix_cache=False)
+        long_row = _rows(70)[0]
+        eng = _engine(model, params, "moech", mesh_expert=2, **kw)
+        try:
+            out = eng.generate_row(long_row, 5, timeout=180)
+        finally:
+            eng.close()
+        assert out["tokens"] == _ep1_tokens(
+            model, params, long_row, 5, **kw
+        )
+
+    @pytest.mark.slow
+    def test_speculation_ep2(self, gpt_moe_and_params):
+        """K>0 with a MoE draft on the expert mesh: draft and target
+        both run expert-sharded (the draft's expert stacks validate and
+        shard on the same axis); greedy output stays bitwise, rewound
+        pages return.
+
+        @slow (r20): runs unfiltered in the serving CI moe-parity step;
+        tier-1 keeps K>0-on-a-mesh through test_sharded_serving::
+        test_speculation_through_mesh."""
+        model, params = gpt_moe_and_params
+        kw = dict(
+            num_slots=1, max_queue=4, prefix_cache=False,
+            draft_model=model, draft_params=params, num_draft_tokens=3,
+        )
+        row = _rows(7)[0]
+        eng = _engine(model, params, "moesp", mesh_expert=2, **kw)
+        try:
+            out = eng.generate_row(row, 6, timeout=180)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        assert out["tokens"] == _ep1_tokens(model, params, row, 6, **kw)
+        assert stats["pages_in_use"] == 0
+
+    @pytest.mark.slow
+    def test_int8_ep2_matches_int8_ep1(self, gpt_moe_and_params):
+        """quantize=int8 composed with the expert axis: the int8 expert
+        stacks shard on E exactly like full-width ones (the quantization
+        envelope is per-leaf; the scales ride the same spec) and the
+        sharded int8 engine agrees BITWISE with the unmeshed int8
+        engine — same quantized bits, same local-dequant math.
+
+        @slow (r20): runs unfiltered in the serving CI moe-parity step;
+        tier-1 keeps int8-on-a-mesh through test_sharded_serving::
+        test_int8_on_mesh_matches_int8_unmeshed."""
+        model, params = gpt_moe_and_params
+        row = _rows(9)[0]
+        outs = []
+        for kw in ({}, {"mesh_expert": 2}):
+            eng = _engine(
+                model, params, "moeq", num_slots=1, max_queue=4,
+                quantize="int8", **kw,
+            )
+            try:
+                outs.append(eng.generate_row(row, 6, timeout=180))
+            finally:
+                eng.close()
+        assert outs[0]["tokens"] == outs[1]["tokens"]
+
+    @pytest.mark.slow
+    def test_tensor_times_expert_composes(self, gpt_moe_and_params):
+        """tensor×expert on 4 chips: heads shard 2-way AND experts shard
+        2-way — the attention segment's head sharding and the MLP's
+        expert sharding are independent axes of the same mesh.
+
+        @slow (r20): runs unfiltered in the serving CI moe-parity step;
+        tier-1 keeps each axis alone through
+        test_bitwise_ep2_and_observability (expert) and
+        test_sharded_serving (tensor)."""
+        model, params = gpt_moe_and_params
+        row = _rows(7)[0]
+        eng = _engine(
+            model, params, "moetx", mesh_tensor=2, mesh_expert=2,
+        )
+        try:
+            out = eng.generate_row(row, 6, timeout=180)
+        finally:
+            eng.close()
+        assert out["tokens"] == _ep1_tokens(model, params, row, 6)
+
+
+class TestMoeMeshValidation:
+    def test_dense_model_rejected(self, gpt_and_params):
+        """An expert axis on a dense model is a config error, not a
+        silent no-op axis."""
+        model, params = gpt_and_params  # gpt_tiny: num_experts=0
+        with pytest.raises(ValueError, match="num_experts=0"):
+            DecodeEngine(
+                "bad", model, params, num_slots=1, autostart=False,
+                mesh_expert=2,
+            )
+
+    def test_expert_must_divide_num_experts(self, gpt_moe_and_params):
+        model, params = gpt_moe_and_params  # 4 experts
+        with pytest.raises(ValueError, match="num_experts"):
+            DecodeEngine(
+                "bad", model, params, num_slots=1, autostart=False,
+                mesh_expert=3,
+            )
+
+    def test_topk2_rejected(self):
+        """ep>1 requires top-1 routing: a top-k>1 combine SUMS expert
+        outputs, so the partial-psum identity is reduction-order
+        sensitive and the bitwise contract is unkeepable — rejected
+        loudly at build."""
+        from kubeflow_tpu.models import get_model
+
+        model = get_model("gpt_tiny_moe", dtype=jnp.float32, moe_top_k=2)
+        prompt = jnp.arange(6)[None, :].astype(jnp.int32) % 512
+        params = model.init(
+            jax.random.PRNGKey(0), prompt, deterministic=True
+        )["params"]
+        with pytest.raises(ValueError, match="moe_top_k"):
+            DecodeEngine(
+                "bad", model, params, num_slots=1, autostart=False,
+                mesh_expert=2,
+            )
+
+    def test_mesh_needs_enough_devices(self, gpt_moe_and_params):
+        model, params = gpt_moe_and_params
+        assert len(jax.devices()) < 16
+        with pytest.raises(ValueError, match="devices"):
+            DecodeEngine(
+                "bad", model, params, num_slots=1, autostart=False,
+                mesh_tensor=4, mesh_expert=4,
+            )
+
+    def test_config_rejects_bad_expert(self):
+        import dataclasses
+
+        from kubeflow_tpu.config.core import ConfigError
+        from kubeflow_tpu.config.platform import (
+            ServingConfig,
+            ServingMeshConfig,
+        )
+
+        with pytest.raises(ConfigError, match="serving.mesh"):
+            dataclasses.replace(
+                ServingConfig(), mesh=ServingMeshConfig(expert=0)
+            ).validate()
+        # an expert axis alone is a valid serving mesh
+        dataclasses.replace(
+            ServingConfig(), mesh=ServingMeshConfig(expert=2)
+        ).validate()
+
+
+class TestMoeOperatorSurface:
+    def test_statusz_moe_line_present_and_dense_absent(
+        self, gpt_moe_and_params, gpt_and_params
+    ):
+        """/statusz grows a "moe:" router line on MoE engines (routed /
+        dropped / imbalance / per-expert occupancy) and shows NOTHING on
+        dense engines — the operator's at-a-glance load-balance check.
+        autostart=False: the line renders off the zeroed snapshot, no
+        programs compile."""
+        from kubeflow_tpu.serving.server import ModelServer
+
+        moe_model, moe_params = gpt_moe_and_params
+        dense_model, dense_params = gpt_and_params
+        moe_eng = DecodeEngine(
+            "moesz", moe_model, moe_params, num_slots=1, autostart=False,
+            mesh_expert=2,
+        )
+        dense_eng = DecodeEngine(
+            "densesz", dense_model, dense_params, num_slots=1,
+            autostart=False,
+        )
+        server = ModelServer()
+        server.add_engine(moe_eng)
+        server.add_engine(dense_eng)
+        try:
+            status, resp, _ = server.app.handle_full("GET", "/statusz")
+        finally:
+            server.close()
+        assert status == 200
+        text = resp.body.decode()
+        assert "expert=2" in text
+        # the router line lives in the [engines] section, under the MoE
+        # engine's block only (engines render in insertion order)
+        engines = text.split("[engines]", 1)[1]
+        moe_block, dense_block = engines.split("  densesz:", 1)
+        assert "moe:" in moe_block
+        assert "moe:" not in dense_block
+
+    def test_dense_engine_has_no_moe_stats(self, gpt_and_params):
+        """stats()["moe"] is None on dense engines and no moe series
+        exist for them — the absent-on-dense half of the contract."""
+        model, params = gpt_and_params
+        eng = DecodeEngine(
+            "densest", model, params, num_slots=1, autostart=False,
+        )
+        try:
+            st = eng.stats()
+        finally:
+            eng.close()
+        assert st["moe"] is None
+        assert st["mesh_expert"] == 1
+
+    def test_env_chain_reaches_engine(self, gpt_moe_and_params, monkeypatch):
+        """KFT_SERVING_MESH_EXPERT → engine_knobs_from_env →
+        build_server → a DecodeEngine whose programs run on the expert
+        mesh."""
+        from kubeflow_tpu.serving.main import build_server
+
+        model, params = gpt_moe_and_params
+        monkeypatch.setenv("KFT_SERVING_MESH_EXPERT", "2")
+        monkeypatch.setenv("KFT_SERVING_NUM_SLOTS", "1")
+        server = build_server(
+            "gpt_tiny_moe", params=params, batch_window_ms=0
+        )
+        try:
+            engine = server._engines["gpt_tiny_moe"]
+            assert engine.mesh_expert == 2
+            assert engine.mesh is not None
+        finally:
+            server.close()

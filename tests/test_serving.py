@@ -225,9 +225,11 @@ class TestInferenceServiceController:
             # decode read-path kernel + serving quantization (r13)
             "KFT_SERVING_PAGED_ATTENTION": "gather",
             "KFT_SERVING_QUANTIZE": "none",
-            # serving mesh (r14 sharded serving; 1/1 = unmeshed engine)
+            # serving mesh (r14 sharded serving + r20 expert axis;
+            # 1/1/1 = unmeshed engine)
             "KFT_SERVING_MESH_TENSOR": "1",
             "KFT_SERVING_MESH_FSDP": "1",
+            "KFT_SERVING_MESH_EXPERT": "1",
             "KFT_SERVING_DRAFT_MODEL": "",  # speculation off by default
             "KFT_SERVING_DRAFT_TOKENS": "0",
             "KFT_SERVING_DRAFT_CHECKPOINT_DIR": "",
@@ -277,6 +279,7 @@ class TestInferenceServiceController:
         monkeypatch.setenv("KFT_SERVING_QUANTIZE", "int8")
         monkeypatch.setenv("KFT_SERVING_MESH_TENSOR", "2")
         monkeypatch.setenv("KFT_SERVING_MESH_FSDP", "4")
+        monkeypatch.setenv("KFT_SERVING_MESH_EXPERT", "2")
         monkeypatch.setenv("KFT_SERVING_DRAIN_DEADLINE_S", "12")
         monkeypatch.setenv("KFT_SERVING_KV_HOST_BYTES", "1048576")
         monkeypatch.setenv("KFT_SERVING_KV_PERSIST_DIR", "/kv/store")
@@ -293,6 +296,7 @@ class TestInferenceServiceController:
             "quantize": "int8",
             "mesh_tensor": 2,
             "mesh_fsdp": 4,
+            "mesh_expert": 2,
             "draft_model": "",
             "num_draft_tokens": 0,
             "draft_checkpoint_dir": "",
@@ -310,6 +314,7 @@ class TestInferenceServiceController:
         monkeypatch.setenv("KFT_SERVING_QUANTIZE", "")
         monkeypatch.setenv("KFT_SERVING_MESH_TENSOR", "")
         monkeypatch.setenv("KFT_SERVING_MESH_FSDP", "")
+        monkeypatch.setenv("KFT_SERVING_MESH_EXPERT", "")
         monkeypatch.setenv("KFT_SERVING_DRAIN_DEADLINE_S", "")
         knobs = engine_knobs_from_env()
         assert knobs["num_slots"] == 8  # default
@@ -320,6 +325,7 @@ class TestInferenceServiceController:
         assert knobs["quantize"] == "none"  # default: bitwise engine
         assert knobs["mesh_tensor"] == 1  # default: unmeshed engine
         assert knobs["mesh_fsdp"] == 1
+        assert knobs["mesh_expert"] == 1
         assert knobs["drain_deadline_s"] == 30.0  # default budget
         monkeypatch.setenv("KFT_SERVING_KV_HOST_BYTES", "")
         monkeypatch.setenv("KFT_SERVING_KV_PERSIST_DIR", "")
